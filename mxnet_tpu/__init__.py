@@ -1,0 +1,27 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capabilities.
+
+A ground-up JAX/XLA/PJRT design (not a port) covering the reference stack
+(ref: apache MXNet 1.x via the Jiaolong/mxnet fork — see SURVEY.md):
+NDArray + autograd + Gluon + operator library + KVStore-semantics data
+parallelism, with `mx.tpu()` as the headline context, hybridize() lowering to
+single XLA computations, and mesh sharding (DP/TP/PP/SP/EP) replacing the
+parameter server.
+
+Usage mirrors the reference:
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, autograd, gluon
+"""
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, num_tpus, num_gpus
+from . import engine
+from . import random
+from . import autograd
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+
+__version__ = "0.1.0"
+
+waitall = engine.waitall

@@ -1,0 +1,251 @@
+"""Imperative autograd: record/pause scopes + a VJP tape.
+
+Reference semantics (ref: src/imperative/imperative.cc — Imperative::RecordOp /
+Imperative::Backward; python/mxnet/autograd.py — record, pause, backward,
+mark_variables).  TPU-native mechanism: instead of building an nnvm backward
+graph, every recorded op captures its JAX VJP closure at forward time
+(residuals live in device memory as XLA buffers); ``backward`` replays the tape
+in reverse, accumulating cotangents into attached ``.grad`` arrays.  Gradient
+graphs for hybridized blocks are single tape nodes whose pullback is the VJP of
+the whole compiled computation — the CachedOp::Backward analogue.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "mark_variables",
+    "backward",
+    "grad",
+    "set_recording",
+    "set_training",
+]
+
+_tls = threading.local()
+
+
+def _state():
+    if not hasattr(_tls, "recording"):
+        _tls.recording = False
+        _tls.training = False
+        _tls.tape = []
+    return _tls
+
+
+def is_recording() -> bool:
+    return _state().recording
+
+
+def is_training() -> bool:
+    return _state().training
+
+
+def set_recording(flag: bool) -> bool:
+    s = _state()
+    prev, s.recording = s.recording, bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    s = _state()
+    prev, s.training = s.training, bool(flag)
+    return prev
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+
+    def __enter__(self):
+        s = _state()
+        self._prev = (s.recording, s.training)
+        if self._rec is not None:
+            if self._rec and not s.recording:
+                s.tape = []  # fresh recording session
+            s.recording = self._rec
+        if self._train is not None:
+            s.training = self._train
+        return self
+
+    def __exit__(self, *exc):
+        s = _state()
+        s.recording, s.training = self._prev
+
+
+def record(train_mode: bool = True) -> _Scope:  # noqa: A002 - mxnet API name
+    """Scope in which ops are recorded for backward (ref: autograd.record)."""
+    return _Scope(recording=True, training=train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    return _Scope(recording=False, training=train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(recording=None, training=True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(recording=None, training=False)
+
+
+class TapeNode:
+    """One recorded computation: inputs -> outputs with a ready VJP closure."""
+
+    __slots__ = ("inputs", "outputs", "pullback", "name")
+
+    def __init__(self, inputs, outputs, pullback: Callable, name: str = ""):
+        self.inputs = list(inputs)  # NDArrays (strong refs keep ids stable)
+        self.outputs = list(outputs)
+        self.pullback = pullback  # tuple(cotangents like outputs) -> tuple like inputs
+        self.name = name
+
+
+def append_node(node: TapeNode):
+    _state().tape.append(node)
+
+
+def _zeros_like_arr(nd):
+    return jnp.zeros(nd.shape, nd._data.dtype)
+
+
+def backward(
+    heads,
+    head_grads=None,
+    retain_graph: bool = False,
+    train_mode: bool = True,  # noqa: ARG001 - parity arg; replay uses stored VJPs
+):
+    """Run backward from ``heads`` through the recorded tape.
+
+    Matches ``mx.autograd.backward`` (ref: MXAutogradBackwardEx): cotangents
+    accumulate into ``x.grad`` for every array that called ``attach_grad()``.
+    """
+    from .ndarray import NDArray  # local import to avoid cycle
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads_list = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads_list = [head_grads]
+    else:
+        head_grads_list = list(head_grads)
+
+    s = _state()
+    tape: List[TapeNode] = s.tape
+
+    # Seed cotangents, keyed by id of the NDArray object.
+    grads = {}
+    keep = {}
+
+    def _acc(nd, ct):
+        if ct is None:
+            return
+        if getattr(ct, "dtype", None) is not None and ct.dtype == jax.dtypes.float0:
+            return  # integer/bool inputs carry no cotangent
+        k = id(nd)
+        keep[k] = nd
+        if k in grads:
+            grads[k] = grads[k] + ct
+        else:
+            grads[k] = ct
+
+    for h, hg in zip(heads, head_grads_list):
+        if hg is None:
+            # Reference seeds ones for missing head grads (ref: Imperative::Backward).
+            _acc(h, jnp.ones(h.shape, h._data.dtype))
+        else:
+            _acc(h, hg._data)
+
+    for node in reversed(tape):
+        if not any(id(o) in grads for o in node.outputs):
+            continue
+        cts = tuple(
+            grads.get(id(o), _zeros_like_arr(o)) for o in node.outputs
+        )
+        in_cts = node.pullback(cts)
+        if not isinstance(in_cts, (tuple, list)):
+            in_cts = (in_cts,)
+        for nd, ct in zip(node.inputs, in_cts):
+            _acc(nd, ct)
+
+    # Write into attached grad buffers.
+    for k, nd in keep.items():
+        req = getattr(nd, "_grad_req", "null")
+        if req == "null" or nd._grad is None:
+            continue
+        if req == "add":
+            nd._grad._data = nd._grad._data + grads[k]
+        else:
+            nd._grad._data = grads[k].astype(nd._grad._data.dtype)
+
+    if not retain_graph:
+        s.tape = []
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach externally managed grad buffers (ref: autograd.mark_variables)."""
+    from .ndarray import NDArray
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+        gradients = [gradients]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad = g
+        v._grad_req = req
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode: bool = True):
+    """Return grads of heads w.r.t. variables without touching ``.grad``.
+
+    (ref: python/mxnet/autograd.py — grad).  ``create_graph`` is not yet
+    supported (no higher-order eager autograd); use jax.grad composition via
+    hybridize for that.
+    """
+    from .ndarray import NDArray
+
+    if create_graph:
+        raise NotImplementedError("create_graph=True: compose jax.grad via hybridize instead")
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    # Temporarily detach every grad buffer on the tape so only the requested
+    # variables receive cotangents; restore all afterwards.
+    var_ids = {id(v) for v in variables}
+    touched = {}
+    for node in _state().tape:
+        for nd in list(node.inputs) + list(node.outputs):
+            if id(nd) not in touched:
+                touched[id(nd)] = (nd, nd._grad, getattr(nd, "_grad_req", "null"))
+    for _, (nd, _, _) in touched.items():
+        if id(nd) not in var_ids:
+            nd._grad, nd._grad_req = None, "null"
+    for v in variables:
+        v._grad = _fresh_zero(v)
+        v._grad_req = "write"
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph), train_mode=train_mode)
+        return [v._grad for v in variables]
+    finally:
+        for _, (nd, g, req) in touched.items():
+            nd._grad, nd._grad_req = g, req
+
+
+def _fresh_zero(v):
+    from .ndarray import NDArray
+
+    return NDArray(jnp.zeros(v.shape, v._data.dtype), ctx=v.context)

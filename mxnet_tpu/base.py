@@ -1,0 +1,89 @@
+"""Shared helpers: dtype names, registries, errors.
+
+TPU-native re-design of the reference's dmlc-core helpers
+(ref: 3rdparty/dmlc-core/include/dmlc/{logging,parameter}.h — LOG/CHECK,
+dmlc::Parameter).  Here the dtype table replaces mshadow's type_flag_ and the
+registry replaces dmlc::Registry.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["MXNetError", "dtype_np", "dtype_name", "string_types", "numeric_types"]
+
+
+class MXNetError(RuntimeError):
+    """Framework error type (ref: include/mxnet/c_api.h — MXGetLastError)."""
+
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+# Canonical dtype name table (ref: include/mxnet/tensor_blob.h — type_flag_).
+_DTYPE_ALIASES = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "bfloat16": None,  # resolved lazily via ml_dtypes to avoid import cycles
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int16": np.int16,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+
+
+def dtype_np(dtype):
+    """Normalise a dtype spec (string / np.dtype / python type) to np.dtype."""
+    if dtype is None:
+        return np.dtype(np.float32)
+    if isinstance(dtype, str):
+        if dtype == "bfloat16":
+            import ml_dtypes  # ships with jax
+
+            return np.dtype(ml_dtypes.bfloat16)
+        if dtype in _DTYPE_ALIASES:
+            return np.dtype(_DTYPE_ALIASES[dtype])
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    """Inverse of :func:`dtype_np` — canonical string name."""
+    return np.dtype(dtype).name
+
+
+class Registry:
+    """Minimal name->object registry (ref: dmlc::Registry pattern)."""
+
+    def __init__(self, kind: str):
+        self.kind = kind
+        self._entries = {}
+
+    def register(self, name, obj=None, aliases=()):
+        def _do(o):
+            key = name.lower()
+            if key in self._entries and self._entries[key] is not o:
+                raise MXNetError(f"duplicate {self.kind} registration: {name}")
+            self._entries[key] = o
+            for a in aliases:
+                self._entries[a.lower()] = o
+            return o
+
+        if obj is None:
+            return _do
+        return _do(obj)
+
+    def get(self, name):
+        try:
+            return self._entries[name.lower()]
+        except KeyError:
+            raise MXNetError(
+                f"unknown {self.kind} '{name}'; known: {sorted(self._entries)}"
+            ) from None
+
+    def __contains__(self, name):
+        return name.lower() in self._entries
+
+    def keys(self):
+        return sorted(self._entries)

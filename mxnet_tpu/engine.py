@@ -1,0 +1,77 @@
+"""Async execution semantics.
+
+The reference runs every op through a dependency engine with read/write vars
+(ref: src/engine/threaded_engine.cc — ThreadedEngine::PushAsync,
+include/mxnet/engine.h — Engine).  On TPU, XLA/PJRT already gives us an async
+stream per device: op dispatch returns immediately with futures (jax.Array),
+and data dependencies order execution.  This module therefore only supplies the
+*semantics* the reference exposes to users:
+
+- ``waitall()``  (ref: MXNDArrayWaitAll) — barrier on everything in flight.
+- ``wait_to_read(x)`` (ref: NDArray::WaitToRead) — block on one array.
+- a bulking knob kept for API compat (``set_bulk_size``) — a no-op, because
+  trace+compile (hybridize) subsumes engine bulking.
+
+A bounded ring of recently produced arrays backs ``waitall``; PJRT guarantees
+program order per device so blocking on the newest arrays is a full barrier.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+
+import jax
+
+__all__ = ["waitall", "wait_to_read", "track", "set_bulk_size", "bulk"]
+
+_LOCK = threading.Lock()
+_RECENT = collections.deque(maxlen=256)
+_bulk_size = 0
+
+
+def track(arr):
+    """Record a freshly produced jax.Array for the waitall barrier."""
+    with _LOCK:
+        _RECENT.append(arr)
+    return arr
+
+
+def wait_to_read(arr):
+    jax.block_until_ready(arr)
+
+
+def waitall():
+    """Block until all dispatched work has completed (ref: MXNDArrayWaitAll)."""
+    with _LOCK:
+        pending = list(_RECENT)
+        _RECENT.clear()
+    for a in pending:
+        try:
+            jax.block_until_ready(a)
+        except Exception:  # deleted/donated buffers are already "done"
+            pass
+
+
+def set_bulk_size(size: int) -> int:
+    """API compat (ref: python/mxnet/engine.py — set_bulk_size).
+
+    The reference bulks engine pushes to amortise dispatch; with XLA the
+    equivalent is hybridize/jit which compiles the whole graph, so this is a
+    recorded no-op returning the previous value.
+    """
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+class bulk:
+    """Context manager compat shim for ``mx.engine.bulk(size)``."""
+
+    def __init__(self, size: int):
+        self.size = size
+
+    def __enter__(self):
+        self._prev = set_bulk_size(self.size)
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._prev)

@@ -1,0 +1,495 @@
+"""NDArray: the user-facing async tensor.
+
+Reference: include/mxnet/ndarray.h — class NDArray; src/ndarray/ndarray.cc;
+python/mxnet/ndarray/ndarray.py.  TPU-native design: an NDArray wraps a
+jax.Array (a PJRT buffer future), so the reference's lazy/async semantics —
+ops return immediately, blocking happens at read (ref: NDArray::WaitToRead) —
+fall out of PJRT's async dispatch instead of a hand-built ThreadedEngine.
+Inside a hybridize trace the same NDArray type wraps a JAX tracer, which is
+how one Python forward serves both eager and compiled execution.
+
+Op dispatch (``invoke``) replaces the reference's
+MXImperativeInvokeEx → Imperative::Invoke → Engine::PushAsync chain
+(ref: src/c_api/c_api_ndarray.cc, src/imperative/imperative.cc):
+ - fast path: cached per-(op, static-params) jitted callable;
+ - recording path: jax.vjp captures the pullback for the autograd tape
+   (ref: Imperative::RecordOp);
+ - tracing path: direct call so the op inlines into the enclosing jit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd as _autograd
+from .. import engine as _engine
+from ..base import dtype_np
+from ..context import Context, current_context
+from .. import random as _random
+from ..ops.registry import OPS, OP_META, compiled, get_op, params_key
+
+__all__ = ["NDArray", "invoke", "asarray_jax"]
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def asarray_jax(v, dtype=None):
+    """Coerce NDArray / numpy / scalar to a jax value."""
+    if isinstance(v, NDArray):
+        return v._data
+    if dtype is not None:
+        return jnp.asarray(v, dtype_np(dtype))
+    return v  # let jnp handle scalars with weak typing
+
+
+class NDArray:
+    """Dense tensor on a device (ref: include/mxnet/ndarray.h)."""
+
+    __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "__weakref__")
+    __array_priority__ = 100.0
+
+    def __init__(self, data, ctx: Context | None = None):
+        self._data = data
+        self._ctx = ctx if ctx is not None else current_context()
+        self._grad = None
+        self._grad_req = "null"
+
+    # ------------------------------------------------------------ basics --
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype)
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return "default"  # sparse storage is represented via dedicated types
+
+    @property
+    def T(self):
+        return invoke("transpose", self)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of 0-d NDArray")
+        return self.shape[0]
+
+    def __repr__(self):
+        try:
+            body = str(self.asnumpy())
+        except Exception:
+            body = f"<traced {self.shape} {self.dtype}>"
+        return f"\n{body}\n<NDArray {'x'.join(map(str, self.shape))} @{self._ctx}>"
+
+    # -------------------------------------------------------------- sync --
+    def wait_to_read(self):
+        """ref: NDArray::WaitToRead — block until the buffer is computed."""
+        if not _is_tracer(self._data):
+            jax.block_until_ready(self._data)
+
+    def asnumpy(self) -> np.ndarray:
+        self.wait_to_read()
+        return np.asarray(self._data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError("the array is not scalar-sized")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size == 1:
+            return bool(self.asscalar())
+        raise ValueError("ambiguous truth value of multi-element NDArray")
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # ----------------------------------------------------------- autograd --
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """ref: python/mxnet/ndarray/ndarray.py — attach_grad."""
+        self._grad = NDArray(jnp.zeros(self.shape, self._data.dtype), ctx=self._ctx)
+        self._grad_req = grad_req
+
+    @property
+    def grad(self):
+        return self._grad
+
+    def detach(self):
+        out = NDArray(jax.lax.stop_gradient(self._data) if _is_tracer(self._data) else self._data,
+                      ctx=self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _autograd.backward([self], [out_grad] if out_grad is not None else None,
+                           retain_graph=retain_graph, train_mode=train_mode)
+
+    # ------------------------------------------------------------- moves --
+    def copy(self):
+        return NDArray(jnp.asarray(self._data), ctx=self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other._ctx.device)
+            return other
+        ctx = Context(other)
+        return NDArray(jax.device_put(self._data, ctx.device), ctx=ctx)
+
+    def as_in_context(self, ctx):
+        ctx = Context(ctx)
+        if ctx == self._ctx:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.device), ctx=ctx)
+
+    as_in_ctx = as_in_context
+
+    def astype(self, dtype, copy=True):
+        return invoke("cast", self, dtype=np.dtype(dtype_np(dtype)).name)
+
+    # ----------------------------------------------------------- indexing --
+    def __getitem__(self, key):
+        key2 = _unwrap_index(key)
+        if _autograd.is_recording() and not _is_tracer(self._data):
+            out, pull = jax.vjp(lambda a: a[key2], self._data)
+            res = NDArray(out, ctx=self._ctx)
+            node = _autograd.TapeNode([self], [res], lambda cts, _p=pull: _p(cts[0]),
+                                      name="getitem")
+            _autograd.append_node(node)
+            return res
+        return NDArray(self._data[key2], ctx=self._ctx)
+
+    def __setitem__(self, key, value):
+        if _autograd.is_recording():
+            # ref: MXNet raises the same way — in-place writes would silently
+            # invalidate recorded pullbacks.
+            raise RuntimeError(
+                "in-place item assignment is not supported inside autograd.record(); "
+                "use nd.where / masked ops instead")
+        key2 = _unwrap_index(key)
+        v = value._data if isinstance(value, NDArray) else value
+        if isinstance(key2, slice) and key2 == slice(None):
+            self._data = jnp.broadcast_to(jnp.asarray(v, self._data.dtype), self.shape)
+        else:
+            self._data = self._data.at[key2].set(v)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # ---------------------------------------------------------- operators --
+    def __add__(self, o):
+        return invoke("add", self, o)
+
+    def __radd__(self, o):
+        return invoke("add", o, self)
+
+    def __sub__(self, o):
+        return invoke("subtract", self, o)
+
+    def __rsub__(self, o):
+        return invoke("subtract", o, self)
+
+    def __mul__(self, o):
+        return invoke("multiply", self, o)
+
+    def __rmul__(self, o):
+        return invoke("multiply", o, self)
+
+    def __truediv__(self, o):
+        return invoke("divide", self, o)
+
+    def __rtruediv__(self, o):
+        return invoke("divide", o, self)
+
+    def __mod__(self, o):
+        return invoke("mod", self, o)
+
+    def __pow__(self, o):
+        return invoke("power", self, o)
+
+    def __rpow__(self, o):
+        return invoke("power", o, self)
+
+    def __neg__(self):
+        return invoke("negative", self)
+
+    def __abs__(self):
+        return invoke("abs", self)
+
+    def __matmul__(self, o):
+        return invoke("dot", self, o)
+
+    def __eq__(self, o):
+        return invoke("equal", self, o)
+
+    def __ne__(self, o):
+        return invoke("not_equal", self, o)
+
+    def __gt__(self, o):
+        return invoke("greater", self, o)
+
+    def __ge__(self, o):
+        return invoke("greater_equal", self, o)
+
+    def __lt__(self, o):
+        return invoke("lesser", self, o)
+
+    def __le__(self, o):
+        return invoke("lesser_equal", self, o)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iadd__(self, o):
+        self._data = (self + o)._data
+        return self
+
+    def __isub__(self, o):
+        self._data = (self - o)._data
+        return self
+
+    def __imul__(self, o):
+        self._data = (self * o)._data
+        return self
+
+    def __itruediv__(self, o):
+        self._data = (self / o)._data
+        return self
+
+    # ------------------------------------------------------ method sugar --
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return invoke("reshape", self, shape=shape, **kwargs)
+
+    def reshape_like(self, other):
+        return invoke("reshape_like", self, other)
+
+    def sum(self, axis=None, keepdims=False):
+        return invoke("sum", self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke("mean", self, axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return invoke("max", self, axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return invoke("min", self, axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke("prod", self, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None):
+        return invoke("argmax", self, axis=axis)
+
+    def argmin(self, axis=None):
+        return invoke("argmin", self, axis=axis)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", self, ord=ord, axis=axis, keepdims=keepdims)
+
+    def transpose(self, axes=None):
+        return invoke("transpose", self, axes=axes)
+
+    def swapaxes(self, dim1, dim2):
+        return invoke("swapaxes", self, dim1=dim1, dim2=dim2)
+
+    def expand_dims(self, axis):
+        return invoke("expand_dims", self, axis=axis)
+
+    def squeeze(self, axis=None):
+        return invoke("squeeze", self, axis=axis)
+
+    def flatten(self):
+        return invoke("flatten", self)
+
+    def flip(self, axis):
+        return invoke("flip", self, axis=axis)
+
+    def tile(self, reps):
+        return invoke("tile", self, reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return invoke("repeat", self, repeats=repeats, axis=axis)
+
+    def clip(self, a_min=None, a_max=None):
+        return invoke("clip", self, a_min=a_min, a_max=a_max)
+
+    def abs(self):
+        return invoke("abs", self)
+
+    def sqrt(self):
+        return invoke("sqrt", self)
+
+    def square(self):
+        return invoke("square", self)
+
+    def exp(self):
+        return invoke("exp", self)
+
+    def log(self):
+        return invoke("log", self)
+
+    def relu(self):
+        return invoke("relu", self)
+
+    def sigmoid(self):
+        return invoke("sigmoid", self)
+
+    def tanh(self):
+        return invoke("tanh", self)
+
+    def softmax(self, axis=-1):
+        return invoke("softmax", self, axis=axis)
+
+    def log_softmax(self, axis=-1):
+        return invoke("log_softmax", self, axis=axis)
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", self, axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke("take", self, indices, axis=axis, mode=mode)
+
+    def one_hot(self, depth, **kwargs):
+        return invoke("one_hot", self, depth=depth, **kwargs)
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", self, shape=shape)
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_like", self, other)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("split", self, num_outputs=num_outputs, axis=axis,
+                      squeeze_axis=squeeze_axis)
+
+    def topk(self, axis=-1, k=1, ret_typ="indices", is_ascend=False):
+        return invoke("topk", self, axis=axis, k=k, ret_typ=ret_typ, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke("sort", self, axis=axis, is_ascend=is_ascend)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke("argsort", self, axis=axis, is_ascend=is_ascend)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke("dot", self, other, transpose_a=transpose_a, transpose_b=transpose_b)
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise NotImplementedError("row_sparse/csr conversion: use mxnet_tpu.sparse")
+        return self
+
+    def zeros_like(self):
+        return invoke("zeros_like", self)
+
+    def ones_like(self):
+        return invoke("ones_like", self)
+
+
+def _unwrap_index(key):
+    if isinstance(key, NDArray):
+        d = key._data
+        return d.astype(jnp.int32) if jnp.issubdtype(d.dtype, jnp.floating) else d
+    if isinstance(key, tuple):
+        return tuple(_unwrap_index(k) for k in key)
+    return key
+
+
+def _out_ctx(args):
+    for a in args:
+        if isinstance(a, NDArray):
+            return a._ctx
+    return current_context()
+
+
+def invoke(op_name: str, *args, out=None, **kwargs):
+    """Dispatch one op (see module docstring for the three paths)."""
+    kwargs = {k: v for k, v in kwargs.items() if v is not None or k in ("a_min", "a_max")}
+    meta = OP_META.get(op_name, {})
+    # Mode-dependent ops: the flag must be an explicit static param so the jit
+    # cache keys on it (never constant-folded Python state).
+    if meta.get("has_training") and "training" not in kwargs:
+        kwargs["training"] = _autograd.is_training()
+    ctx = _out_ctx(args)
+    raw = [a._data if isinstance(a, NDArray) else a for a in args]
+    tracing = any(_is_tracer(r) for r in raw)
+
+    if tracing:
+        fn = get_op(op_name)
+        result = fn(*raw, **kwargs)
+    elif _autograd.is_recording():
+        fn = get_op(op_name)
+
+        def _f(*arrs):
+            return fn(*arrs, **kwargs)
+
+        result, pullback = jax.vjp(_f, *raw)
+        nd_positions = [i for i, a in enumerate(args) if isinstance(a, NDArray)]
+        nd_inputs = [args[i] for i in nd_positions]
+
+        def _pull(cts, _pb=pullback, _pos=tuple(nd_positions)):
+            all_cts = _pb(cts[0] if not isinstance(result, tuple) else cts)
+            return [all_cts[i] for i in _pos]
+
+        outs_t = result if isinstance(result, tuple) else (result,)
+        out_nds = tuple(NDArray(o, ctx=ctx) for o in outs_t)
+        if out is not None:
+            # out= must be the array the tape knows, or backward from it
+            # silently finds no node.
+            out._data = out_nds[0]._data
+            out_nds = (out,) + out_nds[1:]
+        node = _autograd.TapeNode(nd_inputs, list(out_nds), _pull, name=op_name)
+        _autograd.append_node(node)
+        return out_nds if isinstance(result, tuple) else out_nds[0]
+    else:
+        jfn = compiled(op_name, params_key(kwargs))
+        if meta.get("needs_rng"):
+            result = jfn(_random.next_key(), *raw)
+        else:
+            result = jfn(*raw)
+
+    if isinstance(result, tuple):
+        result_nd = tuple(NDArray(_engine.track(r), ctx=ctx) for r in result)
+    else:
+        result_nd = NDArray(_engine.track(result) if not tracing else result, ctx=ctx)
+    return _copy_to_out(result_nd, out)
+
+
+def _copy_to_out(result_nd, out):
+    if out is None:
+        return result_nd
+    src = result_nd[0] if isinstance(result_nd, tuple) else result_nd
+    out._data = src._data
+    return out

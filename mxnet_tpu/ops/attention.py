@@ -1,0 +1,80 @@
+"""Attention ops — the BERT hot path.
+
+ref: src/operator/contrib/transformer.{cc,cu} —
+``_contrib_interleaved_matmul_selfatt_qk`` / ``_contrib_interleaved_matmul_selfatt_valatt``
+(cuBLAS strided-batched matmuls over head-interleaved QKV projections).
+TPU-native: the same interleaved layout (seq, batch, heads*3*head_dim) feeds
+lax.dot_general batched matmuls the MXU eats directly; a fused
+``multi_head_attention`` op additionally keeps softmax(QK^T)V in one XLA
+fusion (flash-style Pallas kernel lives in ops/pallas/flash_attention.py and
+is used for long sequences).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _split_interleaved(qkv, heads):
+    """(S, B, H*3*D) -> three (B*H, S, D) tensors, reference layout."""
+    s, b, hd3 = qkv.shape
+    d = hd3 // (heads * 3)
+    x = qkv.reshape(s, b, heads, 3, d)
+    # -> (B, H, S, D) per projection, flattened to (B*H, S, D)
+    def pick(i):
+        t = x[:, :, :, i, :]  # (S, B, H, D)
+        return jnp.transpose(t, (1, 2, 0, 3)).reshape(b * heads, s, d)
+    return pick(0), pick(1), pick(2)
+
+
+@register_op("interleaved_matmul_selfatt_qk",
+             aliases=("_contrib_interleaved_matmul_selfatt_qk",))
+def _selfatt_qk(queries_keys_values, heads=1):
+    """scores = (1/sqrt(d)) Q K^T, output (B*H, S, S) like the reference."""
+    q, k, _ = _split_interleaved(queries_keys_values, heads)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], q.dtype))
+    return jnp.matmul(q * scale, jnp.swapaxes(k, -1, -2))
+
+
+@register_op("interleaved_matmul_selfatt_valatt",
+             aliases=("_contrib_interleaved_matmul_selfatt_valatt",))
+def _selfatt_valatt(queries_keys_values, attention, heads=1):
+    """out = attn @ V, back to (S, B, H*D)."""
+    _, _, v = _split_interleaved(queries_keys_values, heads)
+    s, b = queries_keys_values.shape[0], queries_keys_values.shape[1]
+    d = v.shape[-1]
+    out = jnp.matmul(attention, v)  # (B*H, S, D)
+    out = out.reshape(b, heads, s, d)
+    return jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads * d)
+
+
+@register_op("multi_head_attention")
+def _multi_head_attention(q, k, v, mask=None, heads=1, dropout=0.0, causal=False):
+    """Fused MHA on (B, S, H*D)-shaped projections; XLA fuses scale+softmax.
+
+    No reference analogue as a single op (GluonNLP composes the two contrib
+    ops); provided because one fused op is the idiomatic TPU formulation.
+    """
+    b, sq, hd = q.shape
+    d = hd // heads
+    def to_bhsd(x):
+        return jnp.transpose(x.reshape(b, -1, heads, d), (0, 2, 1, 3))
+    qh, kh, vh = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, q.dtype))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qh * scale, kh)
+    if causal:
+        sk = kh.shape[2]
+        cm = jnp.tril(jnp.ones((sq, sk), bool))
+        scores = jnp.where(cm, scores, jnp.asarray(-1e30, scores.dtype))
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, jnp.asarray(-1e30, scores.dtype))
+    attn = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", attn, vh)
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, hd)
+
+
+@register_op("div_sqrt_dim", aliases=("_contrib_div_sqrt_dim",))
+def _div_sqrt_dim(x):
+    return x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype))

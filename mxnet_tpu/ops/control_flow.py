@@ -1,0 +1,45 @@
+"""Control-flow ops (ref: src/operator/control_flow.{h,cc} — foreach,
+while_loop, cond as subgraph-executing ops).  TPU-native: these ARE the lax
+primitives; the wrappers adapt the reference's calling convention (NDArray
+lists in/out) for gluon.contrib use."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def foreach(body, data, init_states):
+    """ref: foreach op — scan `body(x_t, states) -> (out_t, new_states)` over
+    axis 0 of `data`.  Works on jax arrays; gluon.contrib wraps NDArrays."""
+    def step(states, x):
+        out, new_states = body(x, states)
+        return new_states, out
+
+    final_states, outs = jax.lax.scan(step, init_states, data)
+    return outs, final_states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """ref: while_loop op. Fixed upper bound keeps shapes static on TPU."""
+    if max_iterations is None:
+        final = jax.lax.while_loop(lambda v: cond(*v), lambda v: tuple(func(*v)), tuple(loop_vars))
+        return final
+    def body(i_and_vars):
+        i, v = i_and_vars
+        v = jax.lax.cond(cond(*v), lambda vv: tuple(func(*vv)), lambda vv: vv, v)
+        return i + 1, v
+    def keep_going(i_and_vars):
+        i, v = i_and_vars
+        return (i < max_iterations) & cond(*v)
+    _, final = jax.lax.while_loop(keep_going, body, (jnp.int32(0), tuple(loop_vars)))
+    return final
+
+
+def cond(pred, then_func, else_func, inputs=()):
+    """ref: cond op."""
+    return jax.lax.cond(pred, lambda xs: then_func(*xs), lambda xs: else_func(*xs), tuple(inputs))
+
+
+register_op("_foreach_marker", lambda x: x)  # registry placeholder; python-level API above

@@ -1,0 +1,177 @@
+"""Elementwise and broadcast ops.
+
+Re-emission of the reference's elementwise families (ref:
+src/operator/tensor/elemwise_binary_broadcast_op*.{h,cc,cu},
+elemwise_unary_op*, mshadow_op.h) as jnp expressions.  Broadcasting is native
+in XLA so the ``broadcast_*`` names are aliases of the plain binary ops —
+the reference needed separate kernels; we do not.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, alias_op
+
+# ---------------------------------------------------------------- binary ----
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+    "arctan2": jnp.arctan2,
+    "equal": lambda a, b: jnp.equal(a, b).astype(_res_dtype(a)),
+    "not_equal": lambda a, b: jnp.not_equal(a, b).astype(_res_dtype(a)),
+    "greater": lambda a, b: jnp.greater(a, b).astype(_res_dtype(a)),
+    "greater_equal": lambda a, b: jnp.greater_equal(a, b).astype(_res_dtype(a)),
+    "lesser": lambda a, b: jnp.less(a, b).astype(_res_dtype(a)),
+    "lesser_equal": lambda a, b: jnp.less_equal(a, b).astype(_res_dtype(a)),
+    "logical_and": lambda a, b: jnp.logical_and(a, b).astype(_res_dtype(a)),
+    "logical_or": lambda a, b: jnp.logical_or(a, b).astype(_res_dtype(a)),
+    "logical_xor": lambda a, b: jnp.logical_xor(a, b).astype(_res_dtype(a)),
+}
+
+
+def _res_dtype(a):
+    # Reference comparison ops return the input float dtype, not bool
+    # (ref: src/operator/tensor/elemwise_binary_broadcast_op_logic.cc).
+    d = jnp.result_type(a)
+    return d if jnp.issubdtype(d, jnp.floating) else jnp.float32
+
+
+for _name, _fn in _BINARY.items():
+    register_op(_name, _fn)
+
+# broadcast_* compat aliases (ref: broadcast_add etc.)
+for _name in ("add", "subtract", "multiply", "divide", "mod", "power",
+              "maximum", "minimum", "hypot", "equal", "not_equal", "greater",
+              "greater_equal", "lesser", "lesser_equal", "logical_and",
+              "logical_or", "logical_xor"):
+    alias_op(f"broadcast_{_name}", _name)
+alias_op("broadcast_sub", "subtract")
+alias_op("broadcast_mul", "multiply")
+alias_op("broadcast_div", "divide")
+alias_op("broadcast_plus", "add")
+alias_op("broadcast_minus", "subtract")
+alias_op("elemwise_add", "add")
+alias_op("elemwise_sub", "subtract")
+alias_op("elemwise_mul", "multiply")
+alias_op("elemwise_div", "divide")
+
+# ----------------------------------------------------------------- unary ----
+_UNARY = {
+    "abs": jnp.abs,
+    "sign": jnp.sign,
+    "square": jnp.square,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log1p": jnp.log1p,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "arcsin": jnp.arcsin,
+    "arccos": jnp.arccos,
+    "arctan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh,
+    "arccosh": jnp.arccosh,
+    "arctanh": jnp.arctanh,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "rint": jnp.rint,
+    "trunc": jnp.trunc,
+    "fix": jnp.trunc,
+    "negative": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "relu": jax.nn.relu,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "logical_not": lambda x: jnp.logical_not(x).astype(_res_dtype(x)),
+    "isnan": lambda x: jnp.isnan(x).astype(_res_dtype(x)),
+    "isinf": lambda x: jnp.isinf(x).astype(_res_dtype(x)),
+    "isfinite": lambda x: jnp.isfinite(x).astype(_res_dtype(x)),
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+}
+
+for _name, _fn in _UNARY.items():
+    register_op(_name)(lambda x, _f=_fn: _f(x))
+
+@register_op("copy", aliases=("identity", "_copy"))
+def _copy(x):
+    return jnp.asarray(x)
+
+
+@register_op("stop_gradient", aliases=("BlockGrad", "block_grad"))
+def _stop_gradient(x):
+    return jax.lax.stop_gradient(x)
+
+
+@register_op("clip")
+def _clip(x, a_min=None, a_max=None):
+    return jnp.clip(x, a_min, a_max)
+
+
+@register_op("where")
+def _where(cond, a, b):
+    return jnp.where(cond.astype(bool) if cond.dtype != jnp.bool_ else cond, a, b)
+
+
+@register_op("cast", aliases=("Cast", "astype"))
+def _cast(x, dtype="float32"):
+    from ..base import dtype_np
+
+    return x.astype(dtype_np(dtype))
+
+
+@register_op("amp_cast")
+def _amp_cast(x, dtype="float16"):
+    from ..base import dtype_np
+
+    # bf16 is the TPU half type; fp16 requests map to bf16 by design
+    # (ref: src/operator/tensor/amp_cast.h — amp_cast).
+    if str(dtype) == "float16":
+        dtype = "bfloat16"
+    return x.astype(dtype_np(dtype))
+
+
+@register_op("smooth_l1")
+def _smooth_l1(x, scalar=1.0):
+    # ref: src/operator/tensor/elemwise_unary_op.h — smooth_l1 with sigma
+    sigma2 = scalar * scalar
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / sigma2, 0.5 * sigma2 * x * x, absx - 0.5 / sigma2)
+
+
+@register_op("lerp")
+def _lerp(a, b, t):
+    return a + (b - a) * t
+
+
+@register_op("zeros_like")
+def _zeros_like(x):
+    return jnp.zeros_like(x)
+
+
+@register_op("ones_like")
+def _ones_like(x):
+    return jnp.ones_like(x)

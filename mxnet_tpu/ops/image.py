@@ -1,0 +1,93 @@
+"""Image ops (ref: src/operator/image/image_random*.{h,cc}, resize-inl.h,
+crop-inl.h).  Layout HWC / NHWC like the reference's mx.image namespace;
+augmentations draw from the functional key stream so they trace cleanly."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .. import random as _random
+
+
+@register_op("image_resize", aliases=("resize",))
+def _resize(data, size=None, keep_ratio=False, interp=1):
+    """size int or (w, h); bilinear (interp=1) or nearest (interp=0)."""
+    hwc = data.ndim == 3
+    x = data[None] if hwc else data
+    n, h, w, c = x.shape
+    if isinstance(size, int):
+        if keep_ratio:
+            if h < w:
+                new_h, new_w = size, int(w * size / h)
+            else:
+                new_h, new_w = int(h * size / w), size
+        else:
+            new_h = new_w = size
+    else:
+        new_w, new_h = size
+    method = "nearest" if interp == 0 else "bilinear"
+    out = jax.image.resize(x, (n, new_h, new_w, c), method=method)
+    if data.dtype == jnp.uint8:
+        out = jnp.clip(jnp.round(out), 0, 255).astype(jnp.uint8)
+    return out[0] if hwc else out
+
+
+@register_op("image_normalize", aliases=("normalize",))
+def _normalize(data, mean=0.0, std=1.0):
+    """CHW / NCHW float normalise (ref: image_random-inl.h — NormalizeImpl)."""
+    mean = jnp.asarray(mean, data.dtype)
+    std = jnp.asarray(std, data.dtype)
+    if mean.ndim == 1:
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (data - mean) / std
+
+
+@register_op("image_to_tensor", aliases=("to_tensor",))
+def _to_tensor(data):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (ref: ToTensorImpl)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return jnp.transpose(x, (2, 0, 1))
+    return jnp.transpose(x, (0, 3, 1, 2))
+
+
+@register_op("image_crop", aliases=("crop",))
+def _crop(data, x=0, y=0, width=1, height=1):
+    if data.ndim == 3:
+        return data[y:y + height, x:x + width, :]
+    return data[:, y:y + height, x:x + width, :]
+
+
+@register_op("image_flip_left_right", aliases=("flip_left_right",))
+def _flip_lr(data):
+    return jnp.flip(data, axis=-2)
+
+
+@register_op("image_flip_top_bottom", aliases=("flip_top_bottom",))
+def _flip_tb(data):
+    return jnp.flip(data, axis=-3)
+
+
+@register_op("image_random_flip_left_right", aliases=("random_flip_left_right",), needs_rng=True)
+def _random_flip_lr(data):
+    key = _random.next_key()
+    return jnp.where(jax.random.bernoulli(key), jnp.flip(data, axis=-2), data)
+
+
+@register_op("image_random_brightness", aliases=("random_brightness",), needs_rng=True)
+def _random_brightness(data, min_factor=0.5, max_factor=1.5):
+    key = _random.next_key()
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor)
+    return data * f.astype(data.dtype)
+
+
+@register_op("image_random_contrast", aliases=("random_contrast",), needs_rng=True)
+def _random_contrast(data, min_factor=0.5, max_factor=1.5):
+    key = _random.next_key()
+    f = jax.random.uniform(key, (), minval=min_factor, maxval=max_factor).astype(jnp.float32)
+    x = data.astype(jnp.float32)
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    out = (x - mean) * f + mean
+    return out.astype(data.dtype) if data.dtype == jnp.uint8 else out

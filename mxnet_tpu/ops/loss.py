@@ -1,0 +1,78 @@
+"""Loss ops (ref: src/operator/nn/ctc_loss*, loss_binary_op*,
+softmax_cross_entropy).  Gluon losses build on these."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+@register_op("softmax_cross_entropy")
+def _softmax_cross_entropy(data, label):
+    """ref: src/operator/loss_binary_op-inl.h — per-batch summed CE."""
+    logp = jax.nn.log_softmax(data, axis=-1)
+    picked = jnp.take_along_axis(logp, label.astype(jnp.int32)[..., None], axis=-1)
+    return -jnp.sum(picked)
+
+
+@register_op("CTCLoss", aliases=("ctc_loss",))
+def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
+              use_data_lengths=False, use_label_lengths=False, blank_label="first"):
+    """CTC forward (log-space alpha recursion) — replaces the reference's
+    warp-ctc kernel (ref: src/operator/nn/ctc_loss-inl.h) with a lax.scan that
+    XLA pipelines; fixed shapes, masked tails.
+
+    data: (T, N, C) unnormalised; label: (N, L) int; returns (N,) loss.
+    """
+    t_max, n, c = data.shape
+    l_max = label.shape[1]
+    logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=-1)
+    blank = 0 if blank_label == "first" else c - 1
+    labels = label.astype(jnp.int32)
+    if blank_label != "first":
+        pass  # labels already use 0..c-2, blank at end
+    if data_lengths is None or not use_data_lengths:
+        data_lengths = jnp.full((n,), t_max, jnp.int32)
+    else:
+        data_lengths = data_lengths.astype(jnp.int32)
+    if label_lengths is None or not use_label_lengths:
+        label_lengths = jnp.sum((labels != (0 if blank_label == "first" else -1)).astype(jnp.int32)
+                                 if blank_label == "first" else jnp.ones_like(labels), axis=1)
+        if blank_label == "first":
+            label_lengths = jnp.sum((labels > 0).astype(jnp.int32), axis=1)
+    else:
+        label_lengths = label_lengths.astype(jnp.int32)
+
+    # extended label sequence with blanks: length S = 2L+1
+    s_max = 2 * l_max + 1
+    ext = jnp.full((n, s_max), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = jnp.float32(-1e30)
+
+    def step(alpha, logp_t):
+        # alpha: (N, S)
+        em = jnp.take_along_axis(logp_t, ext, axis=-1)  # (N, S)
+        a_shift1 = jnp.concatenate([jnp.full((n, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a_shift2 = jnp.concatenate([jnp.full((n, 2), neg_inf), alpha[:, :-2]], axis=1)
+        ext_shift2 = jnp.concatenate([jnp.full((n, 2), -1, jnp.int32), ext[:, :-2]], axis=1)
+        allow_skip = (ext != blank) & (ext != ext_shift2)
+        cand = jnp.logaddexp(alpha, a_shift1)
+        cand = jnp.where(allow_skip, jnp.logaddexp(cand, a_shift2), cand)
+        return cand + em, cand + em
+
+    alpha0 = jnp.full((n, s_max), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2], axis=-1)[:, 0])
+    alphas_last, alphas = jax.lax.scan(step, alpha0, logp[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T, N, S)
+    # pick alpha at t = len-1, s in {2L, 2L-1}
+    t_idx = jnp.clip(data_lengths - 1, 0, t_max - 1)
+    a_t = jnp.take_along_axis(alphas, t_idx.reshape(1, n, 1), axis=0)[0]  # (N, S)
+    s1 = jnp.clip(2 * label_lengths, 0, s_max - 1)
+    s2 = jnp.clip(2 * label_lengths - 1, 0, s_max - 1)
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(a_t, s1[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(a_t, s2[:, None], axis=1)[:, 0],
+    )
+    return -ll

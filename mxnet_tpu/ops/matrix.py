@@ -1,0 +1,371 @@
+"""Matrix, shape-manipulation and indexing ops.
+
+Re-emission of (ref: src/operator/tensor/dot*.{h,cc,cu}, matrix_op*.{h,cc,cu},
+indexing_op.{h,cc,cu}, la_op*.{h,cc}).  All matmuls go through jnp.dot /
+lax.dot_general so XLA tiles them onto the MXU; gathers/scatters use XLA
+gather/scatter which the reference hand-wrote as CUDA kernels.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+# ------------------------------------------------------------------- dot ----
+@register_op("dot")
+def _dot(a, b, transpose_a=False, transpose_b=False):
+    """Reference dot semantics: contract last axis of a with first of b
+    (ref: src/operator/tensor/dot-inl.h — DotForward_)."""
+    if transpose_a:
+        a = jnp.moveaxis(a, 0, -1) if a.ndim > 1 else a
+    if transpose_b:
+        b = jnp.moveaxis(b, -1, 0) if b.ndim > 1 else b
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b)
+    return jnp.tensordot(a, b, axes=1)
+
+
+@register_op("batch_dot")
+def _batch_dot(a, b, transpose_a=False, transpose_b=False):
+    """ref: src/operator/tensor/dot-inl.h — BatchDotForward_ (cuBLAS strided)."""
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+@register_op("linalg_gemm2")
+def _linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b)
+
+
+@register_op("linalg_gemm")
+def _linalg_gemm(a, b, c, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0, axis=-2):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return alpha * jnp.matmul(a, b) + beta * c
+
+
+@register_op("linalg_potrf")
+def _potrf(a):
+    return jnp.linalg.cholesky(a)
+
+
+@register_op("linalg_trsm")
+def _trsm(a, b, transpose=False, rightside=False, lower=True, alpha=1.0):
+    import jax.scipy.linalg as jsl
+
+    if rightside:
+        # solve X A = alpha B  <=>  A^T X^T = alpha B^T
+        out = jsl.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(alpha * b, -1, -2),
+            lower=not lower, trans=1 if transpose else 0)
+        return jnp.swapaxes(out, -1, -2)
+    return jsl.solve_triangular(a, alpha * b, lower=lower, trans=1 if transpose else 0)
+
+
+@register_op("linalg_syrk")
+def _syrk(a, transpose=False, alpha=1.0):
+    at = jnp.swapaxes(a, -1, -2)
+    return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+
+
+@register_op("linalg_extractdiag")
+def _extractdiag(a, offset=0):
+    return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+
+
+@register_op("linalg_sumlogdiag")
+def _sumlogdiag(a):
+    return jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)), axis=-1)
+
+
+# ----------------------------------------------------------------- shape ----
+@register_op("reshape", aliases=("Reshape",))
+def _reshape(x, shape=None, reverse=False):
+    """Supports the reference's special codes 0,-1,-2,-3,-4
+    (ref: src/operator/tensor/matrix_op-inl.h — InferReshapeShape)."""
+    if shape is None:
+        return x
+    shape = list(shape)
+    src = list(x.shape)
+    if reverse:
+        src = src[::-1]
+        shape = shape[::-1]
+    out = []
+    i = 0  # index into src
+    j = 0
+    while j < len(shape):
+        s = shape[j]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[j + 1], shape[j + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; j += 2
+        else:
+            out.append(s); i += 1
+        j += 1
+    if reverse:
+        out = out[::-1]
+    if -1 in out:
+        known = 1
+        for s in out:
+            if s != -1:
+                known *= s
+        out[out.index(-1)] = int(x.size // known) if known else 0
+    return x.reshape(out)
+
+
+@register_op("reshape_like")
+def _reshape_like(x, y):
+    return x.reshape(y.shape)
+
+
+@register_op("shape_array")
+def _shape_array(x):
+    return jnp.asarray(x.shape, dtype=jnp.int64)
+
+
+@register_op("size_array")
+def _size_array(x):
+    return jnp.asarray([x.size], dtype=jnp.int64)
+
+
+@register_op("transpose")
+def _transpose(x, axes=None):
+    if axes is None or len(axes) == 0:
+        axes = tuple(reversed(range(x.ndim)))
+    return jnp.transpose(x, axes)
+
+
+@register_op("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(x, dim1=0, dim2=0):
+    return jnp.swapaxes(x, dim1, dim2)
+
+
+@register_op("expand_dims")
+def _expand_dims(x, axis=0):
+    return jnp.expand_dims(x, axis)
+
+
+@register_op("squeeze")
+def _squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+@register_op("flatten", aliases=("Flatten",))
+def _flatten(x):
+    return x.reshape(x.shape[0], -1)
+
+
+@register_op("broadcast_to")
+def _broadcast_to(x, shape=None):
+    tgt = [x.shape[i] if s == 0 else s for i, s in enumerate(shape)]
+    return jnp.broadcast_to(x, tgt)
+
+
+@register_op("broadcast_like")
+def _broadcast_like(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(x, axis=(), size=()):
+    if isinstance(axis, int):
+        axis = (axis,)
+    if isinstance(size, int):
+        size = (size,)
+    tgt = list(x.shape)
+    for a, s in zip(axis, size):
+        tgt[a] = s
+    return jnp.broadcast_to(x, tgt)
+
+
+@register_op("tile")
+def _tile(x, reps=()):
+    return jnp.tile(x, reps)
+
+
+@register_op("repeat")
+def _repeat(x, repeats=1, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op("flip", aliases=("reverse",))
+def _flip(x, axis=()):
+    return jnp.flip(x, axis=axis)
+
+
+@register_op("pad", aliases=("Pad",))
+def _pad(x, mode="constant", pad_width=(), constant_value=0.0):
+    """ref: src/operator/pad-inl.h; pad_width is the flattened (before,after)
+    per-axis list like the reference's."""
+    pw = list(pad_width)
+    pairs = [(pw[i], pw[i + 1]) for i in range(0, len(pw), 2)]
+    while len(pairs) < x.ndim:
+        pairs.append((0, 0))
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, pairs, mode=jmode, constant_values=constant_value)
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+@register_op("concat", aliases=("Concat", "concatenate"))
+def _concat(*xs, dim=1, num_args=None):
+    return jnp.concatenate(xs, axis=dim)
+
+
+@register_op("stack")
+def _stack(*xs, axis=0, num_args=None):
+    return jnp.stack(xs, axis=axis)
+
+
+@register_op("split", aliases=("SliceChannel",))
+def _split(x, num_outputs=1, axis=1, squeeze_axis=False):
+    outs = jnp.split(x, num_outputs, axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register_op("split_v2")
+def _split_v2(x, indices=(), axis=0, squeeze_axis=False, sections=0):
+    if sections:
+        outs = jnp.split(x, sections, axis=axis)
+    else:
+        outs = jnp.split(x, list(indices), axis=axis)
+    if squeeze_axis:
+        outs = [jnp.squeeze(o, axis=axis) for o in outs]
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+@register_op("slice")
+def _slice(x, begin=(), end=(), step=()):
+    slices = []
+    step = list(step) if step else [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        slices.append(slice(b, e, s))
+    return x[tuple(slices)]
+
+
+@register_op("slice_axis")
+def _slice_axis(x, axis=0, begin=0, end=None):
+    sl = [slice(None)] * x.ndim
+    sl[axis] = slice(begin, end)
+    return x[tuple(sl)]
+
+
+@register_op("slice_like")
+def _slice_like(x, y, axes=()):
+    sl = [slice(None)] * x.ndim
+    if not axes:
+        axes = range(min(x.ndim, y.ndim))
+    for a in axes:
+        sl[a] = slice(0, y.shape[a])
+    return x[tuple(sl)]
+
+
+# -------------------------------------------------------------- indexing ----
+@register_op("take")
+def _take(a, indices, axis=0, mode="clip"):
+    """ref: src/operator/tensor/indexing_op.h — TakeOpForward."""
+    idx = indices.astype(jnp.int32)
+    if mode == "wrap":
+        idx = jnp.mod(idx, a.shape[axis])
+    else:
+        idx = jnp.clip(idx, 0, a.shape[axis] - 1)
+    return jnp.take(a, idx, axis=axis)
+
+
+@register_op("Embedding", aliases=("embedding",))
+def _embedding(data, weight, input_dim=None, output_dim=None, dtype="float32", sparse_grad=False):
+    """ref: src/operator/tensor/indexing_op.h — EmbeddingOpForward; XLA gather."""
+    idx = jnp.clip(data.astype(jnp.int32), 0, weight.shape[0] - 1)
+    return jnp.take(weight, idx, axis=0)
+
+
+@register_op("pick")
+def _pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, data.shape[axis] - 1)
+    out = jnp.take_along_axis(data, jnp.expand_dims(idx, axis), axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register_op("gather_nd")
+def _gather_nd(data, indices):
+    """ref: src/operator/tensor/indexing_op.h — GatherNDForward.
+    indices shape (M, ...) indexes the first M dims of data."""
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    return data[tuple(idx[i] for i in range(m))]
+
+
+@register_op("scatter_nd")
+def _scatter_nd(data, indices, shape=None):
+    idx = indices.astype(jnp.int32)
+    m = idx.shape[0]
+    out = jnp.zeros(shape, dtype=data.dtype)
+    return out.at[tuple(idx[i] for i in range(m))].set(data)
+
+
+@register_op("one_hot")
+def _one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import dtype_np
+
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth, dtype=dtype_np(dtype))
+    return oh * on_value + (1.0 - oh) * off_value
+
+
+@register_op("diag")
+def _diag(x, k=0, axis1=0, axis2=1):
+    if x.ndim == 1:
+        return jnp.diag(x, k=k)
+    return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+
+
+@register_op("depth_to_space")
+def _depth_to_space(x, block_size=1):
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+    y = jnp.transpose(y, (0, 3, 4, 1, 5, 2))
+    return y.reshape(b, c // (bs * bs), h * bs, w * bs)
+
+
+@register_op("space_to_depth")
+def _space_to_depth(x, block_size=1):
+    b, c, h, w = x.shape
+    bs = block_size
+    y = x.reshape(b, c, h // bs, bs, w // bs, bs)
+    y = jnp.transpose(y, (0, 3, 5, 1, 2, 4))
+    return y.reshape(b, c * bs * bs, h // bs, w // bs)
+
+
+@register_op("meshgrid_like")
+def _arange_like(x, axis=0, start=0.0, step=1.0):
+    n = x.shape[axis]
+    return start + step * jnp.arange(n, dtype=jnp.float32)
+
+
+@register_op("masked_fill")
+def _masked_fill(x, mask, value=0.0):
+    return jnp.where(mask.astype(bool), jnp.asarray(value, x.dtype), x)

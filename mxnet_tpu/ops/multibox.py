@@ -1,0 +1,249 @@
+"""SSD detection ops.
+
+ref: src/operator/contrib/multibox_prior-inl.h, multibox_target-inl.h,
+multibox_detection-inl.h, bounding_box-inl.h (box_nms / box_iou).
+The reference's CUDA kernels use data-dependent loops; TPU formulation is
+fixed-shape and mask-based: NMS is a lax.fori_loop over a static candidate
+count with suppression masks, which XLA compiles to a tight on-chip loop.
+Boxes are corner-format (xmin, ymin, xmax, ymax) normalised to [0,1].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+
+
+@register_op("MultiBoxPrior", aliases=("multibox_prior", "_contrib_MultiBoxPrior"))
+def _multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False, steps=(-1.0, -1.0),
+                    offsets=(0.5, 0.5)):
+    """Anchor generation (ref: MultiBoxPriorForward). data: (N, C, H, W);
+    returns (1, H*W*A, 4) with A = len(sizes)+len(ratios)-1."""
+    h, w = data.shape[2], data.shape[3]
+    step_y = steps[0] if steps[0] > 0 else 1.0 / h
+    step_x = steps[1] if steps[1] > 0 else 1.0 / w
+    cy = (jnp.arange(h, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(w, dtype=jnp.float32) + offsets[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+    centers = jnp.stack([cxg, cyg], axis=-1).reshape(-1, 2)  # (H*W, 2) as (x, y)
+    ws, hs = [], []
+    # anchor set: (sizes[0], ratios[*]) then (sizes[1:], ratios[0]) — reference order
+    for i, s in enumerate(sizes):
+        for j, r in enumerate(ratios):
+            if i > 0 and j > 0:
+                continue
+            sr = float(np.sqrt(r))
+            ws.append(s * sr / 2)
+            hs.append(s / sr / 2)
+    half_wh = jnp.asarray(list(zip(ws, hs)), jnp.float32)  # (A, 2)
+    a = half_wh.shape[0]
+    cs = jnp.repeat(centers[:, None, :], a, axis=1)  # (HW, A, 2)
+    anchors = jnp.concatenate([cs - half_wh[None], cs + half_wh[None]], axis=-1)
+    anchors = anchors.reshape(1, -1, 4)
+    if clip:
+        anchors = jnp.clip(anchors, 0.0, 1.0)
+    return anchors
+
+
+def box_iou_matrix(a, b):
+    """IoU of (..., Na, 4) vs (..., Nb, 4) corner boxes -> (..., Na, Nb)."""
+    tl = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    br = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum(a[..., 2] - a[..., 0], 0.0) * jnp.maximum(a[..., 3] - a[..., 1], 0.0)
+    area_b = jnp.maximum(b[..., 2] - b[..., 0], 0.0) * jnp.maximum(b[..., 3] - b[..., 1], 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+@register_op("box_iou", aliases=("_contrib_box_iou",))
+def _box_iou(lhs, rhs, format="corner"):
+    if format == "center":
+        def c2c(x):
+            cx, cy, w, h = x[..., 0], x[..., 1], x[..., 2], x[..., 3]
+            return jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], axis=-1)
+        lhs, rhs = c2c(lhs), c2c(rhs)
+    return box_iou_matrix(lhs, rhs)
+
+
+def _nms_single(boxes, scores, iou_thresh, topk):
+    """Greedy NMS on one image, fixed shapes. Returns keep mask (N,)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    boxes_s = boxes[order]
+    iou = box_iou_matrix(boxes_s, boxes_s)
+    valid = scores[order] > -jnp.inf
+
+    def body(i, keep):
+        # suppress j > i if iou(i, j) > thresh and i is kept
+        sup = (iou[i] > iou_thresh) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~sup
+
+    keep_sorted = jax.lax.fori_loop(0, n if topk <= 0 else min(topk, n), body, valid)
+    # scatter back to original order
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+@register_op("box_nms", aliases=("_contrib_box_nms",))
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+             in_format="corner", out_format="corner"):
+    """ref: bounding_box-inl.h — BoxNMSForward. data (B, N, K) rows of
+    [id, score, x1, y1, x2, y2, ...]; suppressed rows get score/id = -1."""
+    def one(img):
+        scores = img[:, score_index]
+        boxes = jax.lax.dynamic_slice_in_dim(img, coord_start, 4, axis=1)
+        invalid = scores < valid_thresh
+        if id_index >= 0 and background_id >= 0:
+            invalid = invalid | (img[:, id_index] == background_id)
+        s = jnp.where(invalid, -jnp.inf, scores)
+        if id_index >= 0 and not force_suppress:
+            # class-aware: offset boxes by class id so classes never overlap
+            off = img[:, id_index:id_index + 1] * 4.0
+            keep = _nms_single(boxes + off, s, overlap_thresh, topk)
+        else:
+            keep = _nms_single(boxes, s, overlap_thresh, topk)
+        out = img
+        dead = ~keep
+        out = out.at[:, score_index].set(jnp.where(dead, -1.0, img[:, score_index]))
+        if id_index >= 0:
+            out = out.at[:, id_index].set(jnp.where(dead, -1.0, img[:, id_index]))
+        return out
+
+    return jax.vmap(one)(data)
+
+
+@register_op("MultiBoxTarget", aliases=("multibox_target", "_contrib_MultiBoxTarget"))
+def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
+                     ignore_label=-1.0, negative_mining_ratio=-1.0,
+                     negative_mining_thresh=0.5, minimum_negative_samples=0,
+                     variances=(0.1, 0.1, 0.2, 0.2)):
+    """ref: multibox_target-inl.h — anchor/GT matching + box target encoding.
+
+    anchor (1, A, 4); label (B, M, 5) rows [cls, x1, y1, x2, y2] (cls<0 pad);
+    cls_pred (B, C+1, A).  Returns (box_target (B, A*4), box_mask (B, A*4),
+    cls_target (B, A)).
+    """
+    anchors = anchor[0]  # (A, 4)
+    a = anchors.shape[0]
+    var = jnp.asarray(variances, jnp.float32)
+
+    def one(lab, scores):
+        gt_valid = lab[:, 0] >= 0  # (M,)
+        gt_boxes = lab[:, 1:5]
+        iou = box_iou_matrix(anchors, gt_boxes)  # (A, M)
+        iou = jnp.where(gt_valid[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)          # (A,)
+        best_iou = jnp.max(iou, axis=1)
+        # force-match: each gt claims its best anchor
+        best_anchor = jnp.argmax(iou, axis=0)      # (M,)
+        forced = jnp.zeros((a,), bool)
+        m = gt_boxes.shape[0]
+        forced = forced.at[best_anchor].set(gt_valid | forced[best_anchor])
+        forced_gt = jnp.zeros((a,), jnp.int32).at[best_anchor].set(
+            jnp.arange(m, dtype=jnp.int32))
+        pos = forced | (best_iou >= overlap_threshold)
+        gt_idx = jnp.where(forced, forced_gt, best_gt.astype(jnp.int32))
+        matched = gt_boxes[gt_idx]                 # (A, 4)
+        cls_target = jnp.where(pos, lab[gt_idx, 0] + 1.0, 0.0)
+        # hard negative mining by background confidence
+        if negative_mining_ratio > 0:
+            neg_scores = 1.0 - scores[0]  # background prob proxy: (A,) from cls_pred[:,0,:]
+            num_pos = jnp.sum(pos.astype(jnp.int32))
+            max_neg = jnp.maximum((num_pos * negative_mining_ratio).astype(jnp.int32),
+                                  minimum_negative_samples)
+            neg_rank = jnp.argsort(jnp.argsort(-jnp.where(pos, -jnp.inf, neg_scores)))
+            keep_neg = (~pos) & (neg_rank < max_neg)
+            cls_target = jnp.where(~pos & ~keep_neg, ignore_label, cls_target)
+        # encode box targets with variances (center form)
+        aw = anchors[:, 2] - anchors[:, 0]
+        ah = anchors[:, 3] - anchors[:, 1]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        gw = jnp.maximum(matched[:, 2] - matched[:, 0], 1e-8)
+        gh = jnp.maximum(matched[:, 3] - matched[:, 1], 1e-8)
+        gcx = (matched[:, 0] + matched[:, 2]) / 2
+        gcy = (matched[:, 1] + matched[:, 3]) / 2
+        tx = (gcx - acx) / jnp.maximum(aw, 1e-8) / var[0]
+        ty = (gcy - acy) / jnp.maximum(ah, 1e-8) / var[1]
+        tw = jnp.log(gw / jnp.maximum(aw, 1e-8)) / var[2]
+        th = jnp.log(gh / jnp.maximum(ah, 1e-8)) / var[3]
+        bt = jnp.stack([tx, ty, tw, th], axis=-1)  # (A, 4)
+        mask = pos[:, None].astype(jnp.float32) * jnp.ones((1, 4), jnp.float32)
+        return (bt * mask).reshape(-1), mask.reshape(-1), cls_target
+
+    bt, bm, ct = jax.vmap(one)(label, cls_pred)
+    return bt, bm, ct
+
+
+@register_op("MultiBoxDetection", aliases=("multibox_detection", "_contrib_MultiBoxDetection"))
+def _multibox_detection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                        background_id=0, nms_threshold=0.5, force_suppress=False,
+                        variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """ref: multibox_detection-inl.h — decode + per-class NMS.
+    cls_prob (B, C+1, A); loc_pred (B, A*4); anchor (1, A, 4).
+    Output (B, A, 6) rows [cls_id, score, x1, y1, x2, y2]."""
+    anchors = anchor[0]
+    var = jnp.asarray(variances, jnp.float32)
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+
+    def one(probs, loc):
+        loc = loc.reshape(-1, 4)
+        cx = loc[:, 0] * var[0] * aw + acx
+        cy = loc[:, 1] * var[1] * ah + acy
+        w = jnp.exp(loc[:, 2] * var[2]) * aw / 2
+        h = jnp.exp(loc[:, 3] * var[3]) * ah / 2
+        boxes = jnp.stack([cx - w, cy - h, cx + w, cy + h], axis=-1)
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        # best non-background class per anchor (reference picks argmax class)
+        fg = jnp.concatenate([probs[:background_id], probs[background_id + 1:]], axis=0)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        cls_id = jnp.where(score > threshold, cls_id, -1.0)
+        score = jnp.where(score > threshold, score, -1.0)
+        det = jnp.concatenate([cls_id[:, None], score[:, None], boxes], axis=-1)
+        return det
+
+    det = jax.vmap(one)(cls_prob, loc_pred)
+    return _box_nms(det, overlap_thresh=nms_threshold, valid_thresh=0.0,
+                    topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                    background_id=-1, force_suppress=force_suppress)
+
+
+@register_op("ROIPooling", aliases=("roi_pooling",))
+def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """ref: src/operator/roi_pooling-inl.h. rois (R, 5) [batch_idx, x1, y1, x2, y2]."""
+    ph, pw = pooled_size
+
+    def one(roi):
+        bidx = roi[0].astype(jnp.int32)
+        img = data[bidx]  # (C, H, W)
+        x1 = jnp.round(roi[1] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * spatial_scale).astype(jnp.int32)
+        h = jnp.maximum(y2 - y1 + 1, 1)
+        w = jnp.maximum(x2 - x1 + 1, 1)
+        c, ih, iw = img.shape
+        ys = jnp.arange(ih)
+        xs = jnp.arange(iw)
+        # bin index of every pixel, -1 if outside roi
+        ybin = jnp.where((ys >= y1) & (ys <= y2), ((ys - y1) * ph) // h, -1)
+        xbin = jnp.where((xs >= x1) & (xs <= x2), ((xs - x1) * pw) // w, -1)
+        yoh = (ybin[:, None] == jnp.arange(ph)[None, :])  # (H, ph)
+        xoh = (xbin[:, None] == jnp.arange(pw)[None, :])  # (W, pw)
+        neg = jnp.asarray(-1e30, img.dtype)
+        # (C, ph, pw): max over pixels whose bin matches
+        expanded = jnp.where(yoh[None, :, None, :, None] & xoh[None, None, :, None, :],
+                             img[:, :, :, None, None], neg)
+        return jnp.max(expanded, axis=(1, 2))
+
+    return jax.vmap(one)(rois)

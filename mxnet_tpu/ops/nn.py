@@ -1,0 +1,332 @@
+"""Neural-network core ops.
+
+Re-emission of (ref: src/operator/nn/ — convolution-inl.h, fully_connected-inl.h,
+batch_norm-inl.h, layer_norm-inl.h, pooling-inl.h, softmax-inl.h, dropout-inl.h,
+activation-inl.h, ../leaky_relu-inl.h).  Convs lower to lax.conv_general_dilated
+(MXU path, replacing cuDNN autotuned algos — XLA picks the tiling); pooling to
+lax.reduce_window; normalisations are jnp expressions XLA fuses into one kernel.
+Layout is NCHW/NCW/NCDHW to match the reference's default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .registry import register_op
+from .. import random as _random
+from .. import autograd as _autograd
+
+
+def _tup(v, n):
+    if v is None or (isinstance(v, (tuple, list)) and len(v) == 0):
+        return (1,) * n if n else ()
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(v)
+
+
+# -------------------------------------------------------------- linear ------
+@register_op("FullyConnected", aliases=("fully_connected",))
+def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, flatten=True):
+    """ref: src/operator/nn/fully_connected-inl.h — FCForward (cuBLAS gemm).
+    Weight layout (num_hidden, in_units), reference convention."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    out = jnp.matmul(x, weight.T)
+    if bias is not None and not no_bias:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------- conv ------
+_CONV_LAYOUTS = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
+                 3: ("NCDHW", "OIDHW", "NCDHW")}
+
+
+@register_op("Convolution", aliases=("convolution",))
+def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                 pad=None, num_filter=None, num_group=1, workspace=1024,
+                 no_bias=False, cudnn_tune=None, cudnn_off=False, layout=None):
+    """ref: src/operator/nn/convolution-inl.h — ConvolutionOp::Forward.
+    cuDNN algo selection is replaced by XLA's conv emitter onto the MXU."""
+    nd = data.ndim - 2
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad, nd) if pad else (0,) * nd
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_LAYOUTS[nd])
+    out = jax.lax.conv_general_dilated(
+        data, weight,
+        window_strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=dn,
+        feature_group_count=num_group,
+        precision=None,
+    )
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register_op("Deconvolution", aliases=("deconvolution",))
+def _deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                   pad=None, adj=None, target_shape=None, num_filter=None,
+                   num_group=1, workspace=512, no_bias=True, cudnn_tune=None,
+                   cudnn_off=False, layout=None):
+    """ref: src/operator/nn/deconvolution-inl.h — transposed conv via
+    lax.conv_transpose; weight layout (in, out/group, *k) like the reference."""
+    nd = data.ndim - 2
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride, nd)
+    dilate = _tup(dilate, nd)
+    pad = _tup(pad, nd) if pad else (0,) * nd
+    adj = _tup(adj, nd) if adj else (0,) * nd
+    # Gradient-of-conv formulation: conv_transpose with IO swapped weight.
+    lhs, rhs, out_l = _CONV_LAYOUTS[nd]
+    out = jax.lax.conv_transpose(
+        data, jnp.swapaxes(weight, 0, 1),
+        strides=stride,
+        padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
+        dimension_numbers=(lhs, rhs, out_l),
+        transpose_kernel=True,
+    )
+    if adj != (0,) * nd:
+        pads = [(0, 0), (0, 0)] + [(0, a) for a in adj]
+        out = jnp.pad(out, pads)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ------------------------------------------------------------- pooling ------
+@register_op("Pooling", aliases=("pooling",))
+def _pooling(data, kernel=None, pool_type="max", global_pool=False, cudnn_off=False,
+             pooling_convention="valid", stride=None, pad=None, p_value=2,
+             count_include_pad=True, layout=None):
+    """ref: src/operator/nn/pooling-inl.h — PoolingOp; lax.reduce_window."""
+    nd = data.ndim - 2
+    if global_pool:
+        axes = tuple(range(2, data.ndim))
+        if pool_type == "max":
+            return jnp.max(data, axis=axes, keepdims=True)
+        if pool_type == "sum":
+            return jnp.sum(data, axis=axes, keepdims=True)
+        return jnp.mean(data, axis=axes, keepdims=True)
+    kernel = _tup(kernel, nd)
+    stride = _tup(stride, nd) if stride else kernel
+    pad = _tup(pad, nd) if pad else (0,) * nd
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pooling_convention == "full":
+        # ceil-mode output: extend padding on the right so the last window fits
+        extra = []
+        for i in range(nd):
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            extra.append((stride[i] - rem) % stride[i] if rem else 0)
+        pads = ((0, 0), (0, 0)) + tuple((p, p + e) for p, e in zip(pad, extra))
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
+    if pool_type in ("avg", "sum"):
+        summed = jax.lax.reduce_window(data, 0.0, jax.lax.add, window, strides, pads)
+        if pool_type == "sum":
+            return summed
+        if count_include_pad:
+            denom = float(np.prod(kernel))
+            return summed / denom
+        ones = jnp.ones_like(data)
+        counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+        return summed / counts
+    if pool_type == "lp":
+        p = float(p_value)
+        powed = jax.lax.reduce_window(jnp.abs(data) ** p, 0.0, jax.lax.add, window, strides, pads)
+        return powed ** (1.0 / p)
+    raise ValueError(f"unknown pool_type {pool_type}")
+
+
+# ---------------------------------------------------------- normalisation ---
+@register_op("BatchNorm", aliases=("batch_norm",))
+def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
+                fix_gamma=True, use_global_stats=False, output_mean_var=False,
+                axis=1, cudnn_off=False, training=None):
+    """ref: src/operator/nn/batch_norm-inl.h — BatchNormForward.
+
+    Functional form: returns (out, new_moving_mean, new_moving_var); the Gluon
+    layer threads the aux state (the reference mutates aux in-place via the
+    engine; under XLA state must be explicit).
+    """
+    if training is None:
+        training = _autograd.is_training()
+    axes = tuple(i for i in range(data.ndim) if i != axis)
+    g = jnp.ones_like(gamma) if fix_gamma else gamma
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    if training and not use_global_stats:
+        mean = jnp.mean(data, axis=axes)
+        var = jnp.var(data, axis=axes)
+        new_mm = moving_mean * momentum + mean * (1 - momentum)
+        new_mv = moving_var * momentum + var * (1 - momentum)
+    else:
+        mean, var = moving_mean, moving_var
+        new_mm, new_mv = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var + eps)
+    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) + beta.reshape(bshape)
+    if output_mean_var:
+        return out, mean, inv
+    return out, new_mm, new_mv
+
+
+@register_op("LayerNorm", aliases=("layer_norm",))
+def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """ref: src/operator/nn/layer_norm-inl.h — LayerNormCompute."""
+    mean = jnp.mean(data, axis=axis, keepdims=True)
+    var = jnp.var(data, axis=axis, keepdims=True)
+    inv = jax.lax.rsqrt(var + eps)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    if output_mean_var:
+        return out, jnp.squeeze(mean, axis), jnp.squeeze(inv, axis)
+    return out
+
+
+@register_op("RMSNorm", aliases=("rms_norm",))
+def _rms_norm(data, gamma, axis=-1, eps=1e-6):
+    """TPU-era extension (no reference analogue; standard in modern LMs)."""
+    ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
+    shape = [1] * data.ndim
+    shape[axis] = data.shape[axis]
+    return data * jax.lax.rsqrt(ms + eps) * gamma.reshape(shape)
+
+
+@register_op("GroupNorm", aliases=("group_norm",))
+def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
+    """ref: src/operator/nn/group_norm-inl.h."""
+    n, c = data.shape[0], data.shape[1]
+    rest = data.shape[2:]
+    x = data.reshape(n, num_groups, c // num_groups, *rest)
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    x = x.reshape(data.shape)
+    bshape = (1, c) + (1,) * len(rest)
+    return x * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+@register_op("InstanceNorm", aliases=("instance_norm",))
+def _instance_norm(data, gamma, beta, eps=1e-3):
+    """ref: src/operator/instance_norm-inl.h."""
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
+    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+
+
+# ------------------------------------------------------------ activation ----
+@register_op("Activation", aliases=("activation",))
+def _activation(data, act_type="relu"):
+    """ref: src/operator/nn/activation-inl.h."""
+    if act_type == "relu":
+        return jax.nn.relu(data)
+    if act_type == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act_type == "tanh":
+        return jnp.tanh(data)
+    if act_type == "softrelu":
+        return jax.nn.softplus(data)
+    if act_type == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("LeakyReLU", aliases=("leaky_relu",))
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, lower_bound=0.125,
+                upper_bound=0.334):
+    """ref: src/operator/leaky_relu-inl.h (leaky/prelu/elu/selu/gelu/rrelu)."""
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim < data.ndim and g.ndim == 1:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data >= 0, data, g * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        alpha, scale = 1.6732632423543772, 1.0507009873554805
+        return scale * jnp.where(data >= 0, data, alpha * jnp.expm1(data))
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "rrelu":
+        mid = (lower_bound + upper_bound) / 2.0
+        return jnp.where(data >= 0, data, mid * data)
+    raise ValueError(f"unknown act_type {act_type}")
+
+
+@register_op("gelu_tanh")
+def _gelu_tanh(data):
+    return jax.nn.gelu(data, approximate=True)
+
+
+@register_op("silu")
+def _silu(data):
+    return jax.nn.silu(data)
+
+
+# --------------------------------------------------------------- softmax ----
+@register_op("softmax")
+def _softmax(data, axis=-1, temperature=None, length=None, use_length=False, dtype=None):
+    """ref: src/operator/nn/softmax-inl.h — Softmax with optional length mask."""
+    x = data / temperature if temperature else data
+    if length is not None:
+        pos = jnp.arange(x.shape[axis])
+        shape = [1] * x.ndim
+        shape[axis] = x.shape[axis]
+        mask = pos.reshape(shape) < jnp.expand_dims(length.astype(jnp.int32), axis)
+        x = jnp.where(mask, x, -jnp.inf)
+        out = jax.nn.softmax(x, axis=axis)
+        return jnp.where(mask, out, 0.0)
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op("log_softmax")
+def _log_softmax(data, axis=-1, temperature=None, dtype=None):
+    x = data / temperature if temperature else data
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op("softmin")
+def _softmin(data, axis=-1, temperature=None, dtype=None):
+    return _softmax(-data, axis=axis, temperature=temperature)
+
+
+# --------------------------------------------------------------- dropout ----
+@register_op("Dropout", aliases=("dropout",), needs_rng=True)
+def _dropout(data, p=0.5, mode="training", axes=(), cudnn_off=False, training=None):
+    """ref: src/operator/nn/dropout-inl.h — DropoutOp (inverted dropout)."""
+    if training is None:
+        training = _autograd.is_training()
+    if (not training and mode != "always") or p == 0:
+        return data
+    key = _random.next_key()
+    shape = list(data.shape)
+    for a in axes:
+        shape[a] = 1  # broadcast dropout over these axes
+    keep = jax.random.bernoulli(key, 1.0 - p, shape=tuple(shape))
+    return jnp.where(keep, data / (1.0 - p), jnp.zeros((), data.dtype))
+
+
+# ------------------------------------------------------------- legacy fused -
+@register_op("SoftmaxOutput", aliases=("softmax_output",))
+def _softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                    multi_output=False, use_ignore=False, preserve_shape=False,
+                    normalization="null", out_grad=False, smooth_alpha=0.0):
+    """ref: src/operator/softmax_output-inl.h — forward only returns softmax;
+    the fused backward trick is replaced by SoftmaxCrossEntropyLoss + autograd."""
+    return jax.nn.softmax(data, axis=1 if multi_output else -1)

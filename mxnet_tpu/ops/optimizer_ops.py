@@ -1,0 +1,189 @@
+"""Optimizer update ops.
+
+Re-emission of (ref: src/operator/optimizer_op{.cc,.cu,-inl.h},
+contrib/adamw*, contrib/multi_lamb*).  Functional form: each op returns the
+updated weight (and updated state tensors); the Trainer writes them back —
+the reference mutates in place through the engine.  XLA fuses each update into
+a single elementwise kernel; the ``multi_*`` fused multi-tensor variants are
+realised by jit-ing the whole Trainer step instead.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _apply_wd(grad, weight, wd, rescale_grad, clip_gradient):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register_op("sgd_update")
+def _sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    return weight - lr * g
+
+
+@register_op("sgd_mom_update")
+def _sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register_op("nag_mom_update")
+def _nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register_op("adam_update")
+def _adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                 lazy_update=True):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon), new_mean, new_var
+
+
+@register_op("adamw_update")
+def _adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: src/operator/contrib/adamw.cc — decoupled weight decay."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    upd = new_mean / (jnp.sqrt(new_var) + epsilon) + wd * weight
+    return weight - eta * lr * upd, new_mean, new_var
+
+
+@register_op("rmsprop_update")
+def _rmsprop_update(weight, grad, n, lr=0.001, rho=0.9, epsilon=1e-8, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    out = weight - lr * g / (jnp.sqrt(new_n) + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        out = jnp.clip(out, -clip_weights, clip_weights)
+    return out, new_n
+
+
+@register_op("rmspropalex_update")
+def _rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, rho=0.9,
+                        momentum=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_n = rho * n + (1 - rho) * jnp.square(g)
+    new_g = rho * g_state + (1 - rho) * g
+    new_delta = momentum * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    return weight + new_delta, new_n, new_g, new_delta
+
+
+@register_op("ftrl_update")
+def _ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) <= lamda1,
+        jnp.zeros_like(weight),
+        -(new_z - jnp.sign(new_z) * lamda1) / ((beta + jnp.sqrt(new_n)) / lr + wd),
+    )
+    return new_w, new_z, new_n
+
+
+@register_op("signsgd_update")
+def _signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    return weight - lr * jnp.sign(g)
+
+
+@register_op("signum_update")
+def _signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - (1 - momentum) * g
+    out = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return out, new_mom
+
+
+@register_op("adagrad_update")
+def _adagrad_update(weight, grad, history, lr=0.01, epsilon=1e-7, wd=0.0,
+                    rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_h = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(new_h) + epsilon), new_h
+
+
+@register_op("adadelta_update")
+def _adadelta_update(weight, grad, acc_g, acc_delta, lr=1.0, rho=0.9, epsilon=1e-5,
+                     wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd(grad, weight, wd, rescale_grad, clip_gradient)
+    new_acc_g = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(new_acc_g + epsilon) * g
+    new_acc_delta = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - lr * delta, new_acc_g, new_acc_delta
+
+
+@register_op("lamb_update_phase1")
+def _lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                        epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                        rescale_grad=1.0, clip_gradient=-1.0):
+    """ref: src/operator/optimizer_op.cc — lamb_update_phase1."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    m_hat, v_hat = new_mean, new_var
+    if bias_correction:
+        m_hat = new_mean / (1 - beta1 ** t)
+        v_hat = new_var / (1 - beta2 ** t)
+    update = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * weight
+    return update, new_mean, new_var
+
+
+@register_op("lamb_update_phase2")
+def _lamb_update_phase2(weight, g_update, r1, r2, lr=0.01, lower_bound=-1.0,
+                        upper_bound=-1.0):
+    """ref: src/operator/optimizer_op.cc — lamb_update_phase2 (trust ratio)."""
+    r1c = r1
+    if lower_bound is not None and lower_bound > 0:
+        r1c = jnp.maximum(r1c, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        r1c = jnp.minimum(r1c, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1c > 0, r2 > 0), r1c / r2, jnp.ones_like(r1c))
+    return weight - lr * ratio * g_update
+
+
+@register_op("mp_sgd_update")
+def _mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, lazy_update=True):
+    """Mixed-precision: bf16 weight + fp32 master copy
+    (ref: src/operator/optimizer_op.cc — mp_sgd_update)."""
+    g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad, clip_gradient)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register_op("mp_sgd_mom_update")
+def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd(grad.astype(jnp.float32), weight32, wd, rescale_grad, clip_gradient)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32

@@ -1,0 +1,56 @@
+"""INT8 quantization ops (ref: src/operator/quantization/*.{h,cc,cu} —
+quantize_v2.cc, dequantize.cc, quantized_fully_connected.cc, calibrate.cc).
+TPU-native: int8 matmuls go through lax.dot_general with int32 accumulation,
+which XLA maps onto the MXU's int8 path."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _range_for(x, min_calib, max_calib):
+    if min_calib is not None and max_calib is not None:
+        return jnp.asarray(min_calib, jnp.float32), jnp.asarray(max_calib, jnp.float32)
+    return jnp.min(x).astype(jnp.float32), jnp.max(x).astype(jnp.float32)
+
+
+@register_op("quantize_v2")
+def _quantize_v2(data, out_type="int8", min_calib_range=None, max_calib_range=None):
+    mn, mx = _range_for(data, min_calib_range, max_calib_range)
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    scale = 127.0 / jnp.maximum(amax, 1e-10)
+    q = jnp.clip(jnp.round(data.astype(jnp.float32) * scale), -127, 127).astype(jnp.int8)
+    return q, -amax, amax
+
+
+@register_op("dequantize")
+def _dequantize(data, min_range, max_range, out_type="float32"):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (amax / 127.0)
+
+
+@register_op("quantized_fully_connected")
+def _quantized_fc(data, weight, bias, min_data, max_data, min_weight, max_weight,
+                  min_bias=None, max_bias=None, num_hidden=None, no_bias=False,
+                  flatten=True):
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    acc = jax.lax.dot_general(
+        x, weight.T, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    sx = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    sw = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    out = acc.astype(jnp.float32) * (sx * sw)
+    if bias is not None and not no_bias:
+        sb = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+        out = out + bias.astype(jnp.float32) * sb
+    return out
+
+
+@register_op("quantized_matmul")
+def _quantized_matmul(a, b, scale_a, scale_b):
+    acc = jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * (scale_a * scale_b)

@@ -1,0 +1,141 @@
+"""Reduction / sorting / cumulative ops.
+
+Re-emission of (ref: src/operator/tensor/broadcast_reduce_op*.{h,cc,cu},
+ordering_op*.{h,cc,cu}).  XLA lowers these onto the VPU/MXU natively; the
+reference's hand-tiled reduce kernels are unnecessary.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _norm_axis(axis):
+    if axis is None or isinstance(axis, (int, tuple)):
+        return axis
+    if isinstance(axis, list):
+        return tuple(axis)
+    return int(axis)
+
+
+def _reduce(fn):
+    def op(x, axis=None, keepdims=False, exclude=False):
+        axis = _norm_axis(axis)
+        if exclude and axis is not None:
+            ax = (axis,) if isinstance(axis, int) else tuple(axis)
+            axis = tuple(i for i in range(x.ndim) if i not in ax and i - x.ndim not in ax)
+        return fn(x, axis=axis, keepdims=keepdims)
+
+    return op
+
+
+register_op("sum", _reduce(jnp.sum), aliases=("sum_axis",))
+register_op("mean", _reduce(jnp.mean))
+register_op("prod", _reduce(jnp.prod))
+register_op("max", _reduce(jnp.max), aliases=("max_axis",))
+register_op("min", _reduce(jnp.min), aliases=("min_axis",))
+register_op("nansum", _reduce(jnp.nansum))
+register_op("nanprod", _reduce(jnp.nanprod))
+
+
+@register_op("norm")
+def _norm(x, ord=2, axis=None, keepdims=False):
+    axis = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(x), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdims))
+
+
+@register_op("argmax")
+def _argmax(x, axis=None, keepdims=False):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdims)
+    return out.astype(jnp.float32)  # reference returns float indices
+
+
+@register_op("argmin")
+def _argmin(x, axis=None, keepdims=False):
+    return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+
+
+@register_op("argmax_channel")
+def _argmax_channel(x):
+    return jnp.argmax(x, axis=-1).astype(jnp.float32)
+
+
+@register_op("topk")
+def _topk(x, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    """ref: src/operator/tensor/ordering_op-inl.h — TopKImpl."""
+    from ..base import dtype_np
+
+    xm = jnp.moveaxis(x, axis, -1)
+    neg = xm if is_ascend else -xm
+    vals, idx = jax.lax.top_k(-neg, k) if is_ascend else jax.lax.top_k(xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, axis)
+    idx = jnp.moveaxis(idx, -1, axis)
+    idxc = idx.astype(dtype_np(dtype))
+    if ret_typ == "indices":
+        return idxc
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return vals, idxc
+    if ret_typ == "mask":
+        xm_shape = jnp.moveaxis(x, axis, -1).shape
+        mask = jnp.zeros(xm_shape, dtype=x.dtype)
+        mask = jax.vmap(lambda m, i: m.at[i].set(1), in_axes=(0, 0))(
+            mask.reshape(-1, xm_shape[-1]), idx.reshape(-1, idx.shape[-1])
+        ).reshape(xm_shape)
+        return jnp.moveaxis(mask, -1, axis)
+    raise ValueError(f"unknown ret_typ {ret_typ}")
+
+
+@register_op("sort")
+def _sort(x, axis=-1, is_ascend=True):
+    out = jnp.sort(x, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register_op("argsort")
+def _argsort(x, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import dtype_np
+
+    idx = jnp.argsort(x, axis=axis)
+    if not is_ascend:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(dtype_np(dtype))
+
+
+@register_op("cumsum")
+def _cumsum(x, axis=None, dtype=None):
+    from ..base import dtype_np
+
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    out = jnp.cumsum(x, axis=axis)
+    return out.astype(dtype_np(dtype)) if dtype is not None else out
+
+
+@register_op("cumprod")
+def _cumprod(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumprod(x, axis=axis)
+
+
+@register_op("L2Normalization", aliases=("l2_normalization",))
+def _l2norm(x, eps=1e-10, mode="instance"):
+    """ref: src/operator/l2_normalization-inl.h."""
+    if mode == "instance":
+        axes = tuple(range(1, x.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, x.ndim))
+    denom = jnp.sqrt(jnp.sum(jnp.square(x), axis=axes, keepdims=True) + eps)
+    return x / denom

@@ -1,0 +1,99 @@
+"""Op registry.
+
+Replaces the reference's NNVM op registry (ref: 3rdparty/tvm/nnvm/include/nnvm
+— NNVM_REGISTER_OP; src/operator pattern ``.set_attr<FCompute>``).  An op here
+is a pure function ``fn(*jax_arrays, **static_params) -> array | tuple`` whose
+shape/dtype inference, gradient, and fusion all come from XLA tracing, so the
+FInferShape/FInferType/FGradient attribute machinery of the reference is not
+needed.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Dict
+
+import jax
+
+OPS: Dict[str, Callable] = {}
+# Per-op dispatch metadata: has_training (op behavior depends on train/predict
+# mode — must be part of the jit cache key) and needs_rng (op draws random
+# numbers — a fresh key must be a traced argument, never constant-folded).
+OP_META: Dict[str, dict] = {}
+
+
+def register_op(name, fn: Callable = None, aliases=(), needs_rng: bool = False):
+    """Register ``fn`` under ``name`` (+aliases). Usable as a decorator."""
+
+    def _do(f):
+        try:
+            has_training = "training" in inspect.signature(f).parameters
+        except (TypeError, ValueError):
+            has_training = False
+        meta = {"has_training": has_training, "needs_rng": needs_rng}
+        OPS[name] = f
+        OP_META[name] = meta
+        for a in aliases:
+            OPS[a] = f
+            OP_META[a] = meta
+        return f
+
+    if fn is None:
+        return _do
+    return _do(fn)
+
+
+def alias_op(new_name: str, existing: str):
+    OPS[new_name] = OPS[existing]
+    OP_META[new_name] = OP_META[existing]
+
+
+def get_op(name: str) -> Callable:
+    try:
+        return OPS[name]
+    except KeyError:
+        raise ValueError(f"unknown operator '{name}'") from None
+
+
+@functools.lru_cache(maxsize=8192)
+def compiled(name: str, params_key: tuple):
+    """Cached jitted closure of an op at fixed static params.
+
+    This is the eager fast path: dispatch cost is a dict lookup + jit cache
+    hit, the TPU-native analogue of the reference's cached FCompute dispatch
+    (ref: src/imperative/imperative_utils.h — PushFCompute).
+
+    Static Python state must never be constant-folded into the cache:
+    the training flag is part of ``params_key`` (invoke injects it), and for
+    ``needs_rng`` ops the PRNG key is a traced leading argument feeding a
+    RandomScope, so every call draws fresh randomness.
+    """
+    fn = get_op(name)
+    kwargs = dict(params_key)
+
+    if OP_META.get(name, {}).get("needs_rng"):
+        from .. import random as _random
+
+        @jax.jit
+        def _run_rng(key, *arrays):
+            with _random.RandomScope(key):
+                return fn(*arrays, **kwargs)
+
+        return _run_rng
+
+    @jax.jit
+    def _run(*arrays):
+        return fn(*arrays, **kwargs)
+
+    return _run
+
+
+def params_key(kwargs: dict) -> tuple:
+    """Normalise static kwargs to a hashable cache key (lists -> tuples)."""
+    items = []
+    for k in sorted(kwargs):
+        v = kwargs[k]
+        if isinstance(v, list):
+            v = tuple(v)
+        items.append((k, v))
+    return tuple(items)

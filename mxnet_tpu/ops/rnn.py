@@ -1,0 +1,165 @@
+"""Fused RNN op (LSTM / GRU / vanilla), the cuDNN-RNN equivalent.
+
+ref: src/operator/rnn{.cc,-inl.h}, rnn_impl.h — one op runs a multi-layer,
+optionally bidirectional recurrent stack over a packed parameter vector.
+TPU-native design: the time loop is a single ``lax.scan`` per layer/direction
+(compiled once, pipelined by XLA, weights stay resident in registers/VMEM);
+the packed layout matches cuDNN's (all i2h/h2h weights layer-major, then all
+biases) so Gluon layers can pack/unpack identically to the reference.
+
+Gate order: LSTM [i, f, g, o]; GRU [r, z, n] (cuDNN order).
+Data layout: time-major (T, N, C) like the reference's default.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .. import autograd as _autograd
+from .. import random as _random
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers, bidirectional, projection_size=None):
+    """Total packed parameter count (matches cuDNN packing)."""
+    g = _GATES[mode]
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else state_size * dirs
+        size += dirs * g * state_size * (in_sz + state_size + 2)
+    return size
+
+
+def _unpack(params, mode, input_size, state_size, num_layers, dirs):
+    """Split the flat parameter vector into per-(layer, dir) weight/bias mats."""
+    g = _GATES[mode]
+    h = state_size
+    shapes = []
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else h * dirs
+        for _ in range(dirs):
+            shapes.append(("w_ih", (g * h, in_sz)))
+            shapes.append(("w_hh", (g * h, h)))
+    for layer in range(num_layers):
+        for _ in range(dirs):
+            shapes.append(("b_ih", (g * h,)))
+            shapes.append(("b_hh", (g * h,)))
+    out, off = [], 0
+    for _, shp in shapes:
+        n = 1
+        for s in shp:
+            n *= s
+        out.append(params[off:off + n].reshape(shp))
+        off += n
+    weights = out[: 2 * num_layers * dirs]
+    biases = out[2 * num_layers * dirs:]
+    cells = []
+    for i in range(num_layers * dirs):
+        cells.append((weights[2 * i], weights[2 * i + 1], biases[2 * i], biases[2 * i + 1]))
+    return cells  # indexed [layer * dirs + dir]
+
+
+def _lstm_cell(carry, xw, w_hh, b):
+    h, c = carry
+    gates = xw + jnp.matmul(h, w_hh.T) + b
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return (h_new, c_new), h_new
+
+
+def _gru_cell(carry, x_t, w_ih, w_hh, b_ih, b_hh):
+    (h,) = carry
+    gi = jnp.matmul(x_t, w_ih.T) + b_ih
+    gh = jnp.matmul(h, w_hh.T) + b_hh
+    i_r, i_z, i_n = jnp.split(gi, 3, axis=-1)
+    h_r, h_z, h_n = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(i_r + h_r)
+    z = jax.nn.sigmoid(i_z + h_z)
+    n = jnp.tanh(i_n + r * h_n)
+    h_new = (1 - z) * n + z * h
+    return (h_new,), h_new
+
+
+def _vanilla_cell(carry, xw, w_hh, b, act):
+    (h,) = carry
+    pre = xw + jnp.matmul(h, w_hh.T) + b
+    h_new = act(pre)
+    return (h_new,), h_new
+
+
+def _run_direction(x, h0, c0, cell_params, mode, reverse):
+    """Scan one layer in one direction. x: (T, N, C_in)."""
+    w_ih, w_hh, b_ih, b_hh = cell_params
+    xs = jnp.flip(x, axis=0) if reverse else x
+    if mode == "lstm":
+        # precompute input projections for the whole sequence: one big MXU
+        # matmul; both biases fold into it, so the scan body is h2h-only
+        xw = jnp.matmul(xs, w_ih.T) + b_ih + b_hh
+
+        def step(carry, xw_t):
+            return _lstm_cell(carry, xw_t, w_hh, jnp.zeros((), xw_t.dtype))
+
+        (h_n, c_n), ys = jax.lax.scan(step, (h0, c0), xw)
+    elif mode == "gru":
+        def step(carry, x_t):
+            return _gru_cell(carry, x_t, w_ih, w_hh, b_ih, b_hh)
+        (h_n,), ys = jax.lax.scan(step, (h0,), xs)
+        c_n = None
+    else:
+        act = jax.nn.relu if mode == "rnn_relu" else jnp.tanh
+        xw = jnp.matmul(xs, w_ih.T) + b_ih + b_hh
+        def step(carry, xw_t):
+            return _vanilla_cell(carry, xw_t, w_hh, jnp.zeros((), xw_t.dtype), act)
+        (h_n,), ys = jax.lax.scan(step, (h0,), xw)
+        c_n = None
+    if reverse:
+        ys = jnp.flip(ys, axis=0)
+    return ys, h_n, c_n
+
+
+@register_op("RNN", needs_rng=True)
+def _rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
+         bidirectional=False, mode="lstm", p=0.0, state_outputs=False,
+         projection_size=None, lstm_state_clip_min=None, lstm_state_clip_max=None,
+         lstm_state_clip_nan=False, use_sequence_length=False, training=None):
+    """Fused multi-layer RNN (ref: src/operator/rnn.cc — the PTB-LSTM hot path).
+
+    data (T, N, C); state (L*dirs, N, H); lstm also takes state_cell.
+    Returns out, state_h [, state_c] — always the tuple; callers select.
+    """
+    if training is None:
+        training = _autograd.is_training()
+    dirs = 2 if bidirectional else 1
+    h = state_size
+    cells = _unpack(parameters, mode, data.shape[-1], h, num_layers, dirs)
+    x = data
+    h_states, c_states = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(dirs):
+            idx = layer * dirs + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if mode == "lstm" else None
+            ys, h_n, c_n = _run_direction(x, h0, c0, cells[idx], mode, reverse=(d == 1))
+            outs.append(ys)
+            h_states.append(h_n)
+            if mode == "lstm":
+                c_states.append(c_n)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0 and training and layer < num_layers - 1:
+            key = _random.next_key()
+            keep = jax.random.bernoulli(key, 1.0 - p, shape=x.shape)
+            x = jnp.where(keep, x / (1.0 - p), jnp.zeros((), x.dtype))
+    h_out = jnp.stack(h_states, axis=0)
+    if mode == "lstm":
+        c_out = jnp.stack(c_states, axis=0)
+        if lstm_state_clip_min is not None and lstm_state_clip_max is not None:
+            c_out = jnp.clip(c_out, lstm_state_clip_min, lstm_state_clip_max)
+        return x, h_out, c_out
+    return x, h_out

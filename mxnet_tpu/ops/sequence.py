@@ -1,0 +1,48 @@
+"""Sequence ops (ref: src/operator/sequence_mask-inl.h, sequence_last-inl.h,
+sequence_reverse-inl.h).  Layout matches the reference: time-major (T, N, ...)
+with optional per-batch lengths."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register_op
+
+
+def _time_mask(x, sequence_length):
+    t = x.shape[0]
+    steps = jnp.arange(t).reshape((t,) + (1,) * (x.ndim - 1))
+    lens = sequence_length.astype(jnp.int32).reshape((1, -1) + (1,) * (x.ndim - 2))
+    return steps < lens
+
+
+@register_op("SequenceMask", aliases=("sequence_mask",))
+def _sequence_mask(data, sequence_length=None, use_sequence_length=False, value=0.0, axis=0):
+    if sequence_length is None or not use_sequence_length:
+        return data
+    x = jnp.swapaxes(data, 0, axis) if axis != 0 else data
+    mask = _time_mask(x, sequence_length)
+    out = jnp.where(mask, x, jnp.asarray(value, x.dtype))
+    return jnp.swapaxes(out, 0, axis) if axis != 0 else out
+
+
+@register_op("SequenceLast", aliases=("sequence_last",))
+def _sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    x = jnp.swapaxes(data, 0, axis) if axis != 0 else data
+    if sequence_length is None or not use_sequence_length:
+        return x[-1]
+    idx = jnp.clip(sequence_length.astype(jnp.int32) - 1, 0, x.shape[0] - 1)
+    return jnp.take_along_axis(
+        x, idx.reshape((1, -1) + (1,) * (x.ndim - 2)), axis=0
+    )[0]
+
+
+@register_op("SequenceReverse", aliases=("sequence_reverse",))
+def _sequence_reverse(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if sequence_length is None or not use_sequence_length:
+        return jnp.flip(data, axis=0)
+    t = data.shape[0]
+    steps = jnp.arange(t).reshape((t,) + (1,) * (data.ndim - 1))
+    lens = sequence_length.astype(jnp.int32).reshape((1, -1) + (1,) * (data.ndim - 2))
+    # position i maps to (len-1-i) inside the valid prefix, identity elsewhere
+    src = jnp.where(steps < lens, lens - 1 - steps, steps)
+    return jnp.take_along_axis(data, jnp.broadcast_to(src, data.shape), axis=0)

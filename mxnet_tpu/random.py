@@ -1,0 +1,73 @@
+"""Random state management.
+
+The reference seeds per-device mshadow PRNGs (ref: src/common/random_generator.h,
+python/mxnet/random.py — mx.random.seed).  TPU-native design: a functional
+threaded key.  Eagerly, a global RandomState splits a jax PRNG key per draw.
+Inside a trace (hybridize / jit), the tracing machinery pushes a TraceRandomScope
+whose key is a traced argument, so compiled graphs are reproducible and pure.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "next_key", "RandomScope", "current_key_source"]
+
+_tls = threading.local()
+
+
+class _EagerState:
+    def __init__(self, seed_val: int = 0):
+        self.key = jax.random.key(seed_val)
+
+    def next_key(self):
+        self.key, sub = jax.random.split(self.key)
+        return sub
+
+
+_GLOBAL = _EagerState()
+
+
+class RandomScope:
+    """Functional key source for traced regions.
+
+    Holds a base key (usually a tracer); each ``next_key`` folds in a counter
+    so a traced forward draws deterministic independent streams.
+    """
+
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self._count = 0
+
+    def next_key(self):
+        k = jax.random.fold_in(self.base_key, self._count)
+        self._count += 1
+        return k
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _tls.stack.pop()
+
+
+def current_key_source():
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return stack[-1]
+    return _GLOBAL
+
+
+def next_key():
+    return current_key_source().next_key()
+
+
+def seed(seed_state: int, ctx=None):  # ctx accepted for API compat
+    """Reseed the global generator (ref: mx.random.seed)."""
+    global _GLOBAL
+    _GLOBAL = _EagerState(int(seed_state))

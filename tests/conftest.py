@@ -1,0 +1,34 @@
+"""Test harness: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (ref: tests/python/gpu/test_operator_gpu.py
+imports CPU suites with ctx switched): here the switch is platform-level — the
+suite runs on XLA:CPU with 8 virtual devices so sharding/collective tests
+exercise real multi-device paths without TPU hardware
+(SURVEY.md §4 "distributed-without-a-cluster").
+"""
+import os
+
+# Must happen before jax backend init. The axon sitecustomize may have already
+# registered the TPU tunnel plugin; force platform selection back to cpu.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    """with_seed() equivalent (ref: tests/python/unittest/common.py)."""
+    import mxnet_tpu as mx
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield

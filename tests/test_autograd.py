@@ -1,0 +1,168 @@
+"""Autograd semantics (ref: tests/python/unittest/test_autograd.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 4, 6])
+
+
+def test_chain_rule():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x)
+        z = y * y
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * np.exp(4.0), rtol=1e-5)
+
+
+def test_backward_non_scalar_uses_ones():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3, 3])
+
+
+def test_head_grads():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(out_grad=nd.array([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [2, 40])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6])
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [9])
+
+
+def test_stop_gradient_op():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [9])
+
+
+def test_multi_input_grads():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [4])
+    np.testing.assert_allclose(b.grad.asnumpy(), [2])
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_pause_excludes_ops():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            w = y * 10  # not recorded
+        z = y * 1
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4])
+
+
+def test_autograd_grad_function():
+    x = nd.array([2.0])
+    with autograd.record():
+        y = x * x * x
+    (g,) = autograd.grad(y, [x])
+    np.testing.assert_allclose(g.asnumpy(), [12], rtol=1e-6)
+
+
+def test_mark_variables():
+    x = nd.array([5.0])
+    g = nd.zeros((1,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 4
+    y.backward()
+    np.testing.assert_allclose(g.asnumpy(), [4])
+
+
+def test_grad_through_reductions_and_indexing():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = x[0].sum() + 2 * x[1].mean()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[1, 1, 1], [2 / 3, 2 / 3, 2 / 3]], rtol=1e-6)
+
+
+def test_grad_multi_output_op():
+    x = nd.array(np.random.rand(4, 6).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        parts = nd.split(x, num_outputs=2, axis=1)
+        y = (parts[0] * 2).sum() + (parts[1] * 3).sum()
+    y.backward()
+    expect = np.concatenate([np.full((4, 3), 2.0), np.full((4, 3), 3.0)], axis=1)
+    np.testing.assert_allclose(x.grad.asnumpy(), expect)
+
+
+def test_retain_graph():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward(retain_graph=True)
+    np.testing.assert_allclose(x.grad.asnumpy(), [4])
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4])

@@ -1,0 +1,188 @@
+"""NDArray basics (ref: tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_creation_roundtrip():
+    x = nd.array([[1, 2], [3, 4]])
+    assert x.shape == (2, 2)
+    assert x.dtype == np.float32
+    np.testing.assert_array_equal(x.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_creation_dtypes():
+    for dt in ["float32", "float16", "bfloat16", "int32", "uint8"]:
+        x = nd.zeros((2, 3), dtype=dt)
+        assert x.shape == (2, 3)
+        assert x.asnumpy().sum() == 0
+
+
+def test_zeros_ones_full_arange():
+    assert nd.zeros((2, 2)).asnumpy().sum() == 0
+    assert nd.ones((2, 2)).asnumpy().sum() == 4
+    np.testing.assert_array_equal(nd.full((2,), 7).asnumpy(), [7, 7])
+    np.testing.assert_array_equal(nd.arange(0, 5).asnumpy(), [0, 1, 2, 3, 4])
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+    np.testing.assert_allclose((2 + a).asnumpy(), [3, 4, 5])
+    np.testing.assert_allclose((1 - a).asnumpy(), [0, -1, -2])
+
+
+def test_comparison_returns_float_mask():
+    a = nd.array([1.0, 2.0, 3.0])
+    m = a > 1.5
+    assert m.dtype == np.float32
+    np.testing.assert_array_equal(m.asnumpy(), [0, 1, 1])
+
+
+def test_inplace_ops():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), [2, 3])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [4, 6])
+
+
+def test_indexing():
+    x = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_array_equal(x[1].asnumpy(), [4, 5, 6, 7])
+    np.testing.assert_array_equal(x[0:2, 1].asnumpy(), [1, 5])
+    x[0] = 0
+    assert x.asnumpy()[0].sum() == 0
+    x[1, 2] = 99
+    assert x.asnumpy()[1, 2] == 99
+
+
+def test_setitem_full_slice():
+    x = nd.zeros((2, 3))
+    x[:] = 5
+    assert x.asnumpy().sum() == 30
+
+
+def test_reshape_special_codes():
+    x = nd.zeros((2, 3, 4))
+    assert x.reshape((0, -1)).shape == (2, 12)
+    assert x.reshape((-2,)).shape == (2, 3, 4)
+    assert x.reshape((-3, 4)).shape == (6, 4)
+    assert x.reshape((2, -4, 3, 1, 4)).shape == (2, 3, 1, 4)
+    assert x.reshape((-1,)).shape == (24,)
+
+
+def test_dot_semantics():
+    a = nd.array(np.random.rand(2, 3))
+    b = nd.array(np.random.rand(3, 4))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(), a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    # ndim>2: contract last axis of a with first of b
+    a3 = nd.array(np.random.rand(2, 2, 3))
+    np.testing.assert_allclose(
+        nd.dot(a3, b).asnumpy(), np.tensordot(a3.asnumpy(), b.asnumpy(), axes=1), rtol=1e-5)
+
+
+def test_batch_dot():
+    a = np.random.rand(4, 2, 3).astype(np.float32)
+    b = np.random.rand(4, 3, 5).astype(np.float32)
+    out = nd.batch_dot(nd.array(a), nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+
+
+def test_take_embedding_onehot():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2])
+    out = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    np.testing.assert_array_equal(out.asnumpy(), [[0, 1, 2], [6, 7, 8]])
+    oh = nd.one_hot(nd.array([1, 3]), depth=4)
+    np.testing.assert_array_equal(oh.asnumpy(), [[0, 1, 0, 0], [0, 0, 0, 1]])
+
+
+def test_reductions():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    assert x.sum().asscalar() == 15
+    np.testing.assert_allclose(x.sum(axis=0).asnumpy(), [3, 5, 7])
+    np.testing.assert_allclose(x.mean(axis=1).asnumpy(), [1, 4])
+    assert x.max().asscalar() == 5
+    assert nd.norm(x).asscalar() == pytest.approx(np.sqrt((np.arange(6) ** 2).sum()))
+
+
+def test_topk_sort():
+    x = nd.array([[3.0, 1.0, 2.0]])
+    np.testing.assert_array_equal(nd.topk(x, k=2).asnumpy(), [[0, 2]])
+    np.testing.assert_array_equal(nd.sort(x).asnumpy(), [[1, 2, 3]])
+    np.testing.assert_array_equal(nd.argsort(x).asnumpy(), [[1, 2, 0]])
+
+
+def test_save_load_list_dict(tmp_path):
+    f = str(tmp_path / "params.npz")
+    a, b = nd.ones((2,)), nd.zeros((3,))
+    nd.save(f, [a, b])
+    lst = nd.load(f)
+    assert len(lst) == 2 and lst[0].shape == (2,)
+    nd.save(f, {"w": a, "b": b})
+    d = nd.load(f)
+    assert set(d) == {"w", "b"}
+
+
+def test_context_placement():
+    x = nd.ones((2,), ctx=mx.cpu())
+    assert x.context.device_type == "cpu"
+    y = x.as_in_context(mx.cpu(0))
+    assert y.context == mx.cpu(0)
+
+
+def test_astype_cast():
+    x = nd.array([1.5, 2.5])
+    assert x.astype("int32").dtype == np.int32
+    assert x.astype("bfloat16").astype("float32").asnumpy()[0] == 1.5
+
+
+def test_waitall_and_wait_to_read():
+    x = nd.ones((100, 100))
+    y = nd.dot(x, x)
+    y.wait_to_read()
+    mx.waitall()
+    assert y.asnumpy()[0, 0] == 100
+
+
+def test_random_ops():
+    u = nd.random.uniform(shape=(100,))
+    assert 0 <= u.asnumpy().min() and u.asnumpy().max() <= 1
+    n = nd.random.normal(loc=0.0, scale=1.0, shape=(1000,))
+    assert abs(float(n.asnumpy().mean())) < 0.2
+    r = nd.random.randint(0, 10, shape=(50,))
+    assert r.asnumpy().min() >= 0 and r.asnumpy().max() < 10
+
+
+def test_random_seed_reproducible():
+    mx.random.seed(42)
+    a = nd.random.uniform(shape=(5,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.uniform(shape=(5,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_where_clip():
+    x = nd.array([-1.0, 0.5, 2.0])
+    np.testing.assert_allclose(nd.clip(x, 0.0, 1.0).asnumpy(), [0, 0.5, 1])
+    cond = nd.array([1.0, 0.0, 1.0])
+    np.testing.assert_allclose(nd.where(cond, x, -x).asnumpy(), [-1, -0.5, 2])
