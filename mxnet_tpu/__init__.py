@@ -21,6 +21,12 @@ from . import autograd
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import NDArray
+from . import initializer
+from . import initializer as init
+from . import lr_scheduler
+from . import optimizer
+from . import metric
+from . import gluon
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,14 @@
+"""mx.gluon — the imperative/hybrid high-level API (ref: python/mxnet/gluon/)."""
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import model_zoo
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Parameter, Constant, ParameterDict
+from .trainer import Trainer
+from . import parameter
+from . import contrib
+
+__all__ = ["nn", "rnn", "loss", "data", "model_zoo", "Block", "HybridBlock",
+           "SymbolBlock", "Parameter", "Constant", "ParameterDict", "Trainer"]

@@ -1,0 +1,2 @@
+"""gluon.contrib (ref: python/mxnet/gluon/contrib/) — experimental blocks."""
+from . import nn

@@ -1,0 +1,29 @@
+"""gluon.contrib.nn (ref: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from ...nn.basic_layers import SyncBatchNorm
+from ...block import HybridBlock
+
+__all__ = ["SyncBatchNorm", "Concurrent", "HybridConcurrent"]
+
+
+class HybridConcurrent(HybridBlock):
+    """ref: contrib/nn — HybridConcurrent (parallel branches, concat)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def infer_shape(self, *args):
+        pass
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        outs = [b(x) for b in self._children.values()]
+        return nd.concat(*outs, dim=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    """ref: contrib/nn — Concurrent."""

@@ -1,0 +1,6 @@
+"""gluon.data (ref: python/mxnet/gluon/data/)."""
+from . import vision
+from .dataloader import DataLoader, default_batchify_fn
+from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
+from .sampler import (BatchSampler, IntervalSampler, RandomSampler, Sampler,
+                      SequentialSampler)

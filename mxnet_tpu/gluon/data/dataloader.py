@@ -1,0 +1,130 @@
+"""DataLoader.
+
+ref: python/mxnet/gluon/data/dataloader.py — class DataLoader,
+_MultiWorkerIter (multiprocessing workers + batchify + pin_memory).
+
+TPU-native: workers produce numpy batches (host); `device_put` to HBM happens
+once per batch on read.  For the highest-throughput input path use the C++
+pipeline (mxnet_tpu.io) which decodes+augments off the Python GIL — this class
+matches the reference's flexible python path.
+"""
+from __future__ import annotations
+
+import io
+import multiprocessing as mp
+import pickle
+import sys
+
+import numpy as np
+
+from ...ndarray import NDArray
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """ref: default_batchify_fn — stack samples into a batch."""
+    if isinstance(data[0], NDArray):
+        from ... import ndarray as nd
+        return nd.stack(*data, axis=0)
+    if isinstance(data[0], tuple):
+        data = zip(*data)
+        return [default_batchify_fn(i) for i in data]
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return arr
+
+
+default_mp_batchify_fn = default_batchify_fn  # no shared-mem rewrap needed
+
+
+def _as_numpy_sample(s):
+    if isinstance(s, NDArray):
+        return s.asnumpy()
+    if isinstance(s, tuple):
+        return tuple(_as_numpy_sample(x) for x in s)
+    return s
+
+
+def _worker_fn(dataset, key, samples, batchify_fn):
+    batch = batchify_fn([_as_numpy_sample(dataset[i]) for i in samples])
+    return key, batch
+
+
+class DataLoader:
+    """ref: class DataLoader."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError("batch_size required when batch_sampler is None")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle \
+                    else SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle and sampler are mutually exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size, last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError("batch_sampler is mutually exclusive with "
+                             "batch_size/shuffle/sampler/last_batch")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
+        self._thread_pool = thread_pool
+        self._pool = None
+        if self._num_workers > 0:
+            if thread_pool:
+                from multiprocessing.dummy import Pool
+                self._pool = Pool(self._num_workers)
+            else:
+                ctx = mp.get_context("fork") if sys.platform != "win32" else mp.get_context()
+                self._pool = ctx.Pool(self._num_workers)
+
+    def __iter__(self):
+        if self._pool is None:
+            for samples in self._batch_sampler:
+                yield self._batchify_fn(
+                    [_as_numpy_sample(self._dataset[i]) for i in samples])
+            return
+        # multi-worker: async map with bounded prefetch (ref: _MultiWorkerIter)
+        results = {}
+        order = iter(range(10 ** 12))
+        issued = {}
+        batches = list(self._batch_sampler)
+        next_issue = 0
+        next_yield = 0
+
+        def _issue():
+            nonlocal next_issue
+            if next_issue < len(batches):
+                key = next_issue
+                issued[key] = self._pool.apply_async(
+                    _worker_fn, (self._dataset, key, batches[key], self._batchify_fn))
+                next_issue += 1
+
+        for _ in range(self._prefetch or 1):
+            _issue()
+        while next_yield < len(batches):
+            key, batch = issued[next_yield].get(self._timeout)
+            del issued[next_yield]
+            _issue()
+            next_yield += 1
+            yield batch
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()

@@ -1,0 +1,208 @@
+"""Vision transforms.
+
+ref: python/mxnet/gluon/data/vision/transforms.py — Compose, Cast, ToTensor,
+Normalize, Resize, CenterCrop, RandomResizedCrop, RandomFlipLeftRight, ...
+Transforms are Blocks operating on HWC uint8 images (numpy or NDArray);
+the heavy per-batch math (normalize etc.) runs as XLA ops when given NDArrays.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ....ndarray import NDArray
+from ...block import Block, HybridBlock
+from ...nn.basic_layers import HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "CropResize", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast"]
+
+
+def _to_nd(x):
+    from .... import ndarray as nd
+    if isinstance(x, np.ndarray):
+        return nd.array(x, dtype=x.dtype if x.dtype != np.float64 else np.float32)
+    return x
+
+
+class Compose(HybridSequential):
+    """ref: class Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+    def __call__(self, x, *args):
+        x = _to_nd(x)
+        for b in self._children.values():
+            x = b(x)
+        return (x,) + args if args else x
+
+
+class Cast(HybridBlock):
+    """ref: class Cast."""
+
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def infer_shape(self, *a):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.cast(_to_nd(x), dtype=self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """ref: class ToTensor — HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def infer_shape(self, *a):
+        pass
+
+    def __call__(self, x, *args):
+        out = super().__call__(_to_nd(x))
+        return (out,) + args if args else out
+
+    def hybrid_forward(self, F, x):
+        return F.image_to_tensor(x)
+
+
+class Normalize(HybridBlock):
+    """ref: class Normalize — (x - mean) / std per channel, CHW."""
+
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = mean
+        self._std = std
+
+    def infer_shape(self, *a):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.image_normalize(_to_nd(x), mean=self._mean, std=self._std)
+
+
+class Resize(Block):
+    """ref: class Resize — bilinear HWC resize."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        return nd.image_resize(_to_nd(x), size=self._size)
+
+
+class CenterCrop(Block):
+    """ref: class CenterCrop."""
+
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+
+    def forward(self, x):
+        x = _to_nd(x)
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        from .... import ndarray as nd
+        return nd.image_crop(x, x=x0, y=y0, width=min(w, W), height=min(h, H))
+
+
+class CropResize(Block):
+    """ref: class CropResize."""
+
+    def __init__(self, x, y, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._args = (x, y, width, height)
+        self._size = size
+
+    def forward(self, data):
+        from .... import ndarray as nd
+        x, y, w, h = self._args
+        out = nd.image_crop(_to_nd(data), x=x, y=y, width=w, height=h)
+        if self._size:
+            out = nd.image_resize(out, size=self._size)
+        return out
+
+
+class RandomResizedCrop(Block):
+    """ref: class RandomResizedCrop — random area+ratio crop then resize."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = size if isinstance(size, (tuple, list)) else (size, size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        x = _to_nd(x)
+        H, W = x.shape[-3], x.shape[-2]
+        area = H * W
+        for _ in range(10):
+            target_area = np.random.uniform(*self._scale) * area
+            log_ratio = (np.log(self._ratio[0]), np.log(self._ratio[1]))
+            ar = np.exp(np.random.uniform(*log_ratio))
+            w = int(round(np.sqrt(target_area * ar)))
+            h = int(round(np.sqrt(target_area / ar)))
+            if w <= W and h <= H:
+                x0 = np.random.randint(0, W - w + 1)
+                y0 = np.random.randint(0, H - h + 1)
+                out = nd.image_crop(x, x=x0, y=y0, width=w, height=h)
+                return nd.image_resize(out, size=self._size)
+        return nd.image_resize(x, size=self._size)  # fallback
+
+
+class RandomFlipLeftRight(HybridBlock):
+    """ref: class RandomFlipLeftRight."""
+
+    def infer_shape(self, *a):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.image_random_flip_left_right(_to_nd(x))
+
+
+class RandomFlipTopBottom(Block):
+    """ref: class RandomFlipTopBottom."""
+
+    def forward(self, x):
+        from .... import ndarray as nd
+        if np.random.rand() < 0.5:
+            return nd.image_flip_top_bottom(_to_nd(x))
+        return _to_nd(x)
+
+
+class RandomBrightness(HybridBlock):
+    """ref: class RandomBrightness."""
+
+    def __init__(self, brightness):
+        super().__init__()
+        self._args = (max(0, 1 - brightness), 1 + brightness)
+
+    def infer_shape(self, *a):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.image_random_brightness(_to_nd(x), min_factor=self._args[0],
+                                         max_factor=self._args[1])
+
+
+class RandomContrast(HybridBlock):
+    """ref: class RandomContrast."""
+
+    def __init__(self, contrast):
+        super().__init__()
+        self._args = (max(0, 1 - contrast), 1 + contrast)
+
+    def infer_shape(self, *a):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.image_random_contrast(_to_nd(x), min_factor=self._args[0],
+                                       max_factor=self._args[1])

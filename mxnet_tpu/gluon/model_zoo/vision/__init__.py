@@ -1,0 +1,38 @@
+"""gluon.model_zoo.vision (ref: python/mxnet/gluon/model_zoo/vision/__init__.py
+— get_model registry over resnet/vgg/alexnet/densenet/squeezenet/mobilenet)."""
+from .alexnet import *
+from .densenet import *
+from .mobilenet import *
+from .resnet import *
+from .squeezenet import *
+from .vgg import *
+
+from . import alexnet as _alexnet_mod  # noqa: F401
+
+
+def get_model(name, **kwargs):
+    """ref: vision/__init__.py — get_model(name)."""
+    models = {
+        "resnet18_v1": resnet18_v1, "resnet34_v1": resnet34_v1,
+        "resnet50_v1": resnet50_v1, "resnet101_v1": resnet101_v1,
+        "resnet152_v1": resnet152_v1,
+        "resnet18_v2": resnet18_v2, "resnet34_v2": resnet34_v2,
+        "resnet50_v2": resnet50_v2, "resnet101_v2": resnet101_v2,
+        "resnet152_v2": resnet152_v2,
+        "vgg11": vgg11, "vgg13": vgg13, "vgg16": vgg16, "vgg19": vgg19,
+        "vgg11_bn": vgg11_bn, "vgg13_bn": vgg13_bn, "vgg16_bn": vgg16_bn,
+        "vgg19_bn": vgg19_bn,
+        "alexnet": alexnet,
+        "densenet121": densenet121, "densenet161": densenet161,
+        "densenet169": densenet169, "densenet201": densenet201,
+        "squeezenet1.0": squeezenet1_0, "squeezenet1.1": squeezenet1_1,
+        "mobilenet1.0": mobilenet1_0, "mobilenet0.75": mobilenet0_75,
+        "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
+        "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
+        "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+    }
+    name = name.lower()
+    if name not in models:
+        raise ValueError(
+            f"model '{name}' is not in the zoo ({sorted(models)})")
+    return models[name](**kwargs)

@@ -1,0 +1,10 @@
+"""gluon.nn — neural network layers (ref: python/mxnet/gluon/nn/)."""
+from ..block import Block, HybridBlock, SymbolBlock
+from .activations import *
+from .basic_layers import *
+from .conv_layers import *
+
+from . import activations, basic_layers, conv_layers
+
+__all__ = (["Block", "HybridBlock", "SymbolBlock"]
+           + activations.__all__ + basic_layers.__all__ + conv_layers.__all__)

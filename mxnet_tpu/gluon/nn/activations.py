@@ -1,0 +1,123 @@
+"""Gluon activation layers.
+
+ref: python/mxnet/gluon/nn/activations.py — Activation, LeakyReLU, PReLU,
+ELU, SELU, Swish, GELU.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish", "GELU",
+           "SiLU"]
+
+
+class Activation(HybridBlock):
+    """ref: class Activation → Activation op."""
+
+    def __init__(self, activation, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._act_type = activation
+
+    def _alias(self):
+        return self._act_type if hasattr(self, "_act_type") else "activation"
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    """ref: class LeakyReLU → LeakyReLU op."""
+
+    def __init__(self, alpha, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._alpha = alpha
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+    def __repr__(self):
+        return f"LeakyReLU({self._alpha})"
+
+
+class PReLU(HybridBlock):
+    """ref: class PReLU — learned negative slope."""
+
+    def __init__(self, alpha_initializer="zeros", in_channels=1, prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.alpha = self.params.get("alpha", shape=(in_channels,),
+                                     init=alpha_initializer)
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, alpha, act_type="prelu")
+
+
+class ELU(HybridBlock):
+    """ref: class ELU."""
+
+    def __init__(self, alpha=1.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._alpha = alpha
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    """ref: class SELU."""
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    """ref: class GELU (BERT's activation)."""
+
+    def __init__(self, approximate=False, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._approx = approximate
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        if self._approx:
+            return F.gelu_tanh(x)
+        return F.LeakyReLU(x, act_type="gelu")
+
+
+class Swish(HybridBlock):
+    """ref: class Swish — x * sigmoid(beta x)."""
+
+    def __init__(self, beta=1.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._beta = beta
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        if self._beta == 1.0:
+            return F.silu(x)
+        return x * F.sigmoid(self._beta * x)
+
+
+SiLU = Swish

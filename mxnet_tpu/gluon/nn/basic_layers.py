@@ -1,0 +1,379 @@
+"""Gluon basic layers.
+
+ref: python/mxnet/gluon/nn/basic_layers.py — Sequential, HybridSequential,
+Dense, Dropout, BatchNorm, InstanceNorm, LayerNorm, GroupNorm, Embedding,
+Flatten, Lambda, HybridLambda.  Compute lowers to the framework op library
+(mxnet_tpu/ops/nn.py) — XLA fuses the elementwise pieces into the matmuls.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ... import autograd as _autograd
+from ...ndarray import NDArray
+from ..block import Block, HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "SyncBatchNorm", "InstanceNorm", "LayerNorm", "GroupNorm", "RMSNorm",
+           "Embedding", "Flatten", "Lambda", "HybridLambda", "Identity"]
+
+
+class Sequential(Block):
+    """ref: class Sequential — stack of Blocks run in order."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        vals = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            for b in vals[key]:
+                net.add(b)
+            return net
+        return vals[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+    def hybridize(self, active=True, **kwargs):
+        for c in self._children.values():
+            c.hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """ref: class HybridSequential — compiled as ONE XLA computation when
+    hybridized (CachedOp over the whole stack)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def forward(self, x, *args):
+        for b in self._children.values():
+            x = b(x)
+        return x
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, key):
+        vals = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)(prefix=self._prefix)
+            for b in vals[key]:
+                net.add(b)
+            return net
+        return vals[key]
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """ref: class Dense → FullyConnected op (MXU matmul).
+    Weight layout (units, in_units) matches the reference."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None, bias_initializer="zeros",
+                 in_units=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._flatten = flatten
+        self._act_type = activation
+        self.weight = self.params.get("weight", shape=(units, in_units),
+                                      init=weight_initializer, dtype=dtype,
+                                      allow_deferred_init=True)
+        self.bias = (self.params.get("bias", shape=(units,),
+                                     init=bias_initializer, dtype=dtype,
+                                     allow_deferred_init=True)
+                     if use_bias else None)
+
+    def infer_shape(self, x, *args):
+        in_units = int(np.prod(x.shape[1:])) if self._flatten else x.shape[-1]
+        self.weight.shape = (self._units, in_units)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                               no_bias=bias is None, flatten=self._flatten)
+        if self._act_type:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return (f"Dense({shape[1] if shape and len(shape) > 1 else None} -> "
+                f"{self._units}, "
+                f"{self._act_type or 'linear'})")
+
+
+class Dropout(HybridBlock):
+    """ref: class Dropout → Dropout op (inverted, train-mode only)."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = tuple(axes)
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        if self._rate == 0:
+            return x
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """ref: class BatchNorm → BatchNorm op.
+
+    Running stats are explicit op outputs written back to the aux Parameters
+    (the reference mutates them through the engine; see block.py aux-state
+    handling for how this survives jit capture).
+    """
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._momentum = momentum
+        self._eps = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer,
+                                     allow_deferred_init=True,
+                                     differentiable=scale)
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer,
+                                    allow_deferred_init=True,
+                                    differentiable=center)
+        self.running_mean = self.params.get("running_mean", shape=(in_channels,),
+                                            init=running_mean_initializer,
+                                            allow_deferred_init=True,
+                                            differentiable=False)
+        self.running_var = self.params.get("running_var", shape=(in_channels,),
+                                           init=running_variance_initializer,
+                                           allow_deferred_init=True,
+                                           differentiable=False)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape = (c,)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        out, new_mm, new_mv = F.BatchNorm(
+            x, gamma, beta, running_mean, running_var,
+            eps=self._eps, momentum=self._momentum, fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis)
+        if _autograd.is_training() and not self._use_global_stats:
+            self.running_mean._data = NDArray(new_mm.detach()._data)
+            self.running_var._data = NDArray(new_mv.detach()._data)
+        return out
+
+    def __repr__(self):
+        return (f"BatchNorm(axis={self._axis}, momentum={self._momentum}, "
+                f"eps={self._eps}, in_channels={self.gamma.shape[0] if self.gamma.shape else None})")
+
+
+class SyncBatchNorm(BatchNorm):
+    """ref: gluon/contrib/nn — SyncBatchNorm (cross-device stats).
+
+    TPU-native: under pjit/shard_map the batch axis is sharded and XLA computes
+    the mean/var reduction as a cross-replica collective automatically when the
+    reduction spans the sharded axis, so this IS BatchNorm under SPMD; kept as
+    a distinct class for API parity and for explicit-mesh training loops.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        kwargs.setdefault("epsilon", 1e-5)
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class InstanceNorm(HybridBlock):
+    """ref: class InstanceNorm → InstanceNorm op."""
+
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._eps = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer, allow_deferred_init=True,
+                                     differentiable=scale)
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer, allow_deferred_init=True,
+                                    differentiable=center)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._eps)
+
+
+class LayerNorm(HybridBlock):
+    """ref: class LayerNorm → LayerNorm op (fused by XLA)."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._eps = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer, allow_deferred_init=True,
+                                     differentiable=scale)
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer, allow_deferred_init=True,
+                                    differentiable=center)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma.shape = (c,)
+        self.beta.shape = (c,)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._eps)
+
+
+class GroupNorm(HybridBlock):
+    """ref: class GroupNorm → GroupNorm op."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._groups = num_groups
+        self._eps = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init=gamma_initializer, allow_deferred_init=True,
+                                     differentiable=scale)
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init=beta_initializer, allow_deferred_init=True,
+                                    differentiable=center)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[1],)
+        self.beta.shape = (x.shape[1],)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta, num_groups=self._groups, eps=self._eps)
+
+
+class RMSNorm(HybridBlock):
+    """TPU-era extension (modern-LM norm; no reference analogue)."""
+
+    def __init__(self, axis=-1, epsilon=1e-6, in_channels=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._axis = axis
+        self._eps = epsilon
+        self.gamma = self.params.get("gamma", shape=(in_channels,), init="ones",
+                                     allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        self.gamma.shape = (x.shape[self._axis],)
+
+    def hybrid_forward(self, F, x, gamma):
+        return F.RMSNorm(x, gamma, axis=self._axis, eps=self._eps)
+
+
+class Embedding(HybridBlock):
+    """ref: class Embedding → Embedding op (gather; one-hot matmul on MXU for
+    small vocabs is XLA's choice)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = self.params.get("weight", shape=(input_dim, output_dim),
+                                      init=weight_initializer, dtype=dtype)
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    """ref: class Flatten."""
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return F.flatten(x)
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Identity(HybridBlock):
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class Lambda(Block):
+    """ref: class Lambda — wrap a function of NDArrays."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as ndmod
+            function = getattr(ndmod, function)
+        self._func = function
+
+    def forward(self, *args):
+        return self._func(*args)
+
+
+class HybridLambda(HybridBlock):
+    """ref: class HybridLambda."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            from ... import ndarray as ndmod
+            fname = function
+            function = lambda F, *args: getattr(F, fname)(*args)  # noqa: E731
+        self._func = function
+
+    def infer_shape(self, *args):
+        pass
+
+    def hybrid_forward(self, F, *args):
+        return self._func(F, *args)

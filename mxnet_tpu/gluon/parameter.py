@@ -1,0 +1,298 @@
+"""Gluon Parameter / ParameterDict.
+
+ref: python/mxnet/gluon/parameter.py — class Parameter (deferred init on first
+forward via shape-0 wildcards, grad_req, initialize/set_data/zero_grad),
+class ParameterDict (prefix-scoped registry, get(), save/load).
+
+TPU-native notes: a Parameter owns one NDArray per framework (no per-device
+replica list — replication is a sharding annotation, see mxnet_tpu.parallel);
+``list_data()`` is kept for API parity and returns a one-element list. Casting
+to bf16 for AMP is ``cast()``, matching the reference.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import initializer as init_mod
+from ..base import MXNetError, dtype_np
+from ..context import current_context
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _nd_mod
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """ref: gluon/parameter.py — raised when data() is read before shapes known."""
+
+
+class Parameter:
+    """A weight/bias/state tensor of a Block (ref: class Parameter)."""
+
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._data: Optional[NDArray] = None
+        self._deferred_init = None  # (initializer, ctx, default_init)
+        self._stype = stype
+
+    # ----------------------------------------------------------------- reqs --
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        assert req in ("write", "add", "null")
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._data.attach_grad(req)
+
+    # ----------------------------------------------------------------- init --
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """ref: Parameter.initialize — allocate + fill; defer if shape unknown."""
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = init_mod.Uniform()
+        if ctx is None:
+            ctx = current_context()
+        if self.shape is None or any(s == 0 for s in self.shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise ValueError(
+                f"cannot initialize parameter '{self.name}' with unknown shape "
+                f"{self.shape}; set allow_deferred_init=True or give a full shape")
+        self._finish_init(init, ctx, default_init)
+
+    def _finish_init(self, init, ctx, default_init):
+        initializer = init_mod.create(init if init is not None else
+                                      (self.init if self.init is not None else default_init))
+        value = initializer(self.name, self.shape, self.dtype)
+        self._data = NDArray(value, ctx=ctx)
+        if self._grad_req != "null":
+            self._data.attach_grad(self._grad_req)
+        self._deferred_init = None
+
+    def _finish_deferred_init(self, inferred_shape=None):
+        """Called by layers at first forward once input shapes are known
+        (ref: Parameter._finish_deferred_init)."""
+        if inferred_shape is not None:
+            if self.shape is not None:
+                merged = tuple(i if s == 0 else s
+                               for s, i in zip(self.shape, inferred_shape))
+                self.shape = merged
+            else:
+                self.shape = tuple(inferred_shape)
+        if self._deferred_init is None:
+            raise DeferredInitializationError(
+                f"parameter '{self.name}' was not initialize()d")
+        init, ctx, default_init = self._deferred_init
+        self._finish_init(init, ctx, default_init)
+
+    # ----------------------------------------------------------------- data --
+    def data(self, ctx=None):
+        """ref: Parameter.data — the NDArray, raising if deferred/uninitialised."""
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"parameter '{self.name}' deferred-init pending: run a forward "
+                    f"pass (or pass in_units/in_channels) before accessing data()")
+            raise RuntimeError(
+                f"parameter '{self.name}' has not been initialized; "
+                f"call .initialize() first")
+        return self._data
+
+    def list_data(self):
+        return [self.data()]
+
+    def set_data(self, data):
+        arr = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        if self._data is None:
+            self.shape = tuple(arr.shape)
+            self._data = NDArray(arr)
+            if self._grad_req != "null":
+                self._data.attach_grad(self._grad_req)
+            self._deferred_init = None
+            return
+        if tuple(arr.shape) != self.shape:
+            raise ValueError(
+                f"shape mismatch for '{self.name}': {tuple(arr.shape)} vs {self.shape}")
+        self._data._data = arr.astype(self._data._data.dtype)
+
+    def grad(self, ctx=None):
+        d = self.data(ctx)
+        if d.grad is None:
+            raise RuntimeError(f"parameter '{self.name}' has grad_req='null'")
+        return d.grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def zero_grad(self):
+        if self._data is not None and self._data.grad is not None:
+            g = self._data.grad
+            g._data = jnp.zeros_like(g._data)
+
+    def reset_ctx(self, ctx):
+        pass  # single logical device; placement is sharding (mxnet_tpu.parallel)
+
+    def list_ctx(self):
+        return [self._data.context] if self._data is not None else []
+
+    def cast(self, dtype):
+        """ref: Parameter.cast — used by AMP to make bf16 master copies."""
+        self.dtype = dtype
+        if self._data is not None:
+            self._data._data = self._data._data.astype(dtype_np(dtype))
+            if self._data.grad is not None:
+                self._data.attach_grad(self._grad_req)
+
+    def var(self):
+        return self.data()
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+
+class Constant(Parameter):
+    """Non-differentiable parameter holding a fixed value (ref: class Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, np.ndarray):
+            value = np.asarray(value.asnumpy() if isinstance(value, NDArray) else value)
+        self.value = value
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype.name,
+                         init=init_mod.Constant(0))
+
+    def _finish_init(self, init, ctx, default_init):
+        self._data = NDArray(jnp.asarray(self.value), ctx=ctx)
+        self._deferred_init = None
+
+
+class ParameterDict:
+    """Prefix-scoped parameter registry (ref: class ParameterDict)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = {}
+        self._shared = shared
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, k):
+        return k in self._params
+
+    def __getitem__(self, k):
+        return self._params[k]
+
+    def get(self, name, **kwargs):
+        """Create-or-retrieve ``prefix+name`` (ref: ParameterDict.get)."""
+        full = self._prefix + name
+        if full in self._params:
+            p = self._params[full]
+            for k, v in kwargs.items():
+                if v is not None and getattr(p, k, None) in (None, (), 0):
+                    setattr(p, k, v)
+            return p
+        if self._shared is not None and full in self._shared:
+            self._params[full] = self._shared[full]
+            return self._params[full]
+        p = Parameter(full, **kwargs)
+        self._params[full] = p
+        return p
+
+    def get_constant(self, name, value=None):
+        full = self._prefix + name
+        if full not in self._params:
+            self._params[full] = Constant(full, value)
+        return self._params[full]
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise ValueError(f"duplicate parameter name '{k}'")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        for p in self._params.values():
+            p.initialize(init=None, ctx=ctx, default_init=init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self._params.values():
+            p.zero_grad()
+
+    def setattr(self, name, value):
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def reset_ctx(self, ctx):
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def save(self, filename, strip_prefix=""):
+        """ref: ParameterDict.save — via the ndarray container format."""
+        from .. import ndarray as nd
+        d = {}
+        for name, p in self._params.items():
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            d[name] = p.data()
+        nd.save(filename, d)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from .. import ndarray as nd
+        loaded = nd.load(filename)
+        if restore_prefix:
+            loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        for name, p in self._params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise ValueError(f"parameter '{name}' missing in file {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(self._params)
+            if extra:
+                raise ValueError(f"file {filename} has extra parameters {sorted(extra)}")
+
+    def __repr__(self):
+        body = "\n".join(f"  {p!r}" for p in self._params.values())
+        return f"ParameterDict '{self._prefix}' (\n{body}\n)"

@@ -1,0 +1,357 @@
+"""Unfused recurrent cells.
+
+ref: python/mxnet/gluon/rnn/rnn_cell.py — RecurrentCell, RNNCell, LSTMCell,
+GRUCell, SequentialRNNCell, DropoutCell, ZoneoutCell, ResidualCell,
+BidirectionalCell; unroll().  For long sequences prefer the fused layers
+(rnn_layer.py) whose time loop is a compiled lax.scan; unroll() here is the
+reference-style Python loop (it inlines fully under hybridize).
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ZoneoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """ref: class RecurrentCell."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for c in self._children.values():
+            if hasattr(c, "reset"):
+                c.reset()
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            states.append(nd.zeros(info["shape"], **kwargs))
+        return states
+
+    def infer_shape(self, *args):
+        pass
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """ref: RecurrentCell.unroll — python time loop, inlined by jit."""
+        from ... import ndarray as nd
+        self.reset()
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            batch = inputs.shape[0] if axis == 1 else inputs.shape[1]
+            seq = [x.squeeze(axis=axis) for x in
+                   inputs.split(num_outputs=length, axis=axis, squeeze_axis=False)]
+        states = begin_state if begin_state is not None else self.begin_state(batch)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if valid_length is not None:
+            m = nd.SequenceMask(nd.stack(*outputs, axis=0),
+                                sequence_length=valid_length,
+                                use_sequence_length=True)
+            outputs = [m.slice_axis(axis=0, begin=t, end=t + 1).squeeze(axis=0)
+                       for t in range(length)]
+        if merge_outputs:
+            outputs = nd.stack(*outputs, axis=axis)
+        return outputs, states
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        return super().__call__(inputs, states)
+
+
+class RNNCell(RecurrentCell):
+    """ref: class RNNCell — single-gate cell."""
+
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        self._input_size = input_size
+        self.i2h_weight = self.params.get("i2h_weight", shape=(hidden_size, input_size),
+                                          init=i2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight", shape=(hidden_size, hidden_size),
+                                          init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(hidden_size,),
+                                        init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(hidden_size,),
+                                        init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    """ref: class LSTMCell (gate order i,f,g,o matching the fused op)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get("i2h_weight",
+                                          shape=(4 * hidden_size, input_size),
+                                          init=i2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight",
+                                          shape=(4 * hidden_size, hidden_size),
+                                          init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(4 * hidden_size,),
+                                        init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(4 * hidden_size,),
+                                        init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * self._hidden_size)
+        gates = i2h + h2h
+        ig, fg, gg, og = gates.split(num_outputs=4, axis=-1)
+        i = ig.sigmoid()
+        f = fg.sigmoid()
+        g = gg.tanh()
+        o = og.sigmoid()
+        c = f * states[1] + i * g
+        h = o * c.tanh()
+        return h, [h, c]
+
+
+class GRUCell(RecurrentCell):
+    """ref: class GRUCell (cuDNN gate order r,z,n)."""
+
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        self.i2h_weight = self.params.get("i2h_weight",
+                                          shape=(3 * hidden_size, input_size),
+                                          init=i2h_weight_initializer,
+                                          allow_deferred_init=True)
+        self.h2h_weight = self.params.get("h2h_weight",
+                                          shape=(3 * hidden_size, hidden_size),
+                                          init=h2h_weight_initializer)
+        self.i2h_bias = self.params.get("i2h_bias", shape=(3 * hidden_size,),
+                                        init=i2h_bias_initializer)
+        self.h2h_bias = self.params.get("h2h_bias", shape=(3 * hidden_size,),
+                                        init=h2h_bias_initializer)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=3 * self._hidden_size)
+        i_r, i_z, i_n = i2h.split(num_outputs=3, axis=-1)
+        h_r, h_z, h_n = h2h.split(num_outputs=3, axis=-1)
+        r = (i_r + h_r).sigmoid()
+        z = (i_z + h_z).sigmoid()
+        n = (i_n + r * h_n).tanh()
+        h = (1 - z) * n + z * states[0]
+        return h, [h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """ref: class SequentialRNNCell — stack cells."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        out = []
+        for c in self._children.values():
+            out.extend(c.state_info(batch_size))
+        return out
+
+    def begin_state(self, batch_size=0, **kwargs):
+        out = []
+        for c in self._children.values():
+            out.extend(c.begin_state(batch_size, **kwargs))
+        return out
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        next_states = []
+        p = 0
+        for c in self._children.values():
+            n = len(c.state_info())
+            inputs, st = c(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+
+class ModifierCell(RecurrentCell):
+    """ref: class ModifierCell."""
+
+    def __init__(self, base_cell):
+        super().__init__(prefix=None, params=None)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size, **kwargs)
+
+
+class DropoutCell(RecurrentCell):
+    """ref: class DropoutCell."""
+
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, inputs, states):
+        if self._rate > 0:
+            inputs = F.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class ZoneoutCell(ModifierCell):
+    """ref: class ZoneoutCell — stochastic state preservation."""
+
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def __call__(self, inputs, states):
+        from ... import ndarray as nd
+        from ... import autograd
+        out, next_states = self.base_cell(inputs, states)
+        if autograd.is_training():
+            if self.zoneout_outputs > 0:
+                mask = nd.random.bernoulli(p=1 - self.zoneout_outputs,
+                                           shape=out.shape)
+                prev = self._prev_output if self._prev_output is not None \
+                    else nd.zeros(out.shape)
+                out = mask * out + (1 - mask) * prev
+            if self.zoneout_states > 0:
+                mixed = []
+                for new, old in zip(next_states, states):
+                    mask = nd.random.bernoulli(p=1 - self.zoneout_states,
+                                               shape=new.shape)
+                    mixed.append(mask * new + (1 - mask) * old)
+                next_states = mixed
+        self._prev_output = out
+        return out, next_states
+
+
+class ResidualCell(ModifierCell):
+    """ref: class ResidualCell."""
+
+    def __call__(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class BidirectionalCell(RecurrentCell):
+    """ref: class BidirectionalCell — used with unroll only."""
+
+    def __init__(self, l_cell, r_cell, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return (self.l_cell.state_info(batch_size)
+                + self.r_cell.state_info(batch_size))
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return (self.l_cell.begin_state(batch_size, **kwargs)
+                + self.r_cell.begin_state(batch_size, **kwargs))
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell supports unroll() only")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+        states = begin_state
+        nl = len(self.l_cell.state_info())
+        l_states = states[:nl] if states else None
+        r_states = states[nl:] if states else None
+        l_out, l_states = self.l_cell.unroll(length, inputs, l_states, layout,
+                                             merge_outputs=False,
+                                             valid_length=valid_length)
+        if isinstance(inputs, (list, tuple)):
+            rev_inputs = list(reversed(inputs))
+        else:
+            axis = layout.find("T")
+            rev_inputs = nd.flip(inputs, axis=axis)
+        r_out, r_states = self.r_cell.unroll(length, rev_inputs, r_states,
+                                             layout, merge_outputs=False,
+                                             valid_length=valid_length)
+        outs = [nd.concat(lo, ro, dim=-1)
+                for lo, ro in zip(l_out, reversed(r_out))]
+        if merge_outputs:
+            axis = layout.find("T")
+            outs = nd.stack(*outs, axis=axis)
+        return outs, l_states + r_states

@@ -1,0 +1,141 @@
+"""Fused recurrent layers.
+
+ref: python/mxnet/gluon/rnn/rnn_layer.py — class _RNNLayer: RNN/LSTM/GRU lower
+to the single fused RNN op (src/operator/rnn.cc, cuDNN path).  Here the fused
+op is a lax.scan stack (ops/rnn.py): weights packed in cuDNN layout so
+parameter files interoperate; input projections batched into one MXU matmul
+per layer.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...ndarray import NDArray, invoke
+from ...ops.rnn import rnn_param_size, _GATES
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    """ref: rnn_layer.py — _RNNLayer."""
+
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size=0, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", dtype="float32", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert layout in ("TNC", "NTC"), "layout must be TNC or NTC"
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._dtype = dtype
+        # Packed cuDNN-layout parameter vector (ref: rnn-inl.h — GetParamSize).
+        psize = (rnn_param_size(mode, input_size, hidden_size, num_layers,
+                                bidirectional) if input_size else 0)
+        self.parameters = self.params.get(
+            "rnn_param", shape=(psize,), init=i2h_weight_initializer,
+            dtype=dtype, allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        input_size = x.shape[-1]
+        self._input_size = input_size
+        self.parameters.shape = (rnn_param_size(
+            self._mode, input_size, self._hidden_size, self._num_layers,
+            self._dir == 2),)
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        """ref: _RNNLayer.begin_state."""
+        from ... import ndarray as nd
+        states = []
+        for info in self.state_info(batch_size):
+            shape = info["shape"]
+            states.append(nd.zeros(shape, dtype=self._dtype))
+        return states
+
+    def hybrid_forward(self, F, x, *states, **params):
+        parameters = params["parameters"]
+        if self._layout == "NTC":
+            x = F.swapaxes(x, dim1=0, dim2=1)
+        batch = x.shape[1]
+        if not states:
+            states = self._make_zero_states(F, batch)
+        elif len(states) == 1 and isinstance(states[0], (list, tuple)):
+            states = tuple(states[0])
+        outs = F.RNN(x, parameters, *states,
+                     state_size=self._hidden_size,
+                     num_layers=self._num_layers,
+                     bidirectional=self._dir == 2,
+                     mode=self._mode, p=self._dropout,
+                     state_outputs=True)
+        out, new_states = outs[0], list(outs[1:])
+        if self._layout == "NTC":
+            out = F.swapaxes(out, dim1=0, dim2=1)
+        return out, new_states
+
+    def _make_zero_states(self, F, batch):
+        from ... import ndarray as nd
+        infos = self.state_info(batch)
+        return tuple(nd.zeros(i["shape"], dtype=self._dtype) for i in infos)
+
+    def __call__(self, x, states=None, **kwargs):
+        if states is None:
+            out, _ = super().__call__(x)
+            return out
+        if isinstance(states, (list, tuple)):
+            return super().__call__(x, *states)
+        return super().__call__(x, states)
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._input_size} -> "
+                f"{self._hidden_size}, {self._layout}, "
+                f"num_layers={self._num_layers})")
+
+
+class RNN(_RNNLayer):
+    """ref: class RNN (vanilla relu/tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers, layout,
+                         dropout, bidirectional, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]
+
+
+class LSTM(_RNNLayer):
+    """ref: class LSTM — the PTB language-model hot path."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        return [{"shape": shape, "__layout__": "LNC"},
+                {"shape": shape, "__layout__": "LNC"}]
+
+
+class GRU(_RNNLayer):
+    """ref: class GRU."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size), "__layout__": "LNC"}]

@@ -1,0 +1,151 @@
+"""Gluon Trainer.
+
+ref: python/mxnet/gluon/trainer.py — class Trainer: owns an Optimizer, one
+state per parameter, drives kvstore push/pull around the optimizer update.
+
+TPU-native: gradient "aggregation" over the data-parallel axis happens inside
+the compiled step as an XLA collective (psum over the mesh 'dp' axis — see
+mxnet_tpu.parallel) or, in single-chip eager mode, is the identity.  KVStore
+semantics (update_on_kvstore, push/pull ordering) are preserved through the
+mxnet_tpu.kvstore module when one is passed.
+"""
+from __future__ import annotations
+
+from .. import optimizer as opt_mod
+from ..ndarray import NDArray
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    """ref: class Trainer."""
+
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if hasattr(params, "values"):
+            params = list(params.values())
+        self._params = []
+        self._param_names = []
+        for p in params:
+            if p.grad_req != "null":
+                self._params.append(p)
+                self._param_names.append(p.name)
+        self._scale = 1.0
+        optimizer_params = optimizer_params or {}
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                             **optimizer_params)
+        self._states = [None] * len(self._params)
+        self._states_ready = False
+        self._kvstore = None
+        self._update_on_kvstore = bool(update_on_kvstore)
+        if kvstore is not None and not isinstance(kvstore, str):
+            self._kvstore = kvstore  # a mxnet_tpu.kvstore.KVStore instance
+        self._kv_initialized = False
+
+    # --------------------------------------------------------------- state --
+    def _init_states(self):
+        for i, p in enumerate(self._params):
+            if self._states[i] is None:
+                self._states[i] = self._optimizer.create_state_multi_precision(
+                    i, p.data())
+        self._states_ready = True
+
+    def _init_kvstore(self):
+        if self._kvstore is not None and not self._kv_initialized:
+            for i, p in enumerate(self._params):
+                self._kvstore.init(i, p.data())
+            self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # --------------------------------------------------------------- steps --
+    def step(self, batch_size, ignore_stale_grad=False):
+        """ref: Trainer.step — rescale by 1/batch_size, allreduce, update."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if not self._states_ready:
+            self._init_states()
+        self._init_kvstore()
+        if self._kvstore is not None:
+            self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        """ref: Trainer.allreduce_grads (for gradient-manipulation workflows)."""
+        self._init_kvstore()
+        if self._kvstore is not None:
+            self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        for i, p in enumerate(self._params):
+            g = p.grad()
+            self._kvstore.push(i, g)
+            self._kvstore.pull(i, out=g)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """ref: Trainer.update — optimizer update only (grads already reduced)."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        if not self._states_ready:
+            self._init_states()
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, p in enumerate(self._params):
+            self._optimizer.update_multi_precision(i, p.data(), p.grad(),
+                                                   self._states[i])
+
+    def zero_grad(self):
+        for p in self._params:
+            p.zero_grad()
+
+    # ---------------------------------------------------------- checkpoints --
+    def save_states(self, fname):
+        """ref: Trainer.save_states — optimizer state dict."""
+        from .. import ndarray as nd
+        d = {}
+        for i, s in enumerate(self._states):
+            for j, arr in enumerate(_flatten_state(s)):
+                d[f"{i}.{j}"] = arr
+        d["__meta__num_update"] = nd.array([self._optimizer.num_update])
+        nd.save(fname, d)
+
+    def load_states(self, fname):
+        from .. import ndarray as nd
+        loaded = nd.load(fname)
+        if not self._states_ready:
+            self._init_states()
+        for i, s in enumerate(self._states):
+            flat = _flatten_state(s)
+            for j, arr in enumerate(flat):
+                key = f"{i}.{j}"
+                if key in loaded:
+                    arr._data = loaded[key]._data.astype(arr._data.dtype)
+        if "__meta__num_update" in loaded:
+            n = int(loaded["__meta__num_update"].asnumpy()[0])
+            self._optimizer.num_update = n
+            for i in range(len(self._params)):
+                self._optimizer._index_update_count[i] = n
+
+
+def _flatten_state(state):
+    if state is None:
+        return []
+    if isinstance(state, NDArray):
+        return [state]
+    out = []
+    for s in state:
+        out.extend(_flatten_state(s))
+    return out

@@ -1,0 +1,235 @@
+"""Weight initializers.
+
+ref: python/mxnet/initializer.py — class Initializer and the registry of
+Xavier/MSRAPrelu/Orthogonal/... . TPU-native: initializers produce values via
+the framework PRNG (threefry key splits, reproducible under seed()) and return
+jax arrays; `InitDesc`-style attribute dispatch is kept so layers can request
+special inits by parameter name suffix (ref: Initializer.__call__ dispatching
+on name endings like "weight"/"bias"/"gamma"/"beta").
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .base import dtype_np
+from . import random as _random
+
+__all__ = [
+    "Initializer", "Zero", "One", "Constant", "Uniform", "Normal", "Xavier",
+    "MSRAPrelu", "Orthogonal", "LSTMBias", "Bilinear", "register", "create",
+]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    """ref: python/mxnet/initializer.py — @register decorator."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs):
+    """Create an initializer from an instance / name / None."""
+    if init is None:
+        return Uniform(0.07)
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        name = init.lower()
+        # common plural/alias forms used throughout the reference's layers
+        aliases = {"zeros": "zero", "ones": "one", "gaussian": "normal"}
+        name = aliases.get(name, name)
+        if name not in _REGISTRY:
+            raise ValueError(f"unknown initializer '{init}'")
+        return _REGISTRY[name](**kwargs)
+    raise TypeError(f"cannot create initializer from {init!r}")
+
+
+class Initializer:
+    """Base initializer (ref: python/mxnet/initializer.py — class Initializer)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name: str, shape, dtype="float32"):
+        """Dispatch on parameter-name suffix like the reference does."""
+        if name.endswith("gamma") or name.endswith("running_var") or name.endswith("var"):
+            return self._init_one(shape, dtype)
+        if name.endswith("beta") or name.endswith("running_mean") or name.endswith("mean"):
+            return self._init_zero(shape, dtype)
+        if name.endswith("bias"):
+            return self._init_zero(shape, dtype)
+        return self.init_array(shape, dtype)
+
+    # The actual strategy for "weight-like" params; subclasses override.
+    def init_array(self, shape, dtype="float32"):
+        raise NotImplementedError
+
+    def _init_zero(self, shape, dtype):
+        return jnp.zeros(shape, dtype_np(dtype))
+
+    def _init_one(self, shape, dtype):
+        return jnp.ones(shape, dtype_np(dtype))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Zero(Initializer):
+    def init_array(self, shape, dtype="float32"):
+        return jnp.zeros(shape, dtype_np(dtype))
+
+
+@register
+class One(Initializer):
+    def init_array(self, shape, dtype="float32"):
+        return jnp.ones(shape, dtype_np(dtype))
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def init_array(self, shape, dtype="float32"):
+        return jnp.full(shape, self.value, dtype_np(dtype))
+
+    # constants apply to every suffix
+    def __call__(self, name, shape, dtype="float32"):
+        return self.init_array(shape, dtype)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def init_array(self, shape, dtype="float32"):
+        key = _random.next_key()
+        return jax.random.uniform(key, shape, jnp.float32,
+                                  minval=-self.scale, maxval=self.scale).astype(dtype_np(dtype))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def init_array(self, shape, dtype="float32"):
+        key = _random.next_key()
+        return (jax.random.normal(key, shape, jnp.float32) * self.sigma).astype(dtype_np(dtype))
+
+
+def _fan(shape, factor_type):
+    """fan_in/fan_out with conv receptive-field scaling (ref: Xavier._init_weight)."""
+    hw_scale = 1.0
+    if len(shape) < 2:
+        fan_in = fan_out = shape[0] if shape else 1
+        return fan_in, fan_out
+    if len(shape) > 2:
+        hw_scale = float(np.prod(shape[2:]))
+    fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+    return fan_in, fan_out
+
+
+@register
+class Xavier(Initializer):
+    """ref: python/mxnet/initializer.py — class Xavier."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def init_array(self, shape, dtype="float32"):
+        fan_in, fan_out = _fan(shape, self.factor_type)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise ValueError("factor_type must be avg/in/out")
+        scale = math.sqrt(self.magnitude / max(factor, 1e-12))
+        key = _random.next_key()
+        if self.rnd_type == "uniform":
+            a = jax.random.uniform(key, shape, jnp.float32, minval=-scale, maxval=scale)
+        elif self.rnd_type == "gaussian":
+            a = jax.random.normal(key, shape, jnp.float32) * scale
+        else:
+            raise ValueError("rnd_type must be uniform/gaussian")
+        return a.astype(dtype_np(dtype))
+
+
+@register
+class MSRAPrelu(Xavier):
+    """ref: class MSRAPrelu — He init with slope correction."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    """ref: class Orthogonal — SVD-orthogonalised gaussian."""
+
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def init_array(self, shape, dtype="float32"):
+        nout = shape[0]
+        nin = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        key = _random.next_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), jnp.float32, minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q.reshape(shape)).astype(dtype_np(dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """ref: class LSTMBias — forget-gate bias set to a constant (default 1)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def __call__(self, name, shape, dtype="float32"):
+        b = np.zeros(shape, dtype_np(dtype))
+        n = shape[0] // 4  # [i, f, g, o] cuDNN gate order (see ops/rnn.py)
+        b[n:2 * n] = self.forget_bias
+        return jnp.asarray(b)
+
+    init_array = __call__  # pragma: no cover - name-independent
+
+
+@register
+class Bilinear(Initializer):
+    """ref: class Bilinear — upsampling deconv weights."""
+
+    def init_array(self, shape, dtype="float32"):
+        weight = np.zeros(shape, dtype_np("float32"))
+        f = np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight, dtype_np(dtype))

@@ -1,0 +1,376 @@
+"""Evaluation metrics.
+
+ref: python/mxnet/metric.py — class EvalMetric registry (Accuracy, TopK, F1,
+MAE/MSE/RMSE, CrossEntropy, Perplexity, PearsonCorrelation, CompositeEvalMetric,
+CustomMetric).  Metrics accumulate on host in float64 (they sync via .asnumpy(),
+the reference's implicit WaitToRead point).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .ndarray import NDArray
+
+__all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
+           "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
+           "Perplexity", "PearsonCorrelation", "Loss", "Torch", "Caffe",
+           "CustomMetric", "create", "np"]
+
+_REGISTRY = {}
+
+
+def register(klass, *names):
+    for n in names or (klass.__name__.lower(),):
+        _REGISTRY[n] = klass
+    return klass
+
+
+def create(metric, *args, **kwargs):
+    """ref: metric.create."""
+    if isinstance(metric, EvalMetric):
+        return metric
+    if isinstance(metric, list):
+        c = CompositeEvalMetric()
+        for m in metric:
+            c.add(create(m, *args, **kwargs))
+        return c
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    name = metric.lower()
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown metric '{metric}'")
+    return _REGISTRY[name](*args, **kwargs)
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class EvalMetric:
+    """ref: class EvalMetric."""
+
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = name
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def update(self, labels, preds):
+        raise NotImplementedError
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, self.sum_metric / self.num_inst
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+class CompositeEvalMetric(EvalMetric):
+    """ref: class CompositeEvalMetric."""
+
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric):
+        self.metrics.append(create(metric))
+
+    def update(self, labels, preds):
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self):
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return names, values
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Accuracy(EvalMetric):
+    """ref: class Accuracy."""
+
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _to_np(pred)
+            label = _to_np(label)
+            if pred.ndim > label.ndim:
+                pred = _np.argmax(pred, axis=self.axis)
+            pred = pred.astype(_np.int64).ravel()
+            label = label.astype(_np.int64).ravel()
+            self.sum_metric += (pred == label).sum()
+            self.num_inst += len(label)
+
+
+class TopKAccuracy(EvalMetric):
+    """ref: class TopKAccuracy."""
+
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _to_np(pred)
+            label = _to_np(label).astype(_np.int64)
+            argsorted = _np.argsort(pred, axis=-1)[:, ::-1][:, :self.top_k]
+            correct = (argsorted == label.reshape(-1, 1)).any(axis=1)
+            self.sum_metric += correct.sum()
+            self.num_inst += len(label)
+
+
+class F1(EvalMetric):
+    """ref: class F1 (binary)."""
+
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.reset_stats()
+
+    def reset_stats(self):
+        self.tp = self.fp = self.fn = 0
+
+    def reset(self):
+        super().reset()
+        if hasattr(self, "tp"):
+            self.reset_stats()
+        else:
+            self.reset_stats()
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            pred = _to_np(pred)
+            label = _to_np(label).ravel().astype(_np.int64)
+            if pred.ndim > 1 and pred.shape[-1] > 1:
+                pred = _np.argmax(pred, axis=-1)
+            else:
+                pred = (pred.ravel() > 0.5).astype(_np.int64)
+            pred = pred.ravel()
+            self.tp += int(((pred == 1) & (label == 1)).sum())
+            self.fp += int(((pred == 1) & (label == 0)).sum())
+            self.fn += int(((pred == 0) & (label == 1)).sum())
+            self.num_inst += 1
+
+    def get(self):
+        prec = self.tp / max(self.tp + self.fp, 1)
+        rec = self.tp / max(self.tp + self.fn, 1)
+        f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        return self.name, f1
+
+
+class MAE(EvalMetric):
+    """ref: class MAE."""
+
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            self.sum_metric += _np.abs(label - pred.reshape(label.shape)).mean()
+            self.num_inst += 1
+
+
+class MSE(EvalMetric):
+    """ref: class MSE."""
+
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            self.sum_metric += ((label - pred.reshape(label.shape)) ** 2).mean()
+            self.num_inst += 1
+
+
+class RMSE(MSE):
+    """ref: class RMSE."""
+
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(_np.sqrt(self.sum_metric / self.num_inst))
+
+
+class CrossEntropy(EvalMetric):
+    """ref: class CrossEntropy."""
+
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype(_np.int64)
+            pred = _to_np(pred)
+            prob = pred[_np.arange(label.shape[0]), label]
+            self.sum_metric += (-_np.log(prob + self.eps)).sum()
+            self.num_inst += label.shape[0]
+
+
+class NegativeLogLikelihood(CrossEntropy):
+    """ref: class NegativeLogLikelihood."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(eps=eps, name=name, **kwargs)
+
+
+class Perplexity(EvalMetric):
+    """ref: class Perplexity (the PTB LM metric)."""
+
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity", **kwargs):
+        super().__init__(name, **kwargs)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).ravel().astype(_np.int64)
+            pred = _to_np(pred).reshape(-1, _to_np(pred).shape[-1])
+            prob = pred[_np.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = (label == self.ignore_label)
+                prob = _np.where(ignore, 1.0, prob)
+                num = (~ignore).sum()
+            else:
+                num = label.shape[0]
+            self.sum_metric += -_np.log(_np.maximum(prob, 1e-30)).sum()
+            self.num_inst += num
+
+    def get(self):
+        if self.num_inst == 0:
+            return self.name, float("nan")
+        return self.name, float(_np.exp(self.sum_metric / self.num_inst))
+
+
+class PearsonCorrelation(EvalMetric):
+    """ref: class PearsonCorrelation."""
+
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+        self._labels = []
+        self._preds = []
+
+    def reset(self):
+        super().reset()
+        self._labels, self._preds = [], []
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            self._labels.append(_to_np(label).ravel())
+            self._preds.append(_to_np(pred).ravel())
+            self.num_inst += 1
+
+    def get(self):
+        if not self._labels:
+            return self.name, float("nan")
+        l = _np.concatenate(self._labels)
+        p = _np.concatenate(self._preds)
+        return self.name, float(_np.corrcoef(l, p)[0, 1])
+
+
+class Loss(EvalMetric):
+    """ref: class Loss — mean of raw loss values."""
+
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds):
+        for pred in _as_list(preds):
+            v = _to_np(pred)
+            self.sum_metric += v.sum()
+            self.num_inst += v.size
+
+
+class Torch(Loss):
+    """ref: class Torch (alias of Loss semantics)."""
+
+    def __init__(self, name="torch", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+
+class Caffe(Loss):
+    """ref: class Caffe."""
+
+    def __init__(self, name="caffe", **kwargs):
+        super().__init__(name=name, **kwargs)
+
+
+class CustomMetric(EvalMetric):
+    """ref: class CustomMetric — wrap feval(label, pred)."""
+
+    def __init__(self, feval, name="custom", allow_extra_outputs=False, **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+        self._allow_extra_outputs = allow_extra_outputs
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            v = self._feval(_to_np(label), _to_np(pred))
+            if isinstance(v, tuple):
+                s, n = v
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+def np_metric(numpy_feval, name="custom", allow_extra_outputs=False):
+    """ref: metric.np — wrap a numpy feval into a CustomMetric factory."""
+    return CustomMetric(numpy_feval, name, allow_extra_outputs)
+
+
+np = np_metric  # noqa: F811 - reference exports `mx.metric.np`
+
+for _k in ["accuracy", "acc"]:
+    _REGISTRY[_k] = Accuracy
+_REGISTRY["top_k_accuracy"] = TopKAccuracy
+_REGISTRY["top_k_acc"] = TopKAccuracy
+_REGISTRY["f1"] = F1
+_REGISTRY["mae"] = MAE
+_REGISTRY["mse"] = MSE
+_REGISTRY["rmse"] = RMSE
+_REGISTRY["ce"] = CrossEntropy
+_REGISTRY["cross-entropy"] = CrossEntropy
+_REGISTRY["nll_loss"] = NegativeLogLikelihood
+_REGISTRY["perplexity"] = Perplexity
+_REGISTRY["pearsonr"] = PearsonCorrelation
+_REGISTRY["loss"] = Loss
+_REGISTRY["composite"] = CompositeEvalMetric

@@ -28,7 +28,8 @@ from .. import engine as _engine
 from ..base import dtype_np
 from ..context import Context, current_context
 from .. import random as _random
-from ..ops.registry import OPS, OP_META, compiled, get_op, params_key
+from ..ops.registry import (OPS, OP_META, compiled, get_op, params_key,
+                            split_dynamic)
 
 __all__ = ["NDArray", "invoke", "asarray_jax"]
 
@@ -474,11 +475,13 @@ def invoke(op_name: str, *args, out=None, **kwargs):
         _autograd.append_node(node)
         return out_nds if isinstance(result, tuple) else out_nds[0]
     else:
-        jfn = compiled(op_name, params_key(kwargs))
+        static, dnames, dvals = split_dynamic(kwargs, meta.get("dynamic", False))
+        jfn = compiled(op_name, params_key(static), dnames)
+        dyn = tuple(jnp.asarray(v) for v in dvals)  # weak-typed: no recompile
         if meta.get("needs_rng"):
-            result = jfn(_random.next_key(), *raw)
+            result = jfn(_random.next_key(), dyn, *raw)
         else:
-            result = jfn(*raw)
+            result = jfn(dyn, *raw)
 
     if isinstance(result, tuple):
         result_nd = tuple(NDArray(_engine.track(r), ctx=ctx) for r in result)

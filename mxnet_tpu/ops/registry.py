@@ -30,7 +30,11 @@ def register_op(name, fn: Callable = None, aliases=(), needs_rng: bool = False):
             has_training = "training" in inspect.signature(f).parameters
         except (TypeError, ValueError):
             has_training = False
-        meta = {"has_training": has_training, "needs_rng": needs_rng}
+        meta = {"has_training": has_training, "needs_rng": needs_rng,
+                # Only optimizer update kernels take per-step scalar
+                # hyperparams (lr schedules etc.) as traced args; everywhere
+                # else scalars stay static so XLA constant-folds them.
+                "dynamic": name.endswith("_update")}
         OPS[name] = f
         OP_META[name] = meta
         for a in aliases:
@@ -55,8 +59,19 @@ def get_op(name: str) -> Callable:
         raise ValueError(f"unknown operator '{name}'") from None
 
 
+# Scalar hyperparameters that change between calls (lr schedules, adam bias
+# correction, ...).  They are passed as TRACED weak-typed scalars so the jit
+# cache keys only on their NAMES — otherwise every new lr value would trigger
+# a recompile (the reference passes these through dmlc::Parameter per call;
+# kernels read them as runtime scalars, same idea).
+DYNAMIC_SCALARS = frozenset({
+    "lr", "wd", "momentum", "beta1", "beta2", "epsilon", "rho", "eta",
+    "lamda1", "beta", "wd_lh", "rescale_grad", "t",
+})
+
+
 @functools.lru_cache(maxsize=8192)
-def compiled(name: str, params_key: tuple):
+def compiled(name: str, params_key: tuple, dyn_names: tuple = ()):
     """Cached jitted closure of an op at fixed static params.
 
     This is the eager fast path: dispatch cost is a dict lookup + jit cache
@@ -64,9 +79,9 @@ def compiled(name: str, params_key: tuple):
     (ref: src/imperative/imperative_utils.h — PushFCompute).
 
     Static Python state must never be constant-folded into the cache:
-    the training flag is part of ``params_key`` (invoke injects it), and for
-    ``needs_rng`` ops the PRNG key is a traced leading argument feeding a
-    RandomScope, so every call draws fresh randomness.
+    the training flag is part of ``params_key`` (invoke injects it), for
+    ``needs_rng`` ops the PRNG key is a traced argument feeding a
+    RandomScope, and DYNAMIC_SCALARS arrive as the traced ``dyn`` tuple.
     """
     fn = get_op(name)
     kwargs = dict(params_key)
@@ -75,17 +90,33 @@ def compiled(name: str, params_key: tuple):
         from .. import random as _random
 
         @jax.jit
-        def _run_rng(key, *arrays):
+        def _run_rng(key, dyn, *arrays):
             with _random.RandomScope(key):
-                return fn(*arrays, **kwargs)
+                return fn(*arrays, **kwargs, **dict(zip(dyn_names, dyn)))
 
         return _run_rng
 
     @jax.jit
-    def _run(*arrays):
-        return fn(*arrays, **kwargs)
+    def _run(dyn, *arrays):
+        return fn(*arrays, **kwargs, **dict(zip(dyn_names, dyn)))
 
     return _run
+
+
+def split_dynamic(kwargs: dict, enabled: bool = True):
+    """Split op kwargs into (static, dyn_names, dyn_values), sorted by name
+    so differing call-site kwarg order maps to one compile-cache entry."""
+    if not enabled:
+        return kwargs, (), ()
+    static, dyn = {}, []
+    for k, v in kwargs.items():
+        if k in DYNAMIC_SCALARS and isinstance(v, (int, float)) \
+                and not isinstance(v, bool):
+            dyn.append((k, v))
+        else:
+            static[k] = v
+    dyn.sort()
+    return (static, tuple(k for k, _ in dyn), tuple(v for _, v in dyn))
 
 
 def params_key(kwargs: dict) -> tuple:

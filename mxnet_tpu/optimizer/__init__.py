@@ -1,0 +1,7 @@
+"""mx.optimizer (ref: python/mxnet/optimizer/)."""
+from .optimizer import *
+from .optimizer import _REGISTRY, create, register
+from ..lr_scheduler import (LRScheduler, FactorScheduler, MultiFactorScheduler,
+                            PolyScheduler, CosineScheduler)
+
+Test = None  # reference keeps a test optimizer; not part of the public API

@@ -1,0 +1,520 @@
+"""Optimizers.
+
+ref: python/mxnet/optimizer/optimizer.py — class Optimizer (registry,
+lr/wd mults, update_multi_precision) and the standard family; the update
+math runs as the fused optimizer ops of ops/optimizer_ops.py (ref:
+src/operator/optimizer_op.cc — sgd_update, sgd_mom_update, adam_update, ...),
+each a single jitted XLA kernel.
+
+TPU-native: state lives in NDArrays; multi-precision keeps an fp32 master copy
+when weights are bf16/fp16 (ref: mp_sgd_update).  For whole-model fused
+updates use mxnet_tpu.parallel.train_step, which jits model+loss+optimizer
+into one XLA program.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ..base import dtype_np
+from ..ndarray import NDArray, invoke
+from .. import lr_scheduler as lr_scheduler_mod
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "Adamax", "Nadam", "LAMB",
+           "LARS", "RMSProp", "AdaGrad", "AdaDelta", "Ftrl", "Signum", "SGLD",
+           "create", "register"]
+
+_REGISTRY = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs):
+    """ref: Optimizer.create_optimizer."""
+    if isinstance(name, Optimizer):
+        return name
+    n = name.lower()
+    if n not in _REGISTRY:
+        raise ValueError(f"unknown optimizer '{name}'")
+    return _REGISTRY[n](**kwargs)
+
+
+def _writeback(outs, *targets):
+    """Optimizer ops are functional (weight', state'...); write results into
+    the live NDArrays (the reference mutates in place via the engine)."""
+    outs = outs if isinstance(outs, tuple) else (outs,)
+    for t, o in zip(targets, outs):
+        t._data = o._data
+
+
+class Optimizer:
+    """Base optimizer (ref: class Optimizer)."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 begin_num_update=0, multi_precision=False, param_dict=None,
+                 aggregate_num=4):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and getattr(lr_scheduler, "base_lr", None):
+            self.lr = lr_scheduler.base_lr
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self._index_update_count = {}
+        self._all_index_update_counts = self._index_update_count
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # ------------------------------------------------------------- plumbing --
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        """ref: Optimizer.create_state_multi_precision — fp32 master weights."""
+        if self.multi_precision and weight.dtype in (np.float16, dtype_np("bfloat16")):
+            master = weight.astype("float32")
+            return (master, self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def _update_count(self, index):
+        self._index_update_count.setdefault(index, self.begin_num_update)
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        lr = self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+        p = self.param_dict.get(index)
+        if p is not None:
+            lr *= p.lr_mult
+        else:
+            lr *= self.lr_mult.get(index, self.lr_mult.get(self.idx2name.get(index, ""), 1.0))
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        p = self.param_dict.get(index)
+        if p is not None:
+            wd *= p.wd_mult
+        else:
+            wd *= self.wd_mult.get(index, self.wd_mult.get(self.idx2name.get(index, ""), 1.0))
+        return wd
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        return self.lr_scheduler(self.num_update) if self.lr_scheduler else self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    # -------------------------------------------------------------- update --
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        """ref: Optimizer.update_multi_precision — update fp32 master, cast."""
+        if self.multi_precision and isinstance(state, tuple) and isinstance(state[0], NDArray) \
+                and state[0].dtype == np.float32 and weight.dtype != np.float32:
+            master, sub = state
+            g32 = grad.astype("float32")
+            self.update(index, master, g32, sub)
+            weight._data = master._data.astype(weight._data.dtype)
+        else:
+            self.update(index, weight, grad, state)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(lr={self.lr})"
+
+
+@register
+class SGD(Optimizer):
+    """ref: class SGD → sgd_update / sgd_mom_update ops."""
+
+    def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return NDArray(weight._data * 0)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        if self.momentum == 0.0:
+            _writeback(invoke("sgd_update", weight, grad, **kw), weight)
+        else:
+            _writeback(invoke("sgd_mom_update", weight, grad, state,
+                              momentum=self.momentum, **kw), weight, state)
+
+
+@register
+class NAG(SGD):
+    """ref: class NAG → nag_mom_update."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        _writeback(invoke("nag_mom_update", weight, grad, state,
+                          momentum=self.momentum, **kw), weight, state)
+
+
+@register
+class Adam(Optimizer):
+    """ref: class Adam → adam_update op."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=False, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(weight._data * 0), NDArray(weight._data * 0))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        kw = dict(lr=lr_t, beta1=self.beta1, beta2=self.beta2,
+                  epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        _writeback(invoke("adam_update", weight, grad, mean, var, **kw),
+                   weight, mean, var)
+
+
+@register
+class AdamW(Adam):
+    """ref: contrib adamw_update — decoupled weight decay."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        kw = dict(lr=lr_t, beta1=self.beta1, beta2=self.beta2,
+                  epsilon=self.epsilon, wd=wd, eta=1.0,
+                  rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        _writeback(invoke("adamw_update", weight, grad, mean, var, **kw),
+                   weight, mean, var)
+
+
+@register
+class Adamax(Optimizer):
+    """ref: class Adamax."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (NDArray(weight._data * 0), NDArray(weight._data * 0))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr / (1.0 - self.beta1 ** t)
+        m, u = state
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m._data = self.beta1 * m._data + (1 - self.beta1) * g
+        u._data = jnp.maximum(self.beta2 * u._data, jnp.abs(g))
+        weight._data = weight._data - lr_t * m._data / (u._data + 1e-8)
+
+
+@register
+class Nadam(Optimizer):
+    """ref: class Nadam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (NDArray(weight._data * 0), NDArray(weight._data * 0))
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._data = self.beta1 * m._data + (1.0 - self.beta1) * g
+        v._data = self.beta2 * v._data + (1.0 - self.beta2) * g * g
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m._data / (1.0 - m_schedule_next)
+        v_prime = v._data / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._data = weight._data - lr * m_bar / (jnp.sqrt(v_prime) + self.epsilon)
+
+
+@register
+class LAMB(Optimizer):
+    """ref: contrib multi_lamb / lamb_update_phase1+2 — the BERT optimizer."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (NDArray(weight._data * 0), NDArray(weight._data * 0))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        kw1 = dict(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+                   t=t, bias_correction=self.bias_correction, wd=wd,
+                   rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw1["clip_gradient"] = self.clip_gradient
+        outs1 = invoke("lamb_update_phase1", weight, grad, mean, var, **kw1)
+        g = outs1[0]
+        mean._data, var._data = outs1[1]._data, outs1[2]._data
+        kw2 = dict(lr=lr)
+        if self.lower_bound is not None:
+            kw2["lower_bound"] = self.lower_bound
+        if self.upper_bound is not None:
+            kw2["upper_bound"] = self.upper_bound
+        r1 = weight.norm()
+        r2 = g.norm()
+        _writeback(invoke("lamb_update_phase2", weight, g, r1, r2, **kw2), weight)
+
+
+@register
+class LARS(Optimizer):
+    """ref: class LARS — layer-wise adaptive rate scaling."""
+
+    def __init__(self, momentum=0.0, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return NDArray(weight._data * 0)
+        return None
+
+    def update(self, index, weight, grad, state):
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w_norm = jnp.linalg.norm(weight._data.astype(np.float32))
+        g_norm = jnp.linalg.norm(g.astype(np.float32))
+        trust = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon), 1.0)
+        g = g + wd * weight._data
+        if state is not None:
+            state._data = self.momentum * state._data + trust * lr * g
+            weight._data = weight._data - state._data
+        else:
+            weight._data = weight._data - trust * lr * g
+
+
+@register
+class RMSProp(Optimizer):
+    """ref: class RMSProp → rmsprop_update / rmspropalex_update."""
+
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho = rho
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (NDArray(weight._data * 0), NDArray(weight._data * 0),
+                    NDArray(weight._data * 0))
+        return (NDArray(weight._data * 0),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  rho=self.rho, epsilon=self.epsilon)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        if self.centered:
+            n, g, delta = state
+            _writeback(invoke("rmspropalex_update", weight, grad, n, g, delta,
+                              momentum=self.momentum, **kw),
+                       weight, n, g, delta)
+        else:
+            (n,) = state
+            _writeback(invoke("rmsprop_update", weight, grad, n, **kw), weight, n)
+
+
+@register
+class AdaGrad(Optimizer):
+    """ref: class AdaGrad → adagrad_update."""
+
+    def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(weight._data * 0)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  epsilon=self.float_stable_eps)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        _writeback(invoke("adagrad_update", weight, grad, state, **kw),
+                   weight, state)
+
+
+@register
+class AdaDelta(Optimizer):
+    """ref: class AdaDelta → adadelta_update."""
+
+    def __init__(self, learning_rate=1.0, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (NDArray(weight._data * 0), NDArray(weight._data * 0))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        kw = dict(wd=wd, rho=self.rho, epsilon=self.epsilon,
+                  rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        _writeback(invoke("adadelta_update", weight, grad, acc_g, acc_delta, **kw),
+                   weight, acc_g, acc_delta)
+
+
+@register
+class Ftrl(Optimizer):
+    """ref: class Ftrl → ftrl_update."""
+
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (NDArray(weight._data * 0), NDArray(weight._data * 0))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        kw = dict(lr=lr, wd=wd, lamda1=self.lamda1, beta=self.beta,
+                  rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        _writeback(invoke("ftrl_update", weight, grad, z, n, **kw), weight, z, n)
+
+
+@register
+class Signum(Optimizer):
+    """ref: class Signum → signsgd_update / signum_update."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return NDArray(weight._data * 0)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad)
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        if state is not None:
+            _writeback(invoke("signum_update", weight, grad, state,
+                              momentum=self.momentum, wd_lh=self.wd_lh, **kw),
+                       weight, state)
+        else:
+            _writeback(invoke("signsgd_update", weight, grad, **kw), weight)
+
+
+@register
+class SGLD(Optimizer):
+    """ref: class SGLD — stochastic gradient Langevin dynamics."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        import jax
+        import jax.numpy as jnp
+        from .. import random as _random
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad._data * self.rescale_grad + wd * weight._data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  jnp.float32).astype(weight._data.dtype)
+        weight._data = (weight._data - lr / 2 * g
+                        + math.sqrt(lr) * noise)

@@ -1,0 +1,255 @@
+"""Gluon block/layer tests (ref: tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_shapes():
+    layer = nn.Dense(10, in_units=4)
+    layer.initialize()
+    x = nd.ones((2, 4))
+    out = layer(x)
+    assert out.shape == (2, 10)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(7)
+    layer.initialize()
+    x = nd.ones((3, 5))
+    out = layer(x)
+    assert out.shape == (3, 7)
+    assert layer.weight.shape == (7, 5)
+
+
+def test_dense_flatten_false():
+    layer = nn.Dense(6, flatten=False)
+    layer.initialize()
+    out = layer(nd.ones((2, 3, 4)))
+    assert out.shape == (2, 3, 6)
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((2, 16)))
+    assert out.shape == (2, 4)
+    params = net.collect_params()
+    assert len(params) == 4  # 2 weights + 2 biases
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.random.normal(shape=(2, 16))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    compiled = net(x).asnumpy()
+    np.testing.assert_allclose(eager, compiled, rtol=2e-5, atol=2e-5)
+
+
+def test_hybridize_grad_matches_eager():
+    def run(hybridize):
+        mx.random.seed(7)
+        np.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(1))
+        net.initialize()
+        if hybridize:
+            net.hybridize()
+        x = nd.array(np.random.randn(4, 6).astype(np.float32))
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        w = net[0].weight
+        return w.grad().asnumpy()
+
+    g_eager = run(False)
+    g_hybrid = run(True)
+    np.testing.assert_allclose(g_eager, g_hybrid, rtol=2e-4, atol=2e-5)
+
+
+def test_conv2d():
+    layer = nn.Conv2D(4, kernel_size=3, padding=1)
+    layer.initialize()
+    out = layer(nd.ones((1, 3, 8, 8)))
+    assert out.shape == (1, 4, 8, 8)
+
+
+def test_conv2d_stride_groups():
+    layer = nn.Conv2D(8, kernel_size=3, strides=2, padding=1, groups=2,
+                      in_channels=4)
+    layer.initialize()
+    out = layer(nd.ones((2, 4, 8, 8)))
+    assert out.shape == (2, 8, 4, 4)
+
+
+def test_pooling_layers():
+    x = nd.random.uniform(shape=(1, 2, 8, 8))
+    assert nn.MaxPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(2, strides=1)(x).shape == (1, 2, 7, 7)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 2, 1, 1)
+
+
+def test_batchnorm_updates_running_stats():
+    layer = nn.BatchNorm(in_channels=3)
+    layer.initialize()
+    x = nd.array(np.random.randn(4, 3, 5, 5).astype(np.float32) * 3 + 1)
+    before = layer.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        layer(x)
+    after = layer.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_batchnorm_hybridized_aux_update():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(3, 3, padding=1), nn.BatchNorm())
+    net.initialize()
+    x = nd.random.normal(shape=(2, 3, 6, 6))
+    net(x)  # resolve deferred
+    net.hybridize()
+    bn = net[1]
+    before = bn.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        net(x)
+    after = bn.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+
+
+def test_batchnorm_eval_uses_running_stats():
+    layer = nn.BatchNorm(in_channels=2)
+    layer.initialize()
+    x = nd.array(np.random.randn(8, 2, 4, 4).astype(np.float32))
+    out_eval = layer(x)  # not recording -> predict mode: global stats (0,1)
+    expected = x.asnumpy() / np.sqrt(1 + 1e-5)
+    np.testing.assert_allclose(out_eval.asnumpy(), expected, rtol=1e-4, atol=1e-4)
+
+
+def test_embedding():
+    layer = nn.Embedding(10, 4)
+    layer.initialize()
+    out = layer(nd.array([[1, 2], [3, 4]], dtype=np.int32))
+    assert out.shape == (2, 2, 4)
+
+
+def test_dropout_train_vs_eval():
+    layer = nn.Dropout(0.5)
+    layer.initialize()
+    x = nd.ones((100, 100))
+    out_eval = layer(x)
+    np.testing.assert_allclose(out_eval.asnumpy(), x.asnumpy())
+    with autograd.record():
+        out_train = layer(x)
+    arr = out_train.asnumpy()
+    assert (arr == 0).mean() > 0.3  # roughly half dropped
+
+
+def test_layernorm():
+    layer = nn.LayerNorm(in_channels=6)
+    layer.initialize()
+    out = layer(nd.random.normal(shape=(3, 6)))
+    m = out.asnumpy().mean(axis=-1)
+    np.testing.assert_allclose(m, np.zeros_like(m), atol=1e-5)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4))
+    net.initialize()
+    net(nd.ones((1, 6)))
+    f = str(tmp_path / "params.npz")
+    net.save_parameters(f)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(8), nn.Dense(4))
+    net2.initialize()
+    net2(nd.ones((1, 6)))
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net[0].weight.data().asnumpy(),
+                               net2[0].weight.data().asnumpy())
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.ones((4, 2))
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(4)
+    w_after = net.weight.data().asnumpy()
+    assert not np.allclose(w_before, w_after)
+
+
+def test_prelu_swish_gelu():
+    x = nd.random.normal(shape=(2, 3))
+    for layer in [nn.PReLU(), nn.SELU(), nn.GELU(), nn.Swish(), nn.ELU(),
+                  nn.LeakyReLU(0.1)]:
+        layer.initialize()
+        out = layer(x)
+        assert out.shape == x.shape
+
+
+def test_rnn_cells_unroll():
+    cell = gluon.rnn.LSTMCell(8, input_size=4)
+    cell.initialize()
+    x = nd.random.normal(shape=(2, 5, 4))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 8)
+    assert len(states) == 2
+
+
+def test_fused_lstm_layer():
+    layer = gluon.rnn.LSTM(16, num_layers=2)
+    layer.initialize()
+    x = nd.random.normal(shape=(7, 3, 8))  # TNC
+    out = layer(x)
+    assert out.shape == (7, 3, 16)
+    states = layer.begin_state(batch_size=3)
+    out, new_states = layer(x, states)
+    assert out.shape == (7, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+
+
+def test_fused_gru_bidirectional():
+    layer = gluon.rnn.GRU(8, num_layers=1, bidirectional=True)
+    layer.initialize()
+    x = nd.random.normal(shape=(5, 2, 4))
+    out = layer(x)
+    assert out.shape == (5, 2, 16)
+
+
+def test_loss_functions():
+    pred = nd.random.normal(shape=(4, 10))
+    label = nd.array([1, 2, 3, 4], dtype=np.int32)
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    l2 = gluon.loss.L2Loss()(pred, nd.zeros((4, 10)))
+    assert l2.shape == (4,)
+    bce = gluon.loss.SigmoidBCELoss()(pred, nd.ones((4, 10)))
+    assert bce.shape == (4,)
+
+
+def test_model_zoo_smoke():
+    net = gluon.model_zoo.vision.get_model("resnet18_v1", classes=10)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 3, 32, 32)))
+    assert out.shape == (1, 10)
+
+
+def test_block_repr_and_summary(capsys):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.initialize()
+    net.summary(nd.ones((1, 3)))
+    out = capsys.readouterr().out
+    assert "Total params" in out
