@@ -26,6 +26,9 @@ from . import initializer as init
 from . import lr_scheduler
 from . import optimizer
 from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import parallel
 from . import gluon
 
 __version__ = "0.1.0"

@@ -43,8 +43,16 @@ class Trainer:
         self._states_ready = False
         self._kvstore = None
         self._update_on_kvstore = bool(update_on_kvstore)
-        if kvstore is not None and not isinstance(kvstore, str):
-            self._kvstore = kvstore  # a mxnet_tpu.kvstore.KVStore instance
+        if kvstore is not None:
+            if isinstance(kvstore, str):
+                from .. import kvstore as kv_mod
+                self._kvstore = kv_mod.create(kvstore)
+            else:
+                self._kvstore = kvstore  # a mxnet_tpu.kvstore.KVStore instance
+            if compression_params:
+                self._kvstore.set_gradient_compression(compression_params)
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
         self._kv_initialized = False
 
     # --------------------------------------------------------------- state --
@@ -79,6 +87,13 @@ class Trainer:
         if not self._states_ready:
             self._init_states()
         self._init_kvstore()
+        if self._kvstore is not None and self._update_on_kvstore:
+            # server-side update (ref: kvstore_dist_server.h DataHandleEx):
+            # push grads, the store applies the optimizer, pull new weights
+            for i, p in enumerate(self._params):
+                self._kvstore.push(i, p.grad())
+                self._kvstore.pull(i, out=p.data())
+            return
         if self._kvstore is not None:
             self._allreduce_grads()
         self._update(ignore_stale_grad)

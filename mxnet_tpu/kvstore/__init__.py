@@ -1,0 +1,20 @@
+"""KVStore — the reference's distributed parameter store, TPU-native.
+
+ref: src/kvstore/kvstore_local.{h,cc} (types "local"/"device"),
+kvstore_nccl.h ("nccl"), kvstore_dist.h ("dist_sync"/"dist_async"/
+"dist_sync_device" over ps-lite), comm.h (CommCPU/CommDevice reduce),
+gradient_compression.{h,cc} (2-bit stochastic quantization).
+
+TPU-native mapping (SURVEY.md §5.8): the push/pull/pushpull *semantics* are
+preserved — per-key init, aggregation of pushed values, optional server-side
+optimizer update (`update_on_kvstore`), gradient compression — but the
+*mechanism* is jax: aggregation is a jitted sum (XLA collective when values
+live on a mesh), there are no server processes, and the multi-worker case
+rides `jax.distributed` + global arrays rather than ZeroMQ.  The heavy-duty
+data-parallel path is mxnet_tpu.parallel.TrainStep, which fuses what
+KVStore+optimizer do into the training program; KVStore remains for API
+parity and for update_on_kvstore workflows.
+"""
+from .kvstore import KVStore, create
+
+__all__ = ["KVStore", "create"]

@@ -1,0 +1,224 @@
+"""KVStore implementation.
+
+ref: src/kvstore/kvstore.cc — KVStore::Create dispatching on type name;
+kvstore_local.h — KVStoreLocal::{Init,Push,Pull} with per-key merge buffers
+(CommCPU/CommDevice::Reduce); kvstore_dist_server.h — server-side optimizer
+(set_updater / DataHandleEx).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+_KNOWN_TYPES = ("local", "device", "nccl", "dist_sync", "dist_async",
+                "dist_sync_device", "dist_async_device", "horovod", "byteps")
+
+
+def create(name="local"):
+    """ref: kvstore.cc — KVStore::Create."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    base = name.lower()
+    if base not in _KNOWN_TYPES:
+        raise ValueError(f"unknown KVStore type '{name}'")
+    return KVStore(base)
+
+
+def _as_list(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+@jax.jit
+def _sum_arrays(arrs):
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = out + a
+    return out
+
+
+@jax.jit
+def _quant_2bit(grad, residual, threshold):
+    """ref: gradient_compression.cc — 2-bit quantization with error feedback:
+    values beyond ±threshold become ±threshold, the rest 0; the quantization
+    error accumulates in the residual."""
+    acc = grad + residual
+    q = jnp.where(acc >= threshold, threshold,
+                  jnp.where(acc <= -threshold, -threshold, 0.0)).astype(acc.dtype)
+    return q, acc - q
+
+
+class KVStore:
+    """Single-controller KVStore (ref: class KVStoreLocal / KVStoreDist).
+
+    Each key holds one logical array (possibly sharded over a mesh — sharding
+    survives push/pull untouched).  Pushing a list of values merges them by
+    summation, the reference's CommDevice::Reduce; in a `jax.distributed`
+    multi-process run the arrays are global and the jitted sum lowers to an
+    ICI/DCN collective.
+    """
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._opt_states = {}
+        self._compression = None   # (type, threshold)
+        self._residuals = {}
+
+    # -------------------------------------------------------------- basics --
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+    @property
+    def num_workers(self):
+        return jax.process_count()
+
+    def init(self, key, value):
+        """ref: KVStore::Init — one-time per-key allocation."""
+        for k, v in zip(_as_list(key), _as_list(value)):
+            k = str(k)
+            if k in self._store:
+                continue
+            self._store[k] = NDArray(jnp.asarray(v._data if isinstance(v, NDArray) else v))
+
+    # ---------------------------------------------------------------- push --
+    def push(self, key, value, priority=0):
+        """ref: KVStore::Push — merge pushed values into the store; with an
+        optimizer attached (update_on_kvstore), run the update server-side."""
+        keys, vals = self._key_value_lists(key, value)
+        for k, vlist in zip(keys, vals):
+            if k not in self._store:
+                raise KeyError(f"key '{k}' was not init()ed")
+            arrs = [v._data if isinstance(v, NDArray) else jnp.asarray(v)
+                    for v in vlist]
+            merged = arrs[0] if len(arrs) == 1 else _sum_arrays(arrs)
+            if self._compression is not None:
+                thr = self._compression[1]
+                res = self._residuals.get(k)
+                if res is None:
+                    res = jnp.zeros_like(merged)
+                merged, res = _quant_2bit(merged, res, thr)
+                self._residuals[k] = res
+            stored = self._store[k]
+            if self._optimizer is not None:
+                st = self._opt_states.get(k)
+                if st is None and k not in self._opt_states:
+                    st = self._optimizer.create_state_multi_precision(
+                        int(k) if k.isdigit() else 0, stored)
+                    self._opt_states[k] = st
+                self._optimizer.update_multi_precision(
+                    int(k) if k.isdigit() else 0, stored, NDArray(merged),
+                    self._opt_states[k])
+            elif self._updater is not None:
+                self._updater(k, NDArray(merged), stored)
+            else:
+                stored._data = merged
+
+    # ---------------------------------------------------------------- pull --
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """ref: KVStore::Pull."""
+        keys = [str(k) for k in _as_list(key)]
+        results = []
+        for k in keys:
+            if k not in self._store:
+                raise KeyError(f"key '{k}' was not init()ed")
+            results.append(self._store[k])
+        if out is not None:
+            outs = _as_list(out)
+            # broadcast each key's value into every provided output
+            if len(outs) == len(results):
+                pairs = zip(outs, results)
+            else:
+                pairs = ((o, results[i // (len(outs) // len(results))])
+                         for i, o in enumerate(outs))
+            for o, r in pairs:
+                o._data = r._data
+            return None
+        return results if len(results) > 1 else results[0]
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """ref: KVStore::PushPull (fused, the dist_sync_device fast path)."""
+        self.push(key, value, priority)
+        self.pull(key, out=out if out is not None else value, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Sparse pull degenerates to dense pull (TPU arrays are dense;
+        ref: KVStoreLocal::PullRowSparse)."""
+        return self.pull(key, out=out, priority=priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    # ----------------------------------------------------------- optimizer --
+    def set_optimizer(self, optimizer):
+        """ref: KVStore::SetOptimizer → server-side updates
+        (kvstore_dist_server.h DataHandleEx)."""
+        self._optimizer = optimizer
+
+    def is_capable(self, capability):
+        return {"optimizer": True}.get(capability, False)
+
+    def _set_updater(self, updater):
+        """ref: KVStore::set_updater — python updater fn(key, recv, stored)."""
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        """ref: KVStore::SetGradientCompression — {'type': '2bit',
+        'threshold': t}."""
+        ctype = compression_params.get("type", "2bit")
+        if ctype != "2bit":
+            raise ValueError(f"unsupported compression '{ctype}'")
+        thr = float(compression_params.get("threshold", 0.5))
+        self._compression = (ctype, thr)
+
+    # ------------------------------------------------------------ plumbing --
+    def _key_value_lists(self, key, value):
+        keys = [str(k) for k in _as_list(key)]
+        if len(keys) == 1:
+            return keys, [_as_list(value)]
+        vals = []
+        for k, v in zip(keys, _as_list(value)):
+            vals.append(_as_list(v))
+        return keys, vals
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        from .. import ndarray as nd
+        d = {}
+        for k, st in self._opt_states.items():
+            for j, arr in enumerate(_flatten(st)):
+                d[f"{k}.{j}"] = arr
+        nd.save(fname, d)
+
+    def load_optimizer_states(self, fname):
+        from .. import ndarray as nd
+        loaded = nd.load(fname)
+        for k, st in self._opt_states.items():
+            for j, arr in enumerate(_flatten(st)):
+                kk = f"{k}.{j}"
+                if kk in loaded:
+                    arr._data = loaded[kk]._data.astype(arr._data.dtype)
+
+    def __repr__(self):
+        return f"KVStore(type={self._type}, keys={len(self._store)})"
+
+
+def _flatten(state):
+    if state is None:
+        return []
+    if isinstance(state, NDArray):
+        return [state]
+    out = []
+    for s in state:
+        out.extend(_flatten(s))
+    return out

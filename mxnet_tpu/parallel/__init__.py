@@ -1,0 +1,27 @@
+"""mxnet_tpu.parallel — mesh parallelism (DP/FSDP/TP/PP/SP/EP).
+
+The reference's distribution stack (SURVEY.md §2.3: KVStore + ps-lite + NCCL
++ device groups) re-imagined as named mesh axes + XLA collectives.  The public
+pieces:
+
+- make_mesh / MeshScope      device mesh with canonical axis names
+- ShardingRules + presets    name-pattern → PartitionSpec parameter placement
+- TrainStep / EvalStep       one-XLA-program fused sharded train/eval step
+- functional_call            pure-function view of any Gluon block
+- pipeline / ring attention  see pipeline.py, ring.py (SP/PP layers)
+"""
+from .mesh import (AXES, MeshScope, current_mesh, default_mesh, make_mesh,
+                   named_sharding, replicated)
+from .sharding import (ShardingRules, batch_spec, fsdp_rules, param_sharding,
+                       tp_dense_rules)
+from .functional import functional_call, param_names_and_values
+from .step import EvalStep, TrainStep
+
+__all__ = [
+    "AXES", "MeshScope", "current_mesh", "default_mesh", "make_mesh",
+    "named_sharding", "replicated",
+    "ShardingRules", "batch_spec", "fsdp_rules", "param_sharding",
+    "tp_dense_rules",
+    "functional_call", "param_names_and_values",
+    "EvalStep", "TrainStep",
+]

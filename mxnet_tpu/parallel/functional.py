@@ -1,0 +1,80 @@
+"""Functional (pure) views of Gluon blocks.
+
+ref: src/imperative/cached_op.cc — CachedOp captures a block's graph and runs
+it as a unit over explicit input/param/aux buffers.  The TPU-native version is
+stronger: ``functional_call`` re-enters the block's Python forward under a
+trace with parameter arrays swapped in, yielding a *pure* jax function of
+(params, inputs, rng) suitable for jit / grad / pjit / shard_map — state
+(BatchNorm running stats) comes back as explicit outputs, exactly how
+CachedOp returns aux_states.
+"""
+from __future__ import annotations
+
+from .. import autograd as _autograd
+from .. import random as _random
+from ..ndarray import NDArray
+from ..gluon.block import Block, _flatten_nd, _unflatten_nd
+
+__all__ = ["param_names_and_values", "trainable_split", "functional_call",
+           "FunctionalState"]
+
+
+def param_names_and_values(block):
+    """Sorted (names, Parameter list, raw jax arrays) of the whole tree."""
+    params = block.collect_params()
+    names = sorted(params.keys())
+    plist = [params[n] for n in names]
+    return names, plist, [p.data()._data for p in plist]
+
+
+def trainable_split(plist):
+    """Indices of trainable vs aux (grad_req == 'null') parameters."""
+    train_idx = [i for i, p in enumerate(plist) if p.grad_req != "null"]
+    aux_idx = [i for i, p in enumerate(plist) if p.grad_req == "null"]
+    return train_idx, aux_idx
+
+
+class FunctionalState:
+    """Per-call mutation record (mutated aux arrays, output structure)."""
+
+    __slots__ = ("out_tree", "mutated")
+
+    def __init__(self):
+        self.out_tree = None
+        self.mutated = None  # list of (param_index, new_array)
+
+
+def functional_call(block, plist, param_arrays, inputs_tree, input_leaves,
+                    rng_key, training, state: FunctionalState):
+    """Run ``block`` forward as a pure function.
+
+    plist/param_arrays follow the order of ``param_names_and_values``.
+    Returns flat output arrays; the output tree and any aux-state mutations
+    are recorded in ``state`` (trace-time metadata, stable across calls with
+    the same signature).
+    """
+    saved = [(p, p._data) for p in plist]
+    prev_train = _autograd.set_training(training)
+    try:
+        for p, arr in zip(plist, param_arrays):
+            p._data = NDArray(arr)
+        wrapped = tuple(NDArray(l) for l in input_leaves)
+        inputs = _unflatten_nd(inputs_tree, wrapped)
+        with _random.RandomScope(rng_key):
+            # grads flow via jax.grad, not the tape; train_mode must survive
+            # the pause (pause() defaults to train_mode=False)
+            with _autograd.pause(train_mode=training):
+                out = Block.__call__(block, *inputs)
+        mutated = []
+        for i, (p, arr) in enumerate(zip(plist, param_arrays)):
+            cur = p._data
+            if isinstance(cur, NDArray) and cur._data is not arr:
+                mutated.append((i, cur._data))
+    finally:
+        for p, d in saved:
+            p._data = d
+        _autograd.set_training(prev_train)
+    out_leaves, out_tree = _flatten_nd(out)
+    state.out_tree = out_tree
+    state.mutated = mutated
+    return [o._data for o in out_leaves]

@@ -1,0 +1,169 @@
+"""Pure (functional) optimizer updates for the fused SPMD train step.
+
+ref: src/operator/optimizer_op.cc + contrib/multi_lamb.cc — the reference
+fuses multi-tensor updates into single kernels (`multi_sgd_update`,
+`multi_lamb`).  TPU-native, the *entire* update over all parameters is traced
+into the one XLA program that also holds forward+backward, so fusion is total.
+These mirror the math of mxnet_tpu.optimizer (which mirrors the reference's
+update ops) but take the step count ``t`` as a traced scalar so one compiled
+executable serves every step.
+
+Each ``pure_update(opt, w, g, state, t, lr, wd)`` returns (new_w, new_state).
+``state`` layout matches Optimizer.create_state flattened to raw arrays.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["pure_update", "state_template"]
+
+
+def _prep(opt, w, g, wd, decoupled=False):
+    g = g * opt.rescale_grad
+    if opt.clip_gradient is not None:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    if not decoupled:
+        g = g + wd * w
+    return g
+
+
+def _sgd(opt, w, g, state, t, lr, wd):
+    g = _prep(opt, w, g, wd)
+    if opt.momentum == 0.0:
+        return w - lr * g, state
+    (mom,) = state
+    mom = opt.momentum * mom - lr * g
+    return w + mom, (mom,)
+
+
+def _nag(opt, w, g, state, t, lr, wd):
+    g = _prep(opt, w, g, wd)
+    (mom,) = state
+    mom = opt.momentum * mom - lr * g
+    return w + opt.momentum * mom - lr * g, (mom,)
+
+
+def _adam(opt, w, g, state, t, lr, wd, decoupled=False):
+    g = _prep(opt, w, g, wd, decoupled=decoupled)
+    m, v = state
+    m = opt.beta1 * m + (1 - opt.beta1) * g
+    v = opt.beta2 * v + (1 - opt.beta2) * g * g
+    tf = t.astype(jnp.float32)
+    lr_t = lr * jnp.sqrt(1 - opt.beta2 ** tf) / (1 - opt.beta1 ** tf)
+    upd = lr_t * m / (jnp.sqrt(v) + opt.epsilon)
+    if decoupled:
+        upd = upd + lr * wd * w
+    return w - upd, (m, v)
+
+
+def _adamw(opt, w, g, state, t, lr, wd):
+    return _adam(opt, w, g, state, t, lr, wd, decoupled=True)
+
+
+def _lamb(opt, w, g, state, t, lr, wd):
+    g = g * opt.rescale_grad
+    if opt.clip_gradient is not None:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    m, v = state
+    m = opt.beta1 * m + (1 - opt.beta1) * g
+    v = opt.beta2 * v + (1 - opt.beta2) * g * g
+    if opt.bias_correction:
+        tf = t.astype(jnp.float32)
+        m_hat = m / (1 - opt.beta1 ** tf)
+        v_hat = v / (1 - opt.beta2 ** tf)
+    else:
+        m_hat, v_hat = m, v
+    upd = m_hat / (jnp.sqrt(v_hat) + opt.epsilon) + wd * w
+    r1 = jnp.linalg.norm(w.astype(jnp.float32))
+    if opt.lower_bound is not None:
+        r1 = jnp.maximum(r1, opt.lower_bound)
+    if opt.upper_bound is not None:
+        r1 = jnp.minimum(r1, opt.upper_bound)
+    r2 = jnp.linalg.norm(upd.astype(jnp.float32))
+    trust = jnp.where((r1 > 0) & (r2 > 0), r1 / r2, 1.0)
+    return w - lr * trust * upd.astype(w.dtype), (m, v)
+
+
+def _lars(opt, w, g, state, t, lr, wd):
+    g = g * opt.rescale_grad
+    if opt.clip_gradient is not None:
+        g = jnp.clip(g, -opt.clip_gradient, opt.clip_gradient)
+    w_norm = jnp.linalg.norm(w.astype(jnp.float32))
+    g_norm = jnp.linalg.norm(g.astype(jnp.float32))
+    trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                      opt.eta * w_norm / (g_norm + wd * w_norm + opt.epsilon),
+                      1.0)
+    g = g + wd * w
+    if state:
+        (mom,) = state
+        mom = opt.momentum * mom + trust * lr * g
+        return w - mom, (mom,)
+    return w - trust * lr * g, state
+
+
+def _rmsprop(opt, w, g, state, t, lr, wd):
+    g = _prep(opt, w, g, wd)
+    if getattr(opt, "centered", False):
+        n, mg, delta = state
+        n = (1 - opt.rho) * g * g + opt.rho * n
+        mg = (1 - opt.rho) * g + opt.rho * mg
+        delta = (opt.momentum * delta
+                 - lr * g / jnp.sqrt(n - mg * mg + opt.epsilon))
+        return w + delta, (n, mg, delta)
+    (n,) = state[:1]
+    n = (1 - opt.rho) * g * g + opt.rho * n
+    return w - lr * g / (jnp.sqrt(n) + opt.epsilon), (n,)
+
+
+def _adagrad(opt, w, g, state, t, lr, wd):
+    g = _prep(opt, w, g, wd)
+    (hist,) = state
+    hist = hist + g * g
+    return w - lr * g / (jnp.sqrt(hist) + opt.eps), (hist,)
+
+
+def _signum(opt, w, g, state, t, lr, wd):
+    g = _prep(opt, w, g, wd)
+    if state:
+        (mom,) = state
+        mom = opt.momentum * mom - (1 - opt.momentum) * g
+        return w + lr * jnp.sign(mom), (mom,)
+    return w - lr * jnp.sign(g), state
+
+
+_DISPATCH = {
+    "SGD": _sgd,
+    "NAG": _nag,
+    "Adam": _adam,
+    "AdamW": _adamw,
+    "LAMB": _lamb,
+    "LARS": _lars,
+    "RMSProp": _rmsprop,
+    "AdaGrad": _adagrad,
+    "Signum": _signum,
+}
+
+
+def pure_update(opt, w, g, state, t, lr, wd):
+    fn = _DISPATCH.get(type(opt).__name__)
+    if fn is None:
+        raise NotImplementedError(
+            f"fused train step has no pure update for optimizer "
+            f"{type(opt).__name__}; use Trainer.step (eager) or add a rule to "
+            f"mxnet_tpu.parallel.functional_opt._DISPATCH")
+    return fn(opt, w, g, state, t, lr, wd)
+
+
+def state_template(opt, weight_array):
+    """Zero state tuple matching pure_update's layout for one weight."""
+    z = lambda: jnp.zeros_like(weight_array)  # noqa: E731
+    name = type(opt).__name__
+    if name in ("SGD", "NAG", "LARS", "Signum"):
+        return (z(),) if getattr(opt, "momentum", 0.0) != 0.0 or name == "NAG" else ()
+    if name in ("Adam", "AdamW", "LAMB"):
+        return (z(), z())
+    if name == "RMSProp":
+        return (z(), z(), z()) if getattr(opt, "centered", False) else (z(),)
+    if name == "AdaGrad":
+        return (z(),)
+    raise NotImplementedError(name)

@@ -1,0 +1,109 @@
+"""Device mesh management.
+
+ref: the reference scales via KVStore device lists (src/kvstore/comm.h —
+CommDevice over ctx lists) and `group2ctx` device groups
+(src/executor/graph_executor.cc — AssignContext).  TPU-native, placement is a
+`jax.sharding.Mesh` with named axes; every parallelism strategy is an axis:
+
+    dp    data parallel (batch sharded; grads all-reduced by XLA over ICI)
+    fsdp  ZeRO-style parameter sharding on top of dp traffic
+    tp    tensor parallel (megatron-style sharded matmuls)
+    pp    pipeline parallel (stage-sharded layer stacks, microbatch schedule)
+    sp    sequence/context parallel (ring attention / Ulysses)
+    ep    expert parallel (MoE dispatch)
+
+The reference has only dp + limited model parallel (SURVEY.md §2.3); the rest
+are first-class here.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["AXES", "make_mesh", "current_mesh", "default_mesh", "MeshScope",
+           "replicated", "named_sharding"]
+
+# Canonical axis order: collectives that ride adjacent devices (tp, sp) go
+# last so they land on the fastest ICI neighbours in the device enumeration.
+AXES = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+_tls = threading.local()
+
+
+def make_mesh(axes=None, devices=None, **axis_sizes):
+    """Build a named-axis mesh, e.g. ``make_mesh(dp=2, tp=4)``.
+
+    Axis sizes must multiply to the device count; any remainder axis may be
+    given as -1 (inferred).  With no args, all devices go onto one ``dp`` axis
+    — the TPU-native equivalent of KVStore "device" over all local GPUs.
+    """
+    if axes:
+        axis_sizes = dict(axes, **axis_sizes)
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if not axis_sizes:
+        axis_sizes = {"dp": n}
+    ordered = OrderedDict()
+    for name in AXES:
+        if name in axis_sizes:
+            ordered[name] = axis_sizes.pop(name)
+    for name, size in axis_sizes.items():  # user-defined extra axes
+        ordered[name] = size
+    infer = [k for k, v in ordered.items() if v == -1]
+    if len(infer) > 1:
+        raise ValueError("at most one axis size may be -1")
+    known = int(np.prod([v for v in ordered.values() if v != -1]))
+    if infer:
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        ordered[infer[0]] = n // known
+        known = n
+    if known != n:
+        raise ValueError(f"mesh axes {dict(ordered)} need {known} devices, "
+                         f"have {n}")
+    arr = np.asarray(devices).reshape(tuple(ordered.values()))
+    return Mesh(arr, tuple(ordered.keys()))
+
+
+class MeshScope:
+    """``with MeshScope(mesh):`` makes it the framework-current mesh."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _tls.stack.pop()
+
+
+def current_mesh():
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        return stack[-1]
+    return None
+
+
+def default_mesh():
+    """Current mesh, or an all-``dp`` mesh over every device."""
+    m = current_mesh()
+    if m is None:
+        m = make_mesh()
+    return m
+
+
+def replicated(mesh):
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def named_sharding(mesh, *spec):
+    return NamedSharding(mesh, PartitionSpec(*spec))

@@ -1,0 +1,141 @@
+"""Parameter / activation sharding rules.
+
+ref: the reference's only placement vocabulary is a Context per NDArray plus
+`ctx_group` symbol attrs (SURVEY.md §2.3).  Here placement is a
+PartitionSpec per parameter, chosen by name-pattern rules — the same idea as
+t5x/flax partitioning rules, expressed MXNet-style (regex over the Gluon
+parameter names that `Block.collect_params()` yields).
+
+A rule is ``(regex, spec)`` where spec is a tuple over the parameter's dims;
+each entry is a mesh-axis name, a tuple of axis names, or None. The first
+matching rule whose sharding divides the shape wins; otherwise replicate.
+"""
+from __future__ import annotations
+
+import re
+
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = ["ShardingRules", "tp_dense_rules", "fsdp_rules", "param_sharding",
+           "batch_spec", "logical_to_sharding"]
+
+
+def _axis_size(mesh, entry):
+    if entry is None:
+        return 1
+    if isinstance(entry, (tuple, list)):
+        n = 1
+        for e in entry:
+            n *= mesh.shape.get(e, 1)
+        return n
+    return mesh.shape.get(entry, 1)
+
+
+def _spec_fits(mesh, spec, shape):
+    if len(spec) > len(shape):
+        return False
+    for dim, entry in zip(shape, spec):
+        sz = _axis_size(mesh, entry)
+        if sz > 1 and dim % sz != 0:
+            return False
+    return True
+
+
+def _drop_missing_axes(mesh, spec):
+    """Remove axis names the mesh doesn't have (so one rule set serves
+    meshes with and without, e.g., a 'tp' axis)."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            kept = tuple(e for e in entry if e in mesh.shape)
+            out.append(kept if kept else None)
+        else:
+            out.append(entry if entry in mesh.shape else None)
+    return tuple(out)
+
+
+class ShardingRules:
+    """Ordered (regex, spec) list → PartitionSpec per parameter."""
+
+    def __init__(self, rules=(), default=()):
+        self.rules = [(re.compile(p), tuple(s)) for p, s in rules]
+        self.default = tuple(default)
+
+    def __add__(self, other):
+        r = ShardingRules()
+        r.rules = self.rules + other.rules
+        r.default = other.default or self.default
+        return r
+
+    def spec_for(self, name, shape, mesh):
+        for pat, spec in self.rules:
+            if pat.search(name):
+                spec = _drop_missing_axes(mesh, spec)
+                if _spec_fits(mesh, spec, shape):
+                    return PartitionSpec(*spec)
+        spec = _drop_missing_axes(mesh, self.default)
+        if self.default and _spec_fits(mesh, spec, shape):
+            return PartitionSpec(*spec)
+        return PartitionSpec()
+
+
+def tp_dense_rules():
+    """Megatron-style rules for the stock Gluon layers: alternate column/row
+    sharding of Dense kernels inside attention/FFN blocks; embeddings sharded
+    on vocab-out dim.  Dense kernel layout here is (units, in_units) — MXNet
+    convention — so 'units' is dim 0.
+    """
+    return ShardingRules(rules=[
+        # attention QKV + FFN-in: shard output features (column parallel)
+        (r"(query|key|value|qkv|ffn_?1|inter|fc1|gate|up)\w*_(weight)$", ("tp", None)),
+        (r"(query|key|value|qkv|ffn_?1|inter|fc1|gate|up)\w*_(bias)$", ("tp",)),
+        # attention out-proj + FFN-out: shard input features (row parallel)
+        (r"(proj|out|ffn_?2|fc2|down)\w*_(weight)$", (None, "tp")),
+        # embeddings: shard embedding dim
+        (r"embedding\w*_weight$", (None, "tp")),
+        # conv kernels (O, I, kH, kW): shard output channels
+        (r"conv\w*_weight$", ("tp", None, None, None)),
+    ])
+
+
+def fsdp_rules():
+    """ZeRO-3-ish: shard every parameter's largest dim over 'fsdp'."""
+
+    class _FSDP(ShardingRules):
+        def spec_for(self, name, shape, mesh):
+            ax = mesh.shape.get("fsdp", 1)
+            if ax <= 1 or not shape:
+                return PartitionSpec()
+            order = sorted(range(len(shape)), key=lambda i: -shape[i])
+            for i in order:
+                if shape[i] % ax == 0 and shape[i] >= ax:
+                    spec = [None] * len(shape)
+                    spec[i] = "fsdp"
+                    return PartitionSpec(*spec)
+            return PartitionSpec()
+
+    return _FSDP()
+
+
+def param_sharding(names, shapes, mesh, rules=None):
+    """NamedSharding per parameter name."""
+    rules = rules or ShardingRules()
+    return [NamedSharding(mesh, rules.spec_for(n, s, mesh))
+            for n, s in zip(names, shapes)]
+
+
+def batch_spec(mesh, extra_axes=("dp", "fsdp")):
+    """PartitionSpec for a leading-batch-dim tensor: batch over dp (and fsdp,
+    which contributes data-parallel replicas in ZeRO style)."""
+    axes = tuple(a for a in extra_axes if mesh.shape.get(a, 1) > 1)
+    if not axes:
+        return PartitionSpec()
+    return PartitionSpec(axes if len(axes) > 1 else axes[0])
+
+
+def logical_to_sharding(mesh, spec):
+    spec = _drop_missing_axes(mesh, tuple(spec))
+    return NamedSharding(mesh, PartitionSpec(*spec))

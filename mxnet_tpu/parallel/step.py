@@ -1,0 +1,260 @@
+"""Fused SPMD train step.
+
+ref: the reference's training loop is CachedOp::Forward +
+Imperative::Backward + kvstore push/pull + optimizer_op updates, each a
+separate engine-scheduled stage (SURVEY.md §3.2/§3.3).  TPU-native, ALL of it
+— forward, loss, backward, cross-device gradient reduction, optimizer update
+— is one jitted XLA program over a sharded mesh: XLA inserts the ICI
+collectives where the `dp` axis demands them (the KVStore allreduce), overlaps
+them with compute, and fuses the whole optimizer (the reference's
+`multi_sgd_update`/`multi_lamb` multi-tensor fusion, taken to 100%).
+
+Usage:
+    mesh = parallel.make_mesh(dp=8)
+    step = parallel.TrainStep(net, loss_fn, optimizer, mesh=mesh)
+    for data, label in loader:
+        loss = step(data, label)          # sharded, async
+    step.sync_params_to_net()             # reflect into Gluon Parameters
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import random as _random
+from .. import autograd as _autograd
+from ..ndarray import NDArray
+from ..gluon.block import Block, _flatten_nd, _unflatten_nd
+from .mesh import default_mesh
+from .sharding import ShardingRules, batch_spec, param_sharding
+from .functional import (FunctionalState, functional_call,
+                         param_names_and_values, trainable_split)
+from .functional_opt import pure_update, state_template
+
+__all__ = ["TrainStep", "EvalStep"]
+
+
+def _leaves(args):
+    nds, tree = _flatten_nd(args)
+    return [a._data for a in nds], tree
+
+
+class TrainStep:
+    """Compiled (params, states, batch) → (params', states', loss) on a mesh."""
+
+    def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
+                 data_spec=None, loss_reduce="mean"):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.rules = rules or ShardingRules()
+        self._data_pspec = data_spec if data_spec is not None \
+            else batch_spec(self.mesh)
+        self._loss_reduce = loss_reduce
+        self._built = False
+        self._jit = None
+        self._num_update = optimizer.begin_num_update
+
+    # --------------------------------------------------------------- build --
+    def _build(self, sample_args):
+        net = self.net
+        if any(p._deferred_init is not None
+               for p in net.collect_params().values()):
+            with _autograd.pause():
+                Block.__call__(net, *sample_args)
+        names, plist, arrays = param_names_and_values(net)
+        self._names, self._plist = names, plist
+        self._train_idx, self._aux_idx = trainable_split(plist)
+        shardings = param_sharding(names, [a.shape for a in arrays],
+                                   self.mesh, self.rules)
+        self._param_shardings = shardings
+        arrays = [jax.device_put(a, s) for a, s in zip(arrays, shardings)]
+        self._train_arrays = [arrays[i] for i in self._train_idx]
+        self._aux_arrays = [arrays[i] for i in self._aux_idx]
+        self._states = tuple(
+            tuple(jax.device_put(s, shardings[i])
+                  for s in state_template(self.optimizer, arrays[i]))
+            for i in self._train_idx)
+        # static per-param lr/wd multipliers (ref: Optimizer._get_lr/_get_wd)
+        self._lr_mults = [plist[i].lr_mult for i in self._train_idx]
+        self._wd_mults = [plist[i].wd_mult for i in self._train_idx]
+        self._t = jnp.zeros((), jnp.int32) + self._num_update
+        self._repl = NamedSharding(self.mesh, PartitionSpec())
+        self._built = True
+
+    def _base_lr(self):
+        opt = self.optimizer
+        if opt.lr_scheduler is not None:
+            return float(opt.lr_scheduler(self._num_update))
+        return float(opt.lr)
+
+    def _compile(self, data_tree, label_tree, n_data):
+        net, opt = self.net, self.optimizer
+        plist = self._plist
+        train_idx, aux_idx = self._train_idx, self._aux_idx
+        lr_mults, wd_mults = self._lr_mults, self._wd_mults
+        loss_fn, reduce = self.loss_fn, self._loss_reduce
+        state_holder = FunctionalState()
+
+        def fn(train_arrays, aux_arrays, states, t, key, lr, *batch):
+            data_leaves = list(batch[:n_data])
+            label_leaves = list(batch[n_data:])
+
+            def loss_of(ta):
+                pa = [None] * len(plist)
+                for i, a in zip(train_idx, ta):
+                    pa[i] = a
+                for i, a in zip(aux_idx, aux_arrays):
+                    pa[i] = a
+                outs = functional_call(net, plist, pa, data_tree, data_leaves,
+                                       key, True, state_holder)
+                out_nd = _unflatten_nd(state_holder.out_tree,
+                                       tuple(NDArray(o) for o in outs))
+                lab_nd = _unflatten_nd(label_tree,
+                                       tuple(NDArray(l) for l in label_leaves))
+                if isinstance(lab_nd, tuple) and len(lab_nd) == 1:
+                    lab_nd = lab_nd[0]
+                loss = loss_fn(out_nd, lab_nd)
+                lv = loss._data if isinstance(loss, NDArray) else loss
+                lv = jnp.mean(lv) if reduce == "mean" else jnp.sum(lv)
+                mut = [m for _, m in state_holder.mutated]
+                return lv.astype(jnp.float32), mut
+
+            (loss, mut), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(train_arrays)
+            t1 = t + 1
+            new_train, new_states = [], []
+            for k, (w, g, s) in enumerate(zip(train_arrays, grads, states)):
+                lr_k = lr * lr_mults[k]
+                wd_k = opt.wd * wd_mults[k]
+                nw, ns = pure_update(opt, w, g, s, t1, lr_k, wd_k)
+                new_train.append(nw)
+                new_states.append(ns)
+            # aux-state writeback (BatchNorm running stats — the reference's
+            # aux_states path in cached_op.cc)
+            mut_map = {i: v for (i, _), v in zip(state_holder.mutated, mut)}
+            new_aux = [mut_map.get(i, a) for i, a in zip(aux_idx, aux_arrays)]
+            return new_train, new_aux, tuple(new_states), t1, loss
+
+        train_sh = [self._param_shardings[i] for i in train_idx]
+        aux_sh = [self._param_shardings[i] for i in aux_idx]
+        state_sh = tuple(tuple(train_sh[k] for _ in s)
+                         for k, s in enumerate(self._states))
+        dat_sh = NamedSharding(self.mesh, self._data_pspec)
+        in_sh = (train_sh, aux_sh, state_sh, self._repl, self._repl,
+                 self._repl)
+        out_sh = (train_sh, aux_sh, state_sh, self._repl, self._repl)
+        return jax.jit(
+            fn,
+            in_shardings=in_sh + tuple([dat_sh] * (n_data + self._n_label)),
+            out_shardings=out_sh,
+            donate_argnums=(0, 1, 2))
+
+    # ---------------------------------------------------------------- call --
+    def __call__(self, data, label):
+        return self.step(data, label)
+
+    def step(self, data, label):
+        data_args = data if isinstance(data, (tuple, list)) else (data,)
+        data_args = tuple(data_args)
+        if not self._built:
+            self._build(data_args)
+        data_leaves, data_tree = _leaves(data_args)
+        label_args = label if isinstance(label, (tuple, list)) else (label,)
+        label_leaves, label_tree = _leaves(tuple(label_args))
+        sig = (data_tree, label_tree,
+               tuple((l.shape, str(l.dtype)) for l in data_leaves),
+               tuple((l.shape, str(l.dtype)) for l in label_leaves))
+        if self._jit is None or sig != getattr(self, "_sig", None):
+            self._n_label = len(label_leaves)
+            self._jit = self._compile(data_tree, label_tree, len(data_leaves))
+            self._sig = sig
+        key = _random.next_key()
+        lr = jnp.float32(self._base_lr())
+        dat_sh = NamedSharding(self.mesh, self._data_pspec)
+        data_leaves = [jax.device_put(l, dat_sh) for l in data_leaves]
+        label_leaves = [jax.device_put(l, dat_sh) for l in label_leaves]
+        (self._train_arrays, self._aux_arrays, self._states, self._t,
+         loss) = self._jit(self._train_arrays, self._aux_arrays, self._states,
+                           self._t, key, lr, *data_leaves, *label_leaves)
+        self._num_update += 1
+        self.optimizer.num_update = self._num_update
+        return NDArray(loss)
+
+    # ---------------------------------------------------------------- sync --
+    def sync_params_to_net(self):
+        """Write the step-owned arrays back into the Gluon Parameters."""
+        for i, a in zip(self._train_idx, self._train_arrays):
+            self._plist[i].data()._data = a
+        for i, a in zip(self._aux_idx, self._aux_arrays):
+            self._plist[i].data()._data = a
+
+    @property
+    def params(self):
+        full = [None] * len(self._plist)
+        for i, a in zip(self._train_idx, self._train_arrays):
+            full[i] = a
+        for i, a in zip(self._aux_idx, self._aux_arrays):
+            full[i] = a
+        return dict(zip(self._names, full))
+
+
+class EvalStep:
+    """Compiled sharded inference: (params, batch) → outputs."""
+
+    def __init__(self, net, mesh=None, rules=None, data_spec=None):
+        self.net = net
+        self.mesh = mesh if mesh is not None else default_mesh()
+        self.rules = rules or ShardingRules()
+        self._data_pspec = data_spec if data_spec is not None \
+            else batch_spec(self.mesh)
+        self._jit = None
+        self._built = False
+
+    def _build(self, sample_args):
+        if any(p._deferred_init is not None
+               for p in self.net.collect_params().values()):
+            with _autograd.pause():
+                Block.__call__(self.net, *sample_args)
+        names, plist, arrays = param_names_and_values(self.net)
+        self._names, self._plist = names, plist
+        sh = param_sharding(names, [a.shape for a in arrays], self.mesh,
+                            self.rules)
+        self._arrays = [jax.device_put(a, s) for a, s in zip(arrays, sh)]
+        self._shardings = sh
+        self._built = True
+
+    def __call__(self, *data):
+        if not self._built:
+            self._build(data)
+        data_leaves, data_tree = _leaves(tuple(data))
+        sig = (data_tree, tuple((l.shape, str(l.dtype)) for l in data_leaves))
+        if self._jit is None or sig != getattr(self, "_sig", None):
+            net, plist = self.net, self._plist
+            holder = FunctionalState()
+
+            def fn(arrays, key, *leaves):
+                outs = functional_call(net, plist, list(arrays), data_tree,
+                                       list(leaves), key, False, holder)
+                return tuple(outs)
+
+            dat_sh = NamedSharding(self.mesh, self._data_pspec)
+            self._jit = jax.jit(
+                fn,
+                in_shardings=(self._shardings,
+                              NamedSharding(self.mesh, PartitionSpec()))
+                + tuple([dat_sh] * len(data_leaves)))
+            self._holder = holder
+            self._sig = sig
+        key = _random.next_key()
+        dat_sh = NamedSharding(self.mesh, self._data_pspec)
+        data_leaves = [jax.device_put(l, dat_sh) for l in data_leaves]
+        outs = self._jit(self._arrays, key, *data_leaves)
+        res = _unflatten_nd(self._holder.out_tree,
+                            tuple(NDArray(o) for o in outs))
+        if isinstance(res, tuple) and len(res) == 1:
+            return res[0]
+        return res
