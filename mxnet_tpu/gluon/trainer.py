@@ -84,17 +84,21 @@ class Trainer:
     def step(self, batch_size, ignore_stale_grad=False):
         """ref: Trainer.step — rescale by 1/batch_size, allreduce, update."""
         self._optimizer.rescale_grad = self._scale / batch_size
-        if not self._states_ready:
-            self._init_states()
         self._init_kvstore()
         if self._kvstore is not None and self._update_on_kvstore:
             # server-side update (ref: kvstore_dist_server.h DataHandleEx):
             # push grads, the store applies the optimizer, pull new weights
+            # (local optimizer states stay unallocated — the store owns them)
             for i, p in enumerate(self._params):
                 self._kvstore.push(i, p.grad())
                 self._kvstore.pull(i, out=p.data())
             return
-        if self._kvstore is not None:
+        if not self._states_ready:
+            self._init_states()
+        # a Parameter holds ONE logical (possibly mesh-sharded) array — there
+        # are no per-device replica lists to reduce, so with one worker the
+        # kvstore round-trip is the identity and is skipped
+        if self._kvstore is not None and self._kvstore.num_workers > 1:
             self._allreduce_grads()
         self._update(ignore_stale_grad)
 

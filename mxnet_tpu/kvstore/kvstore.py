@@ -69,6 +69,7 @@ class KVStore:
         self._opt_states = {}
         self._compression = None   # (type, threshold)
         self._residuals = {}
+        self._key_index = {}       # key -> dense optimizer index
 
     # -------------------------------------------------------------- basics --
     @property
@@ -111,14 +112,18 @@ class KVStore:
                 self._residuals[k] = res
             stored = self._store[k]
             if self._optimizer is not None:
-                st = self._opt_states.get(k)
-                if st is None and k not in self._opt_states:
-                    st = self._optimizer.create_state_multi_precision(
-                        int(k) if k.isdigit() else 0, stored)
-                    self._opt_states[k] = st
+                # dense per-key optimizer index so string keys get distinct
+                # update counts / state slots (ref: kvstore_dist_server.h
+                # keys are ps-lite ints; here any hashable key works)
+                # digit keys keep their value; string keys get negative
+                # indices, a namespace no digit key can collide with
+                idx = self._key_index.setdefault(
+                    k, int(k) if k.isdigit() else -(len(self._key_index) + 1))
+                if k not in self._opt_states:
+                    self._opt_states[k] = \
+                        self._optimizer.create_state_multi_precision(idx, stored)
                 self._optimizer.update_multi_precision(
-                    int(k) if k.isdigit() else 0, stored, NDArray(merged),
-                    self._opt_states[k])
+                    idx, stored, NDArray(merged), self._opt_states[k])
             elif self._updater is not None:
                 self._updater(k, NDArray(merged), stored)
             else:
@@ -135,14 +140,14 @@ class KVStore:
             results.append(self._store[k])
         if out is not None:
             outs = _as_list(out)
-            # broadcast each key's value into every provided output
-            if len(outs) == len(results):
-                pairs = zip(outs, results)
-            else:
-                pairs = ((o, results[i // (len(outs) // len(results))])
-                         for i, o in enumerate(outs))
-            for o, r in pairs:
-                o._data = r._data
+            # broadcast each key's value into its output(s): either 1:1, or
+            # an equal number of device-replica outputs per key
+            if len(outs) % len(results) != 0:
+                raise ValueError(
+                    f"pull: {len(outs)} outputs for {len(results)} keys")
+            per_key = len(outs) // len(results)
+            for i, o in enumerate(outs):
+                o._data = results[i // per_key]._data
             return None
         return results if len(results) > 1 else results[0]
 

@@ -8,7 +8,6 @@ pieces:
 - ShardingRules + presets    name-pattern → PartitionSpec parameter placement
 - TrainStep / EvalStep       one-XLA-program fused sharded train/eval step
 - functional_call            pure-function view of any Gluon block
-- pipeline / ring attention  see pipeline.py, ring.py (SP/PP layers)
 """
 from .mesh import (AXES, MeshScope, current_mesh, default_mesh, make_mesh,
                    named_sharding, replicated)

@@ -119,16 +119,17 @@ def _adagrad(opt, w, g, state, t, lr, wd):
     g = _prep(opt, w, g, wd)
     (hist,) = state
     hist = hist + g * g
-    return w - lr * g / (jnp.sqrt(hist) + opt.eps), (hist,)
+    return w - lr * g / (jnp.sqrt(hist) + opt.float_stable_eps), (hist,)
 
 
 def _signum(opt, w, g, state, t, lr, wd):
     g = _prep(opt, w, g, wd)
+    decay = 1.0 - lr * getattr(opt, "wd_lh", 0.0)
     if state:
         (mom,) = state
         mom = opt.momentum * mom - (1 - opt.momentum) * g
-        return w + lr * jnp.sign(mom), (mom,)
-    return w - lr * jnp.sign(g), state
+        return decay * w + lr * jnp.sign(mom), (mom,)
+    return decay * w - lr * jnp.sign(g), state
 
 
 _DISPATCH = {

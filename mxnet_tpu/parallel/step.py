@@ -86,9 +86,11 @@ class TrainStep:
         self._built = True
 
     def _base_lr(self):
+        # evaluated at the post-increment count, matching the eager path
+        # (Optimizer._update_count runs before _get_lr)
         opt = self.optimizer
         if opt.lr_scheduler is not None:
-            return float(opt.lr_scheduler(self._num_update))
+            return float(opt.lr_scheduler(self._num_update + 1))
         return float(opt.lr)
 
     def _compile(self, data_tree, label_tree, n_data):

@@ -191,3 +191,41 @@ def test_trainer_with_kvstore_allreduce():
     trainer.step(8)  # must not raise; weights move
     l2 = float(loss.asnumpy().mean())
     assert np.isfinite(l2)
+
+
+def test_train_step_adagrad_and_lamb():
+    """Regression: every fused optimizer path must at least run + descend."""
+    for name in ("adagrad", "lamb", "adamw", "nag"):
+        net = _mlp()
+        opt = mx.optimizer.create(name, learning_rate=1e-2)
+        step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                                  opt, mesh=parallel.make_mesh(dp=8))
+        x = mx.nd.array(np.random.randn(16, 16).astype(np.float32))
+        y = mx.nd.array(np.random.randint(0, 10, (16,)))
+        l0 = float(step(x, y).asnumpy())
+        for _ in range(4):
+            l1 = float(step(x, y).asnumpy())
+        assert np.isfinite(l1) and l1 < l0, (name, l0, l1)
+
+
+def test_kvstore_string_keys_distinct_state():
+    kv = mx.kv.create("local")
+    opt = mx.optimizer.create("adam", learning_rate=0.1)
+    kv.set_optimizer(opt)
+    kv.init(["weight", "bias"], [mx.nd.ones((2,)), mx.nd.ones((2,))])
+    for _ in range(2):
+        kv.push(["weight", "bias"],
+                [mx.nd.ones((2,)), mx.nd.ones((2,))])
+    # each key must have advanced its own update count exactly twice
+    idx_w = kv._key_index["weight"]
+    idx_b = kv._key_index["bias"]
+    assert idx_w != idx_b
+    assert opt._index_update_count[idx_w] == 2
+    assert opt._index_update_count[idx_b] == 2
+
+
+def test_kvstore_pull_mismatch_raises():
+    kv = mx.kv.create("local")
+    kv.init([0, 1, 2], [mx.nd.ones((2,))] * 3)
+    with pytest.raises(ValueError):
+        kv.pull([0, 1, 2], out=[mx.nd.zeros((2,)), mx.nd.zeros((2,))])
