@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 v1 training throughput, img/s/chip.
+
+ref: example/image-classification/benchmark_score.py (synthetic-data img/s)
+and BASELINE.md config 2 (ResNet-50 hybridize bf16, bar = 800 img/s/chip on
+v5e ≈ V100 fp16 parity).  The whole train step (fwd+bwd+SGD) is one XLA
+program via parallel.TrainStep; matmul precision bf16 puts convs on the MXU.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 800.0  # BASELINE.md: V100 fp16 ~700-800 img/s, target bar
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    batch = 128 if on_accel else 8
+    iters = 20 if on_accel else 2
+
+    net = resnet50_v1()
+    net.initialize()
+    net.cast("bfloat16")  # bf16 compute, fp32 master weights in the optimizer
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4)
+    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), opt,
+                              mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch, 3, 224, 224)
+                    .astype(np.float32)).astype("bfloat16")
+    y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.int32))
+
+    # compile + warmup
+    step(x, y).asnumpy()
+    step(x, y).asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    loss.asnumpy()  # block
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

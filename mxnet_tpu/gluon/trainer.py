@@ -146,12 +146,26 @@ class Trainer:
         loaded = nd.load(fname)
         if not self._states_ready:
             self._init_states()
+        n_expected = sum(len(_flatten_state(s)) for s in self._states)
+        n_loaded = sum(1 for k in loaded if not k.startswith("__meta__"))
+        if n_loaded != n_expected:
+            raise ValueError(
+                f"optimizer state layout mismatch loading '{fname}': file has "
+                f"{n_loaded} state arrays, current setup expects {n_expected} "
+                f"(optimizer type or multi_precision setting changed?)")
         for i, s in enumerate(self._states):
             flat = _flatten_state(s)
             for j, arr in enumerate(flat):
                 key = f"{i}.{j}"
-                if key in loaded:
-                    arr._data = loaded[key]._data.astype(arr._data.dtype)
+                if key not in loaded:
+                    raise ValueError(
+                        f"optimizer state '{key}' missing in '{fname}'")
+                if tuple(loaded[key].shape) != tuple(arr.shape):
+                    raise ValueError(
+                        f"optimizer state '{key}' shape mismatch loading "
+                        f"'{fname}': {tuple(loaded[key].shape)} vs "
+                        f"{tuple(arr.shape)}")
+                arr._data = loaded[key]._data.astype(arr._data.dtype)
         if "__meta__num_update" in loaded:
             n = int(loaded["__meta__num_update"].asnumpy()[0])
             self._optimizer.num_update = n

@@ -56,7 +56,7 @@ class Optimizer:
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
-                 begin_num_update=0, multi_precision=False, param_dict=None,
+                 begin_num_update=0, multi_precision=None, param_dict=None,
                  aggregate_num=4):
         self.rescale_grad = rescale_grad
         self.lr = learning_rate
@@ -80,9 +80,16 @@ class Optimizer:
     def create_state(self, index, weight):
         return None
 
+    def _mp_for(self, dtype):
+        """multi_precision=None (default) is auto: fp32 master weights for
+        low-precision params, both eager and fused paths."""
+        low = dtype in (np.float16, dtype_np("bfloat16"))
+        return low if self.multi_precision is None \
+            else (self.multi_precision and low)
+
     def create_state_multi_precision(self, index, weight):
         """ref: Optimizer.create_state_multi_precision — fp32 master weights."""
-        if self.multi_precision and weight.dtype in (np.float16, dtype_np("bfloat16")):
+        if self._mp_for(weight.dtype):
             master = weight.astype("float32")
             return (master, self.create_state(index, master))
         return self.create_state(index, weight)
@@ -129,7 +136,8 @@ class Optimizer:
 
     def update_multi_precision(self, index, weight, grad, state):
         """ref: Optimizer.update_multi_precision — update fp32 master, cast."""
-        if self.multi_precision and isinstance(state, tuple) and isinstance(state[0], NDArray) \
+        if self._mp_for(weight.dtype) and isinstance(state, tuple) \
+                and isinstance(state[0], NDArray) \
                 and state[0].dtype == np.float32 and weight.dtype != np.float32:
             master, sub = state
             g32 = grad.astype("float32")

@@ -145,6 +145,14 @@ _DISPATCH = {
 }
 
 
+def _is_mp(opt, dtype):
+    """fp32 master weights for low-precision params (ref: mp_sgd_update — the
+    reference's multi-precision optimizer ops keep an fp32 copy in state).
+    Optimizer._mp_for is the single source of the policy, shared with the
+    eager Trainer/KVStore path so both paths agree."""
+    return bool(opt._mp_for(jnp.dtype(dtype)))
+
+
 def pure_update(opt, w, g, state, t, lr, wd):
     fn = _DISPATCH.get(type(opt).__name__)
     if fn is None:
@@ -152,19 +160,33 @@ def pure_update(opt, w, g, state, t, lr, wd):
             f"fused train step has no pure update for optimizer "
             f"{type(opt).__name__}; use Trainer.step (eager) or add a rule to "
             f"mxnet_tpu.parallel.functional_opt._DISPATCH")
-    return fn(opt, w, g, state, t, lr, wd)
+    if _is_mp(opt, w.dtype):
+        # master fp32 weight rides as the LAST state element
+        master = state[-1]
+        nw32, ns = fn(opt, master, g.astype(jnp.float32), state[:-1], t, lr, wd)
+        return nw32.astype(w.dtype), tuple(ns) + (nw32,)
+    nw, ns = fn(opt, w, g, state, t, lr, wd)
+    # dtype stability: the compiled step is reused across iterations, so the
+    # update must return exactly the input dtypes (fp32 lr would otherwise
+    # promote bf16 weights and force a retrace with mismatched convs)
+    return nw.astype(w.dtype), tuple(s.astype(o.dtype)
+                                     for s, o in zip(ns, state))
 
 
 def state_template(opt, weight_array):
     """Zero state tuple matching pure_update's layout for one weight."""
-    z = lambda: jnp.zeros_like(weight_array)  # noqa: E731
+    mp = _is_mp(opt, weight_array.dtype)
+    base = weight_array.astype(jnp.float32) if mp else weight_array
+    z = lambda: jnp.zeros_like(base)  # noqa: E731
     name = type(opt).__name__
     if name in ("SGD", "NAG", "LARS", "Signum"):
-        return (z(),) if getattr(opt, "momentum", 0.0) != 0.0 or name == "NAG" else ()
-    if name in ("Adam", "AdamW", "LAMB"):
-        return (z(), z())
-    if name == "RMSProp":
-        return (z(), z(), z()) if getattr(opt, "centered", False) else (z(),)
-    if name == "AdaGrad":
-        return (z(),)
-    raise NotImplementedError(name)
+        s = (z(),) if getattr(opt, "momentum", 0.0) != 0.0 or name == "NAG" else ()
+    elif name in ("Adam", "AdamW", "LAMB"):
+        s = (z(), z())
+    elif name == "RMSProp":
+        s = (z(), z(), z()) if getattr(opt, "centered", False) else (z(),)
+    elif name == "AdaGrad":
+        s = (z(),)
+    else:
+        raise NotImplementedError(name)
+    return s + (base,) if mp else s

@@ -81,8 +81,11 @@ class TrainStep:
         # static per-param lr/wd multipliers (ref: Optimizer._get_lr/_get_wd)
         self._lr_mults = [plist[i].lr_mult for i in self._train_idx]
         self._wd_mults = [plist[i].wd_mult for i in self._train_idx]
-        self._t = jnp.zeros((), jnp.int32) + self._num_update
         self._repl = NamedSharding(self.mesh, PartitionSpec())
+        # device_put so t's aval carries the mesh like the jit outputs do —
+        # otherwise step 2 retraces (t: i32[]({}) vs i32[]({Auto: (dp,)}))
+        self._t = jax.device_put(jnp.zeros((), jnp.int32) + self._num_update,
+                                 self._repl)
         self._built = True
 
     def _base_lr(self):

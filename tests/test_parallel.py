@@ -208,6 +208,30 @@ def test_train_step_adagrad_and_lamb():
         assert np.isfinite(l1) and l1 < l0, (name, l0, l1)
 
 
+def test_train_step_bf16_multi_precision():
+    """bf16 params train with fp32 master weights in state (ref: mp_sgd_update)
+    and param/state dtypes stay fixed across steps (no silent fp32 promotion,
+    which would retrace the compiled step with mismatched conv dtypes)."""
+    net = _mlp()
+    net.cast("bfloat16")
+    mesh = parallel.make_mesh(dp=8)
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), opt,
+                              mesh=mesh)
+    x = mx.nd.array(np.random.randn(16, 16).astype(np.float32)).astype("bfloat16")
+    y = mx.nd.array(np.random.randint(0, 10, (16,)))
+    l0 = float(step(x, y).asnumpy())
+    for _ in range(9):
+        l1 = float(step(x, y).asnumpy())
+    assert np.isfinite(l1) and l1 < l0
+    for a in step._train_arrays:
+        assert a.dtype == jnp.bfloat16, a.dtype
+    for s in step._states:
+        assert s[-1].dtype == jnp.float32  # fp32 master weight
+    # exactly one trace: dtype drift in pure_update would retrace every step
+    assert step._jit._cache_size() == 1, step._jit._cache_size()
+
+
 def test_kvstore_string_keys_distinct_state():
     kv = mx.kv.create("local")
     opt = mx.optimizer.create("adam", learning_rate=0.1)
