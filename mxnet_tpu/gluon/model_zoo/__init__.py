@@ -1,3 +1,5 @@
-"""gluon.model_zoo (ref: python/mxnet/gluon/model_zoo/)."""
+"""gluon.model_zoo (ref: python/mxnet/gluon/model_zoo/; bert mirrors the
+GluonNLP model family named by BASELINE.json)."""
 from . import vision
+from . import bert
 from .vision import get_model

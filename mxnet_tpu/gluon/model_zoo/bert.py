@@ -1,0 +1,248 @@
+"""BERT — the reference ecosystem's NLP flagship (config 4 in BASELINE.md).
+
+ref: GluonNLP `src/gluonnlp/model/bert.py` — BERTModel / BERTEncoder /
+BERTLayer HybridBlocks built on the fused attention contrib ops
+(src/operator/contrib/transformer.cc — interleaved_matmul_selfatt_qk/valatt).
+
+TPU-native design notes (not a port):
+- batch-major (B, S, C) activations throughout — maps onto MXU tiles without
+  the reference's (S, B, C) cuBLAS-strided-batch layout gymnastics;
+- one fused `multi_head_attention` op per layer (scale+mask+softmax+matmuls
+  in a single XLA fusion; Pallas flash kernel swaps in for long sequences)
+  instead of the reference's two contrib ops with a materialised (B*H, S, S)
+  score tensor;
+- masked-LM gather uses fixed-shape `take_along` (masked_positions padded to
+  a static width) so the whole pretraining step stays one XLA program.
+"""
+from __future__ import annotations
+
+from ... import initializer as init_mod
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, LayerNorm
+from ..loss import SoftmaxCrossEntropyLoss
+
+__all__ = ["BERTEncoder", "BERTLayer", "BERTModel", "BERTPretrainLoss",
+           "bert_12_768_12", "bert_24_1024_16", "get_bert_model"]
+
+
+class BERTAttentionCell(HybridBlock):
+    """Self-attention with a single interleaved QKV projection.
+
+    ref: gluonnlp BERTSelfAttentionCell + the interleaved projection trick of
+    src/operator/contrib/transformer.cc (one (3*C) matmul, not three)."""
+
+    def __init__(self, units, num_heads, dropout=0.0, in_units=0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert units % num_heads == 0
+        self._units = units
+        self._heads = num_heads
+        self._dropout = dropout
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, in_units=in_units or units,
+                             weight_initializer=init_mod.TruncNorm(stdev=0.02))
+            self.proj = Dense(units, flatten=False, in_units=units,
+                              weight_initializer=init_mod.TruncNorm(stdev=0.02))
+            self.dropout = Dropout(dropout)
+
+    def forward(self, x, mask=None):
+        from ... import ndarray as F
+        qkv = self.qkv(x)                       # (B, S, 3C)
+        q, k, v = F.split(qkv, num_outputs=3, axis=-1)
+        if mask is None:
+            out = F.multi_head_attention(q, k, v, heads=self._heads,
+                                         dropout=self._dropout)
+        else:
+            # mask rides positionally: invoke() unwraps positional NDArrays
+            out = F.multi_head_attention(q, k, v, mask, heads=self._heads,
+                                         dropout=self._dropout)
+        return self.dropout(self.proj(out))
+
+
+class BERTLayer(HybridBlock):
+    """Post-LN transformer encoder layer (ref: gluonnlp BERTEncoderCell)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.attention = BERTAttentionCell(units, num_heads, dropout=dropout)
+            self.ln1 = LayerNorm(in_channels=units, epsilon=1e-12)
+            self.ffn1 = Dense(hidden_size, flatten=False, activation="gelu",
+                              in_units=units,
+                              weight_initializer=init_mod.TruncNorm(stdev=0.02))
+            self.ffn2 = Dense(units, flatten=False, in_units=hidden_size,
+                              weight_initializer=init_mod.TruncNorm(stdev=0.02))
+            self.dropout = Dropout(dropout)
+            self.ln2 = LayerNorm(in_channels=units, epsilon=1e-12)
+
+    def forward(self, x, mask=None):
+        x = self.ln1(x + self.attention(x, mask))
+        h = self.dropout(self.ffn2(self.ffn1(x)))
+        return self.ln2(x + h)
+
+
+class BERTEncoder(HybridBlock):
+    """Stack of BERTLayers (ref: gluonnlp BERTEncoder)."""
+
+    def __init__(self, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_layers = num_layers
+        with self.name_scope():
+            self.layers = []
+            for i in range(num_layers):
+                layer = BERTLayer(units, hidden_size, num_heads, dropout=dropout)
+                self.register_child(layer, f"layer{i}")
+                self.layers.append(layer)
+
+    def forward(self, x, mask=None):
+        for layer in self.layers:
+            x = layer(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """ref: gluonnlp BERTModel.
+
+    forward(inputs, token_types, valid_length=None, masked_positions=None) →
+      (sequence_output, pooled_output[, nsp_scores][, mlm_scores])
+    matching the reference's output ORDER (classifier before decoder):
+      - nsp_scores only when use_classifier
+      - mlm_scores only when masked_positions given and use_decoder
+    """
+
+    def __init__(self, vocab_size=30522, token_type_vocab_size=2,
+                 units=768, hidden_size=3072, num_layers=12, num_heads=12,
+                 max_length=512, dropout=0.1, use_pooler=True,
+                 use_decoder=True, use_classifier=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._use_pooler = use_pooler
+        self._use_decoder = use_decoder
+        self._use_classifier = use_classifier
+        if use_classifier and not use_pooler:
+            raise ValueError("use_classifier=True requires use_pooler=True "
+                             "(the NSP head reads the pooled [CLS] output)")
+        tn = init_mod.TruncNorm(stdev=0.02)
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units, weight_initializer=tn)
+            self.token_type_embed = Embedding(token_type_vocab_size, units,
+                                              weight_initializer=tn)
+            self.position_weight = self.params.get(
+                "position_weight", shape=(max_length, units), init=tn)
+            self.embed_ln = LayerNorm(in_channels=units, epsilon=1e-12)
+            self.embed_dropout = Dropout(dropout)
+            self.encoder = BERTEncoder(num_layers=num_layers, units=units,
+                                       hidden_size=hidden_size,
+                                       num_heads=num_heads, dropout=dropout)
+            if use_pooler:
+                self.pooler = Dense(units, flatten=False, activation="tanh",
+                                    in_units=units, weight_initializer=tn)
+            if use_classifier:
+                self.classifier = Dense(2, flatten=False, in_units=units,
+                                        weight_initializer=tn)
+            if use_decoder:
+                # MLM head; output projection is TIED to word_embed.weight
+                # (ref: gluonnlp BERTModel._decode shares the embedding)
+                self.decoder_transform = Dense(units, flatten=False,
+                                               activation="gelu", in_units=units,
+                                               weight_initializer=tn)
+                self.decoder_ln = LayerNorm(in_channels=units, epsilon=1e-12)
+                self.decoder_bias = self.params.get(
+                    "decoder_bias", shape=(vocab_size,), init="zeros")
+
+    def _embed(self, F, inputs, token_types):
+        x = self.word_embed(inputs) + self.token_type_embed(token_types)
+        seq_len = inputs.shape[1]
+        pos = F.slice_axis(self.position_weight.data(), axis=0, begin=0,
+                           end=seq_len)
+        x = x + F.expand_dims(pos, axis=0)
+        return self.embed_dropout(self.embed_ln(x))
+
+    def forward(self, inputs, token_types, valid_length=None,
+                masked_positions=None):
+        from ... import ndarray as F
+        x = self._embed(F, inputs, token_types)
+        mask = None
+        if valid_length is not None:
+            steps = F.arange(inputs.shape[1], ctx=inputs.context)
+            # (B, 1, 1, S_k): key positions >= valid_length are masked out
+            mask = F.expand_dims(F.expand_dims(
+                F.broadcast_lesser(F.expand_dims(steps, axis=0),
+                                   F.expand_dims(valid_length, axis=-1)),
+                axis=1), axis=1)
+        seq_out = self.encoder(x, mask)
+        outputs = [seq_out]
+        if self._use_pooler:
+            pooled = self.pooler(F.slice_axis(seq_out, axis=1, begin=0, end=1)
+                                 .reshape((0, -1)))
+            outputs.append(pooled)
+            if self._use_classifier:
+                outputs.append(self.classifier(pooled))
+        if self._use_decoder and masked_positions is not None:
+            sel = _take_along_seq(F, seq_out, masked_positions)  # (B, M, C)
+            h = self.decoder_ln(self.decoder_transform(sel))
+            w = self.word_embed.weight.data()                    # (V, C)
+            mlm = F.dot(h.reshape((-1, self._units)), w, transpose_b=True)
+            mlm = mlm.reshape((inputs.shape[0], -1, w.shape[0])) \
+                + self.decoder_bias.data().reshape((1, 1, -1))
+            outputs.append(mlm)
+        return tuple(outputs) if len(outputs) > 1 else outputs[0]
+
+
+def _take_along_seq(F, seq, positions):
+    """Gather (B, M, C) rows of (B, S, C) at int positions (B, M) —
+    fixed-shape (positions are padded), so jit-stable."""
+    b, s, c = seq.shape
+    m = positions.shape[1]
+    batch_idx = F.arange(b, dtype="int32", ctx=seq.context) \
+        .reshape((b, 1)).broadcast_to((b, m))
+    idx = F.stack(batch_idx, positions.astype("int32"), axis=0)  # (2, B, M)
+    return F.gather_nd(seq, idx)
+
+
+class BERTPretrainLoss(HybridBlock):
+    """Masked-LM + next-sentence loss (ref: gluonnlp BERTForPretrainLoss).
+
+    call(mlm_scores, nsp_scores, mlm_labels, mlm_weights, nsp_labels) →
+    scalar loss = mean masked CE + mean NSP CE."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._ce = SoftmaxCrossEntropyLoss()
+
+    def forward(self, mlm_scores, nsp_scores, mlm_labels, mlm_weights,
+                nsp_labels):
+        from ... import ndarray as F
+        v = mlm_scores.shape[-1]
+        mlm_l = self._ce(mlm_scores.reshape((-1, v)), mlm_labels.reshape((-1,)))
+        w = mlm_weights.reshape((-1,)).astype(mlm_l.dtype)
+        mlm_loss = (mlm_l * w).sum() / F.maximum(w.sum(), 1e-5)
+        nsp_loss = self._ce(nsp_scores, nsp_labels).mean()
+        return mlm_loss + nsp_loss
+
+
+_BERT_CONFIGS = {
+    # name: (num_layers, units, hidden, heads)
+    "bert_12_768_12": (12, 768, 3072, 12),
+    "bert_24_1024_16": (24, 1024, 4096, 16),
+}
+
+
+def get_bert_model(model_name="bert_12_768_12", vocab_size=30522,
+                   max_length=512, dropout=0.1, **kwargs):
+    """ref: gluonnlp.model.get_model('bert_12_768_12', ...)."""
+    layers, units, hidden, heads = _BERT_CONFIGS[model_name]
+    return BERTModel(vocab_size=vocab_size, units=units, hidden_size=hidden,
+                     num_layers=layers, num_heads=heads, max_length=max_length,
+                     dropout=dropout, **kwargs)
+
+
+def bert_12_768_12(**kwargs):
+    return get_bert_model("bert_12_768_12", **kwargs)
+
+
+def bert_24_1024_16(**kwargs):
+    return get_bert_model("bert_24_1024_16", **kwargs)

@@ -19,8 +19,9 @@ from .base import dtype_np
 from . import random as _random
 
 __all__ = [
-    "Initializer", "Zero", "One", "Constant", "Uniform", "Normal", "Xavier",
-    "MSRAPrelu", "Orthogonal", "LSTMBias", "Bilinear", "register", "create",
+    "Initializer", "Zero", "One", "Constant", "Uniform", "Normal", "TruncNorm",
+    "Xavier", "MSRAPrelu", "Orthogonal", "LSTMBias", "Bilinear", "register",
+    "create",
 ]
 
 _REGISTRY = {}
@@ -126,6 +127,21 @@ class Normal(Initializer):
     def init_array(self, shape, dtype="float32"):
         key = _random.next_key()
         return (jax.random.normal(key, shape, jnp.float32) * self.sigma).astype(dtype_np(dtype))
+
+
+@register
+class TruncNorm(Initializer):
+    """Truncated normal at ±2σ (ref: gluonnlp TruncNorm — BERT's init)."""
+
+    def __init__(self, mean=0.0, stdev=0.01):
+        super().__init__(mean=mean, stdev=stdev)
+        self.mean = mean
+        self.stdev = stdev
+
+    def init_array(self, shape, dtype="float32"):
+        key = _random.next_key()
+        x = jax.random.truncated_normal(key, -2.0, 2.0, shape, jnp.float32)
+        return (x * self.stdev + self.mean).astype(dtype_np(dtype))
 
 
 def _fan(shape, factor_type):

@@ -50,13 +50,20 @@ def _selfatt_valatt(queries_keys_values, attention, heads=1):
     return jnp.transpose(out, (2, 0, 1, 3)).reshape(s, b, heads * d)
 
 
-@register_op("multi_head_attention")
-def _multi_head_attention(q, k, v, mask=None, heads=1, dropout=0.0, causal=False):
+@register_op("multi_head_attention", needs_rng=True)
+def _multi_head_attention(q, k, v, mask=None, heads=1, dropout=0.0,
+                          causal=False, training=None):
     """Fused MHA on (B, S, H*D)-shaped projections; XLA fuses scale+softmax.
 
     No reference analogue as a single op (GluonNLP composes the two contrib
     ops); provided because one fused op is the idiomatic TPU formulation.
+    ``dropout`` drops attention probabilities (the reference cell's
+    _attention_dropout), train-mode only.
     """
+    from .. import autograd as _autograd
+    from .. import random as _random
+    if training is None:
+        training = _autograd.is_training()
     b, sq, hd = q.shape
     d = hd // heads
     def to_bhsd(x):
@@ -71,6 +78,11 @@ def _multi_head_attention(q, k, v, mask=None, heads=1, dropout=0.0, causal=False
     if mask is not None:
         scores = jnp.where(mask.astype(bool), scores, jnp.asarray(-1e30, scores.dtype))
     attn = jax.nn.softmax(scores, axis=-1)
+    if dropout > 0.0 and training:
+        keep = jax.random.bernoulli(_random.next_key(), 1.0 - dropout,
+                                    shape=attn.shape)
+        attn = jnp.where(keep, attn / (1.0 - dropout),
+                         jnp.zeros((), attn.dtype))
     out = jnp.einsum("bhqk,bhkd->bhqd", attn, vh)
     return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, hd)
 

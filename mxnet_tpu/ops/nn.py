@@ -242,6 +242,12 @@ def _activation(data, act_type="relu"):
         return jax.nn.softplus(data)
     if act_type == "softsign":
         return jax.nn.soft_sign(data)
+    if act_type == "gelu":
+        # reference routes gelu via LeakyReLU(act_type='gelu'); accepted here
+        # too so Dense(activation='gelu') works (the BERT FFN path)
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "silu" or act_type == "swish":
+        return jax.nn.silu(data)
     raise ValueError(f"unknown act_type {act_type}")
 
 

@@ -1,0 +1,118 @@
+"""BERT model family tests (config 4 path, ref: GluonNLP model/bert.py
+contract — see mxnet_tpu/gluon/model_zoo/bert.py docstrings)."""
+import numpy as np
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon, parallel
+from mxnet_tpu.gluon.model_zoo.bert import (BERTModel, BERTPretrainLoss,
+                                            get_bert_model)
+
+
+def _tiny_bert(dropout=0.0):
+    net = BERTModel(vocab_size=50, units=32, hidden_size=64, num_layers=2,
+                    num_heads=4, max_length=32, dropout=dropout)
+    net.initialize()
+    return net
+
+
+def _batch(rng, B=8, S=16, M=4, vocab=50):
+    tok = nd.array(rng.randint(0, vocab, (B, S)).astype(np.int32))
+    tt = nd.array(rng.randint(0, 2, (B, S)).astype(np.int32))
+    vl = nd.array(rng.randint(S // 2, S + 1, (B,)).astype(np.int32))
+    mp = nd.array(rng.randint(0, S // 2, (B, M)).astype(np.int32))
+    ml = nd.array(rng.randint(0, vocab, (B, M)).astype(np.int32))
+    mw = nd.array(np.ones((B, M), np.float32))
+    nl = nd.array(rng.randint(0, 2, (B,)).astype(np.int32))
+    return tok, tt, vl, mp, ml, mw, nl
+
+
+def test_bert_output_contract():
+    """(seq, pooled, mlm, nsp) shapes per the reference contract."""
+    net = _tiny_bert()
+    rng = np.random.RandomState(0)
+    tok, tt, vl, mp, *_ = _batch(rng)
+    # reference output ORDER: seq, pooled, nsp (classifier), mlm (decoder)
+    seq, pooled, nsp, mlm = net(tok, tt, vl, mp)
+    assert seq.shape == (8, 16, 32)
+    assert pooled.shape == (8, 32)
+    assert nsp.shape == (8, 2)
+    assert mlm.shape == (8, 4, 50)
+    # without masked_positions: no mlm output
+    seq2, pooled2, nsp2 = net(tok, tt, vl)
+    assert seq2.shape == (8, 16, 32) and nsp2.shape == (8, 2)
+
+
+def test_bert_valid_length_masks_keys():
+    """Positions past valid_length must not influence earlier outputs."""
+    net = _tiny_bert()
+    rng = np.random.RandomState(1)
+    B, S = 4, 16
+    tok = rng.randint(0, 50, (B, S)).astype(np.int32)
+    tt = np.zeros((B, S), np.int32)
+    vl = np.full((B,), 8, np.int32)
+    out1 = net(nd.array(tok), nd.array(tt), nd.array(vl))[0].asnumpy()
+    tok2 = tok.copy()
+    tok2[:, 8:] = (tok2[:, 8:] + 7) % 50  # scramble masked-out tail
+    out2 = net(nd.array(tok2), nd.array(tt), nd.array(vl))[0].asnumpy()
+    np.testing.assert_allclose(out1[:, :8], out2[:, :8], rtol=1e-5, atol=1e-5)
+
+
+def test_bert_decoder_weight_tied():
+    """MLM projection must reuse the word embedding weight (tied)."""
+    net = _tiny_bert()
+    rng = np.random.RandomState(2)
+    tok, tt, vl, mp, *_ = _batch(rng)
+    mlm1 = net(tok, tt, vl, mp)[3].asnumpy()
+    w = net.word_embed.weight
+    w.set_data(w.data() * 2.0)
+    mlm2 = net(tok, tt, vl, mp)[3].asnumpy()
+    assert not np.allclose(mlm1, mlm2)
+
+
+def test_bert_pretrain_convergence_fused_step():
+    """Tiny BERT memorizes a fixed masked batch through the fused SPMD step
+    with LAMB (the reference's BERT optimizer)."""
+    mx.random.seed(0)
+    net = _tiny_bert(dropout=0.0)
+    loss_blk = BERTPretrainLoss()
+
+    def loss_fn(out, lab):
+        nsp_scores, mlm_scores = out[2], out[3]
+        return loss_blk(mlm_scores, nsp_scores, *lab)
+
+    mesh = parallel.make_mesh(dp=8)
+    opt = mx.optimizer.create("lamb", learning_rate=0.02)
+    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh)
+    rng = np.random.RandomState(3)
+    tok, tt, vl, mp, ml, mw, nl = _batch(rng)
+    losses = [float(step((tok, tt, vl, mp), (ml, mw, nl)).asnumpy())
+              for _ in range(50)]
+    assert losses[-1] < losses[0] - 1.0, (losses[0], losses[-1])
+
+
+def test_bert_attention_dropout_active_in_train_mode():
+    net = _tiny_bert(dropout=0.3)
+    rng = np.random.RandomState(4)
+    tok, tt, vl, mp, *_ = _batch(rng)
+    with autograd.record(train_mode=True):
+        a = net(tok, tt)[0].asnumpy()
+        b = net(tok, tt)[0].asnumpy()
+    assert not np.allclose(a, b)  # dropout draws differ
+    c = net(tok, tt)[0].asnumpy()
+    d = net(tok, tt)[0].asnumpy()
+    np.testing.assert_allclose(c, d)  # eval is deterministic
+
+
+def test_bert_classifier_requires_pooler():
+    import pytest
+    with pytest.raises(ValueError):
+        BERTModel(vocab_size=10, units=8, hidden_size=16, num_layers=1,
+                  num_heads=2, use_pooler=False, use_classifier=True)
+
+
+def test_bert_named_configs():
+    net = get_bert_model("bert_12_768_12", vocab_size=64, max_length=16)
+    # 12 layers, 768 units registered without initialization cost concerns
+    assert len(net.encoder.layers) == 12
+    assert net.encoder.layers[0].ffn1._units == 3072
