@@ -32,12 +32,13 @@ class BERTAttentionCell(HybridBlock):
     src/operator/contrib/transformer.cc (one (3*C) matmul, not three)."""
 
     def __init__(self, units, num_heads, dropout=0.0, in_units=0,
-                 prefix=None, params=None):
+                 attention_impl="dense", prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         assert units % num_heads == 0
         self._units = units
         self._heads = num_heads
         self._dropout = dropout
+        self._impl = attention_impl
         with self.name_scope():
             self.qkv = Dense(3 * units, flatten=False, in_units=in_units or units,
                              weight_initializer=init_mod.TruncNorm(stdev=0.02))
@@ -49,7 +50,16 @@ class BERTAttentionCell(HybridBlock):
         from ... import ndarray as F
         qkv = self.qkv(x)                       # (B, S, 3C)
         q, k, v = F.split(qkv, num_outputs=3, axis=-1)
-        if mask is None:
+        if self._impl != "dense":
+            # sequence-parallel long-context path (ring/ulysses over the
+            # active mesh's sp axis); padding masks not yet supported there
+            if mask is not None:
+                raise ValueError(f"attention_impl='{self._impl}' does not "
+                                 "support valid_length masks yet")
+            op = (F.ring_attention if self._impl == "ring"
+                  else F.ulysses_attention)
+            out = op(q, k, v, heads=self._heads, dropout=self._dropout)
+        elif mask is None:
             out = F.multi_head_attention(q, k, v, heads=self._heads,
                                          dropout=self._dropout)
         else:
@@ -63,10 +73,11 @@ class BERTLayer(HybridBlock):
     """Post-LN transformer encoder layer (ref: gluonnlp BERTEncoderCell)."""
 
     def __init__(self, units, hidden_size, num_heads, dropout=0.0,
-                 prefix=None, params=None):
+                 attention_impl="dense", prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         with self.name_scope():
-            self.attention = BERTAttentionCell(units, num_heads, dropout=dropout)
+            self.attention = BERTAttentionCell(units, num_heads, dropout=dropout,
+                                               attention_impl=attention_impl)
             self.ln1 = LayerNorm(in_channels=units, epsilon=1e-12)
             self.ffn1 = Dense(hidden_size, flatten=False, activation="gelu",
                               in_units=units,
@@ -86,13 +97,15 @@ class BERTEncoder(HybridBlock):
     """Stack of BERTLayers (ref: gluonnlp BERTEncoder)."""
 
     def __init__(self, num_layers=12, units=768, hidden_size=3072,
-                 num_heads=12, dropout=0.1, prefix=None, params=None):
+                 num_heads=12, dropout=0.1, attention_impl="dense",
+                 prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._num_layers = num_layers
         with self.name_scope():
             self.layers = []
             for i in range(num_layers):
-                layer = BERTLayer(units, hidden_size, num_heads, dropout=dropout)
+                layer = BERTLayer(units, hidden_size, num_heads, dropout=dropout,
+                                  attention_impl=attention_impl)
                 self.register_child(layer, f"layer{i}")
                 self.layers.append(layer)
 
@@ -116,7 +129,7 @@ class BERTModel(HybridBlock):
                  units=768, hidden_size=3072, num_layers=12, num_heads=12,
                  max_length=512, dropout=0.1, use_pooler=True,
                  use_decoder=True, use_classifier=True,
-                 prefix=None, params=None):
+                 attention_impl="dense", prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._units = units
         self._use_pooler = use_pooler
@@ -136,7 +149,8 @@ class BERTModel(HybridBlock):
             self.embed_dropout = Dropout(dropout)
             self.encoder = BERTEncoder(num_layers=num_layers, units=units,
                                        hidden_size=hidden_size,
-                                       num_heads=num_heads, dropout=dropout)
+                                       num_heads=num_heads, dropout=dropout,
+                                       attention_impl=attention_impl)
             if use_pooler:
                 self.pooler = Dense(units, flatten=False, activation="tanh",
                                     in_units=units, weight_initializer=tn)

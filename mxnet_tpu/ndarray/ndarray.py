@@ -474,6 +474,10 @@ def invoke(op_name: str, *args, out=None, **kwargs):
         node = _autograd.TapeNode(nd_inputs, list(out_nds), _pull, name=op_name)
         _autograd.append_node(node)
         return out_nds if isinstance(result, tuple) else out_nds[0]
+    elif meta.get("mesh_aware"):
+        # shard_map ops must not be wrapped in a single-device jit: the op
+        # itself device_puts inputs onto the mesh and runs SPMD
+        result = get_op(op_name)(*raw, **kwargs)
     else:
         static, dnames, dvals = split_dynamic(kwargs, meta.get("dynamic", False))
         jfn = compiled(op_name, params_key(static), dnames)

@@ -90,3 +90,29 @@ def _multi_head_attention(q, k, v, mask=None, heads=1, dropout=0.0,
 @register_op("div_sqrt_dim", aliases=("_contrib_div_sqrt_dim",))
 def _div_sqrt_dim(x):
     return x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype))
+
+
+@register_op("ring_attention", mesh_aware=True)
+def _ring_attention(q, k, v, heads=1, causal=False, axis="sp",
+                    batch_axis="dp", dropout=0.0, training=None):
+    """Sequence-parallel attention over the active mesh's ``sp`` axis
+    (no reference analogue — SURVEY.md §5.7 gap, first-class here).
+    Requires a parallel.MeshScope (or TrainStep/EvalStep, which provide one)."""
+    from .. import autograd as _autograd
+    from ..parallel.sequence import ring_attention
+    if training is None:
+        training = _autograd.is_training()
+    return ring_attention(q, k, v, heads, axis=axis, batch_axis=batch_axis,
+                          causal=causal, dropout=dropout, training=training)
+
+
+@register_op("ulysses_attention", mesh_aware=True)
+def _ulysses_attention(q, k, v, heads=1, causal=False, axis="sp",
+                       batch_axis="dp", dropout=0.0, training=None):
+    """Ulysses head-sharded attention over the active mesh (see above)."""
+    from .. import autograd as _autograd
+    from ..parallel.sequence import ulysses_attention
+    if training is None:
+        training = _autograd.is_training()
+    return ulysses_attention(q, k, v, heads, axis=axis, batch_axis=batch_axis,
+                             causal=causal, dropout=dropout, training=training)

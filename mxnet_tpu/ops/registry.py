@@ -22,8 +22,13 @@ OPS: Dict[str, Callable] = {}
 OP_META: Dict[str, dict] = {}
 
 
-def register_op(name, fn: Callable = None, aliases=(), needs_rng: bool = False):
-    """Register ``fn`` under ``name`` (+aliases). Usable as a decorator."""
+def register_op(name, fn: Callable = None, aliases=(), needs_rng: bool = False,
+                mesh_aware: bool = False):
+    """Register ``fn`` under ``name`` (+aliases). Usable as a decorator.
+
+    ``mesh_aware`` ops contain shard_map over the ambient parallel mesh;
+    eager dispatch calls them directly (no single-device jit wrapper, which
+    would pin inputs to one device and fight the mesh)."""
 
     def _do(f):
         try:
@@ -31,6 +36,7 @@ def register_op(name, fn: Callable = None, aliases=(), needs_rng: bool = False):
         except (TypeError, ValueError):
             has_training = False
         meta = {"has_training": has_training, "needs_rng": needs_rng,
+                "mesh_aware": mesh_aware,
                 # Only optimizer update kernels take per-step scalar
                 # hyperparams (lr schedules etc.) as traced args; everywhere
                 # else scalars stay static so XLA constant-folds them.

@@ -14,6 +14,7 @@ from .mesh import (AXES, MeshScope, current_mesh, default_mesh, make_mesh,
 from .sharding import (ShardingRules, batch_spec, fsdp_rules, param_sharding,
                        tp_dense_rules)
 from .functional import functional_call, param_names_and_values
+from .sequence import ring_attention, sp_attention, ulysses_attention
 from .step import EvalStep, TrainStep
 
 __all__ = [
@@ -22,5 +23,6 @@ __all__ = [
     "ShardingRules", "batch_spec", "fsdp_rules", "param_sharding",
     "tp_dense_rules",
     "functional_call", "param_names_and_values",
+    "ring_attention", "sp_attention", "ulysses_attention",
     "EvalStep", "TrainStep",
 ]

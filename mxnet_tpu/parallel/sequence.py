@@ -1,0 +1,215 @@
+"""Sequence/context parallelism: ring attention + Ulysses head-sharding.
+
+The reference has NO long-context story (SURVEY.md §5.7: max sequence length
+is single-device memory; its attention materialises (B*H, S, S) scores —
+src/operator/contrib/transformer.cc).  These are first-class here:
+
+- ``ring_attention``: K/V blocks rotate around the ``sp`` mesh axis via
+  ``lax.ppermute`` (ICI neighbour hops) while each device holds its Q shard;
+  online-softmax accumulation keeps memory O(S_local) — blockwise attention
+  distributed over devices (Liu et al., Ring Attention).
+- ``ulysses_attention``: two ``lax.all_to_all``s re-shard sequence↔heads so
+  each device runs FULL-sequence attention for its head group (DeepSpeed
+  Ulysses) — fewer collectives, bounded by num_heads % sp == 0.
+
+Both take globally-sharded (B, S, H*D) projections (batch over ``dp``,
+sequence over ``sp``) and are called inside jit: shard_map makes the
+collectives explicit while XLA schedules/overlaps them on ICI.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+from jax import shard_map
+
+from .mesh import current_mesh
+
+__all__ = ["ring_attention", "ulysses_attention", "sp_attention"]
+
+
+def _place(mesh, spec, *arrays):
+    """Put inputs on the mesh.  Eager calls arrive committed to one device and
+    are moved; under a trace (jit / eager vjp) device_put is a sharding
+    constraint that forces the same placement."""
+    sh = NamedSharding(mesh, spec)
+    return tuple(jax.device_put(a, sh) for a in arrays)
+
+
+def _maybe_gather(out, *inputs):
+    """Eager calls (concrete inputs) get a single-device result back so the
+    surrounding eager ops (device-0 committed) keep working; traced calls
+    stay mesh-sharded for XLA to fuse."""
+    if any(isinstance(a, jax.core.Tracer) for a in inputs):
+        return out
+    return jax.device_put(out, jax.devices()[0])
+
+
+def _rng_arg(dropout):
+    """A PRNG key input for the shard_map (replicated); dummy when unused so
+    the call signature stays stable."""
+    if dropout > 0.0:
+        from .. import random as _random
+        return _random.next_key()
+    return jax.random.key(0)
+
+
+def _attn_dropout(p, rate, key, axis, step=0):
+    """Drop attention probabilities; independent stream per device+step."""
+    k = jax.random.fold_in(jax.random.fold_in(key, jax.lax.axis_index(axis)),
+                           step)
+    keep = jax.random.bernoulli(k, 1.0 - rate, shape=p.shape)
+    return jnp.where(keep, p / (1.0 - rate), jnp.zeros((), p.dtype))
+
+
+def _to_bhsd(x, heads):
+    b, s, hd = x.shape
+    return jnp.transpose(x.reshape(b, s, heads, hd // heads), (0, 2, 1, 3))
+
+
+def _from_bhsd(x):
+    b, h, s, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, s, h * d)
+
+
+def _ring_body(q, k, v, rng, *, axis, n, causal, scale, dropout):
+    """Per-device ring loop. q/k/v: (B, H, S_loc, D) local shards.
+
+    Dropout matches dense drop-after-softmax semantics: the normaliser l
+    accumulates UNDROPPED exp-weights while the output accumulates dropped
+    ones, so out = sum_j drop(softmax(s))_j v_j exactly."""
+    idx = jax.lax.axis_index(axis)
+    s_loc = q.shape[2]
+    neg = jnp.asarray(-1e30, jnp.float32)
+
+    q32 = q.astype(jnp.float32) * scale
+    o = jnp.zeros(q.shape, jnp.float32)
+    m = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)          # (B, H, Sq)
+    l = jnp.zeros(q.shape[:3], jnp.float32)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    q_pos = idx * s_loc + jnp.arange(s_loc)
+    for step in range(n):
+        # after `step` rotations device idx holds block (idx - step) mod n
+        src = (idx - step) % n
+        s_blk = jnp.einsum("bhqd,bhkd->bhqk", q32, k.astype(jnp.float32))
+        if causal:
+            k_pos = src * s_loc + jnp.arange(s_loc)
+            allow = q_pos[:, None] >= k_pos[None, :]
+            s_blk = jnp.where(allow[None, None], s_blk, neg)
+        m_new = jnp.maximum(m, s_blk.max(axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s_blk - m_new[..., None])
+        l = l * corr + p.sum(axis=-1)
+        p_eff = _attn_dropout(p, dropout, rng, axis, step) if dropout > 0.0 \
+            else p
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p_eff, v.astype(jnp.float32))
+        m = m_new
+        if step != n - 1:
+            k = jax.lax.ppermute(k, axis, perm)
+            v = jax.lax.ppermute(v, axis, perm)
+    # fully-masked rows (causal with no allowed key yet) have l == 0
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q, k, v, heads, mesh=None, axis="sp", batch_axis="dp",
+                   causal=False, dropout=0.0, training=False):
+    """Distributed attention over sequence-sharded (B, S, H*D) projections.
+
+    Returns (B, S, H*D), sequence still sharded over ``axis``.  Within-device
+    blocks are dense MXU matmuls; cross-device K/V movement is ``ppermute``
+    neighbour hops overlapped by XLA with the block compute."""
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention needs a mesh: pass mesh= or enter a "
+                         "parallel.MeshScope")
+    n = mesh.shape[axis]
+    d = (q.shape[-1] // heads)
+    scale = 1.0 / (d ** 0.5)
+    spec = PartitionSpec(batch_axis if batch_axis in mesh.shape else None,
+                         axis, None)
+    drop = dropout if training else 0.0
+    rng = _rng_arg(drop)
+    q0, k0, v0 = _place(mesh, spec, q, k, v)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec, PartitionSpec()),
+                       out_specs=spec, check_vma=False)
+    def _run(ql, kl, vl, rng_l):
+        body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
+                                 scale=scale, dropout=drop)
+        out = body(_to_bhsd(ql, heads), _to_bhsd(kl, heads),
+                   _to_bhsd(vl, heads), rng_l)
+        return _from_bhsd(out)
+
+    return _maybe_gather(_run(q0, k0, v0, rng), q, k, v)
+
+
+def ulysses_attention(q, k, v, heads, mesh=None, axis="sp", batch_axis="dp",
+                      causal=False, dropout=0.0, training=False):
+    """Ulysses: all_to_all seq→heads, full-sequence attention per head group,
+    all_to_all back.  Requires heads % mesh.shape[axis] == 0."""
+    mesh = mesh if mesh is not None else current_mesh()
+    if mesh is None:
+        raise ValueError("ulysses_attention needs a mesh: pass mesh= or enter "
+                         "a parallel.MeshScope")
+    n = mesh.shape[axis]
+    if heads % n != 0:
+        raise ValueError(f"ulysses needs heads ({heads}) divisible by "
+                         f"mesh axis '{axis}' ({n})")
+    d = q.shape[-1] // heads
+    scale = 1.0 / (d ** 0.5)
+    spec = PartitionSpec(batch_axis if batch_axis in mesh.shape else None,
+                         axis, None)
+    drop = dropout if training else 0.0
+    rng = _rng_arg(drop)
+    q0, k0, v0 = _place(mesh, spec, q, k, v)
+
+    @functools.partial(shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec, PartitionSpec()),
+                       out_specs=spec, check_vma=False)
+    def _run(ql, kl, vl, rng_l):
+        def gather_seq(x):  # (B, S_loc, H, D) -> (B, S, H/n, D)
+            return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                      tiled=True)
+        def scatter_seq(x):  # (B, S, H/n, D) -> (B, S_loc, H, D)
+            return jax.lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                      tiled=True)
+        b, s_loc, hd = ql.shape
+        def split_heads(x):
+            return x.reshape(b, s_loc, heads, d)
+        qh = gather_seq(split_heads(ql))
+        kh = gather_seq(split_heads(kl))
+        vh = gather_seq(split_heads(vl))
+        # (B, S, H/n, D) -> (B, H/n, S, D) dense attention
+        qt = jnp.transpose(qh, (0, 2, 1, 3)).astype(jnp.float32) * scale
+        kt = jnp.transpose(kh, (0, 2, 1, 3)).astype(jnp.float32)
+        vt = jnp.transpose(vh, (0, 2, 1, 3)).astype(jnp.float32)
+        s_blk = jnp.einsum("bhqd,bhkd->bhqk", qt, kt)
+        if causal:
+            sq = s_blk.shape[-1]
+            allow = jnp.tril(jnp.ones((sq, sq), bool))
+            s_blk = jnp.where(allow[None, None], s_blk,
+                              jnp.asarray(-1e30, jnp.float32))
+        attn = jax.nn.softmax(s_blk, axis=-1)
+        if drop > 0.0:
+            attn = _attn_dropout(attn, drop, rng_l, axis)
+        out = jnp.einsum("bhqk,bhkd->bhqd", attn, vt).astype(ql.dtype)
+        out = jnp.transpose(out, (0, 2, 1, 3))          # (B, S, H/n, D)
+        out = scatter_seq(out)                          # (B, S_loc, H, D)
+        return out.reshape(b, s_loc, heads * d)
+
+    return _maybe_gather(_run(q0, k0, v0, rng), q, k, v)
+
+
+def sp_attention(q, k, v, heads, impl="ring", **kwargs):
+    """Dispatch helper: impl in {'ring', 'ulysses'}."""
+    if impl == "ring":
+        return ring_attention(q, k, v, heads, **kwargs)
+    if impl == "ulysses":
+        return ulysses_attention(q, k, v, heads, **kwargs)
+    raise ValueError(f"unknown sequence-parallel impl '{impl}'")

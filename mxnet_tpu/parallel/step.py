@@ -27,7 +27,7 @@ from .. import random as _random
 from .. import autograd as _autograd
 from ..ndarray import NDArray
 from ..gluon.block import Block, _flatten_nd, _unflatten_nd
-from .mesh import default_mesh
+from .mesh import MeshScope, default_mesh
 from .sharding import ShardingRules, batch_spec, param_sharding
 from .functional import (FunctionalState, functional_call,
                          param_names_and_values, trainable_split)
@@ -63,7 +63,7 @@ class TrainStep:
         net = self.net
         if any(p._deferred_init is not None
                for p in net.collect_params().values()):
-            with _autograd.pause():
+            with _autograd.pause(), MeshScope(self.mesh):
                 Block.__call__(net, *sample_args)
         names, plist, arrays = param_names_and_values(net)
         self._names, self._plist = names, plist
@@ -114,8 +114,11 @@ class TrainStep:
                     pa[i] = a
                 for i, a in zip(aux_idx, aux_arrays):
                     pa[i] = a
-                outs = functional_call(net, plist, pa, data_tree, data_leaves,
-                                       key, True, state_holder)
+                # mesh visible to mesh-aware ops (ring/ulysses attention)
+                with MeshScope(self.mesh):
+                    outs = functional_call(net, plist, pa, data_tree,
+                                           data_leaves, key, True,
+                                           state_holder)
                 out_nd = _unflatten_nd(state_holder.out_tree,
                                        tuple(NDArray(o) for o in outs))
                 lab_nd = _unflatten_nd(label_tree,
@@ -191,11 +194,16 @@ class TrainStep:
 
     # ---------------------------------------------------------------- sync --
     def sync_params_to_net(self):
-        """Write the step-owned arrays back into the Gluon Parameters."""
+        """Write the step-owned arrays back into the Gluon Parameters.
+
+        Arrays are gathered to the default device: eager Gluon execution is
+        single-logical-device (placement-by-sharding belongs to the step), and
+        mesh-committed params would collide with device-0 inputs in eager ops."""
+        dev = jax.devices()[0]
         for i, a in zip(self._train_idx, self._train_arrays):
-            self._plist[i].data()._data = a
+            self._plist[i].data()._data = jax.device_put(a, dev)
         for i, a in zip(self._aux_idx, self._aux_arrays):
-            self._plist[i].data()._data = a
+            self._plist[i].data()._data = jax.device_put(a, dev)
 
     @property
     def params(self):
@@ -222,7 +230,7 @@ class EvalStep:
     def _build(self, sample_args):
         if any(p._deferred_init is not None
                for p in self.net.collect_params().values()):
-            with _autograd.pause():
+            with _autograd.pause(), MeshScope(self.mesh):
                 Block.__call__(self.net, *sample_args)
         names, plist, arrays = param_names_and_values(self.net)
         self._names, self._plist = names, plist
@@ -242,8 +250,9 @@ class EvalStep:
             holder = FunctionalState()
 
             def fn(arrays, key, *leaves):
-                outs = functional_call(net, plist, list(arrays), data_tree,
-                                       list(leaves), key, False, holder)
+                with MeshScope(self.mesh):
+                    outs = functional_call(net, plist, list(arrays), data_tree,
+                                           list(leaves), key, False, holder)
                 return tuple(outs)
 
             dat_sh = NamedSharding(self.mesh, self._data_pspec)
