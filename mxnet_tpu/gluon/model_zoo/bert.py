@@ -35,6 +35,9 @@ class BERTAttentionCell(HybridBlock):
                  attention_impl="dense", prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         assert units % num_heads == 0
+        if attention_impl not in ("dense", "ring", "ulysses"):
+            raise ValueError(f"unknown attention_impl '{attention_impl}' "
+                             "(expected 'dense', 'ring', or 'ulysses')")
         self._units = units
         self._heads = num_heads
         self._dropout = dropout

@@ -258,3 +258,13 @@ def shuffle(data):
 
 random.shuffle = shuffle
 sys.modules["mxnet_tpu.ndarray.random"] = random
+
+
+def __getattr__(name):
+    """PEP 562 fallback: ops registered after this module imported (e.g. by
+    mxnet_tpu.parallel extensions) still get eager wrappers on first use."""
+    if name in OPS:
+        w = _make_wrapper(name)
+        setattr(_this, name, w)
+        return w
+    raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute '{name}'")

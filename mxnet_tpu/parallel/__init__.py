@@ -14,6 +14,8 @@ from .mesh import (AXES, MeshScope, current_mesh, default_mesh, make_mesh,
 from .sharding import (ShardingRules, batch_spec, fsdp_rules, param_sharding,
                        tp_dense_rules)
 from .functional import functional_call, param_names_and_values
+from .moe import MoEFFN, moe_dispatch
+from .pipeline import PipelineStack, gpipe
 from .sequence import ring_attention, sp_attention, ulysses_attention
 from .step import EvalStep, TrainStep
 
@@ -24,5 +26,7 @@ __all__ = [
     "tp_dense_rules",
     "functional_call", "param_names_and_values",
     "ring_attention", "sp_attention", "ulysses_attention",
+    "PipelineStack", "gpipe",
+    "MoEFFN", "moe_dispatch",
     "EvalStep", "TrainStep",
 ]

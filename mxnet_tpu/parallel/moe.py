@@ -1,0 +1,160 @@
+"""Mixture-of-Experts with expert parallelism over the ``ep`` mesh axis.
+
+The reference has NO MoE (SURVEY.md §2.3: EP absent).  TPU-native design: the
+whole layer is dense einsums over fixed shapes — top-k gating, capacity-
+bounded one-hot dispatch/combine tensors (the Mesh-TensorFlow / GShard
+formulation), stacked expert weights with leading dim E annotated onto the
+``ep`` axis.  GSPMD partitions the einsums and inserts the all-to-alls; no
+hand-written collectives needed, and the whole thing jits into the fused
+train step like any other layer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["moe_dispatch", "MoEFFN"]
+
+
+def moe_dispatch(gate_logits, num_experts, capacity, k=2):
+    """GShard-style top-k routing with fixed capacity.
+
+    gate_logits: (N, E).  Returns (dispatch (N, E, C) float, combine
+    (N, E, C) float, aux_loss scalar).  Tokens beyond an expert's capacity C
+    are dropped (their combine weight is 0) — fixed shapes, jit-stable.
+    """
+    n, e = gate_logits.shape
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)  # (N, E)
+
+    # aux load-balancing loss (Switch/GShard): E * sum_e f_e * p_e
+    top1 = jnp.argmax(probs, axis=-1)
+    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
+    p_mean = jnp.mean(probs, axis=0)
+    aux_loss = e * jnp.sum(f * p_mean)
+
+    dispatch = jnp.zeros((n, e, capacity), jnp.float32)
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    remaining = probs
+    # cumulative per-expert occupancy across the k rounds
+    occupancy = jnp.zeros((e,), jnp.int32)
+    for _ in range(k):
+        idx = jnp.argmax(remaining, axis=-1)                     # (N,)
+        gate = jnp.take_along_axis(remaining, idx[:, None], 1)[:, 0]
+        mask = jax.nn.one_hot(idx, e, dtype=jnp.int32)           # (N, E)
+        pos = jnp.cumsum(mask, axis=0) - mask + occupancy[None, :]
+        pos_tok = jnp.sum(pos * mask, axis=-1)                   # (N,)
+        keep = pos_tok < capacity
+        onehot_pos = jax.nn.one_hot(pos_tok, capacity, dtype=jnp.float32)
+        d = (mask.astype(jnp.float32)[:, :, None] * onehot_pos[:, None, :]
+             * keep[:, None, None])
+        dispatch = dispatch + d
+        combine = combine + d * gate[:, None, None]
+        occupancy = occupancy + jnp.sum(mask * keep[:, None], axis=0)
+        remaining = remaining * (1.0 - mask)
+    if k > 1:
+        # renormalise combine over the selected experts (top-k gates sum to 1)
+        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+    # k == 1 keeps the raw gate multiplier (Switch Transformer): normalising
+    # would make combine ≡ 1 and zero the router's task-loss gradient
+    return dispatch, combine, aux_loss
+
+
+def _moe_ffn_op(tokens, gate_w, w1, b1, w2, b2, num_experts=1, capacity=1,
+                k=2, act="gelu", group_size=0):
+    """Registered op: full MoE FFN on (N, C) tokens -> ((N, C), aux_loss).
+
+    Tokens are routed in GROUPS of ``group_size`` with per-group capacity
+    (the GShard formulation): dispatch/combine are (G, n_g, E, C_g), keeping
+    routing-tensor memory linear in N instead of O(N^2)."""
+    n, d = tokens.shape
+    gs = group_size if group_size and group_size < n else n
+    g = -(-n // gs)                       # ceil
+    pad = g * gs - n
+    if pad:
+        tokens = jnp.concatenate(
+            [tokens, jnp.zeros((pad, d), tokens.dtype)], axis=0)
+    tg = tokens.reshape(g, gs, d)
+    logits = tg.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # (G,gs,E)
+    dispatch, combine, aux = jax.vmap(
+        lambda lg: moe_dispatch(lg, num_experts, capacity, k=k))(logits)
+    aux = aux.mean()
+    exp_in = jnp.einsum("gnec,gnd->gecd", dispatch.astype(tokens.dtype), tg)
+    h = jnp.einsum("gecd,edh->gech", exp_in, w1) + b1[None, :, None, :]
+    h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)
+    out_e = jnp.einsum("gech,ehd->gecd", h, w2) + b2[None, :, None, :]
+    out = jnp.einsum("gnec,gecd->gnd", combine.astype(tokens.dtype), out_e)
+    out = out.reshape(g * gs, d)
+    return out[:n], aux
+
+
+from ..ops.registry import register_op  # noqa: E402
+
+register_op("moe_ffn", _moe_ffn_op)
+
+
+def _make_moe_ffn():
+    from ..gluon.block import HybridBlock
+    from ..ndarray import NDArray
+    from .sharding import ShardingRules
+    import re
+
+    class MoEFFN(HybridBlock):
+        """Top-k gated expert FFN (GShard/Switch style).
+
+        forward(x: (B, S, C) | (N, C)) -> (out, aux_loss).  Add
+        ``aux_loss_weight * aux_loss`` to the training loss for load balance.
+        Stacked expert weights (leading dim E) shard over ``ep`` via
+        ``sharding_rules()``.
+        """
+
+        def __init__(self, units, hidden_size, num_experts, k=2,
+                     capacity_factor=1.25, activation="gelu", ep_axis="ep",
+                     group_size=4096, prefix=None, params=None):
+            super().__init__(prefix=prefix, params=params)
+            self._units = units
+            self._hidden = hidden_size
+            self._e = num_experts
+            self._k = k
+            self._cf = capacity_factor
+            self._act = activation
+            self._gs = group_size
+            self.ep_axis = ep_axis
+            self.gate_weight = self.params.get(
+                "gate_weight", shape=(units, num_experts), init="xavier")
+            self.w1 = self.params.get(
+                "expert_w1", shape=(num_experts, units, hidden_size),
+                init="xavier")
+            self.b1 = self.params.get(
+                "expert_b1", shape=(num_experts, hidden_size), init="zeros")
+            self.w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden_size, units),
+                init="xavier")
+            self.b2 = self.params.get(
+                "expert_b2", shape=(num_experts, units), init="zeros")
+
+        def sharding_rules(self):
+            pats = [(re.escape(self.w1.name), (self.ep_axis,)),
+                    (re.escape(self.b1.name), (self.ep_axis,)),
+                    (re.escape(self.w2.name), (self.ep_axis,)),
+                    (re.escape(self.b2.name), (self.ep_axis,))]
+            return ShardingRules(rules=pats)
+
+        def infer_shape(self, *args):
+            pass
+
+        def hybrid_forward(self, F, x, gate_weight, w1, b1, w2, b2):
+            shape = x.shape
+            tokens = x.reshape((-1, shape[-1]))                # (N, C)
+            n = tokens.shape[0]
+            gs = self._gs if self._gs and self._gs < n else n
+            capacity = max(1, int(self._cf * gs * self._k / self._e))
+            out, aux = F.moe_ffn(tokens, gate_weight, w1, b1, w2, b2,
+                                 num_experts=self._e, capacity=capacity,
+                                 k=self._k, act=self._act, group_size=gs)
+            return out.reshape(shape), aux
+
+    return MoEFFN
+
+
+MoEFFN = _make_moe_ffn()

@@ -56,10 +56,15 @@ def _rng_arg(dropout):
     return jax.random.key(0)
 
 
-def _attn_dropout(p, rate, key, axis, step=0):
-    """Drop attention probabilities; independent stream per device+step."""
+def _attn_dropout(p, rate, key, axis, step=0, batch_axis=None, mesh=None):
+    """Drop attention probabilities; independent stream per device+step.
+    Folds BOTH the sp rank and (when present) the dp rank so data-parallel
+    shards get independent masks, not copies of the same pattern."""
     k = jax.random.fold_in(jax.random.fold_in(key, jax.lax.axis_index(axis)),
                            step)
+    if batch_axis is not None and mesh is not None \
+            and batch_axis in mesh.shape:
+        k = jax.random.fold_in(k, jax.lax.axis_index(batch_axis))
     keep = jax.random.bernoulli(k, 1.0 - rate, shape=p.shape)
     return jnp.where(keep, p / (1.0 - rate), jnp.zeros((), p.dtype))
 
@@ -74,7 +79,8 @@ def _from_bhsd(x):
     return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, s, h * d)
 
 
-def _ring_body(q, k, v, rng, *, axis, n, causal, scale, dropout):
+def _ring_body(q, k, v, rng, *, axis, n, causal, scale, dropout,
+               batch_axis=None, mesh=None):
     """Per-device ring loop. q/k/v: (B, H, S_loc, D) local shards.
 
     Dropout matches dense drop-after-softmax semantics: the normaliser l
@@ -103,8 +109,8 @@ def _ring_body(q, k, v, rng, *, axis, n, causal, scale, dropout):
         corr = jnp.exp(m - m_new)
         p = jnp.exp(s_blk - m_new[..., None])
         l = l * corr + p.sum(axis=-1)
-        p_eff = _attn_dropout(p, dropout, rng, axis, step) if dropout > 0.0 \
-            else p
+        p_eff = _attn_dropout(p, dropout, rng, axis, step,
+                              batch_axis, mesh) if dropout > 0.0 else p
         o = o * corr[..., None] + jnp.einsum(
             "bhqk,bhkd->bhqd", p_eff, v.astype(jnp.float32))
         m = m_new
@@ -141,7 +147,8 @@ def ring_attention(q, k, v, heads, mesh=None, axis="sp", batch_axis="dp",
                        out_specs=spec, check_vma=False)
     def _run(ql, kl, vl, rng_l):
         body = functools.partial(_ring_body, axis=axis, n=n, causal=causal,
-                                 scale=scale, dropout=drop)
+                                 scale=scale, dropout=drop,
+                                 batch_axis=batch_axis, mesh=mesh)
         out = body(_to_bhsd(ql, heads), _to_bhsd(kl, heads),
                    _to_bhsd(vl, heads), rng_l)
         return _from_bhsd(out)
@@ -197,7 +204,8 @@ def ulysses_attention(q, k, v, heads, mesh=None, axis="sp", batch_axis="dp",
                               jnp.asarray(-1e30, jnp.float32))
         attn = jax.nn.softmax(s_blk, axis=-1)
         if drop > 0.0:
-            attn = _attn_dropout(attn, drop, rng_l, axis)
+            attn = _attn_dropout(attn, drop, rng_l, axis,
+                                 batch_axis=batch_axis, mesh=mesh)
         out = jnp.einsum("bhqk,bhkd->bhqd", attn, vt).astype(ql.dtype)
         out = jnp.transpose(out, (0, 2, 1, 3))          # (B, S, H/n, D)
         out = scatter_seq(out)                          # (B, S_loc, H, D)
