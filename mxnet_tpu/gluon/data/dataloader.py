@@ -4,9 +4,8 @@ ref: python/mxnet/gluon/data/dataloader.py — class DataLoader,
 _MultiWorkerIter (multiprocessing workers + batchify + pin_memory).
 
 TPU-native: workers produce numpy batches (host); `device_put` to HBM happens
-once per batch on read.  For the highest-throughput input path use the C++
-pipeline (mxnet_tpu.io) which decodes+augments off the Python GIL — this class
-matches the reference's flexible python path.
+once per batch on read.  This class matches the reference's flexible python
+path; the packed-record high-throughput path is ``mxnet_tpu.io``.
 """
 from __future__ import annotations
 
@@ -46,6 +45,24 @@ def _as_numpy_sample(s):
     if isinstance(s, tuple):
         return tuple(_as_numpy_sample(x) for x in s)
     return s
+
+
+def _to_device_batch(batch):
+    """numpy host batch -> NDArray on device (one device_put per leaf; the
+    reference's pin_memory + copy-to-ctx happens here)."""
+    if isinstance(batch, np.ndarray):
+        from ... import ndarray as nd
+        return nd.array(batch)
+    if isinstance(batch, tuple):
+        # namedtuples construct from positional args, plain tuples from one
+        return (type(batch)(*map(_to_device_batch, batch))
+                if hasattr(batch, "_fields")
+                else tuple(_to_device_batch(b) for b in batch))
+    if isinstance(batch, list):
+        return [_to_device_batch(b) for b in batch]
+    if isinstance(batch, dict):
+        return {k: _to_device_batch(v) for k, v in batch.items()}
+    return batch
 
 
 def _worker_fn(dataset, key, samples, batchify_fn):
@@ -94,8 +111,8 @@ class DataLoader:
     def __iter__(self):
         if self._pool is None:
             for samples in self._batch_sampler:
-                yield self._batchify_fn(
-                    [_as_numpy_sample(self._dataset[i]) for i in samples])
+                yield _to_device_batch(self._batchify_fn(
+                    [_as_numpy_sample(self._dataset[i]) for i in samples]))
             return
         # multi-worker: async map with bounded prefetch (ref: _MultiWorkerIter)
         results = {}
@@ -120,7 +137,7 @@ class DataLoader:
             del issued[next_yield]
             _issue()
             next_yield += 1
-            yield batch
+            yield _to_device_batch(batch)
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -46,12 +46,17 @@ class _DownloadedDataset(Dataset):
         raise NotImplementedError
 
 
-def _synthetic_images(n, shape, num_classes, seed):
+def _synthetic_images(n, shape, num_classes, seed, proto_seed=7):
     """Deterministic class-separable synthetic images: class k gets a distinct
-    mean pattern + noise, so small models can genuinely converge on it."""
+    mean pattern + noise, so small models can genuinely converge on it.
+
+    The class prototypes come from ``proto_seed`` (SHARED between the train
+    and test splits — otherwise the test split would be unlearnable); only
+    the label draws and noise differ per split via ``seed``."""
     rng = np.random.RandomState(seed)
     labels = rng.randint(0, num_classes, size=(n,)).astype(np.int32)
-    protos = rng.uniform(0, 255, size=(num_classes,) + shape).astype(np.float32)
+    protos = np.random.RandomState(proto_seed).uniform(
+        0, 255, size=(num_classes,) + shape).astype(np.float32)
     noise = rng.normal(0, 32, size=(n,) + shape).astype(np.float32)
     data = np.clip(protos[labels] * 0.5 + 64 + noise, 0, 255).astype(np.uint8)
     return data, labels

@@ -26,10 +26,16 @@ __all__ = ["BERTEncoder", "BERTLayer", "BERTModel", "BERTPretrainLoss",
 
 
 class BERTAttentionCell(HybridBlock):
-    """Self-attention with a single interleaved QKV projection.
+    """Self-attention with a single fused QKV projection.
 
-    ref: gluonnlp BERTSelfAttentionCell + the interleaved projection trick of
-    src/operator/contrib/transformer.cc (one (3*C) matmul, not three)."""
+    ref: gluonnlp BERTSelfAttentionCell + the fused projection trick of
+    src/operator/contrib/transformer.cc (one (3*C) matmul, not three).
+
+    Weight layout note: the fused qkv weight is block-[Q;K;V] along the output
+    dim (contiguous C-sized blocks), NOT the reference's per-head-interleaved
+    ``interleaved_matmul_selfatt`` layout.  Importing a reference-format BERT
+    checkpoint requires de-interleaving the qkv weight/bias at the import
+    boundary (reshape (H, 3, D, C) -> concat of (H, D, C) per projection)."""
 
     def __init__(self, units, num_heads, dropout=0.0, in_units=0,
                  attention_impl="dense", prefix=None, params=None):

@@ -25,6 +25,17 @@ def create(name="local"):
     base = name.lower()
     if base not in _KNOWN_TYPES:
         raise ValueError(f"unknown KVStore type '{name}'")
+    if base in ("horovod", "byteps"):
+        raise NotImplementedError(
+            f"KVStore type '{base}' is an external-integration escape hatch "
+            "in the reference; the TPU build's multi-process path is the "
+            "dist_* types over jax.distributed (mxnet_tpu.distributed)")
+    if base.startswith("dist_") and jax.process_count() == 1:
+        raise RuntimeError(
+            f"KVStore type '{base}' needs a multi-process run: initialize "
+            "with mxnet_tpu.distributed.init() (or launch via "
+            "tools/launch.py) so jax.process_count() > 1; for single-process "
+            "multi-device use 'device'")
     return KVStore(base)
 
 

@@ -409,7 +409,12 @@ class NDArray:
 
     def tostype(self, stype):
         if stype != "default":
-            raise NotImplementedError("row_sparse/csr conversion: use mxnet_tpu.sparse")
+            try:
+                from .. import sparse
+            except ImportError:
+                raise NotImplementedError(
+                    f"storage type {stype!r} not supported in this build")
+            return sparse.cast_storage(self, stype)
         return self
 
     def zeros_like(self):

@@ -6,8 +6,7 @@ ref: src/operator/contrib/transformer.{cc,cu} —
 TPU-native: the same interleaved layout (seq, batch, heads*3*head_dim) feeds
 lax.dot_general batched matmuls the MXU eats directly; a fused
 ``multi_head_attention`` op additionally keeps softmax(QK^T)V in one XLA
-fusion (flash-style Pallas kernel lives in ops/pallas/flash_attention.py and
-is used for long sequences).
+fusion.
 """
 from __future__ import annotations
 

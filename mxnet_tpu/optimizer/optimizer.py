@@ -52,7 +52,13 @@ def _writeback(outs, *targets):
 
 
 class Optimizer:
-    """Base optimizer (ref: class Optimizer)."""
+    """Base optimizer (ref: class Optimizer).
+
+    ``multi_precision=None`` (the default) auto-enables fp32 master weights
+    for float16/bfloat16 parameters — unlike the reference's ``False``
+    default.  This changes optimizer-state layout for low-precision params:
+    states saved with ``multi_precision=False`` must be reloaded with it
+    passed explicitly, else ``Trainer.load_states`` fails its count check."""
 
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,

@@ -16,31 +16,53 @@ import jax.numpy as jnp
 __all__ = ["moe_dispatch", "MoEFFN"]
 
 
-def moe_dispatch(gate_logits, num_experts, capacity, k=2):
+def moe_dispatch(gate_logits, num_experts, capacity, k=2, valid=None):
     """GShard-style top-k routing with fixed capacity.
 
-    gate_logits: (N, E).  Returns (dispatch (N, E, C) float, combine
-    (N, E, C) float, aux_loss scalar).  Tokens beyond an expert's capacity C
-    are dropped (their combine weight is 0) — fixed shapes, jit-stable.
+    gate_logits: (N, E).  ``valid``: optional (N,) bool — padded tokens are
+    excluded from dispatch, capacity accounting, and the aux-loss statistics.
+    Returns (dispatch (N, E, C) float, combine (N, E, C) float, aux_loss
+    scalar).  Top-k gates are normalised over the selected k experts BEFORE
+    capacity dropping (GShard semantics: mass routed to an overflowed expert
+    is lost, not re-assigned), so tokens beyond an expert's capacity C simply
+    combine with weight 0 — fixed shapes, jit-stable.
     """
     n, e = gate_logits.shape
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)  # (N, E)
+    valid_f = (jnp.ones((n,), jnp.float32) if valid is None
+               else valid.astype(jnp.float32))
+    n_valid = jnp.maximum(jnp.sum(valid_f), 1.0)
 
-    # aux load-balancing loss (Switch/GShard): E * sum_e f_e * p_e
+    # aux load-balancing loss (Switch/GShard): E * sum_e f_e * p_e over VALID tokens
     top1 = jnp.argmax(probs, axis=-1)
-    f = jnp.mean(jax.nn.one_hot(top1, e, dtype=jnp.float32), axis=0)
-    p_mean = jnp.mean(probs, axis=0)
+    f = jnp.sum(jax.nn.one_hot(top1, e, dtype=jnp.float32) * valid_f[:, None],
+                axis=0) / n_valid
+    p_mean = jnp.sum(probs * valid_f[:, None], axis=0) / n_valid
     aux_loss = e * jnp.sum(f * p_mean)
 
-    dispatch = jnp.zeros((n, e, capacity), jnp.float32)
-    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    # pass 1: select top-k experts per token; gather pre-drop gates
     remaining = probs
-    # cumulative per-expert occupancy across the k rounds
-    occupancy = jnp.zeros((e,), jnp.int32)
+    selections = []
+    gate_sum = jnp.zeros((n,), jnp.float32)
     for _ in range(k):
         idx = jnp.argmax(remaining, axis=-1)                     # (N,)
         gate = jnp.take_along_axis(remaining, idx[:, None], 1)[:, 0]
-        mask = jax.nn.one_hot(idx, e, dtype=jnp.int32)           # (N, E)
+        onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)
+        mask = onehot * valid_f[:, None].astype(jnp.int32)       # (N, E)
+        selections.append((gate, mask))
+        gate_sum = gate_sum + gate
+        remaining = remaining * (1.0 - onehot)
+    # pass 2: capacity-bounded slot assignment with pre-normalised gates
+    dispatch = jnp.zeros((n, e, capacity), jnp.float32)
+    combine = jnp.zeros((n, e, capacity), jnp.float32)
+    occupancy = jnp.zeros((e,), jnp.int32)   # cumulative across the k rounds
+    for gate, mask in selections:
+        if k > 1:
+            # normalise over the selected top-k BEFORE the keep-mask: a token
+            # whose other choice overflows does NOT get its mass re-assigned
+            gate = gate / jnp.maximum(gate_sum, 1e-9)
+        # k == 1 keeps the raw gate multiplier (Switch Transformer):
+        # normalising would make combine ≡ 1 and zero the router's gradient
         pos = jnp.cumsum(mask, axis=0) - mask + occupancy[None, :]
         pos_tok = jnp.sum(pos * mask, axis=-1)                   # (N,)
         keep = pos_tok < capacity
@@ -50,13 +72,6 @@ def moe_dispatch(gate_logits, num_experts, capacity, k=2):
         dispatch = dispatch + d
         combine = combine + d * gate[:, None, None]
         occupancy = occupancy + jnp.sum(mask * keep[:, None], axis=0)
-        remaining = remaining * (1.0 - mask)
-    if k > 1:
-        # renormalise combine over the selected experts (top-k gates sum to 1)
-        denom = jnp.sum(combine, axis=(1, 2), keepdims=True)
-        combine = combine / jnp.maximum(denom, 1e-9)
-    # k == 1 keeps the raw gate multiplier (Switch Transformer): normalising
-    # would make combine ≡ 1 and zero the router's task-loss gradient
     return dispatch, combine, aux_loss
 
 
@@ -75,10 +90,15 @@ def _moe_ffn_op(tokens, gate_w, w1, b1, w2, b2, num_experts=1, capacity=1,
         tokens = jnp.concatenate(
             [tokens, jnp.zeros((pad, d), tokens.dtype)], axis=0)
     tg = tokens.reshape(g, gs, d)
+    valid = (jnp.arange(g * gs) < n).reshape(g, gs)
     logits = tg.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # (G,gs,E)
     dispatch, combine, aux = jax.vmap(
-        lambda lg: moe_dispatch(lg, num_experts, capacity, k=k))(logits)
-    aux = aux.mean()
+        lambda lg, v: moe_dispatch(lg, num_experts, capacity, k=k,
+                                   valid=v))(logits, valid)
+    # weight per-group aux by valid-token count so a padded tail group
+    # doesn't dilute the load-balance statistics
+    nv = jnp.maximum(jnp.sum(valid.astype(jnp.float32), axis=1), 1.0)
+    aux = jnp.sum(aux * nv) / jnp.sum(nv)
     exp_in = jnp.einsum("gnec,gnd->gecd", dispatch.astype(tokens.dtype), tg)
     h = jnp.einsum("gecd,edh->gech", exp_in, w1) + b1[None, :, None, :]
     h = jax.nn.gelu(h) if act == "gelu" else jax.nn.relu(h)

@@ -40,6 +40,13 @@ def gpipe(stage_apply, stacked_params, x, mesh=None, axis="pp",
     if mesh is None:
         raise ValueError("gpipe needs a mesh: pass mesh= or enter a MeshScope")
     P = mesh.shape[axis]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(stacked_params)[0]:
+        if leaf.ndim < 1 or leaf.shape[0] != P:
+            raise ValueError(
+                f"gpipe: stacked param {jax.tree_util.keystr(path)} has "
+                f"leading dim {leaf.shape[:1]} but mesh axis {axis!r} has "
+                f"size {P}; every stacked leaf must have leading dim == "
+                f"number of pipeline stages == mesh.shape[{axis!r}]")
     M = microbatches if microbatches is not None else P
     B = x.shape[0]
     if B % M != 0:

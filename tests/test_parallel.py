@@ -149,7 +149,9 @@ def test_kvstore_push_pull_aggregate():
 
 
 def test_kvstore_update_on_kvstore():
-    kv = mx.kv.create("dist_sync_device")
+    # server-side-optimizer semantics (ref: kvstore_dist_server.h) are
+    # type-independent; dist_* types additionally require a multi-process run
+    kv = mx.kv.create("device")
     opt = mx.optimizer.create("sgd", learning_rate=0.5)
     kv.set_optimizer(opt)
     w0 = np.ones((4,), np.float32)

@@ -1,0 +1,54 @@
+"""End-to-end convergence gates (ref: tests/python/train/ — small models
+trained to an accuracy threshold rather than exact losses; SURVEY.md §7.1 S2
+names "Gluon MLP on MNIST converges" as THE gate for config 1).
+
+Runs on the synthetic MNIST stand-in (class-separable patterns, see
+gluon/data/vision/datasets.py) through the full user path: Dataset →
+transforms → DataLoader → hybridized net → autograd → Trainer → metric.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data.vision import transforms
+
+
+def test_mlp_mnist_convergence():
+    train_set = gluon.data.vision.MNIST(train=True).transform_first(
+        transforms.ToTensor())
+    val_set = gluon.data.vision.MNIST(train=False).transform_first(
+        transforms.ToTensor())
+    # keep the gate fast: a few thousand samples are plenty on separable data
+    train_loader = gluon.data.DataLoader(
+        gluon.data.SimpleDataset([train_set[i] for i in range(4096)]),
+        batch_size=128, shuffle=True)
+    val_loader = gluon.data.DataLoader(
+        gluon.data.SimpleDataset([val_set[i] for i in range(1024)]),
+        batch_size=256)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 1e-3})
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(3):
+        for x, y in train_loader:
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+
+    metric.reset()
+    for x, y in val_loader:
+        metric.update(y, net(x))
+    _, acc = metric.get()
+    assert acc >= 0.97, f"MNIST MLP gate: val accuracy {acc:.4f} < 0.97"
