@@ -10,36 +10,86 @@ import jax.numpy as jnp
 from .registry import register_op
 
 
+def _unwrap(x):
+    from ..ndarray import NDArray
+    return x._data if isinstance(x, NDArray) else x
+
+
 def foreach(body, data, init_states):
     """ref: foreach op — scan `body(x_t, states) -> (out_t, new_states)` over
-    axis 0 of `data`.  Works on jax arrays; gluon.contrib wraps NDArrays."""
+    axis 0 of `data`.  Accepts NDArrays (the ``mx.nd.contrib.foreach``
+    calling convention, including multi-output bodies) or raw jax arrays;
+    the body sees the same kind."""
+    from ..ndarray import NDArray
+    tree = jax.tree_util.tree_map
+    nd_mode = any(
+        isinstance(x, NDArray)
+        for x in jax.tree_util.tree_leaves(data) +
+        jax.tree_util.tree_leaves(init_states))
+
     def step(states, x):
+        if nd_mode:
+            out, new_states = body(tree(NDArray, x), tree(NDArray, states))
+            return tree(_unwrap, new_states), tree(_unwrap, out)
         out, new_states = body(x, states)
         return new_states, out
 
-    final_states, outs = jax.lax.scan(step, init_states, data)
+    final_states, outs = jax.lax.scan(
+        step, tree(_unwrap, init_states), tree(_unwrap, data))
+    if nd_mode:
+        return tree(NDArray, outs), tree(NDArray, final_states)
     return outs, final_states
 
 
 def while_loop(cond, func, loop_vars, max_iterations=None):
     """ref: while_loop op. Fixed upper bound keeps shapes static on TPU."""
+    from ..ndarray import NDArray
+    nd_mode = any(isinstance(v, NDArray) for v in loop_vars)
+    if nd_mode:
+        # NDArray comparisons return float 0/1 (reference semantics);
+        # lax.while_loop needs a bool predicate
+        wrap = lambda vs: [NDArray(v) for v in vs]
+        cond_j = lambda *vs: jnp.asarray(
+            _unwrap(cond(*wrap(vs)))).astype(jnp.bool_)
+        func_j = lambda *vs: [_unwrap(o) for o in func(*wrap(vs))]
+        loop_vars = [_unwrap(v) for v in loop_vars]
+    else:
+        cond_j, func_j = cond, func
     if max_iterations is None:
-        final = jax.lax.while_loop(lambda v: cond(*v), lambda v: tuple(func(*v)), tuple(loop_vars))
-        return final
+        final = jax.lax.while_loop(lambda v: cond_j(*v),
+                                   lambda v: tuple(func_j(*v)),
+                                   tuple(loop_vars))
+        return [NDArray(v) for v in final] if nd_mode else final
     def body(i_and_vars):
         i, v = i_and_vars
-        v = jax.lax.cond(cond(*v), lambda vv: tuple(func(*vv)), lambda vv: vv, v)
+        v = jax.lax.cond(cond_j(*v), lambda vv: tuple(func_j(*vv)),
+                         lambda vv: vv, v)
         return i + 1, v
     def keep_going(i_and_vars):
         i, v = i_and_vars
-        return (i < max_iterations) & cond(*v)
-    _, final = jax.lax.while_loop(keep_going, body, (jnp.int32(0), tuple(loop_vars)))
-    return final
+        return (i < max_iterations) & cond_j(*v)
+    _, final = jax.lax.while_loop(keep_going, body,
+                                  (jnp.int32(0), tuple(loop_vars)))
+    return [NDArray(v) for v in final] if nd_mode else final
 
 
 def cond(pred, then_func, else_func, inputs=()):
     """ref: cond op."""
-    return jax.lax.cond(pred, lambda xs: then_func(*xs), lambda xs: else_func(*xs), tuple(inputs))
+    from ..ndarray import NDArray
+    nd_mode = isinstance(pred, NDArray) or any(
+        isinstance(x, NDArray) for x in inputs)
+    if nd_mode:
+        wrap = lambda xs: tuple(NDArray(x) for x in xs)
+        out = jax.lax.cond(
+            _unwrap(pred),
+            lambda xs: jax.tree_util.tree_map(
+                _unwrap, then_func(*wrap(xs))),
+            lambda xs: jax.tree_util.tree_map(
+                _unwrap, else_func(*wrap(xs))),
+            tuple(_unwrap(x) for x in inputs))
+        return jax.tree_util.tree_map(NDArray, out)
+    return jax.lax.cond(pred, lambda xs: then_func(*xs),
+                        lambda xs: else_func(*xs), tuple(inputs))
 
 
 register_op("_foreach_marker", lambda x: x)  # registry placeholder; python-level API above

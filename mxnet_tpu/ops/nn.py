@@ -82,12 +82,17 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=Non
     dilate = _tup(dilate, nd)
     pad = _tup(pad, nd) if pad else (0,) * nd
     adj = _tup(adj, nd) if adj else (0,) * nd
-    # Gradient-of-conv formulation: conv_transpose with IO swapped weight.
+    # Gradient-of-conv formulation: with transpose_kernel=True jax itself
+    # swaps the kernel's I/O axes, so the reference layout (in, out/group, *k)
+    # is passed through as-is in the O-I slot order.  jax applies ``padding``
+    # to the stride-dilated input, so the reference's output-size contract
+    # out = (in-1)*stride - 2*pad + kernel (+adj) needs (ke-1-pad) here.
     lhs, rhs, out_l = _CONV_LAYOUTS[nd]
+    ke = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
     out = jax.lax.conv_transpose(
-        data, jnp.swapaxes(weight, 0, 1),
+        data, weight,
         strides=stride,
-        padding=[(p, p) for p in pad],
+        padding=[(e - 1 - p, e - 1 - p) for e, p in zip(ke, pad)],
         rhs_dilation=dilate,
         dimension_numbers=(lhs, rhs, out_l),
         transpose_kernel=True,

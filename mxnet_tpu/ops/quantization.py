@@ -43,8 +43,13 @@ def _quantized_fc(data, weight, bias, min_data, max_data, min_weight, max_weight
     sw = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
     out = acc.astype(jnp.float32) * (sx * sw)
     if bias is not None and not no_bias:
-        sb = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
-        out = out + bias.astype(jnp.float32) * sb
+        if min_bias is None or max_bias is None:
+            # float bias path (ref: quantized_fully_connected accepts fp32
+            # bias when no bias calibration ranges are given)
+            out = out + bias.astype(jnp.float32)
+        else:
+            sb = jnp.maximum(jnp.abs(min_bias), jnp.abs(max_bias)) / 127.0
+            out = out + bias.astype(jnp.float32) * sb
     return out
 
 
