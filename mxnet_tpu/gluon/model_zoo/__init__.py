@@ -2,4 +2,5 @@
 GluonNLP model family named by BASELINE.json)."""
 from . import vision
 from . import bert
+from . import ssd
 from .vision import get_model

@@ -1,0 +1,195 @@
+"""SSD detection model family (config 5 of BASELINE.md).
+
+ref: the reference tree's in-repo SSD pipeline — example/ssd (symbol_factory
+multi-scale predictors over a shared backbone) + the contrib multibox ops
+(src/operator/contrib/multibox_prior-inl.h / multibox_target-inl.h /
+multibox_detection-inl.h) — and the GluonCV ``ssd_512_resnet50_v1`` capability
+bar (SURVEY.md §2.5).
+
+TPU-native design: the whole network is fixed-shape — anchors are generated at
+trace time from static feature-map shapes, target matching and NMS are the
+masked fixed-shape formulations in ops/multibox.py — so one hybridized train
+step (fwd+loss+bwd+update) compiles to a single XLA program, and detection
+(decode+NMS) jits cleanly too.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...ndarray import NDArray
+from .. import nn
+from ..block import HybridBlock
+from ..loss import Loss
+from .vision.resnet import get_resnet
+
+__all__ = ["SSD", "SSDMultiBoxLoss", "ssd_512_resnet50_v1",
+           "ssd_300_resnet34_v1"]
+
+
+class _PredictorHead(HybridBlock):
+    """Per-scale 3x3 conv predictor (ref: example/ssd symbol_factory —
+    loc/cls convolution per feature map)."""
+
+    def __init__(self, num_anchors, channels_per_anchor, in_channels,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._n = num_anchors * channels_per_anchor
+        with self.name_scope():
+            self.conv = nn.Conv2D(self._n, kernel_size=3, padding=1,
+                                  in_channels=in_channels)
+
+    def forward(self, x):
+        # (B, A*K, H, W) -> (B, H*W*A*K) in anchor-major order
+        y = self.conv(x)
+        y = y.transpose((0, 2, 3, 1))
+        return y.reshape((y.shape[0], -1))
+
+
+def _down_block(channels, stride, in_channels):
+    """Extra feature block: 1x1 squeeze + 3x3 stride-2 (ref: example/ssd
+    symbol_factory — conv_act_layer pairs)."""
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels // 2, 1, use_bias=False,
+                      in_channels=in_channels),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(channels, 3, strides=stride, padding=1, use_bias=False,
+                      in_channels=channels // 2),
+            nn.BatchNorm(), nn.Activation("relu"))
+    return blk
+
+
+class SSD(HybridBlock):
+    """Single-shot detector over multi-scale feature maps.
+
+    forward(x) -> (cls_preds (B, C+1, A), loc_preds (B, A*4),
+    anchors (1, A, 4)) — the contract of the reference's multibox training
+    ops.  Use :class:`SSDMultiBoxLoss` + ``MultiBoxTarget`` for training and
+    :meth:`detect` (``MultiBoxDetection``) for inference.
+    """
+
+    def __init__(self, backbone_features, num_classes, sizes, ratios,
+                 extra_channels=(512, 256, 256, 256), backbone_out_channels=2048,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        assert len(sizes) == len(ratios)
+        self.num_classes = num_classes
+        self._sizes = sizes
+        self._ratios = ratios
+        num_scales = len(sizes)
+        with self.name_scope():
+            self.features = backbone_features
+            self.extras = nn.HybridSequential()
+            in_ch = backbone_out_channels
+            for i, ch in enumerate(extra_channels):
+                if i >= num_scales - 1:
+                    break
+                self.extras.add(_down_block(ch, 2, in_ch))
+                in_ch = ch
+            self.cls_heads = nn.HybridSequential()
+            self.loc_heads = nn.HybridSequential()
+            chans = [backbone_out_channels] + list(
+                extra_channels[:num_scales - 1])
+            for i in range(num_scales):
+                a = len(sizes[i]) + len(ratios[i]) - 1
+                self.cls_heads.add(_PredictorHead(
+                    a, num_classes + 1, in_channels=chans[i]))
+                self.loc_heads.add(_PredictorHead(a, 4, in_channels=chans[i]))
+
+    def forward(self, x):
+        from ... import ndarray as F
+        feats = [self.features(x)]
+        for blk in self.extras._children.values():
+            feats.append(blk(feats[-1]))
+        cls_preds, loc_preds, anchors = [], [], []
+        heads = list(zip(self.cls_heads._children.values(),
+                         self.loc_heads._children.values()))
+        for i, feat in enumerate(feats):
+            cls_head, loc_head = heads[i]
+            cls_preds.append(cls_head(feat))      # (B, H*W*A*(C+1))
+            loc_preds.append(loc_head(feat))      # (B, H*W*A*4)
+            anchors.append(F.MultiBoxPrior(
+                feat, sizes=self._sizes[i], ratios=self._ratios[i], clip=True))
+        cls_pred = F.concat(*cls_preds, dim=1)
+        cls_pred = cls_pred.reshape((cls_pred.shape[0], -1,
+                                     self.num_classes + 1))
+        cls_pred = cls_pred.transpose((0, 2, 1))   # (B, C+1, A)
+        loc_pred = F.concat(*loc_preds, dim=1)     # (B, A*4)
+        anchor = F.concat(*anchors, dim=1)         # (1, A, 4)
+        return cls_pred, loc_pred, anchor
+
+    def targets(self, anchor, label, cls_pred, overlap_threshold=0.5,
+                negative_mining_ratio=3.0):
+        """MultiBoxTarget wrapper: (box_target, box_mask, cls_target).
+
+        label: (B, M, 5) rows [cls_id, x1, y1, x2, y2], cls_id<0 padding."""
+        from ... import ndarray as F
+        return F.MultiBoxTarget(
+            anchor, label, cls_pred, overlap_threshold=overlap_threshold,
+            negative_mining_ratio=negative_mining_ratio,
+            negative_mining_thresh=0.5)
+
+    def detect(self, cls_pred, loc_pred, anchor, nms_threshold=0.45,
+               threshold=0.01, nms_topk=400):
+        """Decode + NMS -> (B, A, 6) rows [cls_id, score, x1, y1, x2, y2]."""
+        from ... import ndarray as F
+        probs = F.softmax(cls_pred, axis=1)
+        return F.MultiBoxDetection(
+            probs, loc_pred, anchor, nms_threshold=nms_threshold,
+            threshold=threshold, nms_topk=nms_topk)
+
+
+class SSDMultiBoxLoss(Loss):
+    """cls softmax-CE (ignore_label -1 from hard-negative mining) + smooth-L1
+    on masked box offsets (ref: example/ssd train — MultiBoxTarget +
+    SoftmaxOutput(ignore_label) + smooth_l1; GluonCV SSDMultiBoxLoss)."""
+
+    def __init__(self, lambd=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._lambd = lambd
+
+    def hybrid_forward(self, F, cls_pred, loc_pred, cls_target, box_target,
+                       box_mask):
+        # cls_pred (B, C+1, A), cls_target (B, A) with -1 = ignore
+        lp = F.log_softmax(cls_pred, axis=1)
+        tgt = F.maximum(cls_target, 0.0).astype("int32")
+        picked = -F.pick(lp.transpose((0, 2, 1)), tgt, axis=-1)  # (B, A)
+        keep = (cls_target >= 0).astype(lp.dtype)
+        n_valid = F.maximum(keep.sum(axis=1), 1.0)
+        cls_loss = (picked * keep).sum(axis=1) / n_valid
+        loc_l = F.smooth_l1((loc_pred - box_target) * box_mask, scalar=1.0)
+        n_pos = F.maximum(box_mask.sum(axis=1), 1.0)
+        loc_loss = loc_l.sum(axis=1) / n_pos
+        return cls_loss + self._lambd * loc_loss
+
+
+def _resnet_backbone(num_layers):
+    """ResNet-vN features without the classifier head; SSD truncates after
+    the last conv stage (the GlobalAvgPool + Dense are dropped)."""
+    net = get_resnet(1, num_layers)
+    feats = nn.HybridSequential()
+    blocks = list(net.features._children.values())
+    for b in blocks[:-1]:  # drop GlobalAvgPool2D
+        feats.add(b)
+    return feats
+
+
+# normalized anchor scales, min_size ~ 0.07..0.9 with sqrt intermediate sizes
+# (the canonical SSD schedule; ref: example/ssd/symbol_factory.py get_config)
+_SIZES = [[.07, .1025], [.15, .2121], [.3, .3674], [.45, .5196],
+          [.6, .6708], [.75, .8216], [.9, .9721]]
+_RATIOS = [[1, 2, .5]] + [[1, 2, .5, 3, 1. / 3]] * 3 + [[1, 2, .5]] * 3
+
+
+def ssd_512_resnet50_v1(classes=20, **kwargs):
+    """SSD-512 on ResNet-50 v1 (ref: GluonCV ssd_512_resnet50_v1; BASELINE.md
+    config 5, 40 img/s/chip bar)."""
+    return SSD(_resnet_backbone(50), classes, _SIZES, _RATIOS,
+               extra_channels=(512, 512, 256, 256, 256, 256),
+               backbone_out_channels=2048, **kwargs)
+
+
+def ssd_300_resnet34_v1(classes=20, **kwargs):
+    """Smaller SSD-300 variant (ref: GluonCV ssd_300_* family)."""
+    return SSD(_resnet_backbone(34), classes, _SIZES[:6], _RATIOS[:6],
+               extra_channels=(512, 256, 256, 256, 256),
+               backbone_out_channels=512, **kwargs)
