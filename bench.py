@@ -1,25 +1,34 @@
 #!/usr/bin/env python
-"""Headline benchmark: ResNet-50 v1 training throughput, img/s/chip.
+"""Headline benchmarks (one JSON line each, driver contract: default = ResNet).
 
-ref: example/image-classification/benchmark_score.py (synthetic-data img/s)
-and BASELINE.md config 2 (ResNet-50 hybridize bf16, bar = 800 img/s/chip on
-v5e ≈ V100 fp16 parity).  The whole train step (fwd+bwd+SGD) is one XLA
-program via parallel.TrainStep; matmul precision bf16 puts convs on the MXU.
+  python bench.py           # ResNet-50 v1 train throughput, img/s/chip
+  python bench.py bert      # BERT-base seq-128 masked-LM pretrain, tokens/s/chip
+  python bench.py all       # both (two JSON lines)
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+ref: example/image-classification/benchmark_score.py (synthetic-data img/s),
+gluonnlp scripts/bert/run_pretraining.py (masked-LM+NSP step), BASELINE.md
+configs 2 and 4.  The whole train step (fwd+bwd+optimizer) is one XLA program
+via parallel.TrainStep; matmul precision bf16 puts the FLOPs on the MXU.
 """
 import json
+import sys
 import time
 
 import numpy as np
 
-BASELINE_IMG_S = 800.0  # BASELINE.md: V100 fp16 ~700-800 img/s, target bar
+BASELINE_IMG_S = 800.0     # BASELINE.md: V100 fp16 ~700-800 img/s, target bar
+BASELINE_TOK_S = 3000.0    # BASELINE.md: BERT-base >=3k tokens/s/chip bar
 
 
-def main():
+def _setup():
     import jax
 
     jax.config.update("jax_default_matmul_precision", "bfloat16")
+    return jax
+
+
+def bench_resnet():
+    jax = _setup()
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
@@ -52,13 +61,87 @@ def main():
     loss.asnumpy()  # block
     dt = time.perf_counter() - t0
 
-    img_s = batch * iters / dt
+    # global batch is data-parallel over every device: report PER-CHIP rate
+    img_s = batch * iters / dt / len(jax.devices())
     print(json.dumps({
         "metric": "resnet50_train_throughput",
         "value": round(img_s, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
     }))
+
+
+def bench_bert():
+    """BERT-base (L12 H768 A12, vocab 30522) masked-LM + NSP pretraining step,
+    seq 128, ~15% masked (20 positions), LAMB — the reference's phase-1 recipe
+    (ref: gluonnlp scripts/bert/run_pretraining.py)."""
+    jax = _setup()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel, BERTPretrainLoss
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    batch = 64 if on_accel else 2
+    seq_len, n_pred, vocab = 128, 20, 30522
+    iters = 20 if on_accel else 1
+
+    net = BERTModel(vocab_size=vocab, units=768, hidden_size=3072,
+                    num_layers=12, num_heads=12, max_length=512, dropout=0.1)
+    net.initialize()
+    net.cast("bfloat16")
+    loss_blk = BERTPretrainLoss()
+
+    def loss_fn(out, labels):
+        nsp_scores, mlm_scores = out[2], out[3]
+        mlm_labels, mlm_weights, nsp_labels = labels
+        return loss_blk(mlm_scores, nsp_scores, mlm_labels, mlm_weights,
+                        nsp_labels)
+
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    opt = mx.optimizer.create("lamb", learning_rate=1e-3, wd=0.01)
+    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    tok = mx.nd.array(rng.randint(0, vocab, (batch, seq_len)).astype(np.int32))
+    tt = mx.nd.array(rng.randint(0, 2, (batch, seq_len)).astype(np.int32))
+    vl = mx.nd.array(np.full((batch,), seq_len, np.int32))
+    mpos = mx.nd.array(rng.randint(0, seq_len, (batch, n_pred)).astype(np.int32))
+    mlab = mx.nd.array(rng.randint(0, vocab, (batch, n_pred)).astype(np.int32))
+    mw = mx.nd.array(np.ones((batch, n_pred), np.float32))
+    nsp = mx.nd.array(rng.randint(0, 2, (batch,)).astype(np.int32))
+
+    x = (tok, tt, vl, mpos)
+    labels = (mlab, mw, nsp)
+    step(x, labels).asnumpy()
+    step(x, labels).asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, labels)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+
+    # global batch is data-parallel over every device: report PER-CHIP rate
+    tok_s = batch * seq_len * iters / dt / len(jax.devices())
+    print(json.dumps({
+        "metric": "bert_base_pretrain_throughput",
+        "value": round(tok_s, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
+    }))
+
+
+def main():
+    which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
+    if which not in ("resnet", "bert", "all"):
+        print(f"unknown benchmark {which!r} (expected resnet|bert|all)",
+              file=sys.stderr)
+        sys.exit(1)
+    if which in ("resnet", "all"):
+        bench_resnet()
+    if which in ("bert", "all"):
+        bench_bert()
 
 
 if __name__ == "__main__":

@@ -41,9 +41,10 @@ class BERTAttentionCell(HybridBlock):
                  attention_impl="dense", prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         assert units % num_heads == 0
-        if attention_impl not in ("dense", "ring", "ulysses"):
+        if attention_impl not in ("dense", "flash", "ring", "ulysses"):
             raise ValueError(f"unknown attention_impl '{attention_impl}' "
-                             "(expected 'dense', 'ring', or 'ulysses')")
+                             "(expected 'dense', 'flash', 'ring', or "
+                             "'ulysses')")
         self._units = units
         self._heads = num_heads
         self._dropout = dropout
@@ -59,7 +60,20 @@ class BERTAttentionCell(HybridBlock):
         from ... import ndarray as F
         qkv = self.qkv(x)                       # (B, S, 3C)
         q, k, v = F.split(qkv, num_outputs=3, axis=-1)
-        if self._impl != "dense":
+        if self._impl == "flash":
+            # single-chip long-context path (Pallas kernel, O(S·D) memory)
+            if mask is not None:
+                raise ValueError("attention_impl='flash' does not support "
+                                 "valid_length masks yet")
+            if self._dropout > 0.0:
+                import warnings
+                warnings.warn(
+                    "attention_impl='flash' does not apply attention-"
+                    "probability dropout inside the fused kernel; "
+                    f"dropout={self._dropout} affects only the residual "
+                    "dropouts", stacklevel=2)
+            out = F.flash_attention(q, k, v, heads=self._heads)
+        elif self._impl != "dense":
             # sequence-parallel long-context path (ring/ulysses over the
             # active mesh's sp axis); padding masks not yet supported there
             if mask is not None:

@@ -86,6 +86,24 @@ def _multi_head_attention(q, k, v, mask=None, heads=1, dropout=0.0,
     return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, hd)
 
 
+@register_op("flash_attention")
+def _flash_attention_op(q, k, v, heads=1, causal=False, block_q=128,
+                        block_k=128):
+    """Flash MHA on (B, S, H*D) projections via the Pallas kernel
+    (ops/pallas/flash_attention.py) — O(S·D) memory instead of the dense
+    op's O(S^2) scores; the long-context single-chip path."""
+    from .pallas import flash_attention
+    b, sq, hd = q.shape
+    d = hd // heads
+    def to_bhsd(x):
+        return jnp.transpose(x.reshape(b, -1, heads, d),
+                             (0, 2, 1, 3)).reshape(b * heads, -1, d)
+    out = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v), None, causal,
+                          block_q, block_k, None)
+    out = out.reshape(b, heads, sq, d)
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, hd)
+
+
 @register_op("div_sqrt_dim", aliases=("_contrib_div_sqrt_dim",))
 def _div_sqrt_dim(x):
     return x / jnp.sqrt(jnp.asarray(x.shape[-1], x.dtype))

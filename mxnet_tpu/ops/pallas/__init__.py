@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for ops XLA won't fuse well (SURVEY.md §7.0.2)."""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

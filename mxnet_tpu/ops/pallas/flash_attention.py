@@ -1,0 +1,151 @@
+"""Flash attention Pallas kernel — the long-context hot path.
+
+The reference's attention is two cuBLAS strided-batched matmuls with the full
+(B*H, S, S) score matrix materialised (ref: src/operator/contrib/
+transformer.cc).  On TPU that matrix is the HBM wall at long sequence; this
+kernel computes softmax(QK^T)V blockwise with the online-softmax recurrence so
+peak memory is O(S·D + block_q·S) instead of O(S^2) per head, with the two
+matmuls staying resident on the MXU (SURVEY.md §7.0.2 names this kernel).
+
+Forward: one Pallas program per (batch·head, q-block): K/V live in VMEM and
+the kernel loops over k-blocks with fori_loop, carrying (acc, m, l).
+Backward: custom-vjp recomputation — per q-block the scores are rebuilt in a
+``lax.map`` over blocks (pure XLA, never materialising S×S), the flash-
+standard trade of FLOPs for memory.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k):
+    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D); o_ref: (1, block_q, D)
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, D)
+    bq = q.shape[0]
+    s_len = k_ref.shape[1]
+    n_kv = s_len // block_k
+    qi = pl.program_id(1)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
+        s = q @ k.T                                   # (bq, bk)
+        if causal:
+            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + p @ v
+        return acc_new, m_new, l_new
+
+    d = q.shape[-1]
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, n_kv, body, (acc0, m0, l0))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    bh, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    assert s % block_q == 0 and s % block_k == 0, (s, block_q, block_k)
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, s // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _dense_block_bwd(q, k, v, o, do, scale, causal, block_q):
+    """Recompute-based backward: map over q-blocks; each block rebuilds its
+    (block_q, S) score rows (flash-style memory profile, plain XLA)."""
+    bh, s, d = q.shape
+    n_blocks = s // block_q
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
+
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+
+    def one_block(args):
+        qb, dob, deltab, idx = args          # (bh, bq, d), ..., scalar block idx
+        sc = jnp.einsum("bqd,bkd->bqk", qb.astype(jnp.float32) * scale, kf)
+        if causal:
+            q_pos = idx * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, sc.shape, 1)
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 2)
+            sc = jnp.where(q_pos >= k_pos, sc, _NEG_INF)
+        p = jax.nn.softmax(sc, axis=-1)
+        dv_b = jnp.einsum("bqk,bqd->bkd", p, dob.astype(jnp.float32))
+        dp = jnp.einsum("bqd,bkd->bqk", dob.astype(jnp.float32), vf)
+        ds = p * (dp - deltab[..., None])
+        dq_b = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
+        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qb.astype(jnp.float32)) * scale
+        return dq_b, dk_b, dv_b
+
+    qb = q.reshape(bh, n_blocks, block_q, d).transpose(1, 0, 2, 3)
+    dob = do.reshape(bh, n_blocks, block_q, d).transpose(1, 0, 2, 3)
+    deltab = delta.reshape(bh, n_blocks, block_q).transpose(1, 0, 2)
+    idxs = jnp.arange(n_blocks)
+    dq_b, dk_b, dv_b = jax.lax.map(one_block, (qb, dob, deltab, idxs))
+    dq = dq_b.transpose(1, 0, 2, 3).reshape(bh, s, d)
+    dk = dk_b.sum(axis=0)
+    dv = dv_b.sum(axis=0)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
+                    block_k=128, interpret=None):
+    """softmax(scale * Q K^T [, causal]) V without materialising S×S.
+
+    q, k, v: (B*H, S, D).  ``interpret=None`` auto-selects the Pallas
+    interpreter off-TPU (tests on the CPU mesh) and the compiled kernel on
+    TPU."""
+    out, _ = _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k,
+                             interpret)
+    return out
+
+
+def _resolve(scale, d, interpret):
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return scale, interpret
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+    scale, interpret = _resolve(scale, q.shape[-1], interpret)
+    out = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, do):
+    q, k, v, o = res
+    scale, _ = _resolve(scale, q.shape[-1], interpret)
+    bq = min(block_q, q.shape[1])
+    return _dense_block_bwd(q, k, v, o, do, scale, causal, bq)
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
