@@ -12,6 +12,16 @@ Usage mirrors the reference:
     import mxnet_tpu as mx
     from mxnet_tpu import nd, autograd, gluon
 """
+# Multi-process bring-up MUST precede any jax backend touch (jax.devices et
+# al.), so when launched under the DMLC_* env contract (tools/launch.py) the
+# coordination service connects before the rest of the package imports.
+import os as _os
+
+if int(_os.environ.get("DMLC_NUM_WORKER", "0") or 0) > 1:
+    from . import distributed as _distributed
+
+    _distributed.init()
+
 from . import base
 from .base import MXNetError
 from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, num_tpus, num_gpus
@@ -28,6 +38,7 @@ from . import optimizer
 from . import metric
 from . import kvstore
 from . import kvstore as kv
+from . import distributed
 from . import parallel
 from . import gluon
 

@@ -1,0 +1,103 @@
+"""Multi-process bring-up over the PJRT coordination service.
+
+ref: the reference's cluster story is the dmlc tracker + ps-lite Van
+(tools/launch.py exports DMLC_ROLE / DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT /
+DMLC_NUM_WORKER / DMLC_WORKER_ID, then each worker's kvstore connects over
+ZeroMQ — SURVEY.md §2.3 launcher row, §3.3).  TPU-native: there are no
+scheduler/server roles; every process is a worker and ``jax.distributed``'s
+coordination service replaces the tracker, with collectives compiler-scheduled
+over ICI/DCN (SURVEY.md §5.8).  The same DMLC_* env names are honoured so
+reference launch scripts port over unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init", "shutdown", "rank", "num_workers", "barrier",
+           "all_sum", "broadcast"]
+
+_initialized = False
+
+
+def init(coordinator=None, num_processes=None, process_id=None):
+    """Initialize the coordination service from args or DMLC_*/env config.
+
+    Reads (in priority order) explicit args, then ``DMLC_PS_ROOT_URI`` /
+    ``DMLC_PS_ROOT_PORT`` / ``DMLC_NUM_WORKER`` / ``DMLC_WORKER_ID``.
+    Single-process runs (no env, no args) are a no-op so user scripts can
+    call init() unconditionally.  Idempotent."""
+    global _initialized
+    if _initialized:
+        return
+    if coordinator is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9876")
+        if uri:
+            coordinator = f"{uri}:{port}"
+    if num_processes is None:
+        n = os.environ.get("DMLC_NUM_WORKER")
+        num_processes = int(n) if n else None
+    if process_id is None:
+        i = os.environ.get("DMLC_WORKER_ID")
+        process_id = int(i) if i else (0 if num_processes else None)
+    if coordinator is None or num_processes is None or num_processes <= 1:
+        return  # single-process
+    # CPU backend rehearsal (SURVEY.md §4 distributed-without-a-cluster)
+    # needs gloo for cross-process collectives; on TPU the ICI/DCN fabric
+    # is used and this config is ignored.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+
+
+def shutdown():
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def rank():
+    """This process's worker id (ref: KVStore::get_rank)."""
+    return jax.process_index()
+
+
+def num_workers():
+    """ref: KVStore::get_group_size."""
+    return jax.process_count()
+
+
+def barrier(name="barrier"):
+    """ref: KVStore::Barrier (ps-lite Postoffice::Barrier)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices(name)
+
+
+def all_sum(array):
+    """Sum a process-local array across all worker processes (the dist
+    kvstore merge).  jax array | numpy in, jax array out."""
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return jnp.asarray(array)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(jnp.asarray(array))
+    return jnp.sum(gathered, axis=0)
+
+
+def broadcast(array, root=0):
+    """Broadcast ``root``'s value to every process (ref: CommDevice::
+    Broadcast after the server update)."""
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return jnp.asarray(array)
+    from jax.experimental import multihost_utils
+    return multihost_utils.broadcast_one_to_all(
+        jnp.asarray(array), is_source=jax.process_index() == root)

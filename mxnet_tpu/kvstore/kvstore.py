@@ -95,13 +95,23 @@ class KVStore:
     def num_workers(self):
         return jax.process_count()
 
+    @property
+    def _is_dist(self):
+        return self._type.startswith("dist_") and jax.process_count() > 1
+
     def init(self, key, value):
-        """ref: KVStore::Init — one-time per-key allocation."""
+        """ref: KVStore::Init — one-time per-key allocation; in dist mode
+        rank 0's value is broadcast so every worker starts identically
+        (ref: kvstore_dist.h InitImpl pushes only from rank 0)."""
+        from .. import distributed
         for k, v in zip(_as_list(key), _as_list(value)):
             k = str(k)
             if k in self._store:
                 continue
-            self._store[k] = NDArray(jnp.asarray(v._data if isinstance(v, NDArray) else v))
+            arr = jnp.asarray(v._data if isinstance(v, NDArray) else v)
+            if self._is_dist:
+                arr = distributed.broadcast(arr, root=0)
+            self._store[k] = NDArray(arr)
 
     # ---------------------------------------------------------------- push --
     def push(self, key, value, priority=0):
@@ -121,6 +131,11 @@ class KVStore:
                     res = jnp.zeros_like(merged)
                 merged, res = _quant_2bit(merged, res, thr)
                 self._residuals[k] = res
+            if self._is_dist:
+                # dist_sync merge: sum each worker's (compressed) push across
+                # processes — the server-side reduce of kvstore_dist_server.h
+                from .. import distributed
+                merged = distributed.all_sum(merged)
             stored = self._store[k]
             if self._optimizer is not None:
                 # dense per-key optimizer index so string keys get distinct

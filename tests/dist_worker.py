@@ -1,0 +1,59 @@
+"""Worker body for the multi-process localhost rehearsal (spawned by
+tools/launch.py; ref: tests/nightly/dist_sync_kvstore.py — real multi-process
+consistency assertions, no mocks)."""
+import sys
+
+import numpy as np
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import distributed
+
+    distributed.init()
+    n = distributed.num_workers()
+    r = distributed.rank()
+    assert n >= 2, f"expected a multi-process run, got {n}"
+
+    # --- dist kvstore: init broadcast + push/pull sum consistency ---------
+    kv = mx.kv.create("dist_sync")
+    assert kv.rank == r and kv.num_workers == n
+    kv.init(3, mx.nd.ones((4,)) * (r + 7))      # only rank 0's value counts
+    g = mx.nd.ones((4,)) * (r + 1)              # worker r pushes r+1
+    kv.push(3, g)
+    out = mx.nd.zeros((4,))
+    kv.pull(3, out=out)
+    # server-side merge = sum over workers = n(n+1)/2, replacing the store
+    expect = np.full((4,), n * (n + 1) / 2.0, np.float32)
+    np.testing.assert_allclose(out.asnumpy(), expect)
+
+    # --- init value must be rank 0's broadcast ----------------------------
+    kv.init(9, mx.nd.ones((2,)) * (r + 7))
+    out9 = mx.nd.zeros((2,))
+    kv.pull(9, out=out9)
+    np.testing.assert_allclose(out9.asnumpy(), np.full((2,), 7.0, np.float32))
+
+    # --- dist update_on_kvstore: server-side optimizer --------------------
+    kv2 = mx.kv.create("dist_sync_device")
+    opt = mx.optimizer.create("sgd", learning_rate=0.5)
+    kv2.set_optimizer(opt)
+    w0 = np.ones((4,), np.float32)
+    kv2.init(0, mx.nd.array(w0))
+    kv2.push(0, mx.nd.ones((4,)))               # each worker grad = 1
+    outw = mx.nd.zeros((4,))
+    kv2.pull(0, out=outw)
+    np.testing.assert_allclose(outw.asnumpy(), w0 - 0.5 * n)
+
+    # --- collectives helpers ----------------------------------------------
+    s = distributed.all_sum(np.full((3,), float(r + 1), np.float32))
+    np.testing.assert_allclose(np.asarray(s),
+                               np.full((3,), n * (n + 1) / 2.0))
+    b = distributed.broadcast(np.full((2,), float(r), np.float32), root=1)
+    np.testing.assert_allclose(np.asarray(b), np.full((2,), 1.0))
+    distributed.barrier()
+    print(f"worker {r}/{n} OK", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

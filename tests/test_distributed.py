@@ -1,0 +1,41 @@
+"""Multi-process distributed rehearsal on localhost (SURVEY.md §4
+"distributed-without-a-cluster": the reference tests dist kvstore by
+launching real worker processes on one machine via tools/launch.py; same
+technique here over jax.distributed + gloo CPU collectives)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("n", [2])
+def test_launch_local_dist_workers(n):
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # workers must not inherit this process's 8-device XLA_FLAGS
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", str(n), "--platform", "cpu", "--devices-per-worker", "2",
+         sys.executable, os.path.join(REPO, "tests", "dist_worker.py")],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    for r in range(n):
+        assert f"worker {r}/{n} OK" in proc.stdout
+
+
+def test_single_process_init_noop():
+    """distributed.init() with no env/args must be a harmless no-op."""
+    import mxnet_tpu as mx
+    mx.distributed.init()
+    assert mx.distributed.num_workers() >= 1
+    assert mx.distributed.rank() == 0
+    # collectives degrade to identity in single-process mode
+    import numpy as np
+    s = mx.distributed.all_sum(np.ones((2,), np.float32))
+    np.testing.assert_allclose(np.asarray(s), np.ones((2,)))

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Multi-process launcher.
+
+ref: tools/launch.py + the dmlc-core tracker's local launcher
+(3rdparty/dmlc-core/tracker/dmlc_tracker/local.py): export the DMLC_* env
+contract, exec the user command N times, propagate failures.  TPU-native
+differences: there are no server/scheduler roles (every process is a worker
+talking to the jax.distributed coordination service — SURVEY.md §5.8), and
+``--platform cpu`` rehearses a cluster on one machine with virtual devices
+(SURVEY.md §4 "distributed-without-a-cluster").
+
+    python tools/launch.py -n 4 python train.py ...
+    python tools/launch.py -n 2 --platform cpu --devices-per-worker 2 \
+        python tests/dist_worker.py
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-n", "--num-workers", type=int, required=True)
+    p.add_argument("--launcher", choices=["local"], default="local",
+                   help="only the local launcher is built in; multi-host "
+                        "bring-up passes explicit DMLC_* env instead")
+    p.add_argument("--platform", default=None,
+                   help="force JAX_PLATFORMS in workers (e.g. cpu for the "
+                        "localhost rehearsal)")
+    p.add_argument("--devices-per-worker", type=int, default=0,
+                   help="with --platform cpu: virtual CPU devices per worker")
+    p.add_argument("command", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if not args.command:
+        p.error("no command given")
+
+    port = _free_port()
+    procs = []
+    for i in range(args.num_workers):
+        env = dict(os.environ)
+        env.update({
+            "DMLC_ROLE": "worker",
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(args.num_workers),
+            "DMLC_WORKER_ID": str(i),
+        })
+        if args.platform:
+            env["JAX_PLATFORMS"] = args.platform
+            if args.platform == "cpu":
+                # keep the axon/TPU plugin out of CPU rehearsal workers:
+                # sitecustomize registers it at interpreter startup
+                env.pop("PALLAS_AXON_POOL_IPS", None)
+        if args.devices_per_worker:
+            flags = env.get("XLA_FLAGS", "")
+            env["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{args.devices_per_worker}").strip()
+        procs.append(subprocess.Popen(args.command, env=env))
+
+    rc = 0
+    for i, proc in enumerate(procs):
+        r = proc.wait()
+        if r != 0:
+            print(f"worker {i} exited with {r}", file=sys.stderr)
+            rc = rc or r
+    if rc:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
