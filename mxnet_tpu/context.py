@@ -19,8 +19,13 @@ _tls = threading.local()
 
 
 def _accelerator_devices():
-    """Devices of the default (non-cpu) backend, or [] if the default is cpu."""
-    devs = jax.devices()
+    """Local (addressable) devices of the default (non-cpu) backend, or []
+    if the default is cpu.
+
+    Uses ``jax.local_devices()`` — never the global ``jax.devices()`` — so
+    that under ``jax.distributed`` each rank resolves onto a device it can
+    actually address (device_put to a non-addressable device raises)."""
+    devs = jax.local_devices()
     if devs and devs[0].platform != "cpu":
         return devs
     return []
@@ -51,15 +56,15 @@ class Context:
         """Resolve to a concrete jax.Device (fallback-tolerant for CI hosts)."""
         if self.device_type in ("cpu", "cpu_pinned", "cpu_shared"):
             try:
-                devs = jax.devices("cpu")
+                devs = jax.local_devices(backend="cpu")
             except RuntimeError:
-                devs = jax.devices()
+                devs = jax.local_devices()
             return devs[min(self.device_id, len(devs) - 1)]
         accel = _accelerator_devices()
         if accel:
             return accel[min(self.device_id, len(accel) - 1)]
         # No accelerator on this host (e.g. CPU-only test run): fall back.
-        return jax.devices()[0]
+        return jax.local_devices()[0]
 
     @property
     def real_device_type(self) -> str:

@@ -123,7 +123,7 @@ def gpipe(stage_apply, stacked_params, x, mesh=None, axis="pp",
     out = _run(stacked_params, xm, base_key)
     out = out.reshape((B,) + out.shape[2:])
     if eager:
-        out = jax.device_put(out, jax.devices()[0])
+        out = jax.device_put(out, jax.local_devices()[0])
     return out
 
 

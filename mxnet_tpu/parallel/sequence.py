@@ -44,7 +44,7 @@ def _maybe_gather(out, *inputs):
     stay mesh-sharded for XLA to fuse."""
     if any(isinstance(a, jax.core.Tracer) for a in inputs):
         return out
-    return jax.device_put(out, jax.devices()[0])
+    return jax.device_put(out, jax.local_devices()[0])
 
 
 def _rng_arg(dropout):

@@ -41,6 +41,34 @@ def _leaves(args):
     return [a._data for a in nds], tree
 
 
+def _coerce_arrays(v):
+    """Accept raw numpy / jax arrays as batch leaves (wrap into NDArray so
+    they flatten as data, not as static tree structure).  numpy stays in
+    host memory — placement happens once, in ``_put_batch``."""
+    if isinstance(v, (tuple, list)):
+        return tuple(_coerce_arrays(x) for x in v)
+    if isinstance(v, (np.ndarray, jax.Array)):
+        return NDArray(v)
+    return v
+
+
+def _put_batch(leaf, sharding):
+    """Place one batch leaf on the mesh.
+
+    Single-process: plain device_put (the leaf is the full global batch).
+    Multi-process (``jax.distributed``): the leaf is this worker's LOCAL
+    shard — the reference's per-worker data partition (each worker reads its
+    own slice of the dataset; SURVEY §3.3) — so the global batch is assembled
+    from per-process shards without any cross-host copy.  A leaf that is
+    already a global (not fully addressable) jax.Array is already placed;
+    hand it to device_put for a sharding-to-sharding transfer instead."""
+    if jax.process_count() > 1:
+        if not (isinstance(leaf, jax.Array) and not leaf.is_fully_addressable):
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(leaf))
+    return jax.device_put(leaf, sharding)
+
+
 class TrainStep:
     """Compiled (params, states, batch) → (params', states', loss) on a mesh."""
 
@@ -166,6 +194,7 @@ class TrainStep:
         return self.step(data, label)
 
     def step(self, data, label):
+        data, label = _coerce_arrays(data), _coerce_arrays(label)
         data_args = data if isinstance(data, (tuple, list)) else (data,)
         data_args = tuple(data_args)
         if not self._built:
@@ -183,8 +212,8 @@ class TrainStep:
         key = _random.next_key()
         lr = jnp.float32(self._base_lr())
         dat_sh = NamedSharding(self.mesh, self._data_pspec)
-        data_leaves = [jax.device_put(l, dat_sh) for l in data_leaves]
-        label_leaves = [jax.device_put(l, dat_sh) for l in label_leaves]
+        data_leaves = [_put_batch(l, dat_sh) for l in data_leaves]
+        label_leaves = [_put_batch(l, dat_sh) for l in label_leaves]
         (self._train_arrays, self._aux_arrays, self._states, self._t,
          loss) = self._jit(self._train_arrays, self._aux_arrays, self._states,
                            self._t, key, lr, *data_leaves, *label_leaves)
@@ -199,11 +228,26 @@ class TrainStep:
         Arrays are gathered to the default device: eager Gluon execution is
         single-logical-device (placement-by-sharding belongs to the step), and
         mesh-committed params would collide with device-0 inputs in eager ops."""
-        dev = jax.devices()[0]
+        dev = jax.local_devices()[0]
+        if not hasattr(self, "_gather"):
+            # one jitted identity reused across params and calls (a fresh
+            # lambda per param would retrace/recompile every sync)
+            self._gather = jax.jit(lambda x: x, out_shardings=self._repl)
+
+        def host(a):
+            # Multi-process: a may be sharded over non-addressable devices;
+            # all-gather to fully-replicated first (XLA collective), then the
+            # local copy is readable on every rank.
+            if jax.process_count() > 1:
+                if not a.is_fully_replicated:
+                    a = self._gather(a)
+                return np.asarray(a)
+            return a
+
         for i, a in zip(self._train_idx, self._train_arrays):
-            self._plist[i].data()._data = jax.device_put(a, dev)
+            self._plist[i].data()._data = jax.device_put(host(a), dev)
         for i, a in zip(self._aux_idx, self._aux_arrays):
-            self._plist[i].data()._data = jax.device_put(a, dev)
+            self._plist[i].data()._data = jax.device_put(host(a), dev)
 
     @property
     def params(self):
@@ -241,6 +285,7 @@ class EvalStep:
         self._built = True
 
     def __call__(self, *data):
+        data = tuple(_coerce_arrays(d) for d in data)
         if not self._built:
             self._build(data)
         data_leaves, data_tree = _leaves(tuple(data))
@@ -265,7 +310,7 @@ class EvalStep:
             self._sig = sig
         key = _random.next_key()
         dat_sh = NamedSharding(self.mesh, self._data_pspec)
-        data_leaves = [jax.device_put(l, dat_sh) for l in data_leaves]
+        data_leaves = [_put_batch(l, dat_sh) for l in data_leaves]
         outs = self._jit(self._arrays, key, *data_leaves)
         res = _unflatten_nd(self._holder.out_tree,
                             tuple(NDArray(o) for o in outs))

@@ -51,6 +51,35 @@ def main():
     b = distributed.broadcast(np.full((2,), float(r), np.float32), root=1)
     np.testing.assert_allclose(np.asarray(b), np.full((2,), 1.0))
     distributed.barrier()
+
+    # --- multi-process fused TrainStep: every rank must end with identical
+    # weights (the dp allreduce rides the (virtual) fabric, not the kvstore)
+    import jax
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn as gnn
+
+    mx.random.seed(42)                        # identical init on all ranks
+    net = gnn.HybridSequential()
+    net.add(gnn.Dense(16, activation="relu", in_units=8),
+            gnn.Dense(4, in_units=16))
+    net.initialize()
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9)
+    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              opt, mesh=mesh)
+    rng = np.random.RandomState(100 + r)      # per-worker local data shard
+    local_b = 2 * len(jax.local_devices())
+    for _ in range(3):
+        x = rng.randn(local_b, 8).astype(np.float32)
+        y = rng.randint(0, 4, (local_b,))
+        loss = step(x, y)
+        assert np.isfinite(float(loss.asnumpy()))
+    step.sync_params_to_net()
+    flat = np.concatenate([p.data().asnumpy().ravel()
+                           for p in net.collect_params().values()])
+    ref = distributed.broadcast(flat, root=0)
+    np.testing.assert_allclose(np.asarray(ref), flat, rtol=1e-6, atol=1e-6)
+
     print(f"worker {r}/{n} OK", flush=True)
     return 0
 

@@ -932,6 +932,7 @@ COVERED_ELSEWHERE = {
     "ring_attention": "tests/test_sequence_parallel.py",
     "ulysses_attention": "tests/test_sequence_parallel.py",
     "moe_ffn": "tests/test_moe.py",
+    "flash_attention": "tests/test_flash_attention.py",
 }
 
 
