@@ -3,12 +3,15 @@
 
   python bench.py           # ResNet-50 v1 train throughput, img/s/chip
   python bench.py bert      # BERT-base seq-128 masked-LM pretrain, tokens/s/chip
-  python bench.py all       # both (two JSON lines)
+  python bench.py lstm      # 2x650 LSTM LM train (PTB recipe), tokens/s/chip
+  python bench.py ssd       # SSD-512 ResNet-50 train, img/s/chip
+  python bench.py all       # every config (one JSON line each)
 
 ref: example/image-classification/benchmark_score.py (synthetic-data img/s),
-gluonnlp scripts/bert/run_pretraining.py (masked-LM+NSP step), BASELINE.md
-configs 2 and 4.  The whole train step (fwd+bwd+optimizer) is one XLA program
-via parallel.TrainStep; matmul precision bf16 puts the FLOPs on the MXU.
+gluonnlp scripts/bert/run_pretraining.py (masked-LM+NSP step),
+example/gluon/word_language_model (PTB LSTM), GluonCV train_ssd.py —
+BASELINE.md configs 2-5.  The whole train step (fwd+bwd+optimizer) is one XLA
+program via parallel.TrainStep; matmul precision bf16 puts the FLOPs on the MXU.
 """
 import json
 import sys
@@ -18,6 +21,9 @@ import numpy as np
 
 BASELINE_IMG_S = 800.0     # BASELINE.md: V100 fp16 ~700-800 img/s, target bar
 BASELINE_TOK_S = 3000.0    # BASELINE.md: BERT-base >=3k tokens/s/chip bar
+BASELINE_LSTM_TOK_S = 30000.0  # BASELINE.md config 3: V100 cuDNN-RNN "order";
+                               # ~20-40k wps for the 2x650 PTB medium recipe
+BASELINE_SSD_IMG_S = 40.0  # BASELINE.md config 5: >=40 img/s/chip train bar
 
 
 def _setup():
@@ -132,16 +138,134 @@ def bench_bert():
     }))
 
 
+def bench_lstm():
+    """PTB-medium LSTM LM (2 layers x 650, embed 650, vocab 10k, bptt 35) —
+    the reference's word_language_model recipe over the fused lax.scan RNN op
+    (ref: src/operator/rnn.cc cuDNN path; BASELINE config 3)."""
+    jax = _setup()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel, gluon
+    from mxnet_tpu.gluon.model_zoo.language_model import rnn_lm
+    from jax.sharding import PartitionSpec
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    batch = 64 * len(jax.devices()) if on_accel else 8
+    bptt, vocab = 35, 10000
+    iters = 20 if on_accel else 2
+
+    net = rnn_lm(vocab_size=vocab, embed_size=650, hidden_size=650,
+                 num_layers=2, dropout=0.5)
+    net.initialize()
+    net.cast("bfloat16")
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def loss_fn(out, label):
+        return ce(out.reshape((-1, vocab)), label.reshape((-1,)))
+
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    opt = mx.optimizer.create("sgd", learning_rate=20.0 / batch)
+    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh,
+                              data_spec=PartitionSpec(None, "dp"))
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randint(0, vocab, (bptt, batch)).astype(np.int32))
+    y = mx.nd.array(rng.randint(0, vocab, (bptt, batch)).astype(np.int32))
+    step(x, y).asnumpy()
+    step(x, y).asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+
+    tok_s = batch * bptt * iters / dt / len(jax.devices())
+    print(json.dumps({
+        "metric": "lstm_lm_train_throughput",
+        "value": round(tok_s, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_LSTM_TOK_S, 4),
+    }))
+
+
+def bench_ssd():
+    """SSD-512 ResNet-50 train step: forward + MultiBoxTarget matching +
+    cls/loc loss + backward + SGD, one XLA program (ref: GluonCV
+    train_ssd.py; BASELINE config 5)."""
+    jax = _setup()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    from mxnet_tpu.gluon.model_zoo.ssd import (ssd_512_resnet50_v1,
+                                               SSDMultiBoxLoss)
+    from mxnet_tpu import ndarray as F
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    batch = 16 if on_accel else 2
+    iters = 10 if on_accel else 1
+    size = 512 if on_accel else 128
+
+    net = ssd_512_resnet50_v1(classes=20)
+    net.initialize()
+    net.cast("bfloat16")
+    box_loss = SSDMultiBoxLoss()
+
+    def loss_fn(out, label):
+        cls_pred, loc_pred, anchor = out
+        bt, bm, ct = F.MultiBoxTarget(anchor, label, cls_pred,
+                                      overlap_threshold=0.5,
+                                      negative_mining_ratio=3.0,
+                                      negative_mining_thresh=0.5)
+        return box_loss(cls_pred, loc_pred, ct, bt, bm)
+
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    opt = mx.optimizer.create("sgd", learning_rate=1e-3, momentum=0.9,
+                              wd=5e-4)
+    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch, 3, size, size)
+                    .astype(np.float32)).astype("bfloat16")
+    label = np.full((batch, 8, 5), -1.0, np.float32)
+    for i in range(batch):
+        for j in range(rng.randint(1, 4)):
+            cls = rng.randint(0, 20)
+            x1, y1 = rng.uniform(0.05, 0.5, 2)
+            label[i, j] = [cls, x1, y1, x1 + rng.uniform(0.1, 0.4),
+                           y1 + rng.uniform(0.1, 0.4)]
+    label = mx.nd.array(label)
+
+    step(x, label).asnumpy()
+    step(x, label).asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, label)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt / len(jax.devices())
+    print(json.dumps({
+        "metric": "ssd512_train_throughput",
+        "value": round(img_s, 2),
+        "unit": "img/s/chip",
+        "vs_baseline": round(img_s / BASELINE_SSD_IMG_S, 4),
+    }))
+
+
+BENCHES = {"resnet": bench_resnet, "bert": bench_bert,
+           "lstm": bench_lstm, "ssd": bench_ssd}
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "resnet"
-    if which not in ("resnet", "bert", "all"):
-        print(f"unknown benchmark {which!r} (expected resnet|bert|all)",
-              file=sys.stderr)
+    if which not in tuple(BENCHES) + ("all",):
+        print(f"unknown benchmark {which!r} "
+              f"(expected {'|'.join(BENCHES)}|all)", file=sys.stderr)
         sys.exit(1)
-    if which in ("resnet", "all"):
-        bench_resnet()
-    if which in ("bert", "all"):
-        bench_bert()
+    for fn in (BENCHES.values() if which == "all" else [BENCHES[which]]):
+        fn()
 
 
 if __name__ == "__main__":

@@ -39,6 +39,7 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import distributed
+from . import profiler
 from . import parallel
 from . import gluon
 

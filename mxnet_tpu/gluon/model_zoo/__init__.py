@@ -3,4 +3,5 @@ GluonNLP model family named by BASELINE.json)."""
 from . import vision
 from . import bert
 from . import ssd
+from . import language_model
 from .vision import get_model

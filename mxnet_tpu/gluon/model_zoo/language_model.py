@@ -1,0 +1,82 @@
+"""Word-level RNN language models (BASELINE config 3, the PTB recipe).
+
+ref: example/gluon/word_language_model/model.py — class RNNModel (embedding →
+(LSTM|GRU|RNN) stack → dense decoder, optional weight tying), and gluonnlp's
+StandardRNN.  TPU-native: the recurrent stack is the fused lax.scan RNN op
+(ops/rnn.py) so each timestep's gate computation is one MXU matmul; the
+decoder projection over (T*N, H) is a single large matmul.
+"""
+from __future__ import annotations
+
+from ...ndarray import NDArray
+from ..block import HybridBlock
+from .. import nn, rnn
+
+__all__ = ["RNNModel", "rnn_lm"]
+
+
+class RNNModel(HybridBlock):
+    """Container LM: forward(x) -> (T, N, vocab) logits.
+
+    ``x`` is int token ids in TNC layout ``(T, N)``.  Hidden state starts at
+    zero each call (truncated-BPTT without carry); pass explicit ``states``
+    to carry state across segments like the reference's training loop.
+    """
+
+    def __init__(self, mode="lstm", vocab_size=10000, embed_size=650,
+                 hidden_size=650, num_layers=2, dropout=0.5,
+                 tie_weights=False, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if tie_weights and embed_size != hidden_size:
+            raise ValueError("tie_weights requires embed_size == hidden_size")
+        self._tie = tie_weights
+        self._vocab_size = vocab_size
+        with self.name_scope():
+            self.drop = nn.Dropout(dropout)
+            self.embedding = nn.Embedding(vocab_size, embed_size)
+            if mode == "lstm":
+                self.rnn = rnn.LSTM(hidden_size, num_layers, layout="TNC",
+                                    dropout=dropout, input_size=embed_size)
+            elif mode == "gru":
+                self.rnn = rnn.GRU(hidden_size, num_layers, layout="TNC",
+                                   dropout=dropout, input_size=embed_size)
+            elif mode in ("rnn_relu", "rnn_tanh"):
+                self.rnn = rnn.RNN(hidden_size, num_layers,
+                                   activation=mode[4:], layout="TNC",
+                                   dropout=dropout, input_size=embed_size)
+            else:
+                raise ValueError(f"unknown mode {mode!r}")
+            if tie_weights:
+                # decoder reuses the embedding matrix (ref: RNNModel
+                # tie_weights); bias kept as its own parameter
+                self.decoder_bias = self.params.get(
+                    "decoder_bias", shape=(vocab_size,), init="zeros")
+            else:
+                self.decoder = nn.Dense(vocab_size, in_units=hidden_size,
+                                        flatten=False)
+
+    def forward(self, x, states=None):
+        emb = self.drop(self.embedding(x))
+        if states is None:
+            out = self.rnn(emb)
+        else:
+            out, states = self.rnn(emb, states)
+        out = self.drop(out)
+        if self._tie:
+            from ... import ndarray as F
+            # functional_call swaps .data() for the traced array, so this
+            # reads (and differentiates through) the live embedding matrix
+            logits = F.dot(out.reshape((-1, out.shape[-1])),
+                           self.embedding.weight.data(),
+                           transpose_b=True) + self.decoder_bias.data()
+            logits = logits.reshape(out.shape[:-1] + (self._vocab_size,))
+        else:
+            logits = self.decoder(out)
+        if states is None:
+            return logits
+        return logits, states
+
+
+def rnn_lm(mode="lstm", vocab_size=10000, **kwargs):
+    """Factory matching the reference example's CLI presets."""
+    return RNNModel(mode=mode, vocab_size=vocab_size, **kwargs)

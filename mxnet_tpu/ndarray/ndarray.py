@@ -440,7 +440,28 @@ def _out_ctx(args):
     return current_context()
 
 
+# Set by mxnet_tpu.profiler.set_state("run") — None keeps the dispatch
+# hot path free of any profiler cost (ref: src/profiler/profiler.cc hooks
+# every engine Push the same opt-in way).
+_PROF = None
+
+
 def invoke(op_name: str, *args, out=None, **kwargs):
+    """Dispatch one op; profiled when the profiler is running."""
+    prof = _PROF
+    if prof is not None and prof.ACTIVE:
+        t0 = prof._now_us()
+        res = _invoke(op_name, *args, out=out, **kwargs)
+        if prof.want_sync():
+            for r in (res if isinstance(res, tuple) else (res,)):
+                if isinstance(r, NDArray) and not _is_tracer(r._data):
+                    r._data.block_until_ready()
+        prof.record_span(op_name, t0, prof._now_us())
+        return res
+    return _invoke(op_name, *args, out=out, **kwargs)
+
+
+def _invoke(op_name: str, *args, out=None, **kwargs):
     """Dispatch one op (see module docstring for the three paths)."""
     kwargs = {k: v for k, v in kwargs.items() if v is not None or k in ("a_min", "a_max")}
     meta = OP_META.get(op_name, {})

@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import random as _random
 from .. import autograd as _autograd
+from ..profiler import scope as _pscope
 from ..ndarray import NDArray
 from ..gluon.block import Block, _flatten_nd, _unflatten_nd
 from .mesh import MeshScope, default_mesh
@@ -194,6 +195,10 @@ class TrainStep:
         return self.step(data, label)
 
     def step(self, data, label):
+        with _pscope("TrainStep.step", cat="step"):
+            return self._step(data, label)
+
+    def _step(self, data, label):
         data, label = _coerce_arrays(data), _coerce_arrays(label)
         data_args = data if isinstance(data, (tuple, list)) else (data,)
         data_args = tuple(data_args)

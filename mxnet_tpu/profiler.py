@@ -1,0 +1,298 @@
+"""Profiler: per-op aggregates + Chrome-trace dump + device (XLA) tracing.
+
+ref: python/mxnet/profiler.py — set_config/set_state/start/stop/dump/dumps
+and the instrumentation objects (Task/Frame/Event/Counter/Marker);
+src/profiler/profiler.cc — profiler::Profiler emits Chrome-trace JSON with
+one event per engine-dispatched op plus aggregate per-op tables.
+
+TPU-native mapping: host-side spans wrap the ``nd.invoke`` dispatch and the
+fused TrainStep (the two places work is scheduled), written out in Chrome
+``traceEvents`` format that chrome://tracing and Perfetto load directly.
+Device-side timing is XLA's own profiler: ``set_config(profile_device=True,
+logdir=...)`` brackets the run with ``jax.profiler.start_trace`` /
+``stop_trace`` so per-kernel HLO timing lands in TensorBoard/Perfetto too.
+``profile_sync=True`` makes each dispatch block until the result is ready,
+turning dispatch spans into true op latencies (the reference's engine records
+completion times the same way — at the cost of killing async overlap, so
+only for profiling runs).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
+           "dump", "dumps", "reset", "Task", "Frame", "Event", "Counter",
+           "Marker", "scope"]
+
+_lock = threading.Lock()
+
+
+class _ProfilerState:
+    def __init__(self):
+        self.active = False          # fast-path flag read by invoke
+        self.paused = False
+        self.sync = False
+        self.filename = "profile.json"
+        self.aggregate = True
+        self.device = False
+        self.logdir = None
+        self.continuous_dump = False
+        self.events = []             # chrome trace events
+        self.stats = {}              # name -> [count, total_s, min_s, max_s]
+        self._device_tracing = False
+
+
+_P = _ProfilerState()
+# module-level alias read on the invoke hot path (None = off)
+ACTIVE = False
+
+
+def _now_us():
+    return time.perf_counter() * 1e6
+
+
+def set_config(filename="profile.json", profile_all=False,
+               profile_symbolic=True, profile_imperative=True,
+               profile_api=False, profile_memory=False,
+               aggregate_stats=True, continuous_dump=False,
+               profile_sync=False, profile_device=False, logdir=None,
+               **kwargs):
+    """Configure output path and modes (ref: profiler.set_config).
+
+    Unknown legacy kwargs are accepted and ignored (the reference has ~15
+    engine-specific knobs with no TPU meaning)."""
+    with _lock:
+        _P.filename = filename
+        _P.aggregate = aggregate_stats or profile_all
+        _P.sync = profile_sync
+        _P.device = profile_device or (logdir is not None)
+        _P.logdir = logdir or (os.path.splitext(filename)[0] + "_xla")
+        _P.continuous_dump = continuous_dump
+
+
+def set_state(state="stop"):
+    """'run' | 'stop' (ref: profiler.set_state)."""
+    global ACTIVE
+    import sys
+    dump_after = False
+    with _lock:
+        if state == "run":
+            _P.active, _P.paused = True, False
+            # install the dispatch hook (kept out of the package's import
+            # graph so an idle profiler costs the hot path nothing)
+            from .ndarray import ndarray as _nd_mod
+            _nd_mod._PROF = sys.modules[__name__]
+            if _P.device and not _P._device_tracing:
+                try:
+                    import jax
+                    jax.profiler.start_trace(_P.logdir)
+                    _P._device_tracing = True
+                except Exception:
+                    pass
+        elif state == "stop":
+            _P.active = False
+            if _P._device_tracing:
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                _P._device_tracing = False
+            dump_after = _P.continuous_dump
+        else:
+            raise ValueError("state must be 'run' or 'stop'")
+        ACTIVE = _P.active and not _P.paused
+    if dump_after:  # outside _lock — dump() re-acquires it
+        dump()
+
+
+def start():
+    set_state("run")
+
+
+def stop():
+    set_state("stop")
+
+
+def pause(*a, **k):
+    global ACTIVE
+    with _lock:
+        _P.paused = True
+        ACTIVE = False
+
+
+def resume(*a, **k):
+    global ACTIVE
+    with _lock:
+        _P.paused = False
+        ACTIVE = _P.active
+
+
+def reset():
+    with _lock:
+        _P.events.clear()
+        _P.stats.clear()
+
+
+# ------------------------------------------------------------- recording --
+def record_span(name, t0_us, t1_us, cat="operator"):
+    """Append one completed span (µs timestamps) + aggregate it."""
+    dur = t1_us - t0_us
+    ev = {"name": name, "ph": "X", "ts": t0_us, "dur": dur,
+          "pid": os.getpid(), "tid": threading.get_ident(), "cat": cat}
+    with _lock:
+        _P.events.append(ev)
+        if _P.aggregate:
+            s = _P.stats.get(name)
+            if s is None:
+                _P.stats[name] = [1, dur, dur, dur]
+            else:
+                s[0] += 1
+                s[1] += dur
+                s[2] = min(s[2], dur)
+                s[3] = max(s[3], dur)
+
+
+def want_sync():
+    return _P.sync
+
+
+class scope:
+    """``with profiler.scope("name"):`` — explicit span over any region.
+    Also forwards to jax's TraceAnnotation so device traces carry the name."""
+
+    def __init__(self, name, cat="region"):
+        self._name = name
+        self._cat = cat
+        self._jax_ctx = None
+
+    def __enter__(self):
+        self._t0 = _now_us()
+        if _P._device_tracing:
+            try:
+                import jax
+                self._jax_ctx = jax.profiler.TraceAnnotation(self._name)
+                self._jax_ctx.__enter__()
+            except Exception:
+                self._jax_ctx = None
+        return self
+
+    def __exit__(self, *exc):
+        if self._jax_ctx is not None:
+            self._jax_ctx.__exit__(*exc)
+        if ACTIVE:
+            record_span(self._name, self._t0, _now_us(), self._cat)
+
+
+# ---------------------------------------------------------------- output --
+def dump(finished=True):
+    """Write the Chrome-trace JSON to the configured filename."""
+    with _lock:
+        payload = {"traceEvents": list(_P.events),
+                   "displayTimeUnit": "ms"}
+    d = os.path.dirname(_P.filename)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(_P.filename, "w") as f:
+        json.dump(payload, f)
+
+
+def dumps(reset=False):
+    """Aggregate per-op statistics table (ref: profiler.dumps)."""
+    with _lock:
+        rows = sorted(_P.stats.items(), key=lambda kv: -kv[1][1])
+        out = ["Profile Statistics:",
+               f"{'Name':<40s}{'Count':>8s}{'Total(ms)':>12s}"
+               f"{'Min(ms)':>10s}{'Max(ms)':>10s}{'Avg(ms)':>10s}"]
+        for name, (cnt, tot, mn, mx) in rows:
+            out.append(f"{name:<40s}{cnt:>8d}{tot / 1e3:>12.3f}"
+                       f"{mn / 1e3:>10.3f}{mx / 1e3:>10.3f}"
+                       f"{tot / cnt / 1e3:>10.3f}")
+        if reset:
+            _P.stats.clear()
+    return "\n".join(out)
+
+
+# ----------------------------------------------- instrumentation objects --
+class Domain:
+    """Grouping namespace for custom objects (ref: profiler.Domain)."""
+
+    def __init__(self, name):
+        self.name = name
+
+
+class Task(scope):
+    """Named task span (ref: profiler.Task). start()/stop() API."""
+
+    def __init__(self, domain=None, name="task"):
+        super().__init__(name if domain is None
+                         else f"{getattr(domain, 'name', domain)}::{name}",
+                         cat="task")
+
+    def start(self):
+        self.__enter__()
+
+    def stop(self):
+        self.__exit__(None, None, None)
+
+
+class Frame(Task):
+    """Frame span (ref: profiler.Frame) — same mechanics, 'frame' category."""
+
+    def __init__(self, domain=None, name="frame"):
+        Task.__init__(self, domain, name)
+        self._cat = "frame"
+
+
+class Event(Task):
+    """ref: profiler.Event."""
+
+    def __init__(self, name="event"):
+        Task.__init__(self, None, name)
+        self._cat = "event"
+
+
+class Counter:
+    """Numeric counter series (ref: profiler.Counter)."""
+
+    def __init__(self, domain=None, name="counter", value=0):
+        self.name = (name if domain is None
+                     else f"{getattr(domain, 'name', domain)}::{name}")
+        self._value = value
+
+    def _emit(self):
+        ev = {"name": self.name, "ph": "C", "ts": _now_us(),
+              "pid": os.getpid(), "args": {"value": self._value}}
+        with _lock:
+            _P.events.append(ev)
+
+    def set_value(self, value):
+        self._value = value
+        self._emit()
+
+    def increment(self, delta=1):
+        self._value += delta
+        self._emit()
+
+    def decrement(self, delta=1):
+        self._value -= delta
+        self._emit()
+
+
+class Marker:
+    """Instant marker (ref: profiler.Marker)."""
+
+    def __init__(self, domain=None, name="marker"):
+        self.name = (name if domain is None
+                     else f"{getattr(domain, 'name', domain)}::{name}")
+
+    def mark(self, scope="process"):
+        ev = {"name": self.name, "ph": "i", "ts": _now_us(),
+              "pid": os.getpid(), "tid": threading.get_ident(),
+              "s": {"process": "p", "thread": "t",
+                    "global": "g"}.get(scope, "p")}
+        with _lock:
+            _P.events.append(ev)

@@ -1,0 +1,126 @@
+"""Profiler (ref: python/mxnet/profiler.py tests in tests/python/unittest/
+test_profiler.py — config, start/stop, dump containing op events,
+aggregate stats, custom instrumentation objects)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    profiler.reset()
+    yield
+    profiler.set_state("stop")
+    profiler.reset()
+
+
+def test_dump_contains_op_events(tmp_path):
+    f = str(tmp_path / "profile.json")
+    profiler.set_config(filename=f, aggregate_stats=True)
+    profiler.start()
+    a = mx.nd.ones((8, 8))
+    b = mx.nd.dot(a, a)
+    c = mx.nd.softmax(b)
+    c.asnumpy()
+    profiler.stop()
+    profiler.dump()
+    with open(f) as fh:
+        trace = json.load(fh)
+    names = {e["name"] for e in trace["traceEvents"]}
+    assert "dot" in names and "softmax" in names
+    # chrome trace schema essentials
+    ev = next(e for e in trace["traceEvents"] if e["name"] == "dot")
+    assert ev["ph"] == "X" and "ts" in ev and "dur" in ev
+
+
+def test_aggregate_stats_table():
+    profiler.set_config(filename="unused.json", aggregate_stats=True)
+    profiler.start()
+    a = mx.nd.ones((4, 4))
+    for _ in range(3):
+        a = mx.nd.dot(a, a)
+    a.asnumpy()
+    profiler.stop()
+    table = profiler.dumps()
+    assert "Profile Statistics" in table
+    line = next(l for l in table.splitlines() if l.startswith("dot"))
+    assert " 3" in line  # count column
+
+
+def test_pause_resume():
+    profiler.set_config(filename="unused.json")
+    profiler.start()
+    mx.nd.ones((2, 2)).asnumpy()
+    profiler.pause()
+    mx.nd.dot(mx.nd.ones((2, 2)), mx.nd.ones((2, 2))).asnumpy()
+    profiler.resume()
+    profiler.stop()
+    table = profiler.dumps()
+    assert "dot" not in table
+
+
+def test_off_by_default_no_recording():
+    x = mx.nd.dot(mx.nd.ones((2, 2)), mx.nd.ones((2, 2)))
+    x.asnumpy()
+    assert "dot" not in profiler.dumps()
+
+
+def test_train_step_span(tmp_path):
+    from mxnet_tpu import gluon, parallel
+    import jax
+    net = gluon.nn.Dense(4, in_units=4)
+    net.initialize()
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    step = parallel.TrainStep(net, gluon.loss.L2Loss(),
+                              mx.optimizer.create("sgd", learning_rate=0.1),
+                              mesh=mesh)
+    x = np.random.randn(8, 4).astype(np.float32)
+    y = np.random.randn(8, 4).astype(np.float32)
+    step(mx.nd.array(x), mx.nd.array(y))  # compile outside the profile
+    f = str(tmp_path / "prof.json")
+    profiler.set_config(filename=f)
+    profiler.start()
+    step(mx.nd.array(x), mx.nd.array(y)).asnumpy()
+    profiler.stop()
+    profiler.dump()
+    with open(f) as fh:
+        names = {e["name"] for e in json.load(fh)["traceEvents"]}
+    assert "TrainStep.step" in names
+
+
+def test_custom_objects_and_counters(tmp_path):
+    f = str(tmp_path / "prof.json")
+    profiler.set_config(filename=f)
+    profiler.start()
+    d = profiler.Domain("app")
+    t = profiler.Task(d, "load")
+    t.start()
+    t.stop()
+    with profiler.scope("my_region"):
+        pass
+    ctr = profiler.Counter(d, "items", 0)
+    ctr.increment(5)
+    m = profiler.Marker(d, "tick")
+    m.mark()
+    profiler.stop()
+    profiler.dump()
+    with open(f) as fh:
+        evs = json.load(fh)["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"app::load", "my_region", "app::items", "app::tick"} <= names
+    cev = next(e for e in evs if e["name"] == "app::items")
+    assert cev["ph"] == "C" and cev["args"]["value"] == 5
+
+
+def test_profile_sync_mode():
+    profiler.set_config(filename="unused.json", profile_sync=True)
+    profiler.start()
+    a = mx.nd.ones((64, 64))
+    mx.nd.dot(a, a).asnumpy()
+    profiler.stop()
+    assert "dot" in profiler.dumps()
