@@ -41,10 +41,11 @@ def bench_resnet():
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
     on_accel = jax.devices()[0].platform != "cpu"
-    batch = 128 if on_accel else 8
+    batch = 256 if on_accel else 8
     iters = 20 if on_accel else 2
 
-    net = resnet50_v1()
+    # channel-last: the TPU-native layout (features on lanes; see PERF.md)
+    net = resnet50_v1(layout="NHWC")
     net.initialize()
     net.cast("bfloat16")  # bf16 compute, fp32 master weights in the optimizer
     mesh = parallel.make_mesh(dp=len(jax.devices()))
@@ -53,7 +54,7 @@ def bench_resnet():
                               mesh=mesh)
 
     rng = np.random.RandomState(0)
-    x = mx.nd.array(rng.randn(batch, 3, 224, 224)
+    x = mx.nd.array(rng.randn(batch, 224, 224, 3)
                     .astype(np.float32)).astype("bfloat16")
     y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.int32))
 

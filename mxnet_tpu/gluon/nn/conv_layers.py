@@ -40,10 +40,16 @@ class _Conv(HybridBlock):
             self._kwargs["adj"] = adj
         self._op_name = op_name
         self._act_type = activation
+        self._chan_last = bool(layout) and layout[-1] == "C"
+        cin = in_channels // groups if in_channels else 0
+        cout = channels // groups if channels else 0
         if op_name == "Convolution":
-            wshape = (channels, in_channels // groups if in_channels else 0) + kernel_size
-        else:  # Deconvolution: (in, out/group, *k) like the reference
-            wshape = (in_channels, channels // groups if channels else 0) + kernel_size
+            # O,I,*k channel-first; O,*k,I channel-last (reference layouts)
+            wshape = (channels,) + kernel_size + (cin,) if self._chan_last \
+                else (channels, cin) + kernel_size
+        else:  # Deconvolution: (in, out/group, *k) / (in, *k, out/group)
+            wshape = (in_channels,) + kernel_size + (cout,) if self._chan_last \
+                else (in_channels, cout) + kernel_size
         self.weight = self.params.get("weight", shape=wshape,
                                       init=weight_initializer,
                                       allow_deferred_init=True)
@@ -53,10 +59,10 @@ class _Conv(HybridBlock):
                      if use_bias else None)
 
     def infer_shape(self, x, *args):
-        c = x.shape[1]
+        c = x.shape[-1] if self._chan_last else x.shape[1]
         w = list(self.weight.shape)
         if self._op_name == "Convolution":
-            w[1] = c // self._kwargs["num_group"]
+            w[-1 if self._chan_last else 1] = c // self._kwargs["num_group"]
         else:
             w[0] = c
         self.weight.shape = tuple(w)
@@ -158,6 +164,7 @@ class _Pooling(HybridBlock):
             "kernel": pool_size, "stride": strides, "pad": padding,
             "pool_type": pool_type, "global_pool": global_pool,
             "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout,
         }
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad

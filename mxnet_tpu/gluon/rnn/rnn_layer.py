@@ -52,6 +52,12 @@ class _RNNLayer(HybridBlock):
     def state_info(self, batch_size=0):
         raise NotImplementedError
 
+    def cast(self, dtype):
+        """Params AND the zero-state dtype (the scan carry must match, or
+        f32 states silently promote the whole recurrence to f32)."""
+        super().cast(dtype)
+        self._dtype = dtype
+
     def begin_state(self, batch_size=0, func=None, **kwargs):
         """ref: _RNNLayer.begin_state."""
         from ... import ndarray as nd

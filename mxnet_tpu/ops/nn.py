@@ -9,6 +9,8 @@ Layout is NCHW/NCW/NCDHW to match the reference's default.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -39,8 +41,22 @@ def _fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False, fl
 
 
 # ---------------------------------------------------------------- conv ------
-_CONV_LAYOUTS = {1: ("NCW", "OIW", "NCW"), 2: ("NCHW", "OIHW", "NCHW"),
-                 3: ("NCDHW", "OIDHW", "NCDHW")}
+# channel-first (reference default) and channel-last (TPU-preferred: feature
+# dim maps onto lanes without layout-change copies around every conv).
+# Weight conventions follow the reference: O,I,*k channel-first; O,*k,I
+# channel-last (src/operator/nn/convolution-inl.h layout table).
+_CONV_LAYOUTS = {"NCW": ("NCW", "OIW", "NCW"), "NCHW": ("NCHW", "OIHW", "NCHW"),
+                 "NCDHW": ("NCDHW", "OIDHW", "NCDHW"),
+                 "NWC": ("NWC", "OWI", "NWC"), "NHWC": ("NHWC", "OHWI", "NHWC"),
+                 "NDHWC": ("NDHWC", "ODHWI", "NDHWC")}
+_DEFAULT_CONV_LAYOUT = {1: "NCW", 2: "NCHW", 3: "NCDHW"}
+
+
+def _conv_layout(layout, nd):
+    l = layout or _DEFAULT_CONV_LAYOUT[nd]
+    if l not in _CONV_LAYOUTS:
+        raise ValueError(f"unsupported conv layout {l!r}")
+    return l, _CONV_LAYOUTS[l], l[-1] == "C"
 
 
 @register_op("Convolution", aliases=("convolution",))
@@ -54,7 +70,8 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     stride = _tup(stride, nd)
     dilate = _tup(dilate, nd)
     pad = _tup(pad, nd) if pad else (0,) * nd
-    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, _CONV_LAYOUTS[nd])
+    _, dnl, chan_last = _conv_layout(layout, nd)
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, dnl)
     out = jax.lax.conv_general_dilated(
         data, weight,
         window_strides=stride,
@@ -65,7 +82,9 @@ def _convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         precision=None,
     )
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) * (nd + 1) + (-1,)) if chan_last \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -87,7 +106,7 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=Non
     # is passed through as-is in the O-I slot order.  jax applies ``padding``
     # to the stride-dilated input, so the reference's output-size contract
     # out = (in-1)*stride - 2*pad + kernel (+adj) needs (ke-1-pad) here.
-    lhs, rhs, out_l = _CONV_LAYOUTS[nd]
+    _, (lhs, rhs, out_l), chan_last = _conv_layout(layout, nd)
     ke = [(k - 1) * d + 1 for k, d in zip(kernel, dilate)]
     out = jax.lax.conv_transpose(
         data, weight,
@@ -98,10 +117,13 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=Non
         transpose_kernel=True,
     )
     if adj != (0,) * nd:
-        pads = [(0, 0), (0, 0)] + [(0, a) for a in adj]
+        pads = ([(0, 0)] + [(0, a) for a in adj] + [(0, 0)]) if chan_last \
+            else ([(0, 0), (0, 0)] + [(0, a) for a in adj])
         out = jnp.pad(out, pads)
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) * (nd + 1) + (-1,)) if chan_last \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return out
 
 
@@ -110,10 +132,14 @@ def _deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=Non
 def _pooling(data, kernel=None, pool_type="max", global_pool=False, cudnn_off=False,
              pooling_convention="valid", stride=None, pad=None, p_value=2,
              count_include_pad=True, layout=None):
-    """ref: src/operator/nn/pooling-inl.h — PoolingOp; lax.reduce_window."""
+    """ref: src/operator/nn/pooling-inl.h — PoolingOp; lax.reduce_window.
+    ``layout`` accepts the channel-first defaults and the channel-last
+    (NWC/NHWC/NDHWC) TPU-preferred variants."""
     nd = data.ndim - 2
+    chan_last = _conv_layout(layout, nd)[2]
+    sp0 = 1 if chan_last else 2  # first spatial axis
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = tuple(range(sp0, sp0 + nd))
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         if pool_type == "sum":
@@ -122,17 +148,24 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False, cudnn_off=Fa
     kernel = _tup(kernel, nd)
     stride = _tup(stride, nd) if stride else kernel
     pad = _tup(pad, nd) if pad else (0,) * nd
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
     if pooling_convention == "full":
         # ceil-mode output: extend padding on the right so the last window fits
         extra = []
         for i in range(nd):
-            size = data.shape[2 + i] + 2 * pad[i]
+            size = data.shape[sp0 + i] + 2 * pad[i]
             rem = (size - kernel[i]) % stride[i]
             extra.append((stride[i] - rem) % stride[i] if rem else 0)
-        pads = ((0, 0), (0, 0)) + tuple((p, p + e) for p, e in zip(pad, extra))
+        spads = tuple((p, p + e) for p, e in zip(pad, extra))
+    else:
+        spads = tuple((p, p) for p in pad)
+    if chan_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        pads = ((0, 0),) + spads + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + spads
     if pool_type == "max":
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
         return jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
@@ -154,6 +187,70 @@ def _pooling(data, kernel=None, pool_type="max", global_pool=False, cudnn_off=Fa
 
 
 # ---------------------------------------------------------- normalisation ---
+def _norm_axes(axes, ndim):
+    axes = (axes,) if isinstance(axes, int) else tuple(axes)
+    return tuple(a % ndim for a in axes)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _moments(data, axes, keepdims=False):
+    """Centred mean/variance with wide accumulators (f32; f64 for f64 in).
+
+    Centred (not E[x²]−E[x]²) so large-mean/small-std data keeps precision;
+    the custom VJP recomputes the centred values in the backward instead of
+    letting jax store a widened full-activation residual — the norm ops sit
+    on the HBM-bound hot path and must never materialise an f32 activation
+    (that residual alone cost ~15% ResNet-50 step time; see PERF.md)."""
+    ax = _norm_axes(axes, data.ndim)
+    if data.dtype in (jnp.bfloat16, jnp.float16):
+        # half-precision hot path: one fused pass, f32 accumulators.  The
+        # E[x²]−E[x]² cancellation floor (eps_f32·mean²) sits far below the
+        # input's own quantisation noise for any data bf16 can represent,
+        # and a single pass keeps the HBM-bound step at one read of x.
+        x = data.astype(jnp.float32)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(x), axis=axes, keepdims=True) \
+            - jnp.square(mean)
+        var = jnp.maximum(var, 0.0)
+    else:
+        # full-precision path: centred two-pass — immune to large-mean
+        # cancellation (the custom VJP below still avoids storing any
+        # widened residual for the backward).
+        acc_dt = jnp.float64 if data.dtype == jnp.float64 else jnp.float32
+        x = data.astype(acc_dt)
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    if not keepdims:
+        shape = [d for i, d in enumerate(data.shape) if i not in ax]
+        mean = mean.reshape(shape)
+        var = var.reshape(shape)
+    return mean, var
+
+
+def _moments_fwd(data, axes, keepdims):
+    mean, var = _moments(data, axes, keepdims)
+    return (mean, var), (data, mean)
+
+
+def _moments_bwd(axes, keepdims, res, cts):
+    data, mean = res
+    dmean, dvar = cts
+    ax = _norm_axes(axes, data.ndim)
+    n = 1
+    for a in ax:
+        n *= data.shape[a]
+    kshape = [1 if i in ax else s for i, s in enumerate(data.shape)]
+    mean_k = mean.reshape(kshape)
+    dmean_k = dmean.reshape(kshape).astype(mean.dtype)
+    dvar_k = dvar.reshape(kshape).astype(mean.dtype)
+    xm = data.astype(mean.dtype) - mean_k  # recomputed, fuses, not stored
+    dx = dmean_k / n + xm * (2.0 * dvar_k / n)
+    return (dx.astype(data.dtype),)
+
+
+_moments.defvjp(_moments_fwd, _moments_bwd)
+
+
 @register_op("BatchNorm", aliases=("batch_norm",))
 def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                 fix_gamma=True, use_global_stats=False, output_mean_var=False,
@@ -166,46 +263,55 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     """
     if training is None:
         training = _autograd.is_training()
+    axis = axis % data.ndim
     axes = tuple(i for i in range(data.ndim) if i != axis)
     g = jnp.ones_like(gamma) if fix_gamma else gamma
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
     if training and not use_global_stats:
-        mean = jnp.mean(data, axis=axes)
-        var = jnp.var(data, axis=axes)
-        new_mm = moving_mean * momentum + mean * (1 - momentum)
-        new_mv = moving_var * momentum + var * (1 - momentum)
+        mean, var = _moments(data, axes)
+        new_mm = moving_mean * momentum + mean.astype(moving_mean.dtype) * (1 - momentum)
+        new_mv = moving_var * momentum + var.astype(moving_var.dtype) * (1 - momentum)
     else:
-        mean, var = moving_mean, moving_var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
         new_mm, new_mv = moving_mean, moving_var
+    # per-channel scale in wide precision (tiny), then one fused centred
+    # multiply-add over the activation in ITS OWN dtype — the bf16 hot path
+    # never materialises a widened activation (the step is HBM-bound), and
+    # subtracting mean before scaling keeps large-mean data well-conditioned
     inv = jax.lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) + beta.reshape(bshape)
+    scale = (inv * g.astype(var.dtype)).astype(data.dtype)
+    out = ((data - mean.astype(data.dtype).reshape(bshape))
+           * scale.reshape(bshape) + beta.reshape(bshape))
     if output_mean_var:
-        return out, mean, inv
+        return out, mean.astype(data.dtype), inv.astype(data.dtype)
     return out, new_mm, new_mv
 
 
 @register_op("LayerNorm", aliases=("layer_norm",))
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     """ref: src/operator/nn/layer_norm-inl.h — LayerNormCompute."""
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
+    mean, var = _moments(data, axis, keepdims=True)
     inv = jax.lax.rsqrt(var + eps)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
-    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    out = ((data - mean.astype(data.dtype)) * inv.astype(data.dtype)
+           * gamma.reshape(shape) + beta.reshape(shape))
     if output_mean_var:
-        return out, jnp.squeeze(mean, axis), jnp.squeeze(inv, axis)
+        return (out, jnp.squeeze(mean, axis).astype(data.dtype),
+                jnp.squeeze(inv, axis).astype(data.dtype))
     return out
 
 
 @register_op("RMSNorm", aliases=("rms_norm",))
 def _rms_norm(data, gamma, axis=-1, eps=1e-6):
     """TPU-era extension (no reference analogue; standard in modern LMs)."""
-    ms = jnp.mean(jnp.square(data), axis=axis, keepdims=True)
+    ms = jnp.mean(jnp.square(data.astype(jnp.float32)), axis=axis,
+                  keepdims=True)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
-    return data * jax.lax.rsqrt(ms + eps) * gamma.reshape(shape)
+    return data * jax.lax.rsqrt(ms + eps).astype(data.dtype) * gamma.reshape(shape)
 
 
 @register_op("GroupNorm", aliases=("group_norm",))
@@ -215,9 +321,9 @@ def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
     rest = data.shape[2:]
     x = data.reshape(n, num_groups, c // num_groups, *rest)
     axes = tuple(range(2, x.ndim))
-    mean = jnp.mean(x, axis=axes, keepdims=True)
-    var = jnp.var(x, axis=axes, keepdims=True)
-    x = (x - mean) * jax.lax.rsqrt(var + eps)
+    mean, var = _moments(x, axes, keepdims=True)
+    x = ((x - mean.astype(x.dtype))
+         * jax.lax.rsqrt(var + eps).astype(x.dtype))
     x = x.reshape(data.shape)
     bshape = (1, c) + (1,) * len(rest)
     return x * gamma.reshape(bshape) + beta.reshape(bshape)
@@ -227,10 +333,11 @@ def _group_norm(data, gamma, beta, num_groups=1, eps=1e-5):
 def _instance_norm(data, gamma, beta, eps=1e-3):
     """ref: src/operator/instance_norm-inl.h."""
     axes = tuple(range(2, data.ndim))
-    mean = jnp.mean(data, axis=axes, keepdims=True)
-    var = jnp.var(data, axis=axes, keepdims=True)
+    mean, var = _moments(data, axes, keepdims=True)
     bshape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
-    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(bshape) + beta.reshape(bshape)
+    return ((data - mean.astype(data.dtype))
+            * jax.lax.rsqrt(var + eps).astype(data.dtype)
+            * gamma.reshape(bshape) + beta.reshape(bshape))
 
 
 # ------------------------------------------------------------ activation ----

@@ -18,8 +18,10 @@ from .moe import MoEFFN, moe_dispatch
 from .pipeline import PipelineStack, gpipe
 from .sequence import ring_attention, sp_attention, ulysses_attention
 from .step import EvalStep, TrainStep
+from .checkpoint import load_train_step, save_train_step
 
 __all__ = [
+    "load_train_step", "save_train_step",
     "AXES", "MeshScope", "current_mesh", "default_mesh", "make_mesh",
     "named_sharding", "replicated",
     "ShardingRules", "batch_spec", "fsdp_rules", "param_sharding",

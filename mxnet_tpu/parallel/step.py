@@ -88,12 +88,28 @@ class TrainStep:
         self._num_update = optimizer.begin_num_update
 
     # --------------------------------------------------------------- build --
+    def _batch_axis(self):
+        """Index of the dp-sharded (batch) axis in the data pspec."""
+        for i, el in enumerate(self._data_pspec):
+            names = el if isinstance(el, tuple) else (el,)
+            if "dp" in names:
+                return i
+        return 0
+
     def _build(self, sample_args):
         net = self.net
         if any(p._deferred_init is not None
                for p in net.collect_params().values()):
+            # shape-inference dry run on a batch-1 slice: deferred init only
+            # needs feature dims, and a full-batch eager forward would both
+            # waste a step of compute and OOM at large batch sizes
+            ax = self._batch_axis()
+            nds, tree = _flatten_nd(sample_args)
+            small = _unflatten_nd(tree, tuple(
+                NDArray(jax.lax.slice_in_dim(jnp.asarray(a._data), 0, 1, axis=ax))
+                for a in nds))
             with _autograd.pause(), MeshScope(self.mesh):
-                Block.__call__(net, *sample_args)
+                Block.__call__(net, *small)
         names, plist, arrays = param_names_and_values(net)
         self._names, self._plist = names, plist
         self._train_idx, self._aux_idx = trainable_split(plist)

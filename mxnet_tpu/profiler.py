@@ -264,6 +264,8 @@ class Counter:
         self._value = value
 
     def _emit(self):
+        if not ACTIVE:
+            return
         ev = {"name": self.name, "ph": "C", "ts": _now_us(),
               "pid": os.getpid(), "args": {"value": self._value}}
         with _lock:
@@ -290,6 +292,8 @@ class Marker:
                      else f"{getattr(domain, 'name', domain)}::{name}")
 
     def mark(self, scope="process"):
+        if not ACTIVE:
+            return
         ev = {"name": self.name, "ph": "i", "ts": _now_us(),
               "pid": os.getpid(), "tid": threading.get_ident(),
               "s": {"process": "p", "thread": "t",

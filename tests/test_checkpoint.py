@@ -1,0 +1,129 @@
+"""TrainStep checkpoint/resume (SURVEY §5.4, §7.1 S7: "checkpoint
+(params+json, sharded)") — the kill-and-resume contract: a restored run
+must reproduce the exact loss trajectory of an uninterrupted one."""
+import os
+
+import numpy as np
+import pytest
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.checkpoint import save_train_step, load_train_step
+
+
+def _net(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.BatchNorm(in_channels=16),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+def _step_for(net, opt_name="adam", **opt_kw):
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    opt = mx.optimizer.create(opt_name, **opt_kw)
+    return parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              opt, mesh=mesh)
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(16, 8).astype(np.float32),
+             rng.randint(0, 4, (16,))) for _ in range(n)]
+
+
+def test_kill_and_resume_identical_trajectory(tmp_path):
+    f = str(tmp_path / "ckpt.npz")
+    batches = _batches(8)
+
+    # uninterrupted run
+    step = _step_for(_net(7))
+    ref = [float(step(x, y).asnumpy()) for x, y in batches]
+
+    # interrupted: run 4, checkpoint, "die", rebuild from scratch, resume
+    step1 = _step_for(_net(7))
+    for x, y in batches[:4]:
+        step1(x, y)
+    save_train_step(step1, f)
+    del step1
+
+    step2 = _step_for(_net(99))          # different init — must not matter
+    step2(*batches[0])                   # build (runs one step to compile)
+    load_train_step(step2, f)
+    resumed = [float(step2(x, y).asnumpy()) for x, y in batches[4:]]
+    np.testing.assert_allclose(resumed, ref[4:], rtol=1e-5, atol=1e-6)
+
+
+def test_resume_restores_step_count_and_schedule(tmp_path):
+    f = str(tmp_path / "ckpt.npz")
+    sched = mx.lr_scheduler.FactorScheduler(step=3, factor=0.5, base_lr=0.1)
+    net = _net(1)
+    step = _step_for(net, "sgd", lr_scheduler=sched)
+    for x, y in _batches(5, seed=1):
+        step(x, y)
+    assert step._num_update == 5
+    save_train_step(step, f)
+
+    sched2 = mx.lr_scheduler.FactorScheduler(step=3, factor=0.5, base_lr=0.1)
+    step2 = _step_for(_net(2), "sgd", lr_scheduler=sched2)
+    step2(*_batches(1)[0])
+    load_train_step(step2, f)
+    assert step2._num_update == 5
+    assert step2.optimizer.num_update == 5
+
+
+def test_restore_across_mesh_layouts(tmp_path):
+    """dp checkpoint restores onto a dp×tp sharded step (re-placement)."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    f = str(tmp_path / "ckpt.npz")
+    batches = _batches(6, seed=3)
+    step = _step_for(_net(5))
+    ref = [float(step(x, y).asnumpy()) for x, y in batches]
+    sd = _step_for(_net(5))
+    for x, y in batches[:3]:
+        sd(x, y)
+    save_train_step(sd, f)
+
+    mesh = parallel.make_mesh(dp=2, tp=4)
+    rules = parallel.ShardingRules(
+        rules=[(r"dense0_weight", ("tp", None)),
+               (r"dense1_weight", (None, "tp"))])
+    opt = mx.optimizer.create("adam")
+    st = parallel.TrainStep(_net(11), gluon.loss.SoftmaxCrossEntropyLoss(),
+                            opt, mesh=mesh, rules=rules)
+    st(*batches[0])
+    load_train_step(st, f)
+    resumed = [float(st(x, y).asnumpy()) for x, y in batches[3:]]
+    np.testing.assert_allclose(resumed, ref[3:], rtol=1e-4, atol=1e-5)
+
+
+def test_mismatch_raises(tmp_path):
+    f = str(tmp_path / "ckpt.npz")
+    step = _step_for(_net(0))
+    step(*_batches(1)[0])
+    save_train_step(step, f)
+
+    other = nn.HybridSequential()
+    other.add(nn.Dense(3, in_units=8))
+    other.initialize()
+    s2 = _step_for(other)
+    s2(np.random.randn(16, 8).astype(np.float32),
+       np.random.randint(0, 3, (16,)))
+    with pytest.raises(ValueError):
+        load_train_step(s2, f)
+
+    s3 = _step_for(_net(0), "sgd")
+    s3(*_batches(1)[0])
+    with pytest.raises(ValueError, match="optimizer mismatch"):
+        load_train_step(s3, f)
+
+
+def test_unbuilt_step_raises(tmp_path):
+    step = _step_for(_net(0))
+    with pytest.raises(ValueError):
+        save_train_step(step, str(tmp_path / "x.npz"))
