@@ -39,6 +39,10 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import distributed
+from . import sparse
+ndarray.sparse = sparse      # mx.nd.sparse, matching the reference layout
+from . import numpy as np           # mx.np — numpy-semantics frontend
+from . import numpy_extension as npx  # mx.npx — set_np + neural ops
 from . import profiler
 from . import parallel
 from . import gluon

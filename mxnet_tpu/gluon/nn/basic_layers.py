@@ -312,8 +312,10 @@ class Embedding(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._input_dim = input_dim
         self._output_dim = output_dim
-        self.weight = self.params.get("weight", shape=(input_dim, output_dim),
-                                      init=weight_initializer, dtype=dtype)
+        self.weight = self.params.get(
+            "weight", shape=(input_dim, output_dim),
+            init=weight_initializer, dtype=dtype,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def infer_shape(self, *args):
         pass

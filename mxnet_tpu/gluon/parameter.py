@@ -48,6 +48,7 @@ class Parameter:
         self._data: Optional[NDArray] = None
         self._deferred_init = None  # (initializer, ctx, default_init)
         self._stype = stype
+        self._grad_stype = grad_stype
 
     # ----------------------------------------------------------------- reqs --
     @property
@@ -120,6 +121,15 @@ class Parameter:
             raise RuntimeError(
                 f"parameter '{self.name}' has not been initialized; "
                 f"call .initialize() first")
+        from .. import numpy_extension as _npx
+        from ..numpy import ndarray as _np_nd
+        # np mode (npx.set_np): retype the parameter array in place (layout-
+        # compatible subclass, identity preserved for the tape) so block
+        # outputs become mx.np arrays — the reference's set_np mechanism
+        want = _np_nd if _npx.is_np_array() else NDArray
+        if type(self._data) is not want and \
+                type(self._data) in (NDArray, _np_nd):
+            self._data.__class__ = want
         return self._data
 
     def list_data(self):
@@ -143,6 +153,13 @@ class Parameter:
         d = self.data(ctx)
         if d.grad is None:
             raise RuntimeError(f"parameter '{self.name}' has grad_req='null'")
+        if self._grad_stype == "row_sparse":
+            # sparse-grad parameters (Embedding(sparse_grad=True)) hand the
+            # optimizer a row_sparse view for lazy row-wise updates.  The
+            # tape accumulates dense (XLA scatter-add is the TPU-native
+            # form); the rsp view is the update/communication format.
+            from .. import sparse as _sp
+            return _sp.cast_storage(d.grad, "row_sparse")
         return d.grad
 
     def list_grad(self):

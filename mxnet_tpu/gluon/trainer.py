@@ -17,6 +17,15 @@ from ..ndarray import NDArray
 __all__ = ["Trainer"]
 
 
+def _dense_grad(p):
+    """The parameter's dense tape-owned grad buffer (kvstore wire format;
+    stable object so ``pull(out=g)`` lands in the accumulator itself)."""
+    d = p.data()
+    if d.grad is None:
+        raise RuntimeError(f"parameter '{p.name}' has no gradient buffer")
+    return d.grad
+
+
 class Trainer:
     """ref: class Trainer."""
 
@@ -90,7 +99,7 @@ class Trainer:
             # push grads, the store applies the optimizer, pull new weights
             # (local optimizer states stay unallocated — the store owns them)
             for i, p in enumerate(self._params):
-                self._kvstore.push(i, p.grad())
+                self._kvstore.push(i, _dense_grad(p))
                 self._kvstore.pull(i, out=p.data())
             return
         if not self._states_ready:
@@ -109,8 +118,12 @@ class Trainer:
             self._allreduce_grads()
 
     def _allreduce_grads(self):
+        # aggregation is DENSE (the wire format the kvstore understands and
+        # the in-place pull target the tape owns); sparse-grad params get
+        # their row_sparse view re-derived from the reduced buffer at update
+        # time via p.grad()
         for i, p in enumerate(self._params):
-            g = p.grad()
+            g = _dense_grad(p)
             self._kvstore.push(i, g)
             self._kvstore.pull(i, out=g)
 

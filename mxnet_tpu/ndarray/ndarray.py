@@ -446,6 +446,7 @@ def _out_ctx(args):
 _PROF = None
 
 
+
 def invoke(op_name: str, *args, out=None, **kwargs):
     """Dispatch one op; profiled when the profiler is running."""
     prof = _PROF
@@ -470,7 +471,20 @@ def _invoke(op_name: str, *args, out=None, **kwargs):
     if meta.get("has_training") and "training" not in kwargs:
         kwargs["training"] = _autograd.is_training()
     ctx = _out_ctx(args)
-    raw = [a._data if isinstance(a, NDArray) else a for a in args]
+    raw = []
+    out_cls = NDArray
+    for a in args:
+        if isinstance(a, NDArray):
+            raw.append(a._data)
+            if out_cls is NDArray and type(a) is not NDArray:
+                out_cls = type(a)  # mx.np.ndarray in → mx.np.ndarray out
+        else:
+            if getattr(a, "stype", "default") != "default":
+                raise TypeError(
+                    f"op {op_name!r} does not support sparse storage; "
+                    f"densify with .tostype('default') or use the "
+                    f"mxnet_tpu.sparse functions")
+            raw.append(a)
     tracing = any(_is_tracer(r) for r in raw)
 
     if tracing:
@@ -491,7 +505,7 @@ def _invoke(op_name: str, *args, out=None, **kwargs):
             return [all_cts[i] for i in _pos]
 
         outs_t = result if isinstance(result, tuple) else (result,)
-        out_nds = tuple(NDArray(o, ctx=ctx) for o in outs_t)
+        out_nds = tuple(out_cls(o, ctx=ctx) for o in outs_t)
         if out is not None:
             # out= must be the array the tape knows, or backward from it
             # silently finds no node.
@@ -514,9 +528,9 @@ def _invoke(op_name: str, *args, out=None, **kwargs):
             result = jfn(dyn, *raw)
 
     if isinstance(result, tuple):
-        result_nd = tuple(NDArray(_engine.track(r), ctx=ctx) for r in result)
+        result_nd = tuple(out_cls(_engine.track(r), ctx=ctx) for r in result)
     else:
-        result_nd = NDArray(_engine.track(result) if not tracing else result, ctx=ctx)
+        result_nd = out_cls(_engine.track(result) if not tracing else result, ctx=ctx)
     return _copy_to_out(result_nd, out)
 
 

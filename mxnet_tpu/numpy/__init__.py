@@ -1,0 +1,339 @@
+"""``mx.np`` — the numpy-semantics frontend.
+
+ref: python/mxnet/numpy/multiarray.py — mx.np.ndarray and the numpy-compat
+function surface (src/operator/numpy/ implements them as ~100 C++ ops).
+TPU-native: jax.numpy *is* a numpy-semantics array library compiled by XLA,
+so this frontend is a thin typed layer — ``mx.np.ndarray`` subclasses the
+core NDArray (sharing autograd, device placement, and the async engine) and
+the module functions delegate to jnp, wrapping results back.  That keeps
+one implementation for both frontends instead of the reference's parallel
+operator tree, which is the §7.0 "delegate to the compiler" stance.
+
+Use with ``mx.npx.set_np()`` like the reference (it flips the default array
+type used by gluon blocks), or call these functions directly.
+"""
+from __future__ import annotations
+
+import builtins
+import sys
+
+import numpy as _onp
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, invoke
+from ..ndarray import array as _nd_array
+from . import random  # noqa: F401  (mx.np.random)
+from . import linalg  # noqa: F401  (mx.np.linalg)
+
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+
+# dtypes re-exported like numpy's namespace
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+int8 = _onp.int8
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+bfloat16 = jnp.bfloat16
+
+
+class ndarray(NDArray):
+    """mx.np.ndarray (ref: multiarray.py — class ndarray).
+
+    Subclass of the core NDArray: same buffer, autograd tape, and context
+    machinery; numpy-flavoured surface (``.ndim``/``.T``/item()/tolist(),
+    scalar-producing reductions, numpy operator semantics from jnp)."""
+
+    # layout-compatible with NDArray so npx.set_np can retype parameter
+    # arrays in place (identity-preserving — the tape keys on object id)
+    __slots__ = ()
+
+    def item(self):
+        if self.size != 1:
+            raise ValueError("can only convert an array of size 1 to a "
+                             "Python scalar")
+        return self._data.reshape(()).item()
+
+    def tolist(self):
+        return _onp.asarray(self._data).tolist()
+
+    def as_nd_ndarray(self):
+        """Back to the legacy frontend type (ref: ndarray.as_nd_ndarray)."""
+        return NDArray(self._data, ctx=self._ctx)
+
+    # numpy-style named methods delegating to the module functions
+    def mean(self, axis=None, dtype=None, keepdims=False):
+        return mean(self, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    def sum(self, axis=None, dtype=None, keepdims=False):
+        return sum(self, axis=axis, dtype=dtype, keepdims=keepdims)
+
+    def std(self, axis=None, keepdims=False):
+        return std(self, axis=axis, keepdims=keepdims)
+
+    def var(self, axis=None, keepdims=False):
+        return var(self, axis=axis, keepdims=keepdims)
+
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _wrap(self._data.reshape(shape))
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _wrap(jnp.transpose(self._data, axes or None))
+
+    def astype(self, dtype, copy=True):
+        return _wrap(self._data.astype(dtype_np(dtype)))
+
+    def copy(self):
+        return _wrap(self._data + 0)
+
+    def __repr__(self):
+        return repr(_onp.asarray(self._data)).replace("array(", "array(", 1)
+
+
+def _wrap(data):
+    return ndarray(data, ctx=current_context())
+
+
+def _unwrap(x):
+    if isinstance(x, NDArray):
+        return x._data
+    return x
+
+
+def array(object, dtype=None, ctx=None):
+    """ref: mx.np.array — numpy default dtype rules (float32 default for
+    floats, like the reference's mx.np)."""
+    base = _nd_array(object, ctx=ctx, dtype=dtype)
+    return ndarray(base._data, ctx=base._ctx)
+
+
+# ---------------------------------------------------------------- factory ---
+def zeros(shape, dtype="float32", ctx=None):
+    return _wrap(jnp.zeros(shape, dtype_np(dtype)))
+
+
+def ones(shape, dtype="float32", ctx=None):
+    return _wrap(jnp.ones(shape, dtype_np(dtype)))
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    return _wrap(jnp.full(shape, fill_value,
+                          dtype_np(dtype) if dtype else None))
+
+
+def empty(shape, dtype="float32", ctx=None):
+    return zeros(shape, dtype, ctx)
+
+
+def zeros_like(a, dtype=None):
+    return _wrap(jnp.zeros_like(_unwrap(a), dtype))
+
+
+def ones_like(a, dtype=None):
+    return _wrap(jnp.ones_like(_unwrap(a), dtype))
+
+
+def full_like(a, fill_value, dtype=None):
+    return _wrap(jnp.full_like(_unwrap(a), fill_value, dtype))
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return _wrap(jnp.arange(start, stop, step,
+                            dtype_np(dtype) if dtype else None))
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    return _wrap(jnp.linspace(start, stop, num, endpoint=endpoint,
+                              dtype=dtype_np(dtype) if dtype else None))
+
+
+def eye(N, M=None, k=0, dtype="float32", ctx=None):
+    return _wrap(jnp.eye(N, M, k, dtype_np(dtype)))
+
+
+def identity(n, dtype="float32", ctx=None):
+    return eye(n, dtype=dtype)
+
+
+def meshgrid(*xi, indexing="xy"):
+    return tuple(_wrap(g) for g in
+                 jnp.meshgrid(*[_unwrap(x) for x in xi], indexing=indexing))
+
+
+# ---------------------------------- mechanically generated jnp delegates ----
+_UNARY = [
+    "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "cbrt",
+    "square", "abs", "absolute", "sign", "negative", "reciprocal",
+    "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh", "cosh",
+    "tanh", "arcsinh", "arccosh", "arctanh", "degrees", "radians",
+    "floor", "ceil", "rint", "trunc", "fix", "logical_not",
+    "isnan", "isinf", "isfinite", "isneginf", "isposinf",
+]
+_BINARY = [
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "power", "mod", "remainder", "fmod", "maximum", "minimum", "hypot",
+    "arctan2", "copysign", "logaddexp", "equal", "not_equal", "greater",
+    "greater_equal", "less", "less_equal", "logical_and", "logical_or",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "left_shift", "right_shift", "gcd", "lcm",
+]
+_SHAPE = [
+    "reshape", "ravel", "moveaxis", "swapaxes", "expand_dims", "squeeze",
+    "broadcast_to", "flip", "fliplr", "flipud", "roll", "rot90", "tile",
+    "repeat", "atleast_1d", "atleast_2d", "atleast_3d",
+]
+_OTHER = [
+    "where", "clip", "tril", "triu", "diag", "trace", "sort", "argsort",
+    "searchsorted", "unique", "cumsum", "cumprod", "diff", "ediff1d",
+    "nan_to_num", "around", "round", "real", "imag", "interp",
+    "take", "take_along_axis", "nonzero", "count_nonzero", "allclose",
+    "array_equal", "isclose", "may_share_memory", "shares_memory",
+    "histogram", "bincount", "pad", "insert", "delete", "flatnonzero",
+    "tensordot", "dot", "matmul", "inner", "outer", "vdot", "kron",
+    "cross", "einsum", "average",
+]
+_REDUCE = [
+    "sum", "prod", "mean", "std", "var", "max", "min", "amax", "amin",
+    "argmax", "argmin", "all", "any", "nansum", "nanprod", "nanmean",
+    "nanmax", "nanmin", "median", "percentile", "quantile", "ptp",
+]
+_CONCAT = ["concatenate", "stack", "vstack", "hstack", "dstack",
+           "column_stack", "split", "array_split", "vsplit", "hsplit",
+           "dsplit"]
+
+_this = sys.modules[__name__]
+
+
+def _apply(fn, name, nd_args, call):
+    """Run ``call(*raw)`` with the three dispatch modes of ``nd.invoke``:
+    trace-through under jit, VJP-record on the autograd tape, plain eager —
+    so mx.np functions differentiate exactly like mx.nd ops do."""
+    from .. import autograd as _autograd
+
+    raw = [a._data for a in nd_args]
+    tracing = builtins.any(isinstance(r, jax.core.Tracer) for r in raw)
+    if not tracing and _autograd.is_recording():
+        result, pullback = jax.vjp(call, *raw)
+
+        def _pull(cts, _pb=pullback):
+            return list(_pb(cts[0] if not isinstance(result, tuple) else cts))
+
+        outs_t = result if isinstance(result, tuple) else (result,)
+        out_nds = tuple(_wrap(o) for o in outs_t)
+        node = _autograd.TapeNode(list(nd_args), list(out_nds), _pull,
+                                  name=f"np.{name}")
+        _autograd.append_node(node)
+        return out_nds if isinstance(result, tuple) else out_nds[0]
+    out = call(*raw)
+    if isinstance(out, (tuple, list)):
+        return type(out)(_wrap(o) if isinstance(o, jax.Array) else o
+                         for o in out)
+    if isinstance(out, jax.Array):
+        return _wrap(out)
+    return out
+
+
+def _delegate(name):
+    fn = getattr(jnp, name)
+
+    def wrapper(*args, **kwargs):
+        kwargs = {k: _unwrap(v) for k, v in kwargs.items()}
+        # split array args (tape inputs) from static args, keeping a
+        # template to rebuild the call — handles sequences of arrays
+        # (concatenate/stack) and static prefixes (einsum) uniformly
+        template, nd_args = [], []
+        for a in args:
+            if isinstance(a, NDArray):
+                template.append(("nd", len(nd_args)))
+                nd_args.append(a)
+            elif isinstance(a, (tuple, list)) and a and \
+                    builtins.all(isinstance(x, (NDArray, jax.Array,
+                                                _onp.ndarray)) for x in a):
+                wrapped = [NDArray(jnp.asarray(_unwrap(x)))
+                           if not isinstance(x, NDArray) else x for x in a]
+                template.append(("seq", len(nd_args), len(wrapped)))
+                nd_args.extend(wrapped)
+            else:
+                template.append(("static", a))
+
+        def call(*raw):
+            rebuilt = []
+            for t in template:
+                if t[0] == "nd":
+                    rebuilt.append(raw[t[1]])
+                elif t[0] == "seq":
+                    rebuilt.append(list(raw[t[1]:t[1] + t[2]]))
+                else:
+                    rebuilt.append(t[1])
+            return fn(*rebuilt, **kwargs)
+
+        return _apply(fn, name, nd_args, call)
+
+    wrapper.__name__ = name
+    wrapper.__qualname__ = name
+    wrapper.__doc__ = f"numpy-semantics {name} (delegates to jnp.{name})"
+    return wrapper
+
+
+for _n in (_UNARY + _BINARY + _SHAPE + _OTHER + _REDUCE + _CONCAT):
+    if not hasattr(_this, _n) and hasattr(jnp, _n):
+        setattr(_this, _n, _delegate(_n))
+
+abs = _delegate("abs")          # shadow builtins deliberately, like numpy
+round = _delegate("round")
+sum = _delegate("sum")
+max = _delegate("max")
+min = _delegate("min")
+all = _delegate("all")
+any = _delegate("any")
+
+
+def transpose(a, axes=None):
+    return _wrap(jnp.transpose(_unwrap(a), axes))
+
+
+def asnumpy(a):
+    return _onp.asarray(_unwrap(a))
+
+
+def shape(a):
+    return tuple(_unwrap(a).shape)
+
+
+def ndim(a):
+    return _unwrap(a).ndim
+
+
+def size(a):
+    return int(_unwrap(a).size)
+
+
+def result_type(*args):
+    return jnp.result_type(*[_unwrap(a) for a in args])
+
+
+def asarray(a, dtype=None):
+    if isinstance(a, NDArray) and dtype is None:
+        return a if isinstance(a, ndarray) else _wrap(a._data)
+    return array(a, dtype=dtype)
+
+
+__all__ = (["ndarray", "array", "asarray", "zeros", "ones", "full", "empty",
+            "zeros_like", "ones_like", "full_like", "arange", "linspace",
+            "eye", "identity", "meshgrid", "transpose", "asnumpy", "shape",
+            "ndim", "size", "result_type", "random", "linalg",
+            "pi", "e", "inf", "nan", "newaxis"]
+           + _UNARY + _BINARY + _SHAPE + _OTHER + _REDUCE + _CONCAT)

@@ -1,0 +1,38 @@
+"""mx.np.linalg (ref: python/mxnet/numpy/linalg.py) — delegates to
+jnp.linalg (XLA-native factorizations)."""
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+
+_FNS = ["norm", "svd", "cholesky", "inv", "pinv", "det", "slogdet",
+        "eigh", "eigvalsh", "qr", "solve", "lstsq", "matrix_rank",
+        "matrix_power", "tensorsolve", "tensorinv", "multi_dot"]
+
+_this = sys.modules[__name__]
+
+
+def _delegate(name):
+    fn = getattr(jnp.linalg, name)
+
+    def wrapper(*args, **kwargs):
+        from . import ndarray, _wrap, _unwrap
+        args = [[_unwrap(x) for x in a] if isinstance(a, (list, tuple))
+                and name == "multi_dot" else _unwrap(a) for a in args]
+        out = fn(*args, **kwargs)
+        if isinstance(out, (tuple, list)) or hasattr(out, "_fields"):
+            return tuple(_wrap(o) if isinstance(o, jax.Array) else o
+                         for o in out)
+        return _wrap(out) if isinstance(out, jax.Array) else out
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+for _n in _FNS:
+    if hasattr(jnp.linalg, _n):
+        setattr(_this, _n, _delegate(_n))
+
+__all__ = [n for n in _FNS if hasattr(_this, n)]

@@ -1,0 +1,107 @@
+"""mx.np.random (ref: python/mxnet/numpy/random.py) — numpy-style sampling
+over the package's stateful PRNG (random.py threads jax PRNG keys)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import dtype_np
+from .. import random as _random
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint",
+           "choice", "shuffle", "permutation", "beta", "gamma",
+           "exponential", "multinomial"]
+
+
+def seed(s):
+    _random.seed(s)
+
+
+def _wrap(d):
+    from . import ndarray
+    from ..context import current_context
+    return ndarray(d, ctx=current_context())
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype="float32", ctx=None):
+    k = _random.next_key()
+    return _wrap(jax.random.uniform(k, _shape(size), dtype_np(dtype),
+                                    minval=low, maxval=high))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype="float32", ctx=None):
+    k = _random.next_key()
+    return _wrap(jax.random.normal(k, _shape(size),
+                                   dtype_np(dtype)) * scale + loc)
+
+
+def randn(*shape):
+    return normal(size=shape or None)
+
+
+def rand(*shape):
+    return uniform(size=shape or None)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None):
+    if high is None:
+        low, high = 0, low
+    k = _random.next_key()
+    return _wrap(jax.random.randint(k, _shape(size), low, high,
+                                    dtype_np(dtype)))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None):
+    k = _random.next_key()
+    from . import ndarray as _nd_t
+    arr = a._data if isinstance(a, _nd_t) else jnp.asarray(a)
+    if arr.ndim == 0:
+        arr = jnp.arange(int(arr))
+    pp = p._data if isinstance(p, _nd_t) else p
+    return _wrap(jax.random.choice(k, arr, _shape(size), replace=replace,
+                                   p=None if pp is None else jnp.asarray(pp)))
+
+
+def permutation(x):
+    k = _random.next_key()
+    from . import ndarray as _nd_t
+    arr = x._data if isinstance(x, _nd_t) else x
+    if isinstance(arr, int):
+        arr = jnp.arange(arr)
+    return _wrap(jax.random.permutation(k, arr))
+
+
+def shuffle(x):
+    """In-place shuffle along axis 0 (numpy semantics)."""
+    x._data = jax.random.permutation(_random.next_key(), x._data)
+
+
+def beta(a, b, size=None, dtype="float32", ctx=None):
+    k = _random.next_key()
+    return _wrap(jax.random.beta(k, a, b, _shape(size), dtype_np(dtype)))
+
+
+def gamma(shape, scale=1.0, size=None, dtype="float32", ctx=None):
+    k = _random.next_key()
+    return _wrap(jax.random.gamma(k, shape, _shape(size),
+                                  dtype_np(dtype)) * scale)
+
+
+def exponential(scale=1.0, size=None, dtype="float32", ctx=None):
+    k = _random.next_key()
+    return _wrap(jax.random.exponential(k, _shape(size),
+                                        dtype_np(dtype)) * scale)
+
+
+def multinomial(n, pvals, size=None):
+    k = _random.next_key()
+    from . import ndarray as _nd_t
+    pv = pvals._data if isinstance(pvals, _nd_t) else jnp.asarray(pvals)
+    counts = jax.random.multinomial(k, n, pv, shape=_shape(size) or None)
+    return _wrap(counts.astype(jnp.int64))

@@ -1,0 +1,114 @@
+"""``mx.npx`` — numpy-extension namespace.
+
+ref: python/mxnet/numpy_extension/ + python/mxnet/util.py set_np/use_np —
+the neural-network ops that plain numpy doesn't have (softmax, batch_norm,
+convolution, …) exposed with numpy-array in/out, plus the set_np() switch
+Gluon consults to decide which array type its blocks produce."""
+from __future__ import annotations
+
+import sys
+
+from .ndarray.ndarray import NDArray, invoke
+from .numpy import ndarray as np_ndarray
+from .context import current_context
+
+_np_active = False
+
+
+def set_np(shape=True, array=True):
+    """ref: mx.npx.set_np — flip the frontend's default array type.
+
+    With ``array=True``, ``Parameter.data()`` hands out ``mx.np.ndarray``
+    views, so every gluon block's outputs become mx.np arrays (the np type
+    propagates through op dispatch) — the reference's mechanism.  ``shape``
+    is accepted for API parity (zero-size/unknown-shape semantics are
+    always numpy-style here)."""
+    global _np_active
+    _np_active = bool(array)
+
+
+def reset_np():
+    global _np_active
+    _np_active = False
+
+
+def is_np_array():
+    return _np_active
+
+
+def is_np_shape():
+    return _np_active
+
+
+# neural ops with numpy in/out: generated over the same registry that backs
+# mx.nd (ndarray/__init__.py codegen), so there is exactly one kernel per op
+_NPX_OPS = {
+    "activation": "Activation", "batch_norm": "BatchNorm",
+    "convolution": "Convolution", "deconvolution": "Deconvolution",
+    "dropout": "Dropout", "embedding": "Embedding",
+    "fully_connected": "FullyConnected", "layer_norm": "LayerNorm",
+    "rms_norm": "RMSNorm", "group_norm": "GroupNorm",
+    "instance_norm": "InstanceNorm", "leaky_relu": "LeakyReLU",
+    "log_softmax": "log_softmax", "softmax": "softmax",
+    "softmin": "softmin", "one_hot": "one_hot", "pick": "pick",
+    "pooling": "Pooling", "rnn": "RNN", "roi_pooling": "ROIPooling",
+    "sequence_mask": "SequenceMask", "reshape_like": "reshape_like",
+    "smooth_l1": "smooth_l1", "topk": "topk", "gather_nd": "gather_nd",
+    "scatter_nd": "scatter_nd", "sigmoid": None, "relu": None,
+    "gelu": None, "erf": "erf", "erfinv": "erfinv",
+    "multibox_prior": "MultiBoxPrior", "multibox_target": "MultiBoxTarget",
+    "multibox_detection": "MultiBoxDetection", "box_nms": "_contrib_box_nms",
+    "box_iou": "_contrib_box_iou", "ctc_loss": "CTCLoss",
+    "sequence_last": "SequenceLast", "sequence_reverse": "SequenceReverse",
+}
+
+_this = sys.modules[__name__]
+
+
+def _np_wrap(result):
+    if isinstance(result, tuple):
+        return tuple(_np_wrap(r) for r in result)
+    if isinstance(result, NDArray):
+        return np_ndarray(result._data, ctx=result._ctx)
+    return result
+
+
+def _make(name, op_name):
+    if op_name is None:
+        # simple activations routed via Activation(act_type=name)
+        def fn(data, **kwargs):
+            return _np_wrap(invoke("Activation", data, act_type=name))
+    else:
+        def fn(*args, **kwargs):
+            return _np_wrap(invoke(op_name, *args, **kwargs))
+    fn.__name__ = name
+    fn.__doc__ = f"npx.{name} → {op_name or 'Activation:' + name} " \
+                 f"(numpy-array in/out)"
+    return fn
+
+
+for _n, _op in _NPX_OPS.items():
+    setattr(_this, _n, _make(_n, _op))
+
+
+def save(fname, arrays):
+    """ref: npx.save — same container as nd.save."""
+    from . import ndarray as nd
+    nd.save(fname, arrays)
+
+
+def load(fname):
+    from . import ndarray as nd
+    out = nd.load(fname)
+    if isinstance(out, dict):
+        return {k: _np_wrap(v) for k, v in out.items()}
+    return [_np_wrap(v) for v in out]
+
+
+def waitall():
+    from . import engine
+    engine.waitall()
+
+
+__all__ = (["set_np", "reset_np", "is_np_array", "is_np_shape",
+            "save", "load", "waitall"] + list(_NPX_OPS))

@@ -43,6 +43,13 @@ def create(name, **kwargs):
     return _REGISTRY[n](**kwargs)
 
 
+def _is_rsp(grad):
+    """True for a row_sparse gradient (lazy-update dispatch; ref: the
+    storage-type dispatch in src/operator/optimizer_op.cc)."""
+    from ..sparse import RowSparseNDArray
+    return isinstance(grad, RowSparseNDArray)
+
+
 def _writeback(outs, *targets):
     """Optimizer ops are functional (weight', state'...); write results into
     the live NDArrays (the reference mutates in place via the engine)."""
@@ -172,6 +179,19 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if _is_rsp(grad):
+            from .. import sparse as _sp
+            if self.momentum == 0.0:
+                new_w = _sp.sgd_update(weight, grad, lr, wd,
+                                       self.rescale_grad,
+                                       self.clip_gradient)
+            else:
+                new_w = _sp.sgd_mom_update(weight, grad, state, lr,
+                                           self.momentum, wd,
+                                           self.rescale_grad,
+                                           self.clip_gradient)
+            weight._data = new_w._data
+            return
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad)
         if self.clip_gradient is not None:
             kw["clip_gradient"] = self.clip_gradient
@@ -216,6 +236,14 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr_t = lr * math.sqrt(coef2) / coef1
         mean, var = state
+        if _is_rsp(grad):
+            from .. import sparse as _sp
+            new_w = _sp.adam_update(weight, grad, mean, var, t, lr,
+                                    self.beta1, self.beta2, self.epsilon,
+                                    wd, self.rescale_grad,
+                                    self.clip_gradient)
+            weight._data = new_w._data
+            return
         kw = dict(lr=lr_t, beta1=self.beta1, beta2=self.beta2,
                   epsilon=self.epsilon, wd=wd, rescale_grad=self.rescale_grad)
         if self.clip_gradient is not None:
@@ -429,6 +457,16 @@ class AdaGrad(Optimizer):
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
+        if _is_rsp(grad):
+            from .. import sparse as _sp
+            new_w = _sp.adagrad_update(weight, grad, state,
+                                       self._get_lr(index),
+                                       self.float_stable_eps,
+                                       self._get_wd(index),
+                                       self.rescale_grad,
+                                       self.clip_gradient)
+            weight._data = new_w._data
+            return
         lr, wd = self._get_lr(index), self._get_wd(index)
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   epsilon=self.float_stable_eps)

@@ -1,0 +1,384 @@
+"""Sparse storage types: row_sparse and csr.
+
+ref: include/mxnet/ndarray.h — NDArray storage types (kDefaultStorage /
+kRowSparseStorage / kCSRStorage); python/mxnet/ndarray/sparse.py —
+CSRNDArray / RowSparseNDArray / cast_storage / dot / retain;
+src/operator/tensor/cast_storage-inl.h, dot-inl.h, sparse_retain-inl.h;
+src/operator/optimizer_op.cc — SGDUpdateRowSparse etc. (lazy updates).
+
+TPU-native mapping: the payloads are dense jax arrays (indices + values) —
+row_sparse as (indices[k], values[k, *row]) and csr as (indptr, indices,
+data) — so every sparse *operation* is a gather/segment-sum/scatter that
+XLA lowers onto the TPU natively; jax.experimental.sparse's BCOO powers
+csr×dense dot.  Construction from dense (``cast_storage``) is data-dependent
+(nnz) and therefore eager-only — inside jit, keep data dense and let XLA
+exploit zeros; that's the TPU-idiomatic stance, matching SURVEY §7.0's
+"delegate to the compiler" rule.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .ndarray.ndarray import NDArray
+from .context import current_context
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "BaseSparseNDArray",
+           "cast_storage", "row_sparse_array", "csr_matrix", "zeros",
+           "retain", "dot", "add", "elemwise_add",
+           "sgd_update", "sgd_mom_update", "adam_update", "adagrad_update"]
+
+
+def _check_concrete(*arrays):
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            raise TypeError(
+                "sparse storage construction is data-dependent (nnz) and "
+                "eager-only; inside jit keep dense storage and let XLA "
+                "exploit sparsity")
+
+
+class BaseSparseNDArray:
+    """Common surface of the two sparse storage classes."""
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def dtype(self):
+        return np.dtype(str(self._data.dtype)) if self._data.dtype != jnp.bfloat16 \
+            else self._data.dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype):
+        out = self.copy()
+        out._data = self._data.astype(dtype)
+        return out
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} {self.shape} "
+                f"nnz={self._data.shape[0]}>")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """ref: sparse.py — class RowSparseNDArray.
+
+    ``indices``: sorted unique row ids (int32/int64, shape (k,));
+    ``data``: the k present rows, shape (k,) + shape[1:]."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, ctx=None):
+        self._data = jnp.asarray(data)
+        self._indices = jnp.asarray(indices, jnp.int32)
+        self.shape = tuple(shape)
+        self._ctx = ctx if ctx is not None else current_context()
+
+    @property
+    def data(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, ctx=self._ctx)
+
+    def copy(self):
+        return RowSparseNDArray(self._data, self._indices, self.shape,
+                                self._ctx)
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self.shape, self._data.dtype)
+            dense = dense.at[self._indices].set(self._data)
+            return NDArray(dense, ctx=self._ctx)
+        raise ValueError(f"cannot cast row_sparse to {stype!r}")
+
+    todense = lambda self: self.tostype("default")
+
+    def asnumpy(self):
+        return np.asarray(self.tostype("default")._data)
+
+    def __add__(self, other):
+        return add(self, other)
+
+    __radd__ = __add__
+
+    def __mul__(self, scalar):
+        if isinstance(scalar, (int, float)):
+            return RowSparseNDArray(self._data * scalar, self._indices,
+                                    self.shape, self._ctx)
+        return NotImplemented
+
+    __rmul__ = __mul__
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """ref: sparse.py — class CSRNDArray (2-D compressed sparse row)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._data = jnp.asarray(data)
+        self._indices = jnp.asarray(indices, jnp.int32)
+        self._indptr = jnp.asarray(indptr, jnp.int32)
+        self.shape = tuple(shape)
+        assert len(self.shape) == 2, "csr storage is 2-D"
+        self._ctx = ctx if ctx is not None else current_context()
+
+    @property
+    def data(self):
+        return NDArray(self._data, ctx=self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, ctx=self._ctx)
+
+    @property
+    def indptr(self):
+        return NDArray(self._indptr, ctx=self._ctx)
+
+    def copy(self):
+        return CSRNDArray(self._data, self._indices, self._indptr,
+                          self.shape, self._ctx)
+
+    def _row_ids(self):
+        """Expand indptr to one row id per nnz (the BCOO view)."""
+        counts = self._indptr[1:] - self._indptr[:-1]
+        return jnp.repeat(jnp.arange(self.shape[0], dtype=jnp.int32), counts,
+                          total_repeat_length=self._data.shape[0])
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            dense = jnp.zeros(self.shape, self._data.dtype)
+            dense = dense.at[self._row_ids(), self._indices].set(self._data)
+            return NDArray(dense, ctx=self._ctx)
+        if stype == "row_sparse":
+            return cast_storage(self.tostype("default"), "row_sparse")
+        raise ValueError(f"cannot cast csr to {stype!r}")
+
+    todense = lambda self: self.tostype("default")
+
+    def asnumpy(self):
+        return np.asarray(self.tostype("default")._data)
+
+
+# ------------------------------------------------------------ construction --
+def cast_storage(arr, stype):
+    """ref: src/operator/tensor/cast_storage-inl.h — CastStorageComputeEx."""
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if not isinstance(arr, NDArray):
+        arr = NDArray(jnp.asarray(arr))
+    if stype == "default":
+        return arr
+    _check_concrete(arr._data)
+    if stype == "row_sparse":
+        # row selection on device: only the per-row occupancy mask crosses
+        # to host (nnz is data-dependent), then the kept rows are a device
+        # gather — no full dense round trip for big embedding grads
+        dd = arr._data
+        axes = tuple(range(1, dd.ndim))
+        mask = (jnp.abs(dd).sum(axis=axes) != 0) if dd.ndim > 1 else dd != 0
+        idx = np.nonzero(np.asarray(mask))[0].astype(np.int32)
+        return RowSparseNDArray(dd[jnp.asarray(idx)], idx, tuple(dd.shape),
+                                arr.context)
+    d = np.asarray(arr._data)
+    if stype == "csr":
+        assert d.ndim == 2, "csr storage is 2-D"
+        mask = d != 0
+        counts = mask.sum(axis=1)
+        indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        rows, cols = np.nonzero(mask)
+        return CSRNDArray(d[rows, cols], cols.astype(np.int32), indptr,
+                          d.shape, arr.context)
+    raise ValueError(f"unknown storage type {stype!r}")
+
+
+def row_sparse_array(arg, shape=None, ctx=None, dtype=None):
+    """ref: sparse.row_sparse_array — from (data, indices) or dense."""
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        data = jnp.asarray(data._data if isinstance(data, NDArray) else data,
+                           dtype=dtype)
+        return RowSparseNDArray(data, jnp.asarray(
+            indices._data if isinstance(indices, NDArray) else indices),
+            shape if shape else (int(jnp.max(jnp.asarray(indices)) + 1),)
+            + tuple(data.shape[1:]), ctx)
+    return cast_storage(NDArray(jnp.asarray(
+        arg._data if isinstance(arg, NDArray) else arg, dtype=dtype)),
+        "row_sparse")
+
+
+def csr_matrix(arg, shape=None, ctx=None, dtype=None):
+    """ref: sparse.csr_matrix — from (data, indices, indptr) or dense."""
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        unwrap = lambda a: a._data if isinstance(a, NDArray) else a
+        data = jnp.asarray(unwrap(data), dtype=dtype)
+        return CSRNDArray(data, jnp.asarray(unwrap(indices)),
+                          jnp.asarray(unwrap(indptr)), shape, ctx)
+    return cast_storage(NDArray(jnp.asarray(
+        arg._data if isinstance(arg, NDArray) else arg, dtype=dtype)), "csr")
+
+
+def zeros(stype, shape, ctx=None, dtype="float32"):
+    """ref: sparse.zeros."""
+    from .base import dtype_np
+    dt = dtype_np(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
+                                jnp.zeros((0,), jnp.int32), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape, ctx)
+    from . import ndarray as nd
+    return nd.zeros(shape, ctx=ctx, dtype=dtype)
+
+
+# ------------------------------------------------------------------- ops ----
+def retain(rsp, indices):
+    """ref: sparse_retain — keep only the requested rows."""
+    assert isinstance(rsp, RowSparseNDArray)
+    want = jnp.asarray(indices._data if isinstance(indices, NDArray)
+                       else indices, jnp.int32)
+    keep = jnp.isin(rsp._indices, want)
+    _check_concrete(rsp._data)
+    kn = np.asarray(keep)
+    return RowSparseNDArray(rsp._data[kn], rsp._indices[kn], rsp.shape,
+                            rsp._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False):
+    """ref: sparse dot — csr×dense (fwd) and csrᵀ×dense (the grad path)."""
+    if isinstance(lhs, CSRNDArray):
+        dense = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        vec = dense.ndim == 1
+        if vec:
+            dense = dense[:, None]  # matrix-vector: promote, squeeze below
+        rows = lhs._row_ids()
+        if not transpose_a:
+            # out[i, :] = Σ_j csr[i, j] · dense[j, :]
+            gathered = dense[lhs._indices] * lhs._data[:, None]
+            out = jax.ops.segment_sum(gathered, rows,
+                                      num_segments=lhs.shape[0])
+        else:
+            # out[j, :] = Σ_i csr[i, j] · dense[i, :]
+            gathered = dense[rows] * lhs._data[:, None]
+            out = jax.ops.segment_sum(gathered, lhs._indices,
+                                      num_segments=lhs.shape[1])
+        out = out.astype(dense.dtype)
+        return NDArray(out[:, 0] if vec else out, ctx=lhs._ctx)
+    if isinstance(lhs, RowSparseNDArray) and transpose_a:
+        # rspᵀ × dense: Σ over present rows — the embedding-grad contraction
+        if lhs._data.ndim != 2:
+            raise NotImplementedError("rsp dot supports 2-D values")
+        dense = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
+        out = lhs._data.T @ dense[lhs._indices]
+        return NDArray(out, ctx=lhs._ctx)
+    raise TypeError(f"unsupported sparse dot operands "
+                    f"{type(lhs).__name__}, {type(rhs).__name__}")
+
+
+def _merge_rows(a_idx, a_val, b_idx, b_val):
+    """Union-merge two (sorted idx, values) row sets, summing overlaps."""
+    _check_concrete(a_val, b_val)
+    ai, av = np.asarray(a_idx), np.asarray(a_val)
+    bi, bv = np.asarray(b_idx), np.asarray(b_val)
+    union = np.union1d(ai, bi).astype(np.int32)
+    out = np.zeros((len(union),) + av.shape[1:], np.asarray(av).dtype)
+    out[np.searchsorted(union, ai)] += av
+    out[np.searchsorted(union, bi)] += bv
+    return union, out
+
+
+def add(a, b):
+    """rsp+rsp → rsp; rsp+dense → dense (ref: elemwise_add dispatch)."""
+    if isinstance(a, RowSparseNDArray) and isinstance(b, RowSparseNDArray):
+        assert a.shape == b.shape
+        idx, val = _merge_rows(a._indices, a._data, b._indices, b._data)
+        return RowSparseNDArray(val, idx, a.shape, a._ctx)
+    if isinstance(a, RowSparseNDArray) and isinstance(b, NDArray):
+        return NDArray(b._data.at[a._indices].add(
+            a._data.astype(b._data.dtype)), ctx=b._ctx)
+    if isinstance(b, RowSparseNDArray) and isinstance(a, NDArray):
+        return add(b, a)
+    raise TypeError("unsupported sparse add operands")
+
+
+elemwise_add = add
+
+
+# ------------------------------------------------- lazy optimizer updates ---
+def _rows(weight, grad, rescale_grad=1.0, clip_gradient=None):
+    """Gradient rows in the weight's dtype, rescaled and (optionally)
+    clipped — the shared preamble of every dense update op."""
+    g = grad._data.astype(weight._data.dtype) * rescale_grad
+    if clip_gradient is not None:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return grad._indices, g
+
+
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=None):
+    """ref: SGDUpdateRowSparse — lazy: only rows present in the gradient
+    are touched (wd applies to those rows only, like the reference)."""
+    assert isinstance(grad, RowSparseNDArray)
+    idx, g = _rows(weight, grad, rescale_grad, clip_gradient)
+    rows = weight._data[idx]
+    rows = rows - lr * (g + wd * rows)
+    return NDArray(weight._data.at[idx].set(rows), ctx=weight._ctx)
+
+
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.9, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=None):
+    """ref: SGDMomUpdateRowSparse — momentum rows decay lazily too."""
+    assert isinstance(grad, RowSparseNDArray)
+    idx, g = _rows(weight, grad, rescale_grad, clip_gradient)
+    w_rows = weight._data[idx]
+    m_rows = mom._data[idx]
+    m_rows = momentum * m_rows - lr * (g + wd * w_rows)
+    new_mom = mom._data.at[idx].set(m_rows)
+    new_w = weight._data.at[idx].add(m_rows)
+    mom._data = new_mom
+    return NDArray(new_w, ctx=weight._ctx)
+
+
+def adam_update(weight, grad, mean, var, t, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=None,
+                lazy_update=True):
+    """ref: AdamUpdateRowSparse (lazy_update=True path)."""
+    assert isinstance(grad, RowSparseNDArray)
+    idx, g = _rows(weight, grad, rescale_grad, clip_gradient)
+    g = g + wd * weight._data[idx]
+    m_rows = beta1 * mean._data[idx] + (1 - beta1) * g
+    v_rows = beta2 * var._data[idx] + (1 - beta2) * jnp.square(g)
+    coef1 = 1.0 - beta1 ** t
+    coef2 = 1.0 - beta2 ** t
+    lr_t = lr * np.sqrt(coef2) / coef1
+    upd = lr_t * m_rows / (jnp.sqrt(v_rows) + epsilon)
+    mean._data = mean._data.at[idx].set(m_rows)
+    var._data = var._data.at[idx].set(v_rows)
+    return NDArray(weight._data.at[idx].add(-upd), ctx=weight._ctx)
+
+
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=None):
+    """ref: AdagradUpdateRowSparse."""
+    assert isinstance(grad, RowSparseNDArray)
+    idx, g = _rows(weight, grad, rescale_grad, clip_gradient)
+    g = g + wd * weight._data[idx]
+    h_rows = history._data[idx] + jnp.square(g)
+    history._data = history._data.at[idx].set(h_rows)
+    upd = lr * g / (jnp.sqrt(h_rows) + epsilon)
+    return NDArray(weight._data.at[idx].add(-upd), ctx=weight._ctx)

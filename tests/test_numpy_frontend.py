@@ -1,0 +1,213 @@
+"""mx.np / mx.npx numpy-parity sweep (ref: tests/python/unittest/
+test_numpy_op.py — per-function comparison against real numpy)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+from mxnet_tpu import npx
+
+
+RNG = onp.random.RandomState(0)
+A = RNG.randn(4, 5).astype(onp.float32)
+B = RNG.randn(4, 5).astype(onp.float32)
+V = RNG.rand(6).astype(onp.float32) + 0.5
+M = RNG.randn(5, 3).astype(onp.float32)
+
+
+def _close(got, want, tol=1e-5):
+    got = onp.asarray(got._data) if hasattr(got, "_data") else onp.asarray(got)
+    onp.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+UNARY_CASES = ["exp", "log1p", "sqrt", "square", "abs", "sign", "sin",
+               "cos", "tanh", "arctan", "floor", "ceil", "rint",
+               "logical_not", "isnan", "isfinite", "negative", "reciprocal"]
+
+
+@pytest.mark.parametrize("name", UNARY_CASES)
+def test_unary_parity(name):
+    x = onp.abs(A) + 0.1 if name in ("log1p", "sqrt", "reciprocal") else A
+    _close(getattr(mnp, name)(mnp.array(x)), getattr(onp, name)(x))
+
+
+BINARY_CASES = ["add", "subtract", "multiply", "divide", "power", "maximum",
+                "minimum", "arctan2", "hypot", "greater", "less", "equal",
+                "logical_and", "logical_or", "floor_divide", "mod"]
+
+
+@pytest.mark.parametrize("name", BINARY_CASES)
+def test_binary_parity(name):
+    a, b = onp.abs(A) + 0.5, onp.abs(B) + 0.5
+    _close(getattr(mnp, name)(mnp.array(a), mnp.array(b)),
+           getattr(onp, name)(a, b))
+
+
+REDUCE_CASES = [("sum", {}), ("mean", {}), ("prod", {}), ("max", {}),
+                ("min", {}), ("std", {}), ("var", {}),
+                ("sum", {"axis": 1}), ("mean", {"axis": 0}),
+                ("argmax", {"axis": 1}), ("argmin", {"axis": 0}),
+                ("cumsum", {"axis": 1}), ("all", {}), ("any", {})]
+
+
+@pytest.mark.parametrize("name,kw", REDUCE_CASES)
+def test_reduce_parity(name, kw):
+    _close(getattr(mnp, name)(mnp.array(A), **kw),
+           getattr(onp, name)(A, **kw))
+
+
+def test_shape_functions():
+    x = mnp.array(A)
+    _close(mnp.reshape(x, (5, 4)), A.reshape(5, 4))
+    _close(mnp.transpose(x), A.T)
+    _close(x.T, A.T)
+    _close(mnp.expand_dims(x, 0), A[None])
+    _close(mnp.squeeze(mnp.array(A[None])), A)
+    _close(mnp.tile(x, (2, 1)), onp.tile(A, (2, 1)))
+    _close(mnp.repeat(x, 2, axis=1), onp.repeat(A, 2, axis=1))
+    _close(mnp.flip(x, 0), onp.flip(A, 0))
+    _close(mnp.broadcast_to(mnp.array(V), (3, 6)), onp.broadcast_to(V, (3, 6)))
+    _close(mnp.concatenate([x, x], axis=0), onp.concatenate([A, A], 0))
+    _close(mnp.stack([x, x], axis=1), onp.stack([A, A], 1))
+    parts = mnp.split(x, 2, axis=1) if A.shape[1] % 2 == 0 else None
+    _close(mnp.vstack([x, x]), onp.vstack([A, A]))
+    _close(mnp.swapaxes(x, 0, 1), A.swapaxes(0, 1))
+    _close(mnp.ravel(x), A.ravel())
+
+
+def test_linalg_and_products():
+    x, m = mnp.array(A), mnp.array(M)
+    _close(mnp.dot(x, m), A @ M)
+    _close(mnp.matmul(x, m), A @ M)
+    _close(mnp.tensordot(x, m, axes=([1], [0])), onp.tensordot(A, M, ([1], [0])))
+    _close(mnp.einsum("ij,jk->ik", x, m), onp.einsum("ij,jk->ik", A, M))
+    _close(mnp.linalg.norm(x), onp.linalg.norm(A))
+    s = A @ A.T + 5 * onp.eye(4, dtype=onp.float32)
+    _close(mnp.linalg.cholesky(mnp.array(s)), onp.linalg.cholesky(s), 1e-4)
+    _close(mnp.linalg.inv(mnp.array(s)), onp.linalg.inv(s), 1e-3)
+    _close(mnp.linalg.det(mnp.array(s)), onp.linalg.det(s), 1e-2)
+    _close(mnp.outer(mnp.array(V), mnp.array(V)), onp.outer(V, V))
+
+
+def test_other_functions():
+    x = mnp.array(A)
+    _close(mnp.where(x > 0, x, mnp.zeros_like(x)), onp.where(A > 0, A, 0))
+    _close(mnp.clip(x, -0.5, 0.5), onp.clip(A, -0.5, 0.5))
+    _close(mnp.sort(x, axis=1), onp.sort(A, 1))
+    _close(mnp.argsort(x, axis=1), onp.argsort(A, 1))
+    _close(mnp.diff(x, axis=1), onp.diff(A, axis=1))
+    _close(mnp.diag(mnp.array(V)), onp.diag(V))
+    _close(mnp.tril(x), onp.tril(A))
+    _close(mnp.unique(mnp.array(onp.array([3, 1, 2, 1]))), [1, 2, 3])
+    assert bool(mnp.allclose(x, x))
+    _close(mnp.take(x, mnp.array(onp.array([0, 2])), axis=0), A[[0, 2]])
+
+
+def test_factories_and_dtype_rules():
+    assert mnp.zeros((2, 3)).shape == (2, 3)
+    assert str(mnp.zeros((2,)).dtype) == "float32"
+    assert str(mnp.arange(5).dtype).startswith("int")
+    _close(mnp.linspace(0, 1, 5), onp.linspace(0, 1, 5))
+    _close(mnp.eye(3, k=1), onp.eye(3, k=1))
+    _close(mnp.full((2, 2), 7.0), onp.full((2, 2), 7.0))
+    g1, g2 = mnp.meshgrid(mnp.arange(3), mnp.arange(2))
+    w1, w2 = onp.meshgrid(onp.arange(3), onp.arange(2))
+    _close(g1, w1)
+    _close(g2, w2)
+
+
+def test_ndarray_methods_and_interop():
+    x = mnp.array(A)
+    assert isinstance(x, mnp.ndarray)
+    assert isinstance(x, mx.nd.NDArray)       # one array machinery
+    assert x.sum().item() == pytest.approx(A.sum(), rel=1e-5)
+    assert x.mean(axis=0).shape == (5,)
+    assert mnp.array([3.0]).item() == 3.0
+    assert x.tolist() == onp.asarray(A).tolist()
+    legacy = x.as_nd_ndarray()
+    assert type(legacy) is mx.nd.NDArray
+    # arithmetic dunders inherited from NDArray
+    _close(x + x, A + A)
+    _close(x * 2, A * 2)
+    _close(x[1:3, ::2], A[1:3, ::2])
+
+
+def test_np_random():
+    mnp.random.seed(0)
+    u = mnp.random.uniform(0, 1, size=(1000,))
+    assert 0.4 < float(u.mean().item()) < 0.6
+    n = mnp.random.normal(3.0, 0.1, size=(1000,))
+    assert 2.9 < float(n.mean().item()) < 3.1
+    r = mnp.random.randint(0, 10, size=(100,))
+    assert int(r.min().item()) >= 0 and int(r.max().item()) < 10
+    p = mnp.random.permutation(10)
+    assert sorted(p.tolist()) == list(range(10))
+    c = mnp.random.choice(5, size=(20,))
+    assert int(c.max().item()) < 5
+
+
+def test_npx_neural_ops_and_set_np():
+    x = mnp.array(A)
+    s = npx.softmax(x, axis=-1)
+    assert isinstance(s, mnp.ndarray)
+    _close(s.sum(axis=-1), onp.ones(4))
+    r = npx.relu(x)
+    _close(r, onp.maximum(A, 0))
+    _close(npx.sigmoid(x), 1 / (1 + onp.exp(-A)), 1e-4)
+    oh = npx.one_hot(mnp.array(onp.array([0, 2])), depth=3)
+    _close(oh, onp.eye(3)[[0, 2]])
+    assert not npx.is_np_array()
+    npx.set_np()
+    assert npx.is_np_array()
+    npx.reset_np()
+    assert not npx.is_np_array()
+
+
+def test_npx_save_load(tmp_path):
+    f = str(tmp_path / "arrs.npz")
+    npx.save(f, {"a": mnp.array(A)})
+    back = npx.load(f)
+    assert isinstance(back["a"], mnp.ndarray)
+    _close(back["a"], A)
+
+
+def test_autograd_through_np_frontend():
+    """mx.np arrays ride the same tape (the point of subclassing)."""
+    from mxnet_tpu import autograd
+    x = mnp.array(A)
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    _close(x.grad, 2 * A)
+
+
+def test_np_type_preserved_through_ops():
+    x = mnp.array([2.0])
+    y = (x * 3 + 1).exp() if hasattr(x, "exp") else mnp.exp(x * 3 + 1)
+    assert isinstance(x * 3, mnp.ndarray)
+    assert (x * 3).item() == pytest.approx(6.0)
+    z = mnp.array(A)
+    assert isinstance(mx.nd.softmax(z, axis=-1), mnp.ndarray)
+    # mixing with legacy: legacy-only stays legacy
+    legacy = mx.nd.array([1.0])
+    assert type(legacy * 2) is mx.nd.NDArray
+
+
+def test_set_np_flips_frontend_output_type():
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(3, in_units=2)
+    net.initialize()
+    try:
+        npx.set_np()
+        # parameters hand out mx.np arrays -> block outputs are mx.np,
+        # whatever the input type (the reference's set_np mechanism)
+        y = net(mx.nd.ones((1, 2)))
+        assert isinstance(y, mnp.ndarray)
+        y2 = net(mnp.ones((1, 2)))
+        assert isinstance(y2, mnp.ndarray)
+        # explicit legacy arrays keep their type for pure-legacy expressions
+        assert type(mx.nd.ones((2,)) * 2) is mx.nd.NDArray
+    finally:
+        npx.reset_np()
+    assert type(net(mx.nd.ones((1, 2)))) is mx.nd.NDArray

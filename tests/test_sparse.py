@@ -1,0 +1,246 @@
+"""Sparse storage (row_sparse / csr) — numeric parity with dense
+(ref: tests/python/unittest/test_sparse_ndarray.py, test_sparse_operator.py:
+cast_storage roundtrips, sparse dot vs dense dot, sparse_retain, lazy
+optimizer updates vs dense updates on the touched rows)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sparse
+from mxnet_tpu.ndarray import NDArray
+
+
+def _rand_sparse(shape, density, rng):
+    d = rng.randn(*shape).astype(np.float32)
+    d[rng.rand(*shape) > density] = 0.0
+    return d
+
+
+def test_cast_storage_roundtrip_rsp():
+    rng = np.random.RandomState(0)
+    d = _rand_sparse((10, 4), 0.3, rng)
+    d[3] = 0  # fully-zero row must vanish from storage
+    rsp = sparse.cast_storage(mx.nd.array(d), "row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert 3 not in np.asarray(rsp._indices)
+    np.testing.assert_allclose(rsp.asnumpy(), d)
+    np.testing.assert_allclose(rsp.tostype("default").asnumpy(), d)
+    # via NDArray.tostype
+    rsp2 = mx.nd.array(d).tostype("row_sparse")
+    np.testing.assert_allclose(rsp2.asnumpy(), d)
+
+
+def test_cast_storage_roundtrip_csr():
+    rng = np.random.RandomState(1)
+    d = _rand_sparse((7, 9), 0.25, rng)
+    csr = sparse.cast_storage(mx.nd.array(d), "csr")
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), d)
+    assert csr._indptr.shape == (8,)
+    assert int(csr._indptr[-1]) == int((d != 0).sum())
+
+
+def test_construction_helpers():
+    rsp = sparse.row_sparse_array(
+        (np.ones((2, 3), np.float32), np.array([1, 4])), shape=(6, 3))
+    dense = rsp.asnumpy()
+    assert dense.shape == (6, 3)
+    assert dense[1].sum() == 3 and dense[4].sum() == 3 and dense.sum() == 6
+
+    csr = sparse.csr_matrix((np.array([1.0, 2.0], np.float32),
+                             np.array([0, 2]), np.array([0, 1, 2])),
+                            shape=(2, 3))
+    np.testing.assert_allclose(csr.asnumpy(),
+                               [[1, 0, 0], [0, 0, 2]])
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (5, 2))
+    assert z.asnumpy().sum() == 0 and z._data.shape[0] == 0
+    z = sparse.zeros("csr", (4, 4))
+    assert z.asnumpy().sum() == 0
+
+
+def test_csr_dot_matches_dense():
+    rng = np.random.RandomState(2)
+    d = _rand_sparse((6, 8), 0.3, rng)
+    rhs = rng.randn(8, 5).astype(np.float32)
+    csr = sparse.cast_storage(mx.nd.array(d), "csr")
+    out = sparse.dot(csr, mx.nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), d @ rhs, rtol=1e-5, atol=1e-5)
+    # transpose_a (the backward contraction)
+    rhs2 = rng.randn(6, 5).astype(np.float32)
+    out_t = sparse.dot(csr, mx.nd.array(rhs2), transpose_a=True)
+    np.testing.assert_allclose(out_t.asnumpy(), d.T @ rhs2,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_rsp_dot_transpose():
+    rng = np.random.RandomState(3)
+    d = _rand_sparse((6, 4), 0.5, rng)
+    rsp = sparse.cast_storage(mx.nd.array(d), "row_sparse")
+    rhs = rng.randn(6, 3).astype(np.float32)
+    out = sparse.dot(rsp, mx.nd.array(rhs), transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), d.T @ rhs, rtol=1e-5, atol=1e-5)
+
+
+def test_retain():
+    rng = np.random.RandomState(4)
+    d = _rand_sparse((8, 3), 0.9, rng)
+    rsp = sparse.cast_storage(mx.nd.array(d), "row_sparse")
+    kept = sparse.retain(rsp, np.array([0, 3, 7]))
+    expect = np.zeros_like(d)
+    for r in (0, 3, 7):
+        expect[r] = d[r]
+    np.testing.assert_allclose(kept.asnumpy(), expect)
+
+
+def test_sparse_add():
+    rng = np.random.RandomState(5)
+    a = _rand_sparse((6, 2), 0.4, rng)
+    b = _rand_sparse((6, 2), 0.4, rng)
+    ra = sparse.cast_storage(mx.nd.array(a), "row_sparse")
+    rb = sparse.cast_storage(mx.nd.array(b), "row_sparse")
+    s = ra + rb
+    assert s.stype == "row_sparse"
+    np.testing.assert_allclose(s.asnumpy(), a + b, rtol=1e-6)
+    dense = ra + mx.nd.array(b)
+    assert isinstance(dense, NDArray)
+    np.testing.assert_allclose(dense.asnumpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((ra * 2.0).asnumpy(), a * 2, rtol=1e-6)
+
+
+@pytest.mark.parametrize("opt_name,kw", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("adagrad", {"learning_rate": 0.1}),
+])
+def test_lazy_update_matches_dense_on_touched_rows(opt_name, kw):
+    """Lazy rsp update == dense update for rows in the gradient; untouched
+    rows must stay exactly put (the lazy-update contract)."""
+    rng = np.random.RandomState(6)
+    w0 = rng.randn(10, 4).astype(np.float32)
+    g = np.zeros_like(w0)
+    rows = [1, 5, 6]
+    for r in rows:
+        g[r] = rng.randn(4)
+
+    o1 = mx.optimizer.create(opt_name, wd=0.01, **kw)
+    o2 = mx.optimizer.create(opt_name, wd=0.01, **kw)
+    wd_ = mx.nd.array(w0.copy())
+    ws = mx.nd.array(w0.copy())
+    sd = o1.create_state(0, wd_)
+    ss = o2.create_state(0, ws)
+    for _ in range(3):
+        o1.update(0, wd_, mx.nd.array(g), sd)
+        o2.update(0, ws, sparse.cast_storage(mx.nd.array(g), "row_sparse"),
+                  ss)
+    got, want = ws.asnumpy(), wd_.asnumpy()
+    np.testing.assert_allclose(got[rows], want[rows], rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(got[[0, 2, 3, 4, 7, 8, 9]],
+                               w0[[0, 2, 3, 4, 7, 8, 9]])
+
+
+def test_embedding_sparse_grad_end_to_end():
+    """Embedding(sparse_grad=True): grad() is row_sparse over exactly the
+    looked-up rows, Trainer's lazy update touches only those rows, and the
+    result matches a dense-grad run (ref: sparse embedding example)."""
+    from mxnet_tpu import gluon, autograd
+    mx.random.seed(3)
+    emb_s = gluon.nn.Embedding(20, 4, sparse_grad=True)
+    emb_s.initialize()
+    mx.random.seed(3)
+    emb_d = gluon.nn.Embedding(20, 4)
+    emb_d.initialize()
+    w0 = emb_d.weight.data().asnumpy()
+    np.testing.assert_allclose(emb_s.weight.data().asnumpy(), w0)
+
+    x = mx.nd.array(np.array([[1, 3], [3, 7]], np.int32))
+    tr_s = gluon.Trainer(emb_s.collect_params(), "sgd",
+                         {"learning_rate": 0.5})
+    tr_d = gluon.Trainer(emb_d.collect_params(), "sgd",
+                         {"learning_rate": 0.5})
+    for emb, tr in ((emb_s, tr_s), (emb_d, tr_d)):
+        with autograd.record():
+            loss = (emb(x) ** 2).sum()
+        loss.backward()
+        tr.step(1, ignore_stale_grad=True)
+
+    g = emb_s.weight.grad()
+    assert g.stype == "row_sparse"
+    assert sorted(np.asarray(g._indices).tolist()) == [1, 3, 7]
+    np.testing.assert_allclose(emb_s.weight.data().asnumpy(),
+                               emb_d.weight.data().asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+    untouched = [i for i in range(20) if i not in (1, 3, 7)]
+    np.testing.assert_allclose(emb_s.weight.data().asnumpy()[untouched],
+                               w0[untouched])
+
+
+def test_cast_storage_rejects_tracer():
+    import jax
+    def f(x):
+        return sparse.cast_storage(NDArray(x), "row_sparse")
+    with pytest.raises(TypeError, match="eager-only"):
+        jax.jit(f)(np.ones((3, 2), np.float32))
+
+
+def test_csr_dot_vector():
+    rng = np.random.RandomState(7)
+    d = _rand_sparse((5, 6), 0.4, rng)
+    v = rng.randn(6).astype(np.float32)
+    csr = sparse.cast_storage(mx.nd.array(d), "csr")
+    out = sparse.dot(csr, mx.nd.array(v))
+    assert out.shape == (5,)
+    np.testing.assert_allclose(out.asnumpy(), d @ v, rtol=1e-5, atol=1e-5)
+    v2 = rng.randn(5).astype(np.float32)
+    out_t = sparse.dot(csr, mx.nd.array(v2), transpose_a=True)
+    assert out_t.shape == (6,)
+    np.testing.assert_allclose(out_t.asnumpy(), d.T @ v2, rtol=1e-5, atol=1e-5)
+
+
+def test_sparse_clip_gradient():
+    rng = np.random.RandomState(8)
+    w0 = rng.randn(6, 3).astype(np.float32)
+    g = np.zeros_like(w0)
+    g[2] = [100.0, -100.0, 0.5]
+    o1 = mx.optimizer.create("sgd", learning_rate=1.0, clip_gradient=1.0)
+    o2 = mx.optimizer.create("sgd", learning_rate=1.0, clip_gradient=1.0)
+    wd_, ws = mx.nd.array(w0.copy()), mx.nd.array(w0.copy())
+    o1.update(0, wd_, mx.nd.array(g), o1.create_state(0, wd_))
+    o2.update(0, ws, sparse.cast_storage(mx.nd.array(g), "row_sparse"),
+              o2.create_state(0, ws))
+    np.testing.assert_allclose(ws.asnumpy(), wd_.asnumpy(),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_unsupported_optimizer_clear_error():
+    g = sparse.cast_storage(mx.nd.array(np.ones((4, 2), np.float32)),
+                            "row_sparse")
+    w = mx.nd.array(np.ones((4, 2), np.float32))
+    o = mx.optimizer.create("nag", learning_rate=0.1, momentum=0.9)
+    with pytest.raises(TypeError, match="sparse storage"):
+        o.update(0, w, g, o.create_state(0, w))
+
+
+def test_trainer_kvstore_paths_with_sparse_grad():
+    """update_on_kvstore and allreduce paths must not crash with a
+    sparse-grad parameter (dense wire format; rsp view only at update)."""
+    from mxnet_tpu import gluon, autograd
+    emb = gluon.nn.Embedding(10, 3, sparse_grad=True)
+    emb.initialize()
+    tr = gluon.Trainer(emb.collect_params(), "sgd", {"learning_rate": 0.1},
+                       kvstore="device", update_on_kvstore=True)
+    x = mx.nd.array(np.array([1, 2], np.int32))
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    tr.step(1)  # server-side update over the dense wire
+    tr2 = gluon.Trainer(emb.collect_params(), "sgd", {"learning_rate": 0.1},
+                        kvstore="device")
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    tr2.allreduce_grads()
+    tr2.update(1)
