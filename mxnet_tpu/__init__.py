@@ -23,8 +23,11 @@ if int(_os.environ.get("DMLC_NUM_WORKER", "0") or 0) > 1:
     _distributed.init()
 
 from . import base
+from . import config
 from .base import MXNetError
-from .context import Context, cpu, tpu, gpu, cpu_pinned, current_context, num_tpus, num_gpus
+from . import context
+from .context import (Context, cpu, tpu, gpu, cpu_pinned,
+                      current_context, num_tpus, num_gpus, gpu_memory_info)
 from . import engine
 from . import random
 from . import autograd
@@ -40,12 +43,19 @@ from . import kvstore
 from . import kvstore as kv
 from . import distributed
 from . import sparse
+from . import recordio
+from . import io
+from . import amp
+from . import callback
+from . import operator
 ndarray.sparse = sparse      # mx.nd.sparse, matching the reference layout
 from . import numpy as np           # mx.np — numpy-semantics frontend
 from . import numpy_extension as npx  # mx.npx — set_np + neural ops
 from . import profiler
 from . import parallel
 from . import gluon
+
+config._apply_startup()
 
 __version__ = "0.1.0"
 

@@ -1,0 +1,223 @@
+"""``mx.amp`` — automatic mixed precision.
+
+ref: python/mxnet/amp/amp.py — amp.init() (list-driven op-level cast
+rewriting), amp.init_trainer() + amp.scale_loss() (dynamic loss scaling),
+amp/lists/* (op categories).
+
+TPU-native mapping: the default target is **bfloat16** — same exponent
+range as f32, so loss scaling is unnecessary and `amp.init()` alone gives
+the MXU its native dtype.  float16 is supported for parity and uses the
+reference's dynamic loss scaler (scale up every ``scale_window`` clean
+steps, halve and skip the update on overflow).  The cast rewriting hooks
+the single op-dispatch point (``nd.invoke``) instead of rewriting a symbol
+graph: every TARGET_DTYPE op's float inputs are cast down, every FP32 op's
+inputs are cast up, and WIDEST ops unify mixed operands — the same
+semantics as the reference's symbolic pass, applied at the only place ops
+enter the runtime.
+"""
+from __future__ import annotations
+
+import contextlib
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray
+from ..ndarray import ndarray as _nd_mod
+from . import lists
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_model", "LossScaler"]
+
+_FLOATS = (jnp.float16, jnp.bfloat16, jnp.float32)
+
+
+class _AmpState:
+    def __init__(self, target_dtype):
+        self.declared = str(target_dtype)
+        # fp16 requests compute in bf16 on TPU (amp_cast maps them; same
+        # mantissa economics, f32-range exponent) — the declared dtype only
+        # decides whether the loss scaler is installed, for API parity
+        self.target = jnp.dtype(jnp.bfloat16) \
+            if target_dtype in ("float16", "bfloat16") else jnp.dtype(target_dtype)
+        self.target_ops = set(lists.TARGET_DTYPE_OPS)
+        self.fp32_ops = set(lists.FP32_OPS)
+        self.widest_ops = set(lists.WIDEST_OPS)
+
+
+_state = None
+
+
+def _is_float(a):
+    return isinstance(a, NDArray) and a._data.dtype in _FLOATS
+
+
+def _cast_args(op_name, args):
+    """Apply the list-driven dtype policy to one op call's array inputs.
+
+    Casts go through the ``amp_cast`` op (recursion-guarded) so they are
+    recorded on the autograd tape — a raw buffer cast would disconnect the
+    original parameter from the gradient graph."""
+    s = _state
+    if op_name in ("amp_cast", "amp_multicast", "Cast", "stop_gradient"):
+        return args
+    if op_name in s.target_ops:
+        want = s.target
+    elif op_name in s.fp32_ops:
+        want = jnp.dtype(jnp.float32)
+    elif op_name in s.widest_ops:
+        dts = [a._data.dtype for a in args if _is_float(a)]
+        if not dts:
+            return args
+        want = max(dts, key=lambda d: jnp.dtype(d).itemsize)
+        if len(set(dts)) == 1:
+            return args
+    else:
+        return args
+    want_s = str(want)
+    return tuple(
+        _nd_mod.invoke("amp_cast", a, dtype=want_s)
+        if _is_float(a) and a._data.dtype != want else a
+        for a in args)
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP process-wide (ref: amp.init).  Idempotent."""
+    global _state
+    target_dtype = str(jnp.dtype(target_dtype))
+    assert target_dtype in ("float16", "bfloat16")
+    fresh = _state is None
+    if fresh:
+        _state = _AmpState(target_dtype)
+    else:
+        # re-init: keep previously registered custom lists, retarget dtype
+        prev_t, prev_32 = _state.target_ops, _state.fp32_ops
+        _state.__init__(target_dtype)
+        _state.target_ops |= prev_t
+        _state.fp32_ops |= prev_32
+    if target_precision_ops:
+        _state.target_ops.update(target_precision_ops)
+    if fp32_ops:
+        _state.fp32_ops.update(fp32_ops)
+    if conditional_fp32_ops:
+        # reference semantics: run these ops in fp32 when the named attr
+        # matches; conservatively force fp32 always (safe direction)
+        _state.fp32_ops.update(
+            name if isinstance(name, str) else name[0]
+            for name in conditional_fp32_ops)
+    if not fresh:
+        return
+    # splice into the dispatch point (profiler-hook pattern: one global
+    # read per dispatch when off, applied inside invoke itself so every
+    # caller — including from-imports of invoke — goes through the policy)
+    _nd_mod._AMP = sys.modules[__name__]
+
+
+def _deinit_for_tests():
+    """Undo init() (test isolation only; the reference has no amp.off)."""
+    global _state
+    if _state is None:
+        return
+    _nd_mod._AMP = None
+    _state = None
+
+
+class LossScaler:
+    """Dynamic loss scaler (ref: amp/loss_scaler.py — class LossScaler)."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._factor = scale_factor
+        self._window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any gradient is non-finite (checked on device, one small
+        host sync per step — the fp16 tax; bf16 AMP never needs this)."""
+        for p in params:
+            g = p.data().grad
+            if g is None:
+                continue
+            if not bool(jnp.isfinite(g._data).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow):
+        if overflow:
+            self.loss_scale = max(1.0, self.loss_scale / self._factor)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._window:
+                self.loss_scale *= self._factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach dynamic loss scaling to a gluon Trainer (ref: amp.init_trainer).
+
+    bfloat16 targets skip scaling entirely (range matches f32)."""
+    if _state is None:
+        raise RuntimeError("call amp.init() before amp.init_trainer()")
+    if _state.declared == "bfloat16":
+        trainer._amp_loss_scaler = None
+        return
+    trainer._amp_loss_scaler = LossScaler()
+    trainer._amp_original_step = trainer.step
+
+    def _amp_step(batch_size, ignore_stale_grad=False, _t=trainer):
+        scaler = _t._amp_loss_scaler
+        overflow = scaler.has_overflow(_t._params)
+        if overflow:
+            scaler.update_scale(True)
+            _t.zero_grad()
+            return  # skip the update, like the reference
+        # grads were produced under the CURRENT scale: unscale with it,
+        # then let the scaler grow (growth applies to the NEXT backward)
+        eff = 1.0 if getattr(_t, "_amp_unscaled", False) \
+            else scaler.loss_scale
+        _t._amp_unscaled = False
+        _t._amp_original_step(batch_size * eff, ignore_stale_grad)
+        scaler.update_scale(False)
+
+    trainer.step = _amp_step
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as l: l.backward()``
+    (ref: amp.scale_loss).  Scaling is folded into the rescale_grad of the
+    trainer's next step, so gradients are unscaled exactly once."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        yield loss
+        return
+    if isinstance(loss, (list, tuple)):
+        yield type(loss)(l * scaler.loss_scale for l in loss)
+    else:
+        yield loss * scaler.loss_scale
+
+
+def unscale(trainer):
+    """Manually unscale accumulated grads (for gradient clipping between
+    backward and step; ref: amp.unscale).  The scaler keeps its scale for
+    the next iteration — only THIS step's grads are marked pre-unscaled."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        g = p.data().grad
+        if g is not None:
+            g._data = g._data * inv
+    trainer._amp_unscaled = True
+
+
+def convert_model(net, target_dtype="bfloat16"):
+    """Cast a gluon block's parameters to the target dtype
+    (ref: amp.convert_model for the symbolic path; gluon uses net.cast)."""
+    net.cast(str(jnp.dtype(target_dtype)))
+    return net

@@ -1,0 +1,105 @@
+"""Training callbacks (ref: python/mxnet/callback.py — Speedometer,
+do_checkpoint, log_train_metric, ProgressBar; SURVEY §5.5)."""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "ProgressBar"]
+
+
+class BatchEndParam:
+    """ref: mxnet.model.BatchEndParam (namedtuple in the reference)."""
+
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+class Speedometer:
+    """Log samples/sec every ``frequent`` batches (ref: class Speedometer)."""
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "	".join(f"{n}={v:.6f}" for n, v in name_value)
+                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f "
+                                 "samples/sec\t%s", param.epoch, count,
+                                 speed, msg)
+                else:
+                    logging.info("Epoch[%d] Batch [%d]\tSpeed: %.2f "
+                                 "samples/sec", param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving parameters (ref: callback.do_checkpoint).
+    The callback receives (epoch, net_or_params, *rest); gluon blocks are
+    saved via save_parameters, plain dicts via nd.save."""
+    period = max(1, int(period))
+
+    def _callback(epoch, net, *rest):
+        if (epoch + 1) % period != 0:
+            return
+        fname = f"{prefix}-{epoch + 1:04d}.params"
+        if hasattr(net, "save_parameters"):
+            net.save_parameters(fname)
+        else:
+            from . import ndarray as nd
+            nd.save(fname, net)
+        logging.info("Saved checkpoint to \"%s\"", fname)
+
+    return _callback
+
+
+def log_train_metric(period, auto_reset=False):
+    """ref: callback.log_train_metric."""
+
+    def _callback(param):
+        if param.nbatch % max(1, period) == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class ProgressBar:
+    """ref: callback.ProgressBar — textual progress over total batches."""
+
+    def __init__(self, total, length=80):
+        self.total = max(1, total)
+        self.length = length
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.length * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.length - filled)
+        print(f"[{bar}] {pct}%", end="\r", flush=True)

@@ -1,0 +1,123 @@
+"""Environment-variable configuration (the ``MXNET_*`` knob system).
+
+ref: docs/static_site/src/pages/api/faq/env_var.md + the ``dmlc::GetEnv``
+pattern used throughout src/ — every tunable behavior is controlled by an
+``MXNET_*`` environment variable with a documented default.
+
+This module is the single registry: each knob declares its type, default,
+and what it drives.  Knobs whose reference meaning is subsumed by XLA/PJRT
+(thread pools, GPU memory pools, cuDNN autotune) are registered as
+``accepted`` so reference launch scripts run unchanged, but changing them
+is a documented no-op here.  ``describe()`` prints the full table.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get", "describe", "KNOBS"]
+
+
+class Knob:
+    __slots__ = ("name", "default", "type", "doc", "wired")
+
+    def __init__(self, name, default, type_, doc, wired=True):
+        self.name = name
+        self.default = default
+        self.type = type_
+        self.doc = doc
+        self.wired = wired
+
+
+def _as_bool(v):
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+KNOBS = {k.name: k for k in [
+    # --- live knobs (change behavior in this build) ----------------------
+    Knob("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str,
+         "Execution engine. 'NaiveEngine' forces synchronous dispatch "
+         "(every op blocks until complete) — the reference's race-bisect "
+         "debugging mode (SURVEY §5.2)."),
+    Knob("MXNET_CPU_WORKER_NTHREADS", 0, int,
+         "Default DataLoader worker-process count when num_workers is not "
+         "passed (0 = in-process loading)."),
+    Knob("MXNET_PROFILER_AUTOSTART", 0, int,
+         "1 = start the profiler at import; dump to MXNET_PROFILER_FILENAME "
+         "at exit."),
+    Knob("MXNET_PROFILER_FILENAME", "profile.json", str,
+         "Trace output path for the autostarted profiler."),
+    Knob("MXNET_SEED", None, int,
+         "Global PRNG seed applied at import (mx.random.seed)."),
+    Knob("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", 1, int,
+         "Log when a sparse input is densified by a dense-only operator."),
+    Knob("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,
+         "Arrays larger than this (elements) use the big-array gradient "
+         "compression path in the kvstore."),
+    # --- accepted for compatibility (no-ops under XLA/PJRT, documented) --
+    Knob("MXNET_EXEC_BULK_EXEC_TRAIN", 1, int,
+         "Engine bulking — subsumed by hybridize/jit whole-graph compile.",
+         wired=False),
+    Knob("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int,
+         "Engine bulking — subsumed by jit.", wired=False),
+    Knob("MXNET_GPU_MEM_POOL_RESERVE", 5, int,
+         "GPU memory pool — HBM is managed by PJRT.", wired=False),
+    Knob("MXNET_GPU_MEM_POOL_TYPE", "Naive", str,
+         "GPU memory pool — HBM is managed by PJRT.", wired=False),
+    Knob("MXNET_CUDNN_AUTOTUNE_DEFAULT", 1, int,
+         "cuDNN algo search — XLA picks conv strategies at compile time.",
+         wired=False),
+    Knob("MXNET_ENFORCE_DETERMINISM", 0, int,
+         "XLA TPU execution is deterministic by construction.", wired=False),
+    Knob("MXNET_SAFE_ACCUMULATION", 1, int,
+         "Wide-accumulator reductions — always on (norm ops accumulate in "
+         "f32 regardless; see ops/nn.py _moments).", wired=False),
+    Knob("MXNET_GPU_WORKER_NTHREADS", 2, int,
+         "Per-GPU worker threads — PJRT streams replace them.", wired=False),
+]}
+
+
+def get(name, default=None):
+    """Typed read of a knob (env var wins over registry default)."""
+    knob = KNOBS.get(name)
+    raw = os.environ.get(name)
+    if knob is None:
+        return raw if raw is not None else default
+    if raw is None:
+        return knob.default if default is None else default
+    if knob.type is int:
+        try:
+            return int(raw)
+        except ValueError:
+            return knob.default
+    if knob.type is bool:
+        return _as_bool(raw)
+    return raw
+
+
+def describe():
+    """Render the knob table (ref: env_var.md)."""
+    out = [f"{'variable':<38s}{'default':<26s}{'wired':<7s}description"]
+    for k in KNOBS.values():
+        out.append(f"{k.name:<38s}{str(k.default):<26s}"
+                   f"{'yes' if k.wired else 'n/a':<7s}{k.doc}")
+    return "\n".join(out)
+
+
+def _apply_startup():
+    """Run once at package import: knobs that act at process start."""
+    seed = get("MXNET_SEED")
+    if seed is not None:
+        from . import random as _random
+        _random.seed(int(seed))
+    if get("MXNET_PROFILER_AUTOSTART"):
+        import atexit
+
+        from . import profiler
+        profiler.set_config(filename=get("MXNET_PROFILER_FILENAME"))
+        profiler.start()
+        atexit.register(lambda: (profiler.stop(), profiler.dump()))
+
+
+def naive_engine():
+    """True when MXNET_ENGINE_TYPE=NaiveEngine (synchronous dispatch)."""
+    return get("MXNET_ENGINE_TYPE") == "NaiveEngine"

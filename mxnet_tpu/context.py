@@ -13,7 +13,8 @@ import threading
 
 import jax
 
-__all__ = ["Context", "cpu", "tpu", "gpu", "cpu_pinned", "current_context", "num_tpus", "num_gpus"]
+__all__ = ["Context", "cpu", "tpu", "gpu", "cpu_pinned", "current_context",
+           "num_tpus", "num_gpus", "gpu_memory_info"]
 
 _tls = threading.local()
 
@@ -101,6 +102,15 @@ class Context:
 
         gc.collect()
 
+    def memory_info(self):
+        """Device memory statistics from PJRT (the storage-manager
+        introspection surface; ref: storage.cc GetMemoryPoolInfo /
+        mx.context.gpu_memory_info).  Keys follow PJRT's memory_stats
+        (bytes_in_use, peak_bytes_in_use, bytes_limit, ...); CPU backends
+        without stats return {}."""
+        stats = self.device.memory_stats()
+        return dict(stats) if stats else {}
+
     @classmethod
     def default_ctx(cls):
         return current_context()
@@ -133,6 +143,15 @@ def num_gpus() -> int:
         return len(jax.devices("gpu"))
     except RuntimeError:
         return 0
+
+
+def gpu_memory_info(device_id: int = 0):
+    """(free, total) bytes for the accelerator (ref: mx.context.
+    gpu_memory_info; 'gpu' meaning the accelerator backend here)."""
+    stats = Context("tpu", device_id).memory_info()
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (total - used, total)
 
 
 def current_context() -> Context:

@@ -16,7 +16,7 @@ import os
 import jax
 
 __all__ = ["init", "shutdown", "rank", "num_workers", "barrier",
-           "all_sum", "broadcast"]
+           "all_sum", "all_gather", "broadcast"]
 
 _initialized = False
 
@@ -90,6 +90,17 @@ def all_sum(array):
     from jax.experimental import multihost_utils
     gathered = multihost_utils.process_allgather(jnp.asarray(array))
     return jnp.sum(gathered, axis=0)
+
+
+def all_gather(array):
+    """Stack each process's local array along a new leading axis →
+    (num_workers, *shape) on every process (the compressed-gradient wire;
+    ref: ps-lite's per-worker server recv loop)."""
+    import jax.numpy as jnp
+    if jax.process_count() == 1:
+        return jnp.asarray(array)[None]
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(jnp.asarray(array))
 
 
 def broadcast(array, root=0):

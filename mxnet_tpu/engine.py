@@ -28,9 +28,22 @@ _LOCK = threading.Lock()
 _RECENT = collections.deque(maxlen=256)
 _bulk_size = 0
 
+# MXNET_ENGINE_TYPE=NaiveEngine → synchronous dispatch (every op blocks),
+# the reference's race-bisect debug mode.  Read once at import, like the
+# reference's engine singleton.
+from . import config as _config  # noqa: E402
+
+_NAIVE = _config.naive_engine()
+
 
 def track(arr):
     """Record a freshly produced jax.Array for the waitall barrier."""
+    if _NAIVE:
+        try:
+            jax.block_until_ready(arr)
+        except Exception:
+            pass
+        return arr
     with _LOCK:
         _RECENT.append(arr)
     return arr

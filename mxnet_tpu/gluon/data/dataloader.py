@@ -75,8 +75,12 @@ class DataLoader:
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
-                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 num_workers=None, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=False, timeout=120):
+        if num_workers is None:
+            # MXNET_CPU_WORKER_NTHREADS sets the fleet-wide default
+            from ... import config as _config
+            num_workers = _config.get("MXNET_CPU_WORKER_NTHREADS")
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._timeout = timeout

@@ -65,14 +65,8 @@ class BERTAttentionCell(HybridBlock):
             if mask is not None:
                 raise ValueError("attention_impl='flash' does not support "
                                  "valid_length masks yet")
-            if self._dropout > 0.0:
-                import warnings
-                warnings.warn(
-                    "attention_impl='flash' does not apply attention-"
-                    "probability dropout inside the fused kernel; "
-                    f"dropout={self._dropout} affects only the residual "
-                    "dropouts", stacklevel=2)
-            out = F.flash_attention(q, k, v, heads=self._heads)
+            out = F.flash_attention(q, k, v, heads=self._heads,
+                                    dropout=self._dropout)
         elif self._impl != "dense":
             # sequence-parallel long-context path (ring/ulysses over the
             # active mesh's sp axis); padding masks not yet supported there

@@ -1,0 +1,341 @@
+"""``mx.io`` — legacy data iterators.
+
+ref: python/mxnet/io/io.py — DataIter / DataBatch / DataDesc / NDArrayIter /
+CSVIter; src/io/iter_image_recordio_2.cc — ImageRecordIter (threaded packed-
+record image pipeline).  TPU-native: decode/augment runs in Python workers
+over the native recordio core (src/recordio.cc); each batch crosses to the
+device once via ``nd.array`` on read, and the heavy path for training is
+still gluon's DataLoader — these iterators are the Module-era API surface.
+"""
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+
+import numpy as np
+
+from . import recordio
+from .ndarray import NDArray
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ImageRecordIter", "ResizeIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
+    """ref: io.DataDesc."""
+
+    def __new__(cls, name, shape, dtype=np.float32, layout="NCHW"):
+        return super().__new__(cls, name, tuple(shape), dtype, layout)
+
+
+class DataBatch:
+    """ref: io.DataBatch."""
+
+    def __init__(self, data, label=None, pad=0, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """ref: io.DataIter — reset/next/iter protocol."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def reset(self):
+        pass
+
+    def next(self):
+        raise NotImplementedError
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        self.reset()
+        return self
+
+    @property
+    def provide_data(self):
+        raise NotImplementedError
+
+    @property
+    def provide_label(self):
+        raise NotImplementedError
+
+
+def _to_nd(arr):
+    from . import ndarray as nd
+    return arr if isinstance(arr, NDArray) else nd.array(arr)
+
+
+class NDArrayIter(DataIter):
+    """ref: io.NDArrayIter — batches over in-memory arrays with pad/discard/
+    roll_over last-batch handling and optional shuffle."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self._data = self._init_arrays(data, data_name)
+        self._label = self._init_arrays(label, label_name)
+        self._shuffle = shuffle
+        self._last = last_batch_handle
+        self._n = self._data[0][1].shape[0] if self._data else 0
+        for _, a in self._data + self._label:
+            assert a.shape[0] == self._n, "data/label batch axes disagree"
+        self._order = np.arange(self._n)
+        self.reset()
+
+    @staticmethod
+    def _init_arrays(data, default_name):
+        if data is None:
+            return []
+        if isinstance(data, (np.ndarray, NDArray)):
+            data = {default_name: data}
+        if isinstance(data, (list, tuple)):
+            data = {f"{default_name}{i if i else ''}": d
+                    for i, d in enumerate(data)}
+        out = []
+        for k, v in data.items():
+            v = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+            out.append((k, v))
+        return out
+
+    def reset(self):
+        if self._shuffle:
+            np.random.shuffle(self._order)
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self._data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self._label]
+
+    def next(self):
+        if self._cursor >= self._n:
+            raise StopIteration
+        end = self._cursor + self.batch_size
+        pad = 0
+        if end > self._n:
+            if self._last == "discard":
+                raise StopIteration
+            if self._last == "pad":
+                pad = end - self._n
+            elif self._last == "roll_over":
+                raise StopIteration  # remainder carried to next epoch pass
+        idx = self._order[self._cursor:min(end, self._n)]
+        if pad:
+            idx = np.concatenate([idx, self._order[:pad]])
+        self._cursor = end
+        data = [_to_nd(v[idx]) for _, v in self._data]
+        label = [_to_nd(v[idx]) for _, v in self._label]
+        return DataBatch(data, label, pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+class CSVIter(DataIter):
+    """ref: io.CSVIter — numeric csv rows → batches."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
+                 batch_size=1, **kwargs):
+        super().__init__(batch_size)
+        data = np.loadtxt(data_csv, delimiter=",", dtype=np.float32, ndmin=2)
+        self._inner_data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = np.loadtxt(label_csv, delimiter=",",
+                               dtype=np.float32, ndmin=2)
+            label = label.reshape((-1,) + tuple(label_shape))
+        self._it = NDArrayIter(self._inner_data, label, batch_size,
+                               last_batch_handle="discard")
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        return self._it.next()
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+
+class ImageRecordIter(DataIter):
+    """Packed-record image pipeline (ref: iter_image_recordio_2.cc —
+    ImageRecordIOParser2; API: mx.io.ImageRecordIter).
+
+    Decodes with PIL in ``preprocess_threads`` worker processes, applies
+    resize/center-crop (or random-crop/mirror when ``rand_crop``/
+    ``rand_mirror``), mean/std normalisation, and yields NCHW float batches.
+    """
+
+    def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, resize=-1, preprocess_threads=0, seed=0,
+                 round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self._shape = tuple(data_shape)  # (C, H, W)
+        assert len(self._shape) == 3
+        if path_imgidx is None:
+            path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+        self._rec_path = path_imgrec
+        self._idx_path = path_imgidx
+        self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+        self._keys = list(self._rec.keys)
+        if not self._keys:
+            raise IOError(f"empty or unindexed record file {path_imgrec!r}")
+        self._shuffle = shuffle
+        self._rand_crop = rand_crop
+        self._rand_mirror = rand_mirror
+        self._resize = resize
+        c = self._shape[0]
+        self._mean = np.array([mean_r, mean_g, mean_b][:c] or [mean_r],
+                              np.float32)
+        self._std = np.array([std_r, std_g, std_b][:c] or [std_r],
+                             np.float32)
+        self._rng = np.random.RandomState(seed)
+        self._round = round_batch
+        self._pool = None
+        if preprocess_threads and preprocess_threads > 1:
+            import multiprocessing as mp
+            self._pool = mp.get_context("fork").Pool(preprocess_threads)
+        self.reset()
+
+    def _decode(self, key):
+        s = self._rec.read_idx(key)
+        header, img = recordio.unpack_img(
+            s, iscolor=0 if self._shape[0] == 1 else 1)
+        return header, img
+
+    def _augment(self, img):
+        from PIL import Image
+        c, h, w = self._shape
+        if self._resize > 0:
+            im = Image.fromarray(img)
+            short = min(im.size)
+            scale = self._resize / short
+            im = im.resize((max(1, round(im.size[0] * scale)),
+                            max(1, round(im.size[1] * scale))))
+            img = np.asarray(im)
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            im = Image.fromarray(img).resize((max(w, iw), max(h, ih)))
+            img = np.asarray(im)
+            ih, iw = img.shape[:2]
+        if self._rand_crop:
+            y0 = self._rng.randint(0, ih - h + 1)
+            x0 = self._rng.randint(0, iw - w + 1)
+        else:
+            y0, x0 = (ih - h) // 2, (iw - w) // 2
+        img = img[y0:y0 + h, x0:x0 + w]
+        if self._rand_mirror and self._rng.rand() < 0.5:
+            img = img[:, ::-1]
+        if img.ndim == 2:
+            img = np.stack([img] * c, axis=-1)
+        img = (img.astype(np.float32) - self._mean) / self._std
+        return np.ascontiguousarray(img.transpose(2, 0, 1))  # CHW
+
+    def reset(self):
+        self._order = list(self._keys)
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def next(self):
+        if self._cursor >= len(self._order):
+            raise StopIteration
+        keys = self._order[self._cursor:self._cursor + self.batch_size]
+        pad = self.batch_size - len(keys)
+        if pad and not self._round:
+            raise StopIteration
+        if pad:
+            keys = keys + self._order[:pad]
+        self._cursor += self.batch_size
+        if self._pool is not None:
+            iscolor = 0 if self._shape[0] == 1 else 1
+            decoded = self._pool.map(_decode_one,
+                                     [(self._idx_path, self._rec_path, k,
+                                       iscolor) for k in keys])
+        else:
+            decoded = [self._decode(k) for k in keys]
+        imgs = np.stack([self._augment(img) for _, img in decoded])
+        labels = np.array(
+            [h.label if np.isscalar(h.label) or getattr(h.label, "ndim", 1) == 0
+             else np.asarray(h.label).ravel()[0] for h, _ in decoded],
+            np.float32)
+        return DataBatch([_to_nd(imgs)], [_to_nd(labels)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+
+_worker_rec = {}
+
+
+def _decode_one(args):
+    """Pool worker: each process opens its own reader lazily (fds don't
+    survive fork safely for concurrent seeks)."""
+    idx_path, rec_path, key, iscolor = args
+    rec = _worker_rec.get(rec_path)
+    if rec is None:
+        rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
+        _worker_rec[rec_path] = rec
+    return recordio.unpack_img(rec.read_idx(key), iscolor=iscolor)
+
+
+class ResizeIter(DataIter):
+    """ref: io.ResizeIter — cap/extend an iterator to ``size`` batches."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self._it = data_iter
+        self._size = size
+        self._reset_internal = reset_internal
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+        if self._reset_internal:
+            self._it.reset()
+
+    def next(self):
+        if self._i >= self._size:
+            raise StopIteration
+        self._i += 1
+        try:
+            return self._it.next()
+        except StopIteration:
+            self._it.reset()
+            return self._it.next()
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label

@@ -7,6 +7,8 @@ kvstore_local.h — KVStoreLocal::{Init,Push,Pull} with per-key merge buffers
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -60,6 +62,36 @@ def _quant_2bit(grad, residual, threshold):
     q = jnp.where(acc >= threshold, threshold,
                   jnp.where(acc <= -threshold, -threshold, 0.0)).astype(acc.dtype)
     return q, acc - q
+
+
+@jax.jit
+def _pack_2bit(q):
+    """Pack quantized ±t/0 values into the 2-bit wire format (4 values per
+    byte; codes 0→0, +t→1, −t→2 — ref: gradient_compression.cc Quantize2Bit
+    packs the same way into uint32 words).  This is what actually crosses
+    the network in dist mode: 16× smaller than f32."""
+    flat = q.ravel()
+    n = flat.shape[0]
+    pad = (-n) % 4
+    codes = jnp.where(flat > 0, 1, jnp.where(flat < 0, 2, 0)).astype(jnp.uint8)
+    codes = jnp.pad(codes, (0, pad))
+    c = codes.reshape(-1, 4)
+    return (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4) | (c[:, 3] << 6))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _unpack_sum_2bit(gathered, threshold, shape, dtype):
+    """Decode every peer's packed payload and sum — ONE fused dispatch for
+    the whole (P, nbytes) gathered array (the hot dist-gradient path)."""
+    n = 1
+    for s in shape:
+        n *= s
+    b = gathered  # (P, nbytes) uint8
+    codes = jnp.stack([b & 3, (b >> 2) & 3, (b >> 4) & 3, (b >> 6) & 3],
+                      axis=-1).reshape(b.shape[0], -1)[:, :n]
+    vals = jnp.where(codes == 1, threshold,
+                     jnp.where(codes == 2, -threshold, 0.0))
+    return vals.sum(axis=0).astype(dtype).reshape(shape)
 
 
 class KVStore:
@@ -135,7 +167,16 @@ class KVStore:
                 # dist_sync merge: sum each worker's (compressed) push across
                 # processes — the server-side reduce of kvstore_dist_server.h
                 from .. import distributed
-                merged = distributed.all_sum(merged)
+                if self._compression is not None:
+                    # ship the 2-bit wire format (16× less DCN traffic),
+                    # decode + sum all peers in one fused dispatch
+                    thr = self._compression[1]
+                    gathered = distributed.all_gather(_pack_2bit(merged))
+                    merged = _unpack_sum_2bit(
+                        gathered, jnp.asarray(thr, merged.dtype),
+                        tuple(merged.shape), str(merged.dtype))
+                else:
+                    merged = distributed.all_sum(merged)
             stored = self._store[k]
             if self._optimizer is not None:
                 # dense per-key optimizer index so string keys get distinct

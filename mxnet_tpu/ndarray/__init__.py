@@ -268,3 +268,10 @@ def __getattr__(name):
         setattr(_this, name, w)
         return w
     raise AttributeError(f"module 'mxnet_tpu.ndarray' has no attribute '{name}'")
+
+
+def Custom(*inputs, op_type, **kwargs):
+    """Run a registered custom operator (ref: mx.nd.Custom →
+    src/operator/custom/custom.cc; see mxnet_tpu.operator)."""
+    from ..operator import invoke_custom
+    return invoke_custom(*inputs, op_type=op_type, **kwargs)

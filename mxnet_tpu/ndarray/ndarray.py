@@ -445,10 +445,16 @@ def _out_ctx(args):
 # every engine Push the same opt-in way).
 _PROF = None
 
+# Set by mx.amp.init(): applies the list-driven mixed-precision cast policy
+# to every dispatch (same opt-in hook pattern as the profiler).
+_AMP = None
 
 
 def invoke(op_name: str, *args, out=None, **kwargs):
     """Dispatch one op; profiled when the profiler is running."""
+    amp = _AMP
+    if amp is not None:
+        args = amp._cast_args(op_name, args)
     prof = _PROF
     if prof is not None and prof.ACTIVE:
         t0 = prof._now_us()

@@ -83,21 +83,15 @@ class ndarray(NDArray):
     def var(self, axis=None, keepdims=False):
         return var(self, axis=axis, keepdims=keepdims)
 
-    def reshape(self, *shape, **kwargs):
-        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
-            shape = tuple(shape[0])
-        return _wrap(self._data.reshape(shape))
-
+    # reshape/transpose/astype inherit the base (taped, type-preserving)
+    # implementations; only the numpy *axes signature needs adapting
     def transpose(self, *axes):
         if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
             axes = tuple(axes[0])
-        return _wrap(jnp.transpose(self._data, axes or None))
-
-    def astype(self, dtype, copy=True):
-        return _wrap(self._data.astype(dtype_np(dtype)))
+        return NDArray.transpose(self, axes or None)
 
     def copy(self):
-        return _wrap(self._data + 0)
+        return type(self)(jnp.asarray(self._data), ctx=self._ctx)
 
     def __repr__(self):
         return repr(_onp.asarray(self._data)).replace("array(", "array(", 1)
@@ -168,9 +162,6 @@ def identity(n, dtype="float32", ctx=None):
     return eye(n, dtype=dtype)
 
 
-def meshgrid(*xi, indexing="xy"):
-    return tuple(_wrap(g) for g in
-                 jnp.meshgrid(*[_unwrap(x) for x in xi], indexing=indexing))
 
 
 # ---------------------------------- mechanically generated jnp delegates ----
@@ -301,8 +292,8 @@ all = _delegate("all")
 any = _delegate("any")
 
 
-def transpose(a, axes=None):
-    return _wrap(jnp.transpose(_unwrap(a), axes))
+transpose = _delegate("transpose")
+meshgrid = _delegate("meshgrid")
 
 
 def asnumpy(a):

@@ -66,10 +66,9 @@ _this = sys.modules[__name__]
 
 
 def _np_wrap(result):
-    if isinstance(result, tuple):
-        return tuple(_np_wrap(r) for r in result)
-    if isinstance(result, NDArray):
-        return np_ndarray(result._data, ctx=result._ctx)
+    """Identity: invoke() already propagates the np array type from inputs
+    to outputs, and re-wrapping would sever the identity-keyed autograd
+    tape (grads key on the exact output objects the TapeNode holds)."""
     return result
 
 
@@ -99,10 +98,15 @@ def save(fname, arrays):
 
 def load(fname):
     from . import ndarray as nd
+
+    def as_np(v):
+        # fresh arrays off disk: re-typing is safe (no tape identity held)
+        return np_ndarray(v._data, ctx=v._ctx)
+
     out = nd.load(fname)
     if isinstance(out, dict):
-        return {k: _np_wrap(v) for k, v in out.items()}
-    return [_np_wrap(v) for v in out]
+        return {k: as_np(v) for k, v in out.items()}
+    return [as_np(v) for v in out]
 
 
 def waitall():

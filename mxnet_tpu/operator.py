@@ -1,0 +1,125 @@
+"""Custom-operator escape hatch.
+
+ref: python/mxnet/operator.py — class CustomOp / CustomOpProp +
+operator.register; src/operator/custom/custom.cc.  Users subclass CustomOp
+(forward/backward over NDArrays), describe shapes/types in a CustomOpProp,
+register under a name, and call ``mx.nd.Custom(..., op_type=name)``.
+
+TPU-native notes: the custom body runs eagerly in Python (like the
+reference, whose custom ops always run on the engine's Python thread and
+break graph fusion).  Under autograd the user's ``backward`` is spliced
+into the tape; under jit tracing, custom ops raise — wrap the hot path in
+a registered op (ops/registry.py) instead if it must compile."""
+from __future__ import annotations
+
+import jax
+
+from .ndarray import NDArray
+from . import autograd as _autograd
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """ref: operator.CustomOp — override forward() and backward()."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """ref: CustomOp.assign — honour the write/add/null request."""
+        if req in ("write", "inplace", None):
+            dst._data = src._data if isinstance(src, NDArray) else src
+        elif req == "add":
+            dst._data = dst._data + (src._data if isinstance(src, NDArray)
+                                     else src)
+        elif req == "null":
+            pass
+        else:
+            raise ValueError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """ref: operator.CustomOpProp — shapes/dtypes/arity metadata."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+
+def register(name):
+    """ref: mx.operator.register — decorator over a CustomOpProp class."""
+
+    def _reg(prop_cls):
+        _REGISTRY[name] = prop_cls
+        return prop_cls
+
+    return _reg
+
+
+def get(name):
+    return _REGISTRY[name]
+
+
+def invoke_custom(*inputs, op_type, **kwargs):
+    """Run a registered custom op (the ``nd.Custom`` entry point)."""
+    if op_type not in _REGISTRY:
+        raise ValueError(
+            f"custom op {op_type!r} is not registered "
+            f"(known: {sorted(_REGISTRY)})")
+    if any(isinstance(getattr(a, "_data", a), jax.core.Tracer)
+           for a in inputs):
+        raise TypeError(
+            f"custom op {op_type!r} cannot run under jit tracing — custom "
+            f"Python bodies execute eagerly (register a real op in "
+            f"ops/registry.py for a compilable kernel)")
+    prop = _REGISTRY[op_type](**kwargs)
+    in_shapes = [list(a.shape) for a in inputs]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [a.dtype for a in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    # reference contract: create_operator receives the INPUT shapes/dtypes
+    op = prop.create_operator(None, in_shapes, in_types)
+
+    from . import ndarray as nd
+    outs = [nd.zeros(tuple(s), dtype=t)
+            for s, t in zip(out_shapes, out_types)]
+    with _autograd.pause():
+        op.forward(_autograd.is_training(), ["write"] * len(outs),
+                   list(inputs), outs, [])
+
+    if _autograd.is_recording():
+        in_list = list(inputs)
+        out_list = list(outs)
+
+        def _pull(cts):
+            in_grads = [nd.zeros(a.shape, dtype=a.dtype) for a in in_list]
+            out_grads = [NDArray(c) for c in cts]
+            with _autograd.pause():
+                op.backward(["write"] * len(in_grads), out_grads, in_list,
+                            out_list, in_grads, [])
+            return [g._data for g in in_grads]
+
+        node = _autograd.TapeNode(in_list, out_list, _pull,
+                                  name=f"Custom:{op_type}")
+        _autograd.append_node(node)
+    return outs if len(outs) > 1 else outs[0]

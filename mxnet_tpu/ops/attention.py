@@ -88,18 +88,28 @@ def _multi_head_attention(q, k, v, mask=None, heads=1, dropout=0.0,
 
 @register_op("flash_attention")
 def _flash_attention_op(q, k, v, heads=1, causal=False, block_q=128,
-                        block_k=128):
+                        block_k=128, dropout=0.0, training=None):
     """Flash MHA on (B, S, H*D) projections via the Pallas kernel
     (ops/pallas/flash_attention.py) — O(S·D) memory instead of the dense
-    op's O(S^2) scores; the long-context single-chip path."""
+    op's O(S^2) scores; the long-context single-chip path.  ``dropout``
+    applies attention-probability dropout inside the kernel (training only),
+    seeded from the framework RNG stream each call."""
+    from .. import autograd as _autograd
+    from .. import random as _random
     from .pallas import flash_attention
+    if training is None:
+        training = _autograd.is_training()
     b, sq, hd = q.shape
     d = hd // heads
     def to_bhsd(x):
         return jnp.transpose(x.reshape(b, -1, heads, d),
                              (0, 2, 1, 3)).reshape(b * heads, -1, d)
+    drop = float(dropout) if training else 0.0
+    seed = None
+    if drop > 0.0:
+        seed = jax.random.randint(_random.next_key(), (1,), 0, 2 ** 31 - 1)
     out = flash_attention(to_bhsd(q), to_bhsd(k), to_bhsd(v), None, causal,
-                          block_q, block_k, None)
+                          block_q, block_k, None, drop, seed)
     out = out.reshape(b, heads, sq, d)
     return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, hd)
 

@@ -1,17 +1,22 @@
-"""Flash attention Pallas kernel — the long-context hot path.
+"""Flash attention Pallas kernels — the long-context hot path.
 
-The reference's attention is two cuBLAS strided-batched matmuls with the full
-(B*H, S, S) score matrix materialised (ref: src/operator/contrib/
-transformer.cc).  On TPU that matrix is the HBM wall at long sequence; this
-kernel computes softmax(QK^T)V blockwise with the online-softmax recurrence so
-peak memory is O(S·D + block_q·S) instead of O(S^2) per head, with the two
-matmuls staying resident on the MXU (SURVEY.md §7.0.2 names this kernel).
+The reference's attention is two cuBLAS strided-batched matmuls with the
+full (B*H, S, S) score matrix materialised (ref: src/operator/contrib/
+transformer.cc).  On TPU that matrix is the HBM wall at long sequence; these
+kernels compute softmax(QK^T)V blockwise with the online-softmax recurrence
+(SURVEY §7.0.2 names this kernel).
 
-Forward: one Pallas program per (batch·head, q-block): K/V live in VMEM and
-the kernel loops over k-blocks with fori_loop, carrying (acc, m, l).
-Backward: custom-vjp recomputation — per q-block the scores are rebuilt in a
-``lax.map`` over blocks (pure XLA, never materialising S×S), the flash-
-standard trade of FLOPs for memory.
+v2 design (round-3: VERDICT weak #6):
+- K/V are **streamed block-by-block through the grid** — the kernel never
+  holds a whole (S, D) K or V in VMEM, so sequence length is bounded by HBM,
+  not VMEM.  Grid (B·H, S/bq, S/bk); accumulators (acc, m, l) live in VMEM
+  scratch carried across the k-dimension of the grid.
+- The forward also emits the per-row log-sum-exp, and the **backward is two
+  Pallas kernels** (dq, then dk/dv) using the standard recompute-from-lse
+  formulation — O(S·D) memory end to end.
+- **Attention-probability dropout runs inside the kernel**: a counter-based
+  integer hash (SplitMix32 finaliser) of (head, q-pos, k-pos, seed) drawn
+  identically in forward and backward, so no mask is ever materialised.
 """
 from __future__ import annotations
 
@@ -20,111 +25,272 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_k):
-    # q_ref: (1, block_q, D); k_ref/v_ref: (1, S, D); o_ref: (1, block_q, D)
-    q = q_ref[0].astype(jnp.float32) * scale          # (bq, D)
-    bq = q.shape[0]
-    s_len = k_ref.shape[1]
-    n_kv = s_len // block_k
+def _uniform01(h_idx, q_pos, k_pos, seed):
+    """Deterministic U[0,1) per (head, q, k) via a SplitMix32-style hash.
+    Counter-based, so forward and backward regenerate the same draw without
+    storing any mask.  (Statistical-quality RNG, not crypto — exactly what
+    dropout needs.)"""
+    x = (q_pos.astype(jnp.uint32) * jnp.uint32(0x9E3779B9)
+         + k_pos.astype(jnp.uint32) * jnp.uint32(0x85EBCA6B)
+         + jnp.uint32(h_idx) * jnp.uint32(0xC2B2AE35)
+         + jnp.uint32(seed))
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return (x >> 8).astype(jnp.float32) * (1.0 / 16777216.0)
+
+
+def _positions(bq, bk, qi, kj, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return q_pos, k_pos
+
+
+# ------------------------------------------------------------- forward ------
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, scale, causal, block_q, block_k, n_k,
+                dropout):
+    b = pl.program_id(0)
     qi = pl.program_id(1)
+    kj = pl.program_id(2)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(j * block_k, block_k), :].astype(jnp.float32)
-        s = q @ k.T                                   # (bq, bk)
-        if causal:
-            q_pos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            k_pos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + p.sum(axis=-1)
-        acc_new = acc * alpha[:, None] + p @ v
-        return acc_new, m_new, l_new
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    d = q.shape[-1]
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, n_kv, body, (acc0, m0, l0))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    q = q_ref[0].astype(jnp.float32) * scale          # (bq, D)
+    k = k_ref[0].astype(jnp.float32)                  # (bk, D)
+    v = v_ref[0].astype(jnp.float32)
+    s = q @ k.T                                       # (bq, bk)
+    q_pos, k_pos = _positions(s.shape[0], s.shape[1], qi, kj,
+                              block_q, block_k)
+    if causal:
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+
+    m_prev = m_ref[...]
+    l_prev = l_ref[...]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new[:, None])
+    # l tracks the TRUE softmax normaliser (pre-dropout) so lse is exact
+    l_new = l_prev * alpha + p.sum(axis=-1)
+    if dropout > 0.0:
+        keep = _uniform01(b, q_pos, k_pos, seed_ref[0]) >= dropout
+        p = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout))
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + p @ v
+    m_ref[...] = m_new
+    l_ref[...] = l_new
+
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        l = l_ref[...]
+        o_ref[0] = (acc_ref[...] / l[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(l)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, seed, scale, causal, block_q, block_k, interpret,
+               dropout):
     bh, s, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
     assert s % block_q == 0 and s % block_k == 0, (s, block_q, block_k)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=block_k)
-    return pl.pallas_call(
+    n_k = s // block_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, n_k=n_k, dropout=dropout)
+    out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, s // block_q),
+        grid=(bh, s // block_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, s, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1,), lambda b, i, j: (0,)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+            pltpu.VMEM((block_q,), jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v)
+    )(seed, q, k, v)
+    return out, lse
 
 
-def _dense_block_bwd(q, k, v, o, do, scale, causal, block_q):
-    """Recompute-based backward: map over q-blocks; each block rebuilds its
-    (block_q, S) score rows (flash-style memory profile, plain XLA)."""
+# ------------------------------------------------------------ backward ------
+def _recompute_p(q_ref, k_ref, lse_ref, b, qi, kj, scale, causal,
+                 block_q, block_k):
+    q = q_ref[0].astype(jnp.float32) * scale
+    k = k_ref[0].astype(jnp.float32)
+    s = q @ k.T
+    q_pos, k_pos = _positions(s.shape[0], s.shape[1], qi, kj,
+                              block_q, block_k)
+    if causal:
+        s = jnp.where(q_pos >= k_pos, s, _NEG_INF)
+    p = jnp.exp(s - lse_ref[0][:, None])   # true softmax probs (pre-dropout)
+    return p, q_pos, k_pos
+
+
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, scale, causal, block_q, block_k, n_k,
+               dropout):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    p, q_pos, k_pos = _recompute_p(q_ref, k_ref, lse_ref, b, qi, kj, scale,
+                                   causal, block_q, block_k)
+    do = do_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    dp = do @ v.T                                     # (bq, bk)
+    if dropout > 0.0:
+        keep = _uniform01(b, q_pos, k_pos, seed_ref[0]) >= dropout
+        dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout))
+    ds = p * (dp - delta_ref[0][:, None])
+    dq_acc[...] += (ds @ k_ref[0].astype(jnp.float32)) * scale
+
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q,
+                block_k, n_q, dropout):
+    b = pl.program_id(0)
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    p, q_pos, k_pos = _recompute_p(q_ref, k_ref, lse_ref, b, qi, kj, scale,
+                                   causal, block_q, block_k)
+    do = do_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    if dropout > 0.0:
+        keep = _uniform01(b, q_pos, k_pos, seed_ref[0]) >= dropout
+        pd = jnp.where(keep, p, 0.0) * (1.0 / (1.0 - dropout))
+    else:
+        pd = p
+    dv_acc[...] += pd.T @ do
+    dp = do @ v.T
+    if dropout > 0.0:
+        dp = jnp.where(keep, dp, 0.0) * (1.0 / (1.0 - dropout))
+    ds = p * (dp - delta_ref[0][:, None])
+    dk_acc[...] += (ds.T @ (q_ref[0].astype(jnp.float32))) * scale
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, seed, o, lse, do, scale, causal, block_q, block_k,
+               interpret, dropout):
     bh, s, d = q.shape
-    n_blocks = s // block_q
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    n_q, n_k = s // block_q, s // block_k
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)
 
-    kf = k.astype(jnp.float32)
-    vf = v.astype(jnp.float32)
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_k=n_k,
+                          dropout=dropout),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, i, j: (0,)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(seed, q, k, v, do, lse, delta)
 
-    def one_block(args):
-        qb, dob, deltab, idx = args          # (bh, bq, d), ..., scalar block idx
-        sc = jnp.einsum("bqd,bkd->bqk", qb.astype(jnp.float32) * scale, kf)
-        if causal:
-            q_pos = idx * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, sc.shape, 1)
-            k_pos = jax.lax.broadcasted_iota(jnp.int32, sc.shape, 2)
-            sc = jnp.where(q_pos >= k_pos, sc, _NEG_INF)
-        p = jax.nn.softmax(sc, axis=-1)
-        dv_b = jnp.einsum("bqk,bqd->bkd", p, dob.astype(jnp.float32))
-        dp = jnp.einsum("bqd,bkd->bqk", dob.astype(jnp.float32), vf)
-        ds = p * (dp - deltab[..., None])
-        dq_b = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
-        dk_b = jnp.einsum("bqk,bqd->bkd", ds, qb.astype(jnp.float32)) * scale
-        return dq_b, dk_b, dv_b
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, n_q=n_q,
+                          dropout=dropout),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1,), lambda b, j, i: (0,)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        interpret=interpret,
+    )(seed, q, k, v, do, lse, delta)
+    return dq, dk, dv
 
-    qb = q.reshape(bh, n_blocks, block_q, d).transpose(1, 0, 2, 3)
-    dob = do.reshape(bh, n_blocks, block_q, d).transpose(1, 0, 2, 3)
-    deltab = delta.reshape(bh, n_blocks, block_q).transpose(1, 0, 2)
-    idxs = jnp.arange(n_blocks)
-    dq_b, dk_b, dv_b = jax.lax.map(one_block, (qb, dob, deltab, idxs))
-    dq = dq_b.transpose(1, 0, 2, 3).reshape(bh, s, d)
-    dk = dk_b.sum(axis=0)
-    dv = dv_b.sum(axis=0)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
-
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
-                    block_k=128, interpret=None):
-    """softmax(scale * Q K^T [, causal]) V without materialising S×S.
-
-    q, k, v: (B*H, S, D).  ``interpret=None`` auto-selects the Pallas
-    interpreter off-TPU (tests on the CPU mesh) and the compiled kernel on
-    TPU."""
-    out, _ = _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k,
-                             interpret)
+# ----------------------------------------------------------- public api -----
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_attention_core(q, k, v, seed, scale, causal, block_q, block_k,
+                          interpret, dropout):
+    out, _ = _flash_fwd_rule(q, k, v, seed, scale, causal, block_q, block_k,
+                             interpret, dropout)
     return out
+
+
+def flash_attention(q, k, v, scale=None, causal=False, block_q=128,
+                    block_k=128, interpret=None, dropout=0.0, seed=None):
+    """softmax(scale · Q Kᵀ [, causal]) V without materialising S×S.
+
+    q, k, v: (B*H, S, D).  ``dropout`` applies attention-probability dropout
+    inside the kernel (the mask is regenerated from a counter-based hash in
+    forward AND backward — never stored).  ``seed`` may be a traced int32
+    scalar so each training step draws a fresh mask without retracing.
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU (CPU-mesh
+    tests) and the compiled kernel on TPU."""
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    else:
+        seed = jnp.asarray(seed, jnp.int32).reshape((1,))
+    return _flash_attention_core(q, k, v, seed, scale, causal, block_q,
+                                 block_k, interpret, dropout)
 
 
 def _resolve(scale, d, interpret):
@@ -135,17 +301,21 @@ def _resolve(scale, d, interpret):
     return scale, interpret
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, seed, scale, causal, block_q, block_k,
+                    interpret, dropout):
     scale, interpret = _resolve(scale, q.shape[-1], interpret)
-    out = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out)
+    out, lse = _flash_fwd(q, k, v, seed, scale, causal, block_q, block_k,
+                          interpret, float(dropout))
+    return out, (q, k, v, seed, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, res, do):
-    q, k, v, o = res
-    scale, _ = _resolve(scale, q.shape[-1], interpret)
-    bq = min(block_q, q.shape[1])
-    return _dense_block_bwd(q, k, v, o, do, scale, causal, bq)
+def _flash_bwd_rule(scale, causal, block_q, block_k, interpret, dropout,
+                    res, do):
+    q, k, v, seed, o, lse = res
+    scale, interpret = _resolve(scale, q.shape[-1], interpret)
+    dq, dk, dv = _flash_bwd(q, k, v, seed, o, lse, do, scale, causal,
+                            block_q, block_k, interpret, float(dropout))
+    return dq, dk, dv, None
 
 
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash_attention_core.defvjp(_flash_fwd_rule, _flash_bwd_rule)

@@ -37,6 +37,21 @@ def _norm_name(n):
     return re.sub(r"(\D)\d+", r"\1", n)
 
 
+def _natural_order(names):
+    """Indices ordering ``names`` with numeric counters compared as numbers
+    (dense9 < dense10).  The save/load pairing runs in this order on both
+    sides: the plain lexicographic order param_names_and_values uses is NOT
+    stable across processes (counters are process-global, and 'dense10' <
+    'dense9' lexicographically), so positional restore needs it."""
+    import re
+
+    def key(i):
+        return [int(t) if t.isdigit() else t
+                for t in re.split(r"(\d+)", names[i])]
+
+    return sorted(range(len(names)), key=key)
+
+
 def _to_host(step, a):
     """Fetch one (possibly mesh-sharded) array to host memory."""
     if jax.process_count() > 1 and hasattr(a, "is_fully_replicated") \
@@ -91,19 +106,24 @@ def load_train_step(step, fname):
     z = np.load(fname)
     manifest = json.loads(bytes(z[_MANIFEST]).decode())
     names = [step._names[i] for i in step._train_idx]
-    # gluon name counters are process-global ("dense3_weight"), so match
-    # structurally: counter-normalised name sequence + shapes
-    saved = [_norm_name(n) for n in manifest["train_names"]]
-    want = [_norm_name(n) for n in names]
-    shapes = [tuple(z[f"p.{k}"].shape) for k in range(len(saved))]
-    want_shapes = [tuple(step._train_arrays[k].shape) for k in range(len(names))] \
-        if len(names) == len(saved) else []
-    if saved != want or shapes != want_shapes:
-        diff = next(((a, b) for a, b in zip(saved, want) if a != b),
-                    (len(saved), len(want)))
+    saved_names = manifest["train_names"]
+    if len(saved_names) != len(names):
         raise ValueError(
-            f"checkpoint/model mismatch: file params {len(saved)}, model "
-            f"expects {len(want)}; first difference: {diff}")
+            f"checkpoint/model mismatch: file has {len(saved_names)} "
+            f"trainable params, model expects {len(names)}")
+    # pair by natural order on both sides; counter-normalised names and
+    # shapes must then agree pointwise (gluon counters are process-global,
+    # so the plain lexicographic storage order is NOT reproducible)
+    pairs = list(zip(_natural_order(saved_names), _natural_order(names)))
+    for sk, wk in pairs:
+        if _norm_name(saved_names[sk]) != _norm_name(names[wk]) or \
+                tuple(z[f"p.{sk}"].shape) != \
+                tuple(step._train_arrays[wk].shape):
+            raise ValueError(
+                f"checkpoint/model mismatch: saved param "
+                f"{saved_names[sk]!r} {z[f'p.{sk}'].shape} does not match "
+                f"model param {names[wk]!r} "
+                f"{tuple(step._train_arrays[wk].shape)}")
     if manifest["optimizer"] != type(step.optimizer).__name__:
         raise ValueError(
             f"optimizer mismatch: checkpoint={manifest['optimizer']} "
@@ -111,14 +131,21 @@ def load_train_step(step, fname):
 
     shard = [step._param_shardings[i] for i in step._train_idx]
     aux_shard = [step._param_shardings[i] for i in step._aux_idx]
-    step._train_arrays = [
-        jax.device_put(z[f"p.{k}"], s) for k, s in enumerate(shard)]
-    step._states = tuple(
-        tuple(jax.device_put(z[f"s.{k}.{j}"], shard[k])
-              for j in range(n))
-        for k, n in enumerate(manifest["state_counts"]))
-    step._aux_arrays = [
-        jax.device_put(z[f"a.{k}"], s) for k, s in enumerate(aux_shard)]
+    new_train = list(step._train_arrays)
+    new_states = list(step._states)
+    for sk, wk in pairs:
+        new_train[wk] = jax.device_put(z[f"p.{sk}"], shard[wk])
+        new_states[wk] = tuple(
+            jax.device_put(z[f"s.{sk}.{j}"], shard[wk])
+            for j in range(manifest["state_counts"][sk]))
+    step._train_arrays = new_train
+    step._states = tuple(new_states)
+    aux_names = [step._names[i] for i in step._aux_idx]
+    new_aux = list(step._aux_arrays)
+    for sk, wk in zip(_natural_order(manifest["aux_names"]),
+                      _natural_order(aux_names)):
+        new_aux[wk] = jax.device_put(z[f"a.{sk}"], aux_shard[wk])
+    step._aux_arrays = new_aux
     step._num_update = manifest["num_update"]
     step.optimizer.num_update = step._num_update
     import jax.numpy as jnp

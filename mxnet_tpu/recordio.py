@@ -1,0 +1,262 @@
+"""RecordIO: packed-record dataset container.
+
+ref: python/mxnet/recordio.py — MXRecordIO / MXIndexedRecordIO / IRHeader /
+pack / unpack / pack_img / unpack_img; the on-disk format is dmlc-core's
+recordio (magic 0xced7230a framing, 29-bit length, 4-byte alignment) so
+files interoperate with reference tooling.
+
+The hot path is the native C++ core (src/recordio.cc) bound via ctypes; a
+pure-Python twin of the same format serves as fallback (and as the spec).
+The native library is built on demand with the in-image toolchain when
+missing (``make -C src``).
+"""
+from __future__ import annotations
+
+import ctypes
+import io as _pyio
+import os
+import struct
+import subprocess
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
+           "pack", "unpack", "pack_img", "unpack_img"]
+
+_MAGIC = 0xCED7230A
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "_lib", "librecordio.so")
+
+
+def _load_native():
+    """dlopen the native core, building it first if possible."""
+    if not os.path.exists(_LIB_PATH):
+        src = os.path.join(_REPO, "src")
+        if os.path.exists(os.path.join(src, "recordio.cc")):
+            try:
+                subprocess.run(["make", "-C", src], capture_output=True,
+                               timeout=120, check=False)
+            except Exception:
+                pass
+    if not os.path.exists(_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.rio_open.restype = ctypes.c_void_p
+    lib.rio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.rio_close.argtypes = [ctypes.c_void_p]
+    lib.rio_write.restype = ctypes.c_int64
+    lib.rio_write.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_uint64]
+    lib.rio_read.restype = ctypes.c_int64
+    lib.rio_read.argtypes = [ctypes.c_void_p,
+                             ctypes.POINTER(ctypes.c_char_p)]
+    lib.rio_seek.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.rio_tell.restype = ctypes.c_int64
+    lib.rio_tell.argtypes = [ctypes.c_void_p]
+    lib.rio_flush.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_LIB = _load_native()
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (ref: class MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        assert flag in ("r", "w")
+        self.uri = uri
+        self.flag = flag
+        self._native = None
+        self._fp = None
+        self.is_open = False
+        self.open()
+
+    # ------------------------------------------------------------- state --
+    def open(self):
+        if _LIB is not None:
+            h = _LIB.rio_open(self.uri.encode(), 1 if self.flag == "w" else 0)
+            if not h:
+                raise IOError(f"cannot open {self.uri!r} ({self.flag})")
+            self._native = h
+        else:
+            self._fp = open(self.uri, "wb" if self.flag == "w" else "rb")
+        self.is_open = True
+
+    def close(self):
+        if not self.is_open:
+            return
+        if self._native is not None:
+            _LIB.rio_close(self._native)
+            self._native = None
+        if self._fp is not None:
+            self._fp.close()
+            self._fp = None
+        self.is_open = False
+
+    def reset(self):
+        """Seek back to the start for another read pass."""
+        self.close()
+        self.open()
+
+    def __del__(self):
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---------------------------------------------------------------- io --
+    def tell(self):
+        if self._native is not None:
+            return int(_LIB.rio_tell(self._native))
+        return self._fp.tell()
+
+    def write(self, buf):
+        """Append one record; returns nothing (ref semantics)."""
+        assert self.flag == "w", "not opened for writing"
+        self._write_pos(buf)
+
+    def _write_pos(self, buf):
+        if isinstance(buf, str):
+            buf = buf.encode()
+        if self._native is not None:
+            pos = int(_LIB.rio_write(self._native, buf, len(buf)))
+            if pos < 0:
+                raise IOError("record write failed")
+            return pos
+        pos = self._fp.tell()
+        lrec = len(buf) & ((1 << 29) - 1)
+        self._fp.write(struct.pack("<II", _MAGIC, lrec))
+        self._fp.write(buf)
+        pad = (4 - (len(buf) & 3)) & 3
+        if pad:
+            self._fp.write(b"\x00" * pad)
+        return pos
+
+    def read(self):
+        """Next record's bytes, or None at EOF."""
+        assert self.flag == "r", "not opened for reading"
+        if self._native is not None:
+            out = ctypes.c_char_p()
+            n = int(_LIB.rio_read(self._native, ctypes.byref(out)))
+            if n == -1:
+                return None
+            if n < 0:
+                raise IOError(f"corrupt record stream in {self.uri!r}")
+            return ctypes.string_at(out, n)
+        head = self._fp.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack("<II", head)
+        if magic != _MAGIC:
+            raise IOError(f"corrupt record stream in {self.uri!r}")
+        size = lrec & ((1 << 29) - 1)
+        data = self._fp.read(size)
+        pad = (4 - (size & 3)) & 3
+        if pad:
+            self._fp.read(pad)
+        return data
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random-access records via a sidecar .idx file
+    (ref: class MXIndexedRecordIO; tools/im2rec writes the pair)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+        if flag == "r" and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) == 2:
+                        k = key_type(parts[0])
+                        self.idx[k] = int(parts[1])
+                        self.keys.append(k)
+
+    def close(self):
+        if self.flag == "w" and self.is_open:
+            with open(self.idx_path, "w") as f:
+                for k in self.keys:
+                    f.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        pos = self.idx[idx]
+        if self._native is not None:
+            _LIB.rio_seek(self._native, pos)
+        else:
+            self._fp.seek(pos)
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        pos = self._write_pos(buf)
+        self.idx[idx] = pos
+        self.keys.append(idx)
+
+
+# -------------------------------------------------------------- pack fmt ----
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """ref: recordio.pack — IRHeader + payload bytes.  flag>0 means the
+    label is a float array of that length prepended to the payload."""
+    header = IRHeader(*header)
+    if not np.isscalar(header.label):
+        # array label rides in front of the payload, flag = its length
+        label = np.asarray(header.label, dtype=np.float32)
+        header = header._replace(flag=label.size, label=0.0)
+        s = label.tobytes() + s
+    return struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                       header.id, header.id2) + s
+
+
+def unpack(s):
+    """ref: recordio.unpack → (IRHeader, payload bytes)."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """ref: recordio.pack_img — encode a HWC uint8 image (PIL backend)."""
+    from PIL import Image
+    img = np.asarray(img)
+    buf = _pyio.BytesIO()
+    fmt = "JPEG" if img_fmt.lower() in (".jpg", ".jpeg") else "PNG"
+    kw = {"quality": quality} if fmt == "JPEG" else {}
+    Image.fromarray(img).save(buf, format=fmt, **kw)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=1):
+    """ref: recordio.unpack_img → (IRHeader, HWC uint8 array)."""
+    from PIL import Image
+    header, payload = unpack(s)
+    img = Image.open(_pyio.BytesIO(payload))
+    if iscolor:
+        img = img.convert("RGB")
+    else:
+        img = img.convert("L")
+    return header, np.asarray(img)

@@ -1,0 +1,112 @@
+// RecordIO native core.
+//
+// ref: dmlc-core src/recordio.cc (RecordIOWriter/RecordIOReader) and
+// include/dmlc/recordio.h — the packed-record container every MXNet data
+// pipeline reads (magic-framed records, 29-bit length + 3-bit continuation
+// flag, 4-byte alignment).  This is the framework's native IO layer: the
+// Python recordio module binds it via ctypes (no pybind11 in this image)
+// and falls back to a pure-Python twin when the shared object is absent.
+//
+// Build: make -C src   (produces ../mxnet_tpu/_lib/librecordio.so)
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+inline uint32_t EncodeLRec(uint32_t cflag, uint32_t length) {
+  return (cflag << 29U) | (length & ((1U << 29U) - 1U));
+}
+inline uint32_t DecodeFlag(uint32_t rec) { return (rec >> 29U) & 7U; }
+inline uint32_t DecodeLength(uint32_t rec) { return rec & ((1U << 29U) - 1U); }
+
+struct Handle {
+  FILE* fp = nullptr;
+  bool writable = false;
+  std::vector<char> buf;  // read buffer, owned by the handle
+};
+
+}  // namespace
+
+extern "C" {
+
+void* rio_open(const char* path, int writable) {
+  FILE* fp = std::fopen(path, writable ? "wb" : "rb");
+  if (!fp) return nullptr;
+  Handle* h = new Handle();
+  h->fp = fp;
+  h->writable = writable != 0;
+  return h;
+}
+
+void rio_close(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (!h) return;
+  if (h->fp) std::fclose(h->fp);
+  delete h;
+}
+
+// Append one record; returns its start offset (the .idx key target), or -1.
+int64_t rio_write(void* handle, const char* data, uint64_t size) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (!h || !h->writable) return -1;
+  int64_t pos = std::ftell(h->fp);
+  uint32_t magic = kMagic;
+  // Single-part record (cflag 0): the reference splits only on embedded
+  // magic collisions inside multi-part payloads; framing with length makes
+  // that unnecessary, and single-part is what MXRecordIO emits in practice.
+  uint32_t lrec = EncodeLRec(0, static_cast<uint32_t>(size));
+  if (std::fwrite(&magic, 4, 1, h->fp) != 1) return -1;
+  if (std::fwrite(&lrec, 4, 1, h->fp) != 1) return -1;
+  if (size && std::fwrite(data, 1, size, h->fp) != size) return -1;
+  uint64_t pad = (4 - (size & 3U)) & 3U;
+  if (pad) {
+    static const char zeros[4] = {0, 0, 0, 0};
+    if (std::fwrite(zeros, 1, pad, h->fp) != pad) return -1;
+  }
+  return pos;
+}
+
+// Read the next record into the handle-owned buffer.
+// Returns length >= 0, -1 on EOF, -2 on corrupt framing.
+int64_t rio_read(void* handle, const char** out) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (!h || h->writable) return -2;
+  uint32_t magic = 0, lrec = 0;
+  if (std::fread(&magic, 4, 1, h->fp) != 1) return -1;  // EOF
+  if (magic != kMagic) return -2;
+  if (std::fread(&lrec, 4, 1, h->fp) != 1) return -2;
+  uint64_t size = DecodeLength(lrec);
+  if (DecodeFlag(lrec) != 0) return -2;  // multi-part unsupported (unused)
+  h->buf.resize(size);
+  if (size && std::fread(h->buf.data(), 1, size, h->fp) != size) return -2;
+  uint64_t pad = (4 - (size & 3U)) & 3U;
+  if (pad) std::fseek(h->fp, static_cast<long>(pad), SEEK_CUR);
+  *out = h->buf.data();
+  return static_cast<int64_t>(size);
+}
+
+int rio_seek(void* handle, int64_t pos) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (!h) return -1;
+  return std::fseek(h->fp, static_cast<long>(pos), SEEK_SET);
+}
+
+int64_t rio_tell(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (!h) return -1;
+  return std::ftell(h->fp);
+}
+
+int rio_flush(void* handle) {
+  Handle* h = static_cast<Handle*>(handle);
+  if (!h) return -1;
+  return std::fflush(h->fp);
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+"""AMP (ref: tests/python/unittest/test_amp.py / test_amp_init.py —
+list-driven casting, loss scaling, convert_model)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+
+
+@pytest.fixture(autouse=True)
+def _amp_cleanup():
+    yield
+    amp._deinit_for_tests()
+
+
+def test_target_ops_cast_down():
+    amp.init()
+    x = mx.nd.array(np.random.randn(4, 8).astype(np.float32))
+    w = mx.nd.array(np.random.randn(8, 8).astype(np.float32))
+    out = mx.nd.dot(x, w)
+    assert str(out.dtype) == "bfloat16"          # matmul ran on the MXU type
+
+
+def test_fp32_ops_cast_up():
+    amp.init()
+    x = mx.nd.array(np.random.randn(4, 8).astype(np.float32)).astype("bfloat16")
+    out = mx.nd.softmax(x, axis=-1)
+    assert str(out.dtype) == "float32"           # numerically sensitive
+
+
+def test_widest_type_unification():
+    amp.init()
+    a = mx.nd.array(np.ones((3,), np.float32))
+    b = a.astype("bfloat16")
+    out = mx.nd.invoke("add", a, b)
+    assert str(out.dtype) == "float32"
+
+
+def test_untouched_without_init():
+    x = mx.nd.array(np.random.randn(4, 8).astype(np.float32))
+    w = mx.nd.array(np.random.randn(8, 8).astype(np.float32))
+    assert str(mx.nd.dot(x, w).dtype) == "float32"
+
+
+def test_gradients_flow_through_amp_casts():
+    """The cast inserted by AMP must stay on the tape: param grads in f32."""
+    amp.init()
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 8).astype(np.float32))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g = net.weight.data().grad
+    assert g is not None
+    assert float((g._data ** 2).sum()) > 0       # grads reached the f32 param
+    assert str(net.weight.data().dtype) == "float32"
+
+
+def test_amp_training_converges():
+    amp.init()
+    mx.random.seed(0)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(16, activation="relu", in_units=4),
+            gluon.nn.Dense(1, in_units=16))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "adam", {"learning_rate": 0.01})
+    amp.init_trainer(tr)                          # bf16: scaler is a no-op
+    assert tr._amp_loss_scaler is None
+    loss_fn = gluon.loss.L2Loss()
+    x = np.random.randn(32, 4).astype(np.float32)
+    y = (x.sum(1, keepdims=True) * 0.5).astype(np.float32)
+    first = last = None
+    for _ in range(40):
+        with autograd.record():
+            loss = loss_fn(net(mx.nd.array(x)), mx.nd.array(y))
+        loss.backward()
+        tr.step(32)
+        v = float(loss.mean().asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first * 0.2, (first, last)
+
+
+def test_fp16_loss_scaler_mechanics():
+    amp.init(target_dtype="float16")
+    net = gluon.nn.Dense(2, in_units=2)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    scaler = tr._amp_loss_scaler
+    assert scaler is not None and scaler.loss_scale == 2.0 ** 16
+    # overflow halves the scale and skips the update
+    w0 = net.weight.data().asnumpy().copy()
+    x = mx.nd.array(np.ones((1, 2), np.float32))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    net.weight.data().grad._data = np.array(
+        [[np.inf, 1.0], [1.0, 1.0]], np.float32)
+    tr.step(1)
+    np.testing.assert_allclose(net.weight.data().asnumpy(), w0)
+    assert scaler.loss_scale == 2.0 ** 15
+    # clean step updates (scaled loss folded into rescale); scale_loss
+    # nests inside record like the reference's documented pattern
+    with autograd.record():
+        loss = net(x).sum()
+        with amp.scale_loss(loss, tr) as scaled:
+            scaled.backward()
+    tr.step(1)
+    assert not np.allclose(net.weight.data().asnumpy(), w0)
+
+
+def test_convert_model():
+    net = gluon.nn.Dense(3, in_units=3)
+    net.initialize()
+    amp.convert_model(net)
+    assert str(net.weight.data().dtype) == "bfloat16"

@@ -1,0 +1,120 @@
+"""Aux subsystems: callbacks (SURVEY §5.5), custom-op escape hatch
+(ref: src/operator/custom/custom.cc; tests/python/unittest/test_operator.py
+test_custom_op), storage introspection, packed gradient compression."""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, callback, gluon, operator
+
+
+# ------------------------------------------------------------- callbacks ----
+def test_speedometer_logs(caplog):
+    sp = callback.Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    metric = mx.metric.Accuracy()
+    metric.update([mx.nd.array([1, 1])], [mx.nd.array([[0.1, 0.9],
+                                                       [0.2, 0.8]])])
+    with caplog.at_level(logging.INFO):
+        for nb in range(1, 5):
+            sp(callback.BatchEndParam(epoch=0, nbatch=nb, eval_metric=metric))
+    assert any("samples/sec" in r.message for r in caplog.records)
+    assert any("accuracy" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint(tmp_path):
+    net = gluon.nn.Dense(3, in_units=2)
+    net.initialize()
+    cb = callback.do_checkpoint(str(tmp_path / "model"), period=2)
+    cb(0, net)   # epoch 1: no save
+    cb(1, net)   # epoch 2: save
+    assert not (tmp_path / "model-0001.params").exists()
+    assert (tmp_path / "model-0002.params").exists()
+    net2 = gluon.nn.Dense(3, in_units=2)
+    net2.load_parameters(str(tmp_path / "model-0002.params"))
+    np.testing.assert_allclose(net2.weight.data().asnumpy(),
+                               net.weight.data().asnumpy())
+
+
+# ------------------------------------------------------------- custom op ----
+@operator.register("scaled_square")
+class ScaledSquareProp(operator.CustomOpProp):
+    def __init__(self, scale=2.0):
+        super().__init__(need_top_grad=True)
+        self._scale = float(scale)
+
+    def create_operator(self, ctx, shapes, dtypes):
+        outer = self
+
+        class ScaledSquare(operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                x = in_data[0]
+                self.assign(out_data[0], req[0], x * x * outer._scale)
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                x = in_data[0]
+                self.assign(in_grad[0], req[0],
+                            out_grad[0] * 2.0 * outer._scale * x)
+
+        return ScaledSquare()
+
+
+def test_custom_op_forward_and_grad():
+    x = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    out = mx.nd.Custom(x, op_type="scaled_square", scale=3.0)
+    np.testing.assert_allclose(out.asnumpy(), [3, 12, 27])
+    x.attach_grad()
+    with autograd.record():
+        y = mx.nd.Custom(x, op_type="scaled_square")
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4, 8, 12])  # 2*2*x
+
+
+def test_custom_op_unknown_name():
+    with pytest.raises(ValueError, match="not registered"):
+        mx.nd.Custom(mx.nd.ones((2,)), op_type="nope")
+
+
+# -------------------------------------------------------------- storage -----
+def test_memory_info_surface():
+    info = mx.current_context().memory_info()
+    assert isinstance(info, dict)   # CPU backends may report {}
+    free, total = mx.gpu_memory_info()
+    assert free <= total
+
+
+# ------------------------------------------- gradient compression packing ---
+def test_2bit_pack_roundtrip():
+    from mxnet_tpu.kvstore.kvstore import (_pack_2bit, _quant_2bit,
+                                           _unpack_sum_2bit)
+    import jax.numpy as jnp
+    rng = np.random.RandomState(0)
+    g = jnp.asarray(rng.randn(7, 13).astype(np.float32))
+    q, res = _quant_2bit(g, jnp.zeros_like(g), 0.5)
+    packed = _pack_2bit(q)
+    assert packed.dtype == jnp.uint8
+    assert packed.size == int(np.ceil(g.size / 4))       # 16x smaller than f32
+    back = _unpack_sum_2bit(packed[None], jnp.float32(0.5), tuple(g.shape),
+                            str(g.dtype))
+    np.testing.assert_allclose(np.asarray(back), np.asarray(q))
+    # multi-peer decode+sum in one shot
+    both = _unpack_sum_2bit(jnp.stack([packed, packed]), jnp.float32(0.5),
+                            tuple(g.shape), str(g.dtype))
+    np.testing.assert_allclose(np.asarray(both), 2 * np.asarray(q))
+    # error feedback preserved: q + residual == original
+    np.testing.assert_allclose(np.asarray(q + res), np.asarray(g), rtol=1e-6)
+
+
+def test_compression_end_to_end_single_process():
+    kv = mx.kv.create("device")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, mx.nd.zeros((8,)))
+    kv.push(0, mx.nd.array(np.array([1.0, -1.0, 0.1, -0.1, 2.0, 0.0, 0.7,
+                                     -0.7], np.float32)))
+    out = mx.nd.zeros((8,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(
+        out.asnumpy(), [0.5, -0.5, 0.0, 0.0, 0.5, 0.0, 0.5, -0.5])

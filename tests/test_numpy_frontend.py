@@ -211,3 +211,30 @@ def test_set_np_flips_frontend_output_type():
     finally:
         npx.reset_np()
     assert type(net(mx.nd.ones((1, 2)))) is mx.nd.NDArray
+
+
+def test_np_autograd_through_shape_methods():
+    """reshape/transpose/astype/npx ops must stay on the tape (regression:
+    earlier versions wrapped raw jnp results and silently zeroed grads)."""
+    from mxnet_tpu import autograd
+    x = mnp.array(onp.ones((2, 3), onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x.reshape(-1).sum()
+    y.backward()
+    _close(x.grad, onp.ones((2, 3)))
+    x.attach_grad()
+    with autograd.record():
+        y = (x.transpose() * 2).sum()
+    y.backward()
+    _close(x.grad, 2 * onp.ones((2, 3)))
+    x.attach_grad()
+    with autograd.record():
+        y = npx.log_softmax(x, axis=-1).sum()
+    y.backward()
+    assert float(onp.abs(onp.asarray(x.grad._data)).sum()) < 1e-5  # uniform
+    x.attach_grad()
+    with autograd.record():
+        y = (x.astype("float32") ** 2).sum()
+    y.backward()
+    _close(x.grad, 2 * onp.ones((2, 3)))

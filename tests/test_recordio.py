@@ -1,0 +1,200 @@
+"""RecordIO + mx.io iterators (ref: tests/python/unittest/test_recordio.py,
+test_io.py — roundtrips, indexed access, pack/unpack_img, NDArrayIter
+last-batch semantics, ImageRecordIter end-to-end over an im2rec-packed dir)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio, io
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_native_library_built():
+    """The C++ core must actually be in use (built from src/recordio.cc)."""
+    assert recordio._LIB is not None, "native librecordio.so missing/unbuilt"
+
+
+def test_recordio_roundtrip(tmp_path):
+    f = str(tmp_path / "t.rec")
+    w = recordio.MXRecordIO(f, "w")
+    payloads = [b"hello", b"x" * 1, b"y" * 7, b"", b"z" * 4096]
+    for p in payloads:
+        w.write(p)
+    w.close()
+    r = recordio.MXRecordIO(f, "r")
+    got = []
+    while True:
+        rec = r.read()
+        if rec is None:
+            break
+        got.append(rec)
+    assert got == payloads
+    r.reset()
+    assert r.read() == payloads[0]
+    r.close()
+
+
+def test_python_fallback_format_compat(tmp_path):
+    """Native writer ↔ pure-Python reader (and vice versa): same format."""
+    if recordio._LIB is None:
+        pytest.skip("no native lib to cross-check")
+    f = str(tmp_path / "x.rec")
+    w = recordio.MXRecordIO(f, "w")
+    w.write(b"abc123")
+    w.write(b"defgh")
+    w.close()
+    # read with the pure-python path by masking the lib
+    saved = recordio._LIB
+    try:
+        recordio._LIB = None
+        r = recordio.MXRecordIO(f, "r")
+        assert r.read() == b"abc123" and r.read() == b"defgh"
+        r.close()
+        g = str(tmp_path / "y.rec")
+        w2 = recordio.MXRecordIO(g, "w")
+        w2.write(b"pure-python")
+        w2.close()
+    finally:
+        recordio._LIB = saved
+    r2 = recordio.MXRecordIO(g, "r")
+    assert r2.read() == b"pure-python"
+    r2.close()
+
+
+def test_indexed_recordio(tmp_path):
+    f, fi = str(tmp_path / "t.rec"), str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(fi, f, "w")
+    for i in range(10):
+        w.write_idx(i, f"record-{i}".encode())
+    w.close()
+    assert os.path.exists(fi)
+    r = recordio.MXIndexedRecordIO(fi, f, "r")
+    assert r.keys == list(range(10))
+    assert r.read_idx(7) == b"record-7"
+    assert r.read_idx(0) == b"record-0"
+    assert r.read_idx(9) == b"record-9"
+    r.close()
+
+
+def test_pack_unpack_header():
+    h = recordio.IRHeader(0, 3.0, 42, 0)
+    s = recordio.pack(h, b"payload")
+    h2, p = recordio.unpack(s)
+    assert h2.label == 3.0 and h2.id == 42 and p == b"payload"
+    # float-array label via flag
+    h = recordio.IRHeader(0, [1.0, 2.0, 3.0], 1, 0)
+    h2, p = recordio.unpack(recordio.pack(h, b"xy"))
+    np.testing.assert_allclose(h2.label, [1, 2, 3])
+    assert p == b"xy"
+
+
+def test_pack_unpack_img():
+    img = (np.random.rand(24, 32, 3) * 255).astype(np.uint8)
+    s = recordio.pack_img(recordio.IRHeader(0, 5.0, 0, 0), img,
+                          img_fmt=".png")
+    h, back = recordio.unpack_img(s)
+    assert h.label == 5.0
+    np.testing.assert_array_equal(back, img)  # png is lossless
+    s = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                          quality=95, img_fmt=".jpg")
+    _, backj = recordio.unpack_img(s)
+    assert backj.shape == img.shape
+
+
+def test_ndarray_iter_pad_and_discard():
+    x = np.arange(25, dtype=np.float32).reshape(25, 1)
+    y = np.arange(25, dtype=np.float32)
+    it = io.NDArrayIter(x, y, batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3 and batches[-1].pad == 5
+    assert batches[0].data[0].shape == (10, 1)
+    it2 = io.NDArrayIter(x, y, batch_size=10, last_batch_handle="discard")
+    assert len(list(it2)) == 2
+    # second epoch works (reset protocol)
+    assert len(list(it2)) == 2
+    desc = it.provide_data[0]
+    assert desc.name == "data" and desc.shape == (10, 1)
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    x = np.arange(12, dtype=np.float32).reshape(12, 1)
+    it = io.NDArrayIter(x, None, batch_size=4, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(12))
+
+
+def _make_img_tree(root, n_classes=2, per_class=6):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    for c in range(n_classes):
+        d = os.path.join(root, f"class{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = (rng.rand(40 + c, 48, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"img{i}.png"))
+
+
+def test_im2rec_and_image_record_iter(tmp_path):
+    root = str(tmp_path / "imgs")
+    _make_img_tree(root)
+    prefix = str(tmp_path / "data")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, root],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert os.path.exists(prefix + ".rec") and os.path.exists(prefix + ".idx")
+
+    it = io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                            data_shape=(3, 32, 32), batch_size=5,
+                            shuffle=True, rand_mirror=True)
+    batches = list(it)
+    assert len(batches) == 3  # 12 imgs, round_batch pads to 15
+    b = batches[0]
+    assert b.data[0].shape == (5, 3, 32, 32)
+    assert b.label[0].shape == (5,)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert set(labels.astype(int).tolist()) == {0, 1}
+    # second epoch
+    assert len(list(it)) == 3
+
+
+def test_image_record_iter_normalisation(tmp_path):
+    root = str(tmp_path / "imgs")
+    _make_img_tree(root, n_classes=1, per_class=3)
+    prefix = str(tmp_path / "n")
+    import tools.im2rec as im2rec
+    im2rec.pack(prefix, root)
+    it = io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                            data_shape=(3, 24, 24), batch_size=3,
+                            mean_r=127.0, mean_g=127.0, mean_b=127.0,
+                            std_r=58.0, std_g=58.0, std_b=58.0)
+    b = next(iter(it))
+    v = b.data[0].asnumpy()
+    assert abs(v.mean()) < 0.5 and 0.2 < v.std() < 3.0
+
+
+def test_loader_throughput_smoke(tmp_path):
+    """Packed-record read path sanity: sustained records/s through the
+    native core (not a hard perf gate on shared CI hosts)."""
+    import time
+    f, fi = str(tmp_path / "t.rec"), str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(fi, f, "w")
+    payload = os.urandom(64 * 1024)  # 64 KB ≈ a JPEG
+    for i in range(512):
+        w.write_idx(i, payload)
+    w.close()
+    r = recordio.MXIndexedRecordIO(fi, f, "r")
+    t0 = time.perf_counter()
+    for i in range(512):
+        assert len(r.read_idx(i)) == len(payload)
+    dt = time.perf_counter() - t0
+    rate = 512 / dt
+    mb_s = rate * 64 / 1024
+    print(f"indexed read: {rate:.0f} rec/s ({mb_s:.0f} MB/s)")
+    assert rate > 2000, f"native indexed read too slow: {rate:.0f} rec/s"
