@@ -48,12 +48,13 @@ KNOBS = {k.name: k for k in [
          "Trace output path for the autostarted profiler."),
     Knob("MXNET_SEED", None, int,
          "Global PRNG seed applied at import (mx.random.seed)."),
-    Knob("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", 1, int,
-         "Log when a sparse input is densified by a dense-only operator."),
-    Knob("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,
-         "Arrays larger than this (elements) use the big-array gradient "
-         "compression path in the kvstore."),
     # --- accepted for compatibility (no-ops under XLA/PJRT, documented) --
+    Knob("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", 1, int,
+         "No silent sparse→dense fallback exists here: dense-only ops raise "
+         "a storage-type error instead (mxnet_tpu/ndarray).", wired=False),
+    Knob("MXNET_KVSTORE_BIGARRAY_BOUND", 1000000, int,
+         "Server-side big-array sharding bound — no parameter servers here "
+         "(collectives over ICI/DCN).", wired=False),
     Knob("MXNET_EXEC_BULK_EXEC_TRAIN", 1, int,
          "Engine bulking — subsumed by hybridize/jit whole-graph compile.",
          wired=False),

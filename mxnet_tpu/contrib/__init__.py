@@ -1,0 +1,2 @@
+"""mx.contrib (ref: python/mxnet/contrib/)."""
+from . import quantization

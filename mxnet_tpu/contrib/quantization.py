@@ -1,0 +1,158 @@
+"""Post-training INT8 quantization with calibration.
+
+ref: python/mxnet/contrib/quantization.py — quantize_model / quantize_net +
+calibrate.cc (min/max and entropy collectors).  TPU-native flow for gluon:
+
+    qnet = quantize_net(net, calib_data=loader)     # swaps Dense/Conv2D
+    out = qnet(x)                                   # int8 MXU matmuls
+
+Calibration wraps every Dense/Conv2D in a range collector, runs the
+calibration batches, then swaps in quantized layers whose int8 weights are
+pre-computed and whose activations quantize with the calibrated ranges
+(``calib_mode='naive'`` min/max over batches, the reference's default for
+its naive collector).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+from ..ndarray import NDArray
+
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D"]
+
+
+class _RangeCollector(HybridBlock):
+    """Wraps a layer; records min/max of its input during calibration."""
+
+    def __init__(self, inner, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.inner = inner
+        self.min_v = None
+        self.max_v = None
+
+    def forward(self, x):
+        a = np.asarray(x._data)
+        mn, mx = float(a.min()), float(a.max())
+        self.min_v = mn if self.min_v is None else min(self.min_v, mn)
+        self.max_v = mx if self.max_v is None else max(self.max_v, mx)
+        return self.inner(x)
+
+
+def _q8(w):
+    amax = float(np.abs(w).max()) or 1e-10
+    scale = 127.0 / amax
+    return np.clip(np.round(w * scale), -127, 127).astype(np.int8), amax
+
+
+class QuantizedDense(HybridBlock):
+    """int8 Dense with calibrated activation range (ref:
+    quantized_fully_connected.cc)."""
+
+    def __init__(self, dense, min_act, max_act, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        w = dense.weight.data().asnumpy()
+        self._wq, self._wmax = _q8(w)
+        self._amax = max(abs(min_act), abs(max_act)) or 1e-10
+        self._bias = (dense.bias.data().asnumpy()
+                      if dense.bias is not None else None)
+        self._flatten = getattr(dense, "_flatten", True)
+
+    def forward(self, x):
+        from .. import ndarray as F
+        scale = 127.0 / self._amax
+        xq = F.clip(F.round(x * scale), -127, 127).astype("int8")
+        out = F.quantized_fully_connected(
+            xq, F.array(self._wq),
+            F.array(self._bias) if self._bias is not None else None,
+            -self._amax, self._amax, -self._wmax, self._wmax,
+            no_bias=self._bias is None, flatten=self._flatten)
+        return out
+
+
+class QuantizedConv2D(HybridBlock):
+    """int8 Conv2D with calibrated activation range (ref:
+    quantized_conv.cc)."""
+
+    def __init__(self, conv, min_act, max_act, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        w = conv.weight.data().asnumpy()
+        self._wq, self._wmax = _q8(w)
+        self._amax = max(abs(min_act), abs(max_act)) or 1e-10
+        self._bias = (conv.bias.data().asnumpy()
+                      if conv.bias is not None else None)
+        self._kwargs = dict(conv._kwargs)
+        self._act_type = conv._act_type
+
+    def forward(self, x):
+        from .. import ndarray as F
+        scale = 127.0 / self._amax
+        xq = F.clip(F.round(x * scale), -127, 127).astype("int8")
+        out = F.quantized_conv(
+            xq, F.array(self._wq),
+            F.array(self._bias) if self._bias is not None else None,
+            -self._amax, self._amax, -self._wmax, self._wmax,
+            kernel=self._kwargs["kernel"], stride=self._kwargs["stride"],
+            pad=self._kwargs["pad"], num_filter=self._kwargs["num_filter"],
+            num_group=self._kwargs["num_group"],
+            no_bias=self._bias is None, layout=self._kwargs.get("layout"))
+        if self._act_type:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+
+def _walk_swap(block, make):
+    for name, child in list(block._children.items()):
+        repl = make(child)
+        if repl is not None:
+            block._children[name] = repl
+            # attribute references (self.dense = ...) must follow too
+            for attr, val in list(vars(block).items()):
+                if val is child:
+                    object.__setattr__(block, attr, repl)
+        else:
+            _walk_swap(child, make)
+
+
+def quantize_net(net, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=()):
+    """Swap Dense/Conv2D layers for int8 versions, calibrating activation
+    ranges over ``calib_data`` (an iterable of input batches or
+    (data, label) tuples).  Returns the same net object, modified in place
+    (ref: quantize_net; the reference rewrites the symbol graph — here the
+    block tree is rewritten)."""
+    assert quantized_dtype == "int8", "int8 is the TPU-native narrow type"
+    if calib_data is None:
+        raise ValueError("calib_data is required (naive min/max calibration)")
+
+    # 1) wrap targets in range collectors
+    def wrap(child):
+        if isinstance(child, (nn.Dense, nn.Conv2D)) and \
+                child.name not in exclude_layers:
+            return _RangeCollector(child)
+        return None
+
+    _walk_swap(net, wrap)
+
+    # 2) run calibration batches
+    for batch in calib_data:
+        x = batch[0] if isinstance(batch, (tuple, list)) else batch
+        if not isinstance(x, NDArray):
+            from .. import ndarray as F
+            x = F.array(x)
+        net(x)
+
+    # 3) swap collectors for quantized layers
+    def swap(child):
+        if isinstance(child, _RangeCollector):
+            if child.min_v is None:
+                return child.inner      # never exercised: keep float
+            inner = child.inner
+            if isinstance(inner, nn.Conv2D):
+                return QuantizedConv2D(inner, child.min_v, child.max_v)
+            return QuantizedDense(inner, child.min_v, child.max_v)
+        return None
+
+    _walk_swap(net, swap)
+    return net

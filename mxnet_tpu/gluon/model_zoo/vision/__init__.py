@@ -4,6 +4,7 @@ from .alexnet import *
 from .densenet import *
 from .mobilenet import *
 from .resnet import *
+from .inception import *
 from .squeezenet import *
 from .vgg import *
 
@@ -30,6 +31,7 @@ def get_model(name, **kwargs):
         "mobilenet0.5": mobilenet0_5, "mobilenet0.25": mobilenet0_25,
         "mobilenetv2_1.0": mobilenet_v2_1_0, "mobilenetv2_0.75": mobilenet_v2_0_75,
         "mobilenetv2_0.5": mobilenet_v2_0_5, "mobilenetv2_0.25": mobilenet_v2_0_25,
+        "inceptionv3": inception_v3,
     }
     name = name.lower()
     if name not in models:

@@ -89,7 +89,9 @@ class NDArrayIter(DataIter):
         self._n = self._data[0][1].shape[0] if self._data else 0
         for _, a in self._data + self._label:
             assert a.shape[0] == self._n, "data/label batch axes disagree"
-        self._order = np.arange(self._n)
+        self._base_order = np.arange(self._n)
+        self._order = self._base_order
+        self._leftover = None
         self.reset()
 
     @staticmethod
@@ -108,8 +110,14 @@ class NDArrayIter(DataIter):
         return out
 
     def reset(self):
+        self._order = self._base_order.copy()
         if self._shuffle:
             np.random.shuffle(self._order)
+        if self._last == "roll_over" and self._leftover is not None:
+            # remainder from the previous pass leads this epoch (ref:
+            # NDArrayIter roll_over semantics)
+            self._order = np.concatenate([self._leftover, self._order])
+            self._leftover = None
         self._cursor = 0
 
     @property
@@ -123,18 +131,21 @@ class NDArrayIter(DataIter):
                 for k, v in self._label]
 
     def next(self):
-        if self._cursor >= self._n:
+        n = len(self._order)
+        if self._cursor >= n:
             raise StopIteration
         end = self._cursor + self.batch_size
         pad = 0
-        if end > self._n:
+        if end > n:
             if self._last == "discard":
                 raise StopIteration
             if self._last == "pad":
-                pad = end - self._n
+                pad = end - n
             elif self._last == "roll_over":
-                raise StopIteration  # remainder carried to next epoch pass
-        idx = self._order[self._cursor:min(end, self._n)]
+                # stash the remainder; reset() prepends it next epoch
+                self._leftover = self._order[self._cursor:]
+                raise StopIteration
+        idx = self._order[self._cursor:min(end, n)]
         if pad:
             idx = np.concatenate([idx, self._order[:pad]])
         self._cursor = end
@@ -189,8 +200,19 @@ class ImageRecordIter(DataIter):
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=-1, preprocess_threads=0, seed=0,
-                 round_batch=True, **kwargs):
+                 round_batch=True, label_width=1, **kwargs):
         super().__init__(batch_size)
+        _IGNORED_OK = {"prefetch_buffer", "data_name", "label_name",
+                       "verify_decode", "num_parts", "part_index",
+                       "shuffle_chunk_size", "shuffle_chunk_seed",
+                       "inter_method", "dtype", "ctx", "device_id"}
+        unknown = set(kwargs) - _IGNORED_OK
+        if unknown:
+            raise TypeError(f"ImageRecordIter: unsupported options "
+                            f"{sorted(unknown)} (supported reference "
+                            f"options with no TPU meaning are accepted "
+                            f"silently: {sorted(_IGNORED_OK)})")
+        self._label_width = int(label_width)
         self._shape = tuple(data_shape)  # (C, H, W)
         assert len(self._shape) == 3
         if path_imgidx is None:
@@ -264,7 +286,28 @@ class ImageRecordIter(DataIter):
 
     @property
     def provide_label(self):
-        return [DataDesc("softmax_label", (self.batch_size,))]
+        shape = (self.batch_size,) if self._label_width == 1 \
+            else (self.batch_size, self._label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def close(self):
+        """Release the record reader and the worker pool."""
+        if getattr(self, "_pool", None) is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+        if getattr(self, "_rec", None) is not None:
+            self._rec.close()
+            self._rec = None
+
+    def __del__(self):
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
 
     def next(self):
         if self._cursor >= len(self._order):
@@ -284,10 +327,16 @@ class ImageRecordIter(DataIter):
         else:
             decoded = [self._decode(k) for k in keys]
         imgs = np.stack([self._augment(img) for _, img in decoded])
-        labels = np.array(
-            [h.label if np.isscalar(h.label) or getattr(h.label, "ndim", 1) == 0
-             else np.asarray(h.label).ravel()[0] for h, _ in decoded],
-            np.float32)
+        lw = self._label_width
+
+        def lab(h):
+            v = np.asarray(h.label, np.float32).ravel()
+            if v.size < lw:
+                raise ValueError(
+                    f"record label has {v.size} values but label_width={lw}")
+            return v[0] if lw == 1 else v[:lw]
+
+        labels = np.stack([lab(h) for h, _ in decoded]).astype(np.float32)
         return DataBatch([_to_nd(imgs)], [_to_nd(labels)], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)

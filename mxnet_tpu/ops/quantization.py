@@ -59,3 +59,34 @@ def _quantized_matmul(a, b, scale_a, scale_b):
         a, b, (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
         preferred_element_type=jnp.int32)
     return acc.astype(jnp.float32) * (scale_a * scale_b)
+
+
+@register_op("quantized_conv")
+def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
+                    max_weight, kernel=None, stride=None, pad=None,
+                    num_filter=None, num_group=1, no_bias=True, layout=None):
+    """int8 convolution with int32 accumulation (ref: src/operator/
+    quantization/quantized_conv.cc).  Same layout contract as Convolution;
+    output is dequantised fp32 (the reference emits int32 + ranges — the
+    fp32 form composes with the rest of this frontend and XLA fuses the
+    rescale into the conv epilogue)."""
+    from .nn import _conv_layout, _tup
+    nd_ = data.ndim - 2
+    kernel = _tup(kernel, nd_)
+    stride = _tup(stride, nd_) if stride else (1,) * nd_
+    pad = _tup(pad, nd_) if pad else (0,) * nd_
+    _, dnl, chan_last = _conv_layout(layout, nd_)
+    dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, dnl)
+    acc = jax.lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=stride, padding=[(p, p) for p in pad],
+        dimension_numbers=dn, feature_group_count=num_group,
+        preferred_element_type=jnp.int32)
+    sx = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0
+    sw = jnp.maximum(jnp.abs(min_weight), jnp.abs(max_weight)) / 127.0
+    out = acc.astype(jnp.float32) * (sx * sw)
+    if bias is not None and not no_bias:
+        bshape = ((1,) * (nd_ + 1) + (-1,)) if chan_last \
+            else ((1, -1) + (1,) * nd_)
+        out = out + bias.astype(jnp.float32).reshape(bshape)
+    return out

@@ -141,9 +141,20 @@ def load_train_step(step, fname):
     step._train_arrays = new_train
     step._states = tuple(new_states)
     aux_names = [step._names[i] for i in step._aux_idx]
+    saved_aux = manifest["aux_names"]
+    if len(saved_aux) != len(aux_names):
+        raise ValueError(
+            f"checkpoint/model mismatch: file has {len(saved_aux)} aux "
+            f"arrays, model expects {len(aux_names)}")
     new_aux = list(step._aux_arrays)
-    for sk, wk in zip(_natural_order(manifest["aux_names"]),
-                      _natural_order(aux_names)):
+    for sk, wk in zip(_natural_order(saved_aux), _natural_order(aux_names)):
+        if _norm_name(saved_aux[sk]) != _norm_name(aux_names[wk]) or \
+                tuple(z[f"a.{sk}"].shape) != \
+                tuple(step._aux_arrays[wk].shape):
+            raise ValueError(
+                f"checkpoint/model mismatch: saved aux {saved_aux[sk]!r} "
+                f"{z[f'a.{sk}'].shape} does not match model aux "
+                f"{aux_names[wk]!r} {tuple(step._aux_arrays[wk].shape)}")
         new_aux[wk] = jax.device_put(z[f"a.{sk}"], aux_shard[wk])
     step._aux_arrays = new_aux
     step._num_update = manifest["num_update"]

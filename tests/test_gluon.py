@@ -246,6 +246,16 @@ def test_model_zoo_smoke():
     assert out.shape == (1, 10)
 
 
+def test_model_zoo_inception_v3():
+    import numpy as np
+    net = gluon.model_zoo.vision.get_model("inceptionv3", classes=7)
+    net.initialize()
+    out = net(nd.random.normal(shape=(1, 3, 299, 299)))
+    assert out.shape == (1, 7)
+    n = sum(int(np.prod(p.shape)) for p in net.collect_params().values())
+    assert 20e6 < n < 30e6   # the reference's ~23.8M at 1000 classes
+
+
 def test_block_repr_and_summary(capsys):
     net = nn.HybridSequential()
     net.add(nn.Dense(4, in_units=3))

@@ -118,3 +118,53 @@ def test_compression_end_to_end_single_process():
     kv.pull(0, out=out)
     np.testing.assert_allclose(
         out.asnumpy(), [0.5, -0.5, 0.0, 0.0, 0.5, 0.0, 0.5, -0.5])
+
+
+# ---------------------------------------------------- int8 quantization -----
+def test_quantized_conv_matches_float():
+    import jax.numpy as jnp
+    from mxnet_tpu.ndarray import invoke
+    rng = np.random.RandomState(0)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32) * 0.2
+    # quantize inputs/weights with known ranges
+    ax, aw = np.abs(x).max(), np.abs(w).max()
+    xq = np.clip(np.round(x * 127 / ax), -127, 127).astype(np.int8)
+    wq = np.clip(np.round(w * 127 / aw), -127, 127).astype(np.int8)
+    out = invoke("quantized_conv", mx.nd.array(xq), mx.nd.array(wq), None,
+                 -float(ax), float(ax), -float(aw), float(aw),
+                 kernel=(3, 3), stride=(1, 1), pad=(1, 1), num_filter=4,
+                 no_bias=True)
+    ref = invoke("Convolution", mx.nd.array(x), mx.nd.array(w), None,
+                 kernel=(3, 3), stride=(1, 1), pad=(1, 1), num_filter=4,
+                 no_bias=True)
+    err = np.abs(out.asnumpy() - ref.asnumpy()).max()
+    scale = np.abs(ref.asnumpy()).max()
+    assert err / scale < 0.05, (err, scale)   # int8 tolerance
+
+
+def test_quantize_net_calibrated():
+    """quantize_net: calibrate + swap; int8 net tracks the float net and
+    keeps argmax predictions mostly identical (ref: quantize_net flow)."""
+    from mxnet_tpu.contrib.quantization import (quantize_net, QuantizedConv2D,
+                                                QuantizedDense)
+    mx.random.seed(0)
+    rng = np.random.RandomState(1)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=1, in_channels=3,
+                            activation="relu"),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+            gluon.nn.Dense(5, in_units=8))
+    net.initialize(mx.init.Xavier())
+    calib = [rng.randn(4, 3, 12, 12).astype(np.float32) for _ in range(3)]
+    test = mx.nd.array(rng.randn(16, 3, 12, 12).astype(np.float32))
+    ref = net(test).asnumpy()
+
+    quantize_net(net, calib_data=calib)
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert "QuantizedConv2D" in kinds and "QuantizedDense" in kinds
+    got = net(test).asnumpy()
+    agree = (got.argmax(1) == ref.argmax(1)).mean()
+    assert agree >= 0.8, agree
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.2, rel

@@ -933,6 +933,7 @@ COVERED_ELSEWHERE = {
     "ulysses_attention": "tests/test_sequence_parallel.py",
     "moe_ffn": "tests/test_moe.py",
     "flash_attention": "tests/test_flash_attention.py",
+    "quantized_conv": "tests/test_misc_subsystems.py",
 }
 
 

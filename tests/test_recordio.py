@@ -198,3 +198,40 @@ def test_loader_throughput_smoke(tmp_path):
     mb_s = rate * 64 / 1024
     print(f"indexed read: {rate:.0f} rec/s ({mb_s:.0f} MB/s)")
     assert rate > 2000, f"native indexed read too slow: {rate:.0f} rec/s"
+
+
+def test_ndarray_iter_roll_over():
+    """roll_over: the remainder leads the NEXT epoch (reference semantics)."""
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    it = io.NDArrayIter(x, None, batch_size=4, last_batch_handle="roll_over")
+    e1 = [b.data[0].asnumpy().ravel().tolist() for b in it]
+    assert e1 == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    e2 = [b.data[0].asnumpy().ravel().tolist() for b in it]
+    # remainder 8,9 leads epoch 2
+    assert e2[0] == [8, 9, 0, 1]
+    assert len(e2) == 3  # 12 indices -> 3 full batches
+
+
+def test_image_record_iter_label_width_and_close(tmp_path):
+    from mxnet_tpu import recordio as rio
+    f, fi = str(tmp_path / "m.rec"), str(tmp_path / "m.idx")
+    w = rio.MXIndexedRecordIO(fi, f, "w")
+    from PIL import Image
+    import io as pyio
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        img = (rng.rand(16, 16, 3) * 255).astype(np.uint8)
+        header = rio.IRHeader(0, [float(i), float(i * 10), 7.0], i, 0)
+        w.write_idx(i, rio.pack_img(header, img, img_fmt=".png"))
+    w.close()
+    with io.ImageRecordIter(path_imgrec=f, data_shape=(3, 16, 16),
+                            batch_size=2, label_width=3) as it:
+        b = next(iter(it))
+        assert b.label[0].shape == (2, 3)
+        np.testing.assert_allclose(b.label[0].asnumpy()[0], [0, 0, 7])
+    # context manager closed the reader
+    assert it._rec is None
+    import pytest as _pytest
+    with _pytest.raises(TypeError, match="unsupported options"):
+        io.ImageRecordIter(path_imgrec=f, data_shape=(3, 16, 16),
+                           batch_size=2, not_a_real_option=1)

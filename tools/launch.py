@@ -18,6 +18,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 
 def _free_port():
@@ -37,11 +38,30 @@ def main(argv=None):
                         "localhost rehearsal)")
     p.add_argument("--devices-per-worker", type=int, default=0,
                    help="with --platform cpu: virtual CPU devices per worker")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="elastic mode: if any worker dies, tear the job "
+                        "down and relaunch the whole gang up to N times "
+                        "(pair with TrainStep checkpoints to resume; the "
+                        "reference has no equivalent — SURVEY §5.3 names "
+                        "failure recovery as a gap to exceed)")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     if not args.command:
         p.error("no command given")
 
+    attempt = 0
+    while True:
+        rc = _run_gang(args, attempt)
+        if rc == 0 or attempt >= args.max_restarts:
+            return rc
+        attempt += 1
+        print(f"[launch] job failed (rc={rc}); restart "
+              f"{attempt}/{args.max_restarts}", file=sys.stderr)
+
+
+def _run_gang(args, attempt):
+    """One gang launch: all workers, fresh coordinator port; kill the gang
+    when any worker dies (partial gangs deadlock in collectives)."""
     port = _free_port()
     procs = []
     for i in range(args.num_workers):
@@ -52,6 +72,7 @@ def main(argv=None):
             "DMLC_PS_ROOT_PORT": str(port),
             "DMLC_NUM_WORKER": str(args.num_workers),
             "DMLC_WORKER_ID": str(i),
+            "DMLC_ATTEMPT": str(attempt),
         })
         if args.platform:
             env["JAX_PLATFORMS"] = args.platform
@@ -67,15 +88,29 @@ def main(argv=None):
         procs.append(subprocess.Popen(args.command, env=env))
 
     rc = 0
-    for i, proc in enumerate(procs):
-        r = proc.wait()
-        if r != 0:
-            print(f"worker {i} exited with {r}", file=sys.stderr)
-            rc = rc or r
+    alive = set(range(len(procs)))
+    while alive and rc == 0:
+        for i in sorted(alive):
+            r = procs[i].poll()
+            if r is None:
+                continue
+            alive.discard(i)
+            if r != 0:
+                print(f"worker {i} exited with {r}", file=sys.stderr)
+                rc = r
+                break
+        else:
+            time.sleep(0.05)
     if rc:
+        # fail-fast gang teardown (a dead peer hangs the others' collectives)
         for proc in procs:
             if proc.poll() is None:
                 proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
     return rc
 
 
