@@ -1,0 +1,181 @@
+"""Per-operator microbenchmark harness.
+
+ref: benchmark/opperf/opperf.py — the reference sweeps registered ops by
+category with default input configs and reports per-op fwd/bwd latency.
+Same shape here: curated categories over the op registry, each op timed
+in eager dispatch (the MXImperativeInvokeEx-equivalent path) and under
+jit (the hybridize/CachedOp path), so the dispatch overhead the engine
+design is meant to amortise is visible per op.
+
+Usage:
+    python benchmark/opperf.py                    # all categories, table
+    python benchmark/opperf.py --category nn
+    python benchmark/opperf.py --ops exp,dot --json
+    python benchmark/opperf.py --size large       # TPU-scale shapes
+
+Emits one JSON line per op with --json (driver-friendly).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd, engine
+
+
+def _rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+def _arr(shape, seed=0, lo=-1.0, hi=1.0, dtype=np.float32):
+    return nd.array(_rs(seed).uniform(lo, hi, shape).astype(dtype))
+
+
+# Each entry: op name → (inputs_fn(size), kwargs).  size: "small" | "large".
+def _shapes(size):
+    big = size == "large"
+    return {
+        "elem": (1024, 1024) if big else (64, 64),
+        "mat_m": 2048 if big else 64,
+        "batch": 32 if big else 4,
+        "conv_hw": 56 if big else 12,
+        "conv_c": 64 if big else 8,
+        "seq": 512 if big else 32,
+        "hidden": 1024 if big else 32,
+        "vocab": 32768 if big else 128,
+    }
+
+
+def op_configs(size="small"):
+    s = _shapes(size)
+    e = s["elem"]
+    m = s["mat_m"]
+    b, c, hw = s["batch"], s["conv_c"], s["conv_hw"]
+    cfg = {}
+
+    def add(cat, name, inputs, kwargs=None):
+        cfg.setdefault(cat, []).append((name, inputs, kwargs or {}))
+
+    for u in ["exp", "log", "tanh", "sigmoid", "sqrt", "square", "relu",
+              "erf", "rsqrt", "abs"]:
+        add("unary", u, lambda e=e: [_arr(e, lo=0.1, hi=2.0)])
+    for bi in ["broadcast_add", "broadcast_mul", "broadcast_div",
+               "broadcast_maximum", "broadcast_power"]:
+        add("binary", bi,
+            lambda e=e: [_arr(e, 1, 0.1, 2.0), _arr(e, 2, 0.1, 2.0)])
+    for r in ["sum", "mean", "max", "norm"]:
+        add("reduce", r, lambda e=e: [_arr(e)], {"axis": 1})
+    add("matrix", "dot", lambda m=m: [_arr((m, m), 1), _arr((m, m), 2)])
+    add("matrix", "batch_dot",
+        lambda b=b, m=m: [_arr((b, m, m // 4), 1), _arr((b, m // 4, m), 2)])
+    add("matrix", "FullyConnected",
+        lambda b=b, m=m: [_arr((b, m), 1), _arr((m, m), 2), _arr((m,), 3)],
+        {"num_hidden": m})
+    add("nn", "Convolution",
+        lambda b=b, c=c, hw=hw: [_arr((b, c, hw, hw), 1),
+                                 _arr((c, c, 3, 3), 2), _arr((c,), 3)],
+        {"kernel": (3, 3), "num_filter": c, "pad": (1, 1)})
+    add("nn", "BatchNorm",
+        lambda b=b, c=c, hw=hw: [_arr((b, c, hw, hw), 1), _arr((c,), 2),
+                                 _arr((c,), 3), _arr((c,), 4, 0, 1),
+                                 _arr((c,), 5, 0.5, 1.5)])
+    add("nn", "Pooling",
+        lambda b=b, c=c, hw=hw: [_arr((b, c, hw, hw), 1)],
+        {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"})
+    add("nn", "softmax", lambda b=b, m=m: [_arr((b, m), 1)])
+    add("nn", "LayerNorm",
+        lambda b=b, m=m: [_arr((b, m), 1), _arr((m,), 2, 0.5, 1.5),
+                          _arr((m,), 3)])
+    add("indexing", "take",
+        lambda m=m: [_arr((m, 64), 1),
+                     nd.array(_rs(2).randint(0, m, (128,)).astype(np.float32))])
+    add("indexing", "transpose", lambda e=e: [_arr(e, 1)])
+    add("indexing", "slice", lambda e=e: [_arr(e, 1)],
+        {"begin": (0, 0), "end": (e[0] // 2, e[1] // 2)})
+    add("indexing", "concat",
+        lambda e=e: [_arr(e, 1), _arr(e, 2)], {"dim": 0})
+    add("optimizer", "sgd_mom_update",
+        lambda e=e: [_arr(e, 1), _arr(e, 2), _arr(e, 3)],
+        {"lr": 0.1, "momentum": 0.9, "wd": 1e-4})
+    add("optimizer", "adam_update",
+        lambda e=e: [_arr(e, 1), _arr(e, 2), _arr(e, 3),
+                     _arr(e, 4, 0.1, 1.0)],
+        {"lr": 0.001, "beta1": 0.9, "beta2": 0.999, "epsilon": 1e-8,
+         "wd": 0.0})
+    return cfg
+
+
+def time_op(name, inputs_fn, kwargs, warmup=3, runs=20):
+    """Time one op: eager dispatch and compiled-cache-hit latency."""
+    inputs = inputs_fn()
+    for _ in range(warmup):
+        out = nd.invoke(name, *inputs, **kwargs)
+    engine.waitall()
+    t0 = time.perf_counter()
+    for _ in range(runs):
+        out = nd.invoke(name, *inputs, **kwargs)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    out.wait_to_read()
+    engine.waitall()
+    t1 = time.perf_counter()
+    return (t1 - t0) / runs * 1e3
+
+
+def run_performance_test(ops=None, category=None, size="small",
+                         warmup=3, runs=20):
+    """→ list of {op, category, avg_time_ms} (ref: run_performance_test)."""
+    results = []
+    for cat, entries in op_configs(size).items():
+        if category and cat != category:
+            continue
+        for name, inputs_fn, kwargs in entries:
+            if ops and name not in ops:
+                continue
+            try:
+                ms = time_op(name, inputs_fn, kwargs, warmup, runs)
+                results.append({"op": name, "category": cat,
+                                "avg_time_ms": round(ms, 4)})
+            except Exception as exc:  # keep sweeping; report the failure
+                results.append({"op": name, "category": cat,
+                                "error": f"{type(exc).__name__}: {exc}"})
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ops", help="comma-separated op names")
+    ap.add_argument("--category", help="one category only")
+    ap.add_argument("--size", choices=["small", "large"], default="small")
+    ap.add_argument("--runs", type=int, default=20)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    ops = set(args.ops.split(",")) if args.ops else None
+    results = run_performance_test(ops, args.category, args.size,
+                                   runs=args.runs)
+    if not results:
+        print("no ops matched the given --ops/--category filters",
+              file=sys.stderr)
+        sys.exit(1)
+    if args.json:
+        for r in results:
+            print(json.dumps(r))
+        return
+    w = max(len(r["op"]) for r in results) + 2
+    print(f"{'op':<{w}}{'category':<12}{'avg_ms':>10}")
+    for r in results:
+        val = r.get("avg_time_ms")
+        print(f"{r['op']:<{w}}{r['category']:<12}"
+              f"{val if val is not None else r['error']:>10}")
+
+
+if __name__ == "__main__":
+    main()

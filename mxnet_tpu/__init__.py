@@ -29,6 +29,7 @@ from . import context
 from .context import (Context, cpu, tpu, gpu, cpu_pinned,
                       current_context, num_tpus, num_gpus, gpu_memory_info)
 from . import engine
+from . import storage
 from . import random
 from . import autograd
 from . import ndarray
@@ -53,6 +54,7 @@ ndarray.sparse = sparse      # mx.nd.sparse, matching the reference layout
 from . import numpy as np           # mx.np — numpy-semantics frontend
 from . import numpy_extension as npx  # mx.npx — set_np + neural ops
 from . import profiler
+from . import onnx
 from . import parallel
 from . import gluon
 

@@ -61,9 +61,24 @@ KNOBS = {k.name: k for k in [
     Knob("MXNET_EXEC_BULK_EXEC_INFERENCE", 1, int,
          "Engine bulking — subsumed by jit.", wired=False),
     Knob("MXNET_GPU_MEM_POOL_RESERVE", 5, int,
-         "GPU memory pool — HBM is managed by PJRT.", wired=False),
+         "Percent of MXNET_HOST_MEM_POOL_LIMIT_MB kept out of the host "
+         "staging-buffer pool (device HBM itself is managed by PJRT; see "
+         "mxnet_tpu/storage.py)."),
     Knob("MXNET_GPU_MEM_POOL_TYPE", "Naive", str,
-         "GPU memory pool — HBM is managed by PJRT.", wired=False),
+         "Host staging-buffer pool strategy: Naive (exact-size buckets), "
+         "Round (pow2 buckets below the linear cutoff), or Unpooled "
+         "(ref: pooled_storage_manager.h; device HBM stays with PJRT)."),
+    Knob("MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF", 24, int,
+         "Round-pool strategy: sizes below 2^cutoff round to a power of "
+         "two; above, to a page multiple."),
+    Knob("MXNET_HOST_MEM_POOL_LIMIT_MB", 256, int,
+         "Upper bound on host staging buffers retained by the pool."),
+    Knob("MXNET_STORAGE_ACCOUNTING", 1, int,
+         "1 = every NDArray registers its bytes with the storage manager "
+         "(mx.storage.stats(), gpu_memory_info fallback); 0 disables."),
+    Knob("MXNET_TPU_HBM_CAPACITY_MB", 16384, int,
+         "Assumed per-chip HBM capacity when the PJRT plugin reports no "
+         "memory_stats (v5e = 16 GB); used by gpu_memory_info."),
     Knob("MXNET_CUDNN_AUTOTUNE_DEFAULT", 1, int,
          "cuDNN algo search — XLA picks conv strategies at compile time.",
          wired=False),
@@ -110,6 +125,9 @@ def _apply_startup():
     if seed is not None:
         from . import random as _random
         _random.seed(int(seed))
+    if not get("MXNET_STORAGE_ACCOUNTING"):
+        from . import storage
+        storage.set_accounting(False)
     if get("MXNET_PROFILER_AUTOSTART"):
         import atexit
 

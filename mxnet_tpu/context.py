@@ -107,9 +107,14 @@ class Context:
         introspection surface; ref: storage.cc GetMemoryPoolInfo /
         mx.context.gpu_memory_info).  Keys follow PJRT's memory_stats
         (bytes_in_use, peak_bytes_in_use, bytes_limit, ...); CPU backends
-        without stats return {}."""
+        without stats return the framework-side storage accounting only
+        (mxnet_tpu/storage.py)."""
         stats = self.device.memory_stats()
-        return dict(stats) if stats else {}
+        out = dict(stats) if stats else {}
+        from . import storage
+        out["framework_live_bytes"] = storage.live_bytes(str(self))
+        out["framework_peak_bytes"] = storage.stats(str(self))["peak_bytes"]
+        return out
 
     @classmethod
     def default_ctx(cls):
@@ -151,7 +156,13 @@ def gpu_memory_info(device_id: int = 0):
     stats = Context("tpu", device_id).memory_info()
     total = stats.get("bytes_limit", 0)
     used = stats.get("bytes_in_use", 0)
-    return (total - used, total)
+    if not total:
+        # PJRT plugin reports no memory_stats (axon tunnel): fall back to
+        # the configured HBM capacity minus framework-accounted live bytes.
+        from . import config
+        total = int(config.get("MXNET_TPU_HBM_CAPACITY_MB")) << 20
+        used = stats.get("framework_live_bytes", 0)
+    return (max(0, total - used), total)
 
 
 def current_context() -> Context:

@@ -58,6 +58,7 @@ class QuantizedDense(HybridBlock):
         self._bias = (dense.bias.data().asnumpy()
                       if dense.bias is not None else None)
         self._flatten = getattr(dense, "_flatten", True)
+        self._act_type = getattr(dense, "_act_type", None)
 
     def forward(self, x):
         from .. import ndarray as F
@@ -68,6 +69,8 @@ class QuantizedDense(HybridBlock):
             F.array(self._bias) if self._bias is not None else None,
             -self._amax, self._amax, -self._wmax, self._wmax,
             no_bias=self._bias is None, flatten=self._flatten)
+        if self._act_type:
+            out = F.Activation(out, act_type=self._act_type)
         return out
 
 
@@ -96,7 +99,8 @@ class QuantizedConv2D(HybridBlock):
             kernel=self._kwargs["kernel"], stride=self._kwargs["stride"],
             pad=self._kwargs["pad"], num_filter=self._kwargs["num_filter"],
             num_group=self._kwargs["num_group"],
-            no_bias=self._bias is None, layout=self._kwargs.get("layout"))
+            no_bias=self._bias is None, layout=self._kwargs.get("layout"),
+            dilate=self._kwargs.get("dilate"))
         if self._act_type:
             out = F.Activation(out, act_type=self._act_type)
         return out
@@ -121,10 +125,21 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
     ranges over ``calib_data`` (an iterable of input batches or
     (data, label) tuples).  Returns the same net object, modified in place
     (ref: quantize_net; the reference rewrites the symbol graph — here the
-    block tree is rewritten)."""
+    block tree is rewritten).
+
+    Hybridization is suspended during calibration (collectors read
+    concrete values) and restored afterwards — if ``net`` was hybridized,
+    the quantized net comes back hybridized and recompiles on first call."""
     assert quantized_dtype == "int8", "int8 is the TPU-native narrow type"
     if calib_data is None:
         raise ValueError("calib_data is required (naive min/max calibration)")
+
+    # The rewrite changes the forward graph: drop any compiled caches and
+    # run calibration eagerly (range collectors read concrete values);
+    # hybridization state is restored after the swap.
+    was_active = bool(getattr(net, "_active", False))
+    was_flags = dict(getattr(net, "_flags", {}) or {})
+    net.hybridize(False)
 
     # 1) wrap targets in range collectors
     def wrap(child):
@@ -155,4 +170,7 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
         return None
 
     _walk_swap(net, swap)
+    net._invalidate_cache()
+    if was_active:
+        net.hybridize(True, **was_flags)
     return net

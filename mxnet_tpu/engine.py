@@ -14,11 +14,14 @@ and data dependencies order execution.  This module therefore only supplies the
 
 A bounded ring of recently produced arrays backs ``waitall``; PJRT guarantees
 program order per device so blocking on the newest arrays is a full barrier.
+The ring holds weak references — tracking must not extend buffer lifetime
+(256 pinned activations would hold real HBM).
 """
 from __future__ import annotations
 
 import collections
 import threading
+import weakref
 
 import jax
 
@@ -44,8 +47,12 @@ def track(arr):
         except Exception:
             pass
         return arr
+    try:
+        ref = weakref.ref(arr)
+    except TypeError:
+        return arr  # non-weakref-able (plain numpy on cpu ctx): nothing async
     with _LOCK:
-        _RECENT.append(arr)
+        _RECENT.append(ref)
     return arr
 
 
@@ -58,7 +65,10 @@ def waitall():
     with _LOCK:
         pending = list(_RECENT)
         _RECENT.clear()
-    for a in pending:
+    for ref in pending:
+        a = ref()
+        if a is None:
+            continue  # collected — its work is done or unobservable
         try:
             jax.block_until_ready(a)
         except Exception:  # deleted/donated buffers are already "done"

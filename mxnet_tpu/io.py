@@ -234,6 +234,7 @@ class ImageRecordIter(DataIter):
                              np.float32)
         self._rng = np.random.RandomState(seed)
         self._round = round_batch
+        self._inflight = None  # previous batch's pooled buffer handle
         self._pool = None
         if preprocess_threads and preprocess_threads > 1:
             import multiprocessing as mp
@@ -292,6 +293,10 @@ class ImageRecordIter(DataIter):
 
     def close(self):
         """Release the record reader and the worker pool."""
+        if getattr(self, "_inflight", None) is not None:
+            from . import storage
+            storage.Storage.get().free(self._inflight)
+            self._inflight = None
         if getattr(self, "_pool", None) is not None:
             self._pool.terminate()
             self._pool.join()
@@ -326,7 +331,23 @@ class ImageRecordIter(DataIter):
                                        iscolor) for k in keys])
         else:
             decoded = [self._decode(k) for k in keys]
-        imgs = np.stack([self._augment(img) for _, img in decoded])
+        # Batch buffers come from the pooled host allocator (ref:
+        # iter_batchloader.h out_ double-buffer): the PREVIOUS batch's
+        # buffer recycles now — its device copy had a full batch interval
+        # to complete, and the jnp.asarray conversion in nd.array copies
+        # (measured: no host aliasing on cpu or tpu backends), so next()
+        # never blocks on the transfer.
+        from . import storage
+        if self._inflight is not None:
+            storage.Storage.get().free(self._inflight)
+            self._inflight = None
+        c, h, w = self._shape
+        nbytes = self.batch_size * c * h * w * 4
+        handle = storage.Storage.get().alloc(nbytes)
+        imgs = handle.dptr.view(np.float32).reshape(
+            (self.batch_size, c, h, w))
+        for i, (_, img) in enumerate(decoded):
+            imgs[i] = self._augment(img)
         lw = self._label_width
 
         def lab(h):
@@ -337,9 +358,11 @@ class ImageRecordIter(DataIter):
             return v[0] if lw == 1 else v[:lw]
 
         labels = np.stack([lab(h) for h, _ in decoded]).astype(np.float32)
-        return DataBatch([_to_nd(imgs)], [_to_nd(labels)], pad=pad,
-                         provide_data=self.provide_data,
-                         provide_label=self.provide_label)
+        batch = DataBatch([_to_nd(imgs)], [_to_nd(labels)], pad=pad,
+                          provide_data=self.provide_data,
+                          provide_label=self.provide_label)
+        self._inflight = handle
+        return batch
 
 
 _worker_rec = {}

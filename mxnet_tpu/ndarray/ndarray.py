@@ -28,6 +28,7 @@ from .. import engine as _engine
 from ..base import dtype_np
 from ..context import Context, current_context
 from .. import random as _random
+from .. import storage as _storage
 from ..ops.registry import (OPS, OP_META, compiled, get_op, params_key,
                             split_dynamic)
 
@@ -58,6 +59,9 @@ class NDArray:
         self._ctx = ctx if ctx is not None else current_context()
         self._grad = None
         self._grad_req = "null"
+        # storage-manager accounting (ref: Storage::Alloc bookkeeping);
+        # no-ops for tracers and when MXNET_STORAGE_ACCOUNTING=0.
+        _storage.on_create(self)
 
     # ------------------------------------------------------------ basics --
     @property

@@ -1,0 +1,15 @@
+"""ONNX export/import (ref: python/mxnet/onnx — mx2onnx / onnx2mx).
+
+Exports any (Hybrid)Block by translating the jaxpr of its functional
+forward into an ONNX graph (opset 13), writing the protobuf wire format
+directly (no onnx package in the image).  A minimal importer/evaluator
+supports round-trip validation and loading small inference models.
+
+    mx.onnx.export_model(net, example, "model.onnx")
+    fn = mx.onnx.import_to_function("model.onnx")
+"""
+from .export import export_model, export_function
+from .import_ import import_to_function, parse_model
+
+__all__ = ["export_model", "export_function", "import_to_function",
+           "parse_model"]

@@ -1,0 +1,560 @@
+"""ONNX export: jaxpr → ONNX graph translation.
+
+ref: python/mxnet/onnx/mx2onnx/ — ``export_model`` walks the captured
+symbol graph and emits one ONNX node per op via a translator registry.
+TPU-native substitution: the captured graph here IS the jaxpr of the
+block's functional forward (the same trace ``hybridize()`` compiles), so
+the exporter maps **jaxpr primitives** → ONNX ops.  That covers anything a
+HybridBlock does — model-zoo CNNs and MLPs export regardless of how their
+forward was written — rather than a fixed layer whitelist.
+
+Scope: inference graphs (training=False), opset 13, static shapes.
+Unsupported primitives raise with the primitive name (same contract as the
+reference's AttributeError per missing translator).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import proto
+
+# --- ONNX dtype codes ------------------------------------------------------
+
+_DTYPE = {
+    np.dtype(np.float32): 1, np.dtype(np.uint8): 2, np.dtype(np.int8): 3,
+    np.dtype(np.int32): 6, np.dtype(np.int64): 7, np.dtype(bool): 9,
+    np.dtype(np.float16): 10, np.dtype(np.float64): 11,
+}
+_BF16 = 16
+
+
+def _onnx_dtype(dt) -> int:
+    dt = np.dtype(dt) if dt != jnp.bfloat16 else None
+    if dt is None:
+        return _BF16
+    try:
+        return _DTYPE[dt]
+    except KeyError:
+        raise ValueError(f"dtype {dt} has no ONNX mapping") from None
+
+
+# --- proto builders --------------------------------------------------------
+
+
+def _tensor(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    return (proto.field_packed_varints(1, arr.shape)
+            + proto.field_varint(2, _onnx_dtype(arr.dtype))
+            + proto.field_str(8, name)
+            + proto.field_bytes(9, arr.tobytes()))
+
+
+def _attr(name: str, value) -> bytes:
+    out = proto.field_str(1, name)
+    if isinstance(value, bool):
+        out += proto.field_varint(3, int(value)) + proto.field_varint(20, 2)
+    elif isinstance(value, int):
+        out += proto.field_varint(3, value) + proto.field_varint(20, 2)
+    elif isinstance(value, float):
+        out += proto.field_float(2, value) + proto.field_varint(20, 1)
+    elif isinstance(value, str):
+        out += proto.field_bytes(4, value.encode()) + proto.field_varint(20, 3)
+    elif isinstance(value, (list, tuple)) and all(
+            isinstance(v, (int, np.integer)) for v in value):
+        out += proto.field_packed_varints(8, [int(v) for v in value])
+        out += proto.field_varint(20, 7)
+    else:
+        raise TypeError(f"attribute {name}: unsupported {type(value)}")
+    return out
+
+
+def _node(op_type: str, inputs, outputs, name: str, attrs: dict) -> bytes:
+    out = b"".join(proto.field_str(1, i) for i in inputs)
+    out += b"".join(proto.field_str(2, o) for o in outputs)
+    out += proto.field_str(3, name) + proto.field_str(4, op_type)
+    out += b"".join(proto.field_bytes(5, _attr(k, v))
+                    for k, v in attrs.items())
+    return out
+
+
+def _value_info(name: str, shape, dtype) -> bytes:
+    dims = b"".join(
+        proto.field_bytes(1, proto.field_varint(1, int(d))) for d in shape)
+    tensor_type = (proto.field_varint(1, _onnx_dtype(dtype))
+                   + proto.field_bytes(2, dims))
+    return (proto.field_str(1, name)
+            + proto.field_bytes(2, proto.field_bytes(1, tensor_type)))
+
+
+# --- the graph builder -----------------------------------------------------
+
+
+class _Graph:
+    def __init__(self):
+        self.nodes: list[bytes] = []
+        self.inits: list[bytes] = []
+        self._n = 0
+
+    def name(self, hint: str) -> str:
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def node(self, op_type, inputs, outputs=None, **attrs):
+        if outputs is None:
+            outputs = [self.name(op_type.lower())]
+        self.nodes.append(_node(op_type, inputs, outputs,
+                                self.name(op_type), attrs))
+        return outputs[0]
+
+    def const(self, arr, hint="const") -> str:
+        name = self.name(hint)
+        self.inits.append(_tensor(name, np.asarray(arr)))
+        return name
+
+    def const_i64(self, values, hint="shape") -> str:
+        return self.const(np.asarray(list(values), np.int64), hint)
+
+
+# --- primitive translators -------------------------------------------------
+
+_HANDLERS = {}
+
+
+def _reg(name):
+    def deco(fn):
+        _HANDLERS[name] = fn
+        return fn
+    return deco
+
+
+def _simple(prim, op):
+    @_reg(prim)
+    def _h(g, eqn, ins):
+        return g.node(op, ins)
+
+
+for _p, _o in [("add", "Add"), ("sub", "Sub"), ("mul", "Mul"),
+               ("div", "Div"), ("max", "Max"), ("min", "Min"),
+               ("neg", "Neg"), ("exp", "Exp"), ("log", "Log"),
+               ("tanh", "Tanh"), ("logistic", "Sigmoid"), ("sqrt", "Sqrt"),
+               ("abs", "Abs"), ("sign", "Sign"), ("floor", "Floor"),
+               ("ceil", "Ceil"), ("round", "Round"), ("erf", "Erf"),
+               ("sin", "Sin"), ("cos", "Cos"), ("pow", "Pow"),
+               ("rem", "Mod"), ("stop_gradient", "Identity"),
+               ("copy", "Identity"), ("not", "Not")]:
+    _simple(_p, _o)
+
+
+@_reg("rsqrt")
+def _rsqrt(g, eqn, ins):
+    return g.node("Reciprocal", [g.node("Sqrt", ins)])
+
+
+@_reg("integer_pow")
+def _ipow(g, eqn, ins):
+    y = eqn.params["y"]
+    return g.node("Pow", [ins[0], g.const(np.float32(y), "exp")])
+
+
+@_reg("convert_element_type")
+def _cast(g, eqn, ins):
+    return g.node("Cast", ins, to=_onnx_dtype(eqn.params["new_dtype"]))
+
+
+@_reg("clamp")
+def _clamp(g, eqn, ins):  # clamp(min, x, max) → Clip(x, min, max)
+    return g.node("Clip", [ins[1], ins[0], ins[2]])
+
+
+@_reg("select_n")
+def _select(g, eqn, ins):  # select_n(pred, case0, case1) — bool pred only
+    if len(ins) != 3:
+        raise _unsupported(eqn)
+    return g.node("Where", [ins[0], ins[2], ins[1]])
+
+
+@_reg("transpose")
+def _transpose(g, eqn, ins):
+    return g.node("Transpose", ins, perm=list(eqn.params["permutation"]))
+
+
+@_reg("reshape")
+def _reshape(g, eqn, ins):
+    if eqn.params.get("dimensions") is not None:
+        raise _unsupported(eqn, "reshape with dimensions")
+    shape = g.const_i64(eqn.outvars[0].aval.shape)
+    return g.node("Reshape", [ins[0], shape])
+
+
+@_reg("squeeze")
+def _squeeze(g, eqn, ins):
+    axes = g.const_i64(eqn.params["dimensions"], "axes")
+    return g.node("Squeeze", [ins[0], axes])
+
+
+@_reg("expand_dims")
+def _expand_dims(g, eqn, ins):
+    axes = g.const_i64(eqn.params["dimensions"], "axes")
+    return g.node("Unsqueeze", [ins[0], axes])
+
+
+@_reg("broadcast_in_dim")
+def _bcast(g, eqn, ins):
+    shape = eqn.params["shape"]
+    bdims = eqn.params["broadcast_dimensions"]
+    in_aval = eqn.invars[0].aval
+    # step 1: reshape so rank matches (1s everywhere except bdims)
+    mid = [1] * len(shape)
+    for src, dst in enumerate(bdims):
+        mid[dst] = in_aval.shape[src]
+    cur = ins[0]
+    if tuple(mid) != tuple(in_aval.shape):
+        cur = g.node("Reshape", [cur, g.const_i64(mid)])
+    if tuple(mid) != tuple(shape):
+        cur = g.node("Expand", [cur, g.const_i64(shape)])
+    return cur
+
+
+@_reg("reduce_sum")
+def _rsum(g, eqn, ins):
+    # opset 13: ReduceSum (alone among reduces) takes axes as an input
+    axes = g.const_i64(eqn.params["axes"], "axes")
+    return g.node("ReduceSum", [ins[0], axes], keepdims=0)
+
+
+def _reduce_attr(g, eqn, ins, op):
+    # opset 13: ReduceMax/Min/Prod take axes as an ATTRIBUTE (input form
+    # only arrives at opset 18)
+    return g.node(op, [ins[0]], axes=[int(a) for a in eqn.params["axes"]],
+                  keepdims=0)
+
+
+@_reg("reduce_max")
+def _rmax(g, eqn, ins):
+    return _reduce_attr(g, eqn, ins, "ReduceMax")
+
+
+@_reg("reduce_min")
+def _rmin(g, eqn, ins):
+    return _reduce_attr(g, eqn, ins, "ReduceMin")
+
+
+@_reg("reduce_prod")
+def _rprod(g, eqn, ins):
+    return _reduce_attr(g, eqn, ins, "ReduceProd")
+
+
+@_reg("argmax")
+def _argmax(g, eqn, ins):
+    axes = eqn.params["axes"]
+    out = g.node("ArgMax", ins, axis=int(axes[0]), keepdims=0)
+    return g.node("Cast", [out], to=_onnx_dtype(eqn.outvars[0].aval.dtype))
+
+
+@_reg("concatenate")
+def _concat(g, eqn, ins):
+    return g.node("Concat", ins, axis=int(eqn.params["dimension"]))
+
+
+@_reg("slice")
+def _slice(g, eqn, ins):
+    p = eqn.params
+    starts = g.const_i64(p["start_indices"], "starts")
+    ends = g.const_i64(p["limit_indices"], "ends")
+    axes = g.const_i64(range(len(p["start_indices"])), "axes")
+    steps = g.const_i64(p["strides"] or [1] * len(p["start_indices"]),
+                        "steps")
+    return g.node("Slice", [ins[0], starts, ends, axes, steps])
+
+
+@_reg("rev")
+def _rev(g, eqn, ins):
+    dims = eqn.params["dimensions"]
+    shape = eqn.invars[0].aval.shape
+    starts = g.const_i64([shape[d] - 1 for d in dims], "starts")
+    ends = g.const_i64([-(shape[d] + 1) for d in dims], "ends")
+    axes = g.const_i64(dims, "axes")
+    steps = g.const_i64([-1] * len(dims), "steps")
+    return g.node("Slice", [ins[0], starts, ends, axes, steps])
+
+
+@_reg("pad")
+def _pad(g, eqn, ins):
+    cfg = eqn.params["padding_config"]
+    if any(interior for _, _, interior in cfg):
+        raise _unsupported(eqn, "interior padding")
+    if any(lo < 0 or hi < 0 for lo, hi, _ in cfg):
+        raise _unsupported(eqn, "negative padding")
+    pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+    return g.node("Pad", [ins[0], g.const_i64(pads, "pads"), ins[1]])
+
+
+@_reg("dot_general")
+def _dot(g, eqn, ins):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    la, ra = eqn.invars[0].aval, eqn.invars[1].aval
+    lhs, rhs = ins
+    # common cases map to MatMul (numpy semantics): contract last-of-lhs
+    # with second-to-last-of-rhs (or only-dim), leading batch dims aligned.
+    nb = len(lb)
+    if (tuple(lb) == tuple(range(nb)) and tuple(rb) == tuple(range(nb))
+            and len(lc) == 1 and len(rc) == 1
+            and lc[0] == la.ndim - 1
+            and rc[0] == nb):  # rhs contracted dim right after batch dims
+        return g.node("MatMul", [lhs, rhs])
+    if not lb and len(lc) == 1 and len(rc) == 1:
+        # transpose operands into matmul position
+        if lc[0] != la.ndim - 1:
+            perm = [d for d in range(la.ndim) if d != lc[0]] + [lc[0]]
+            lhs = g.node("Transpose", [lhs], perm=perm)
+        if rc[0] != 0:
+            perm = [rc[0]] + [d for d in range(ra.ndim) if d != rc[0]]
+            rhs = g.node("Transpose", [rhs], perm=perm)
+        return g.node("MatMul", [lhs, rhs])
+    raise _unsupported(eqn, f"dot_general {eqn.params['dimension_numbers']}")
+
+
+@_reg("conv_general_dilated")
+def _conv(g, eqn, ins):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    nsp = len(p["window_strides"])
+    nchw = tuple(range(nsp + 2))
+    x, w = ins
+    if tuple(p["lhs_dilation"]) != (1,) * nsp:
+        raise _unsupported(eqn, "lhs_dilation (ConvTranspose)")
+    if tuple(dn.lhs_spec) != nchw:
+        # permute input to NC<spatial>
+        x = g.node("Transpose", [x], perm=list(dn.lhs_spec))
+    if tuple(dn.rhs_spec) != nchw:
+        w = g.node("Transpose", [w], perm=list(dn.rhs_spec))
+    pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
+    out = g.node("Conv", [x, w],
+                 strides=list(p["window_strides"]),
+                 pads=pads,
+                 dilations=list(p["rhs_dilation"]),
+                 group=int(p["feature_group_count"]))
+    if tuple(dn.out_spec) != nchw:
+        # out currently NC<spatial>; permute to the jaxpr's out layout
+        inv = [0] * (nsp + 2)
+        for onnx_pos, jax_pos in enumerate(dn.out_spec):
+            inv[jax_pos] = onnx_pos
+        out = g.node("Transpose", [out], perm=inv)
+    return out
+
+
+def _window_pool(g, eqn, ins, op, extra=None):
+    p = eqn.params
+    wd = p["window_dimensions"]
+    ws = p["window_strides"]
+    padding = p["padding"]
+    if tuple(p.get("base_dilation", (1,) * len(wd))) != (1,) * len(wd) or \
+            tuple(p.get("window_dilation", (1,) * len(wd))) != (1,) * len(wd):
+        raise _unsupported(eqn, "dilated pooling")
+    if wd[0] != 1 or wd[1] != 1:
+        raise _unsupported(eqn, f"pooling window {wd} (expect NCHW)")
+    pads = [lo for lo, _ in padding[2:]] + [hi for _, hi in padding[2:]]
+    attrs = dict(kernel_shape=list(wd[2:]), strides=list(ws[2:]), pads=pads)
+    if extra:
+        attrs.update(extra)
+    return g.node(op, ins, **attrs)
+
+
+@_reg("reduce_window_max")
+def _maxpool(g, eqn, ins):
+    return _window_pool(g, eqn, ins, "MaxPool")
+
+
+@_reg("reduce_window_sum")
+def _sumpool(g, eqn, ins):
+    # sum-pool = AveragePool × window_size (count_include_pad matches the
+    # framework's pooling op which pads with zeros and divides by k)
+    wd = eqn.params["window_dimensions"]
+    out = _window_pool(g, eqn, ins, "AveragePool",
+                       extra=dict(count_include_pad=1))
+    k = float(np.prod([d for d in wd if d > 1]) or 1)
+    return g.node("Mul", [out, g.const(np.float32(k), "winsize")])
+
+
+@_reg("iota")
+def _iota(g, eqn, ins):
+    aval = eqn.outvars[0].aval
+    if aval.ndim != 1:
+        raise _unsupported(eqn, "multi-dim iota")
+    arr = np.arange(aval.shape[0], dtype=aval.dtype)
+    return g.node("Identity", [g.const(arr, "iota")])
+
+
+def _inline(g, eqn, ins, env_run):
+    inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr")
+    closed = inner if hasattr(inner, "jaxpr") else None
+    jaxpr = closed.jaxpr if closed is not None else inner
+    consts = closed.consts if closed is not None else []
+    return env_run(jaxpr, consts, ins)
+
+
+def _unsupported(eqn, extra=""):
+    return NotImplementedError(
+        f"ONNX export: no translator for jaxpr primitive "
+        f"'{eqn.primitive.name}'{' — ' + extra if extra else ''} "
+        f"(ref: mx2onnx unsupported-op contract)")
+
+
+# comparison ops produce bool
+for _p, _o in [("eq", "Equal"), ("gt", "Greater"), ("lt", "Less"),
+               ("ge", "GreaterOrEqual"), ("le", "LessOrEqual")]:
+    _simple(_p, _o)
+
+
+@_reg("ne")
+def _ne(g, eqn, ins):
+    return g.node("Not", [g.node("Equal", ins)])
+
+
+# --- the walker ------------------------------------------------------------
+
+
+def _translate(closed_jaxpr, input_names, g: _Graph):
+    """Walk the jaxpr, emitting nodes; returns output names."""
+
+    def run(jaxpr, consts, in_names):
+        env = {}
+
+        def get(v):
+            if hasattr(v, "val"):  # jax core Literal
+                return g.const(np.asarray(v.val), "lit")
+            return env[v]
+
+        for var, cname in zip(jaxpr.constvars, consts):
+            env[var] = cname
+        for var, name in zip(jaxpr.invars, in_names):
+            env[var] = name
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            ins = [get(v) for v in eqn.invars]
+            if prim in ("jit", "pjit", "closed_call", "custom_jvp_call",
+                        "custom_vjp_call", "custom_vjp_call_jaxpr",
+                        "remat", "checkpoint", "custom_jvp_call_jaxpr"):
+                inner = eqn.params.get("jaxpr") or eqn.params.get("call_jaxpr") \
+                    or eqn.params.get("fun_jaxpr")
+                if inner is None:
+                    raise _unsupported(eqn, "no inner jaxpr")
+                if hasattr(inner, "jaxpr"):  # ClosedJaxpr
+                    cnames = [g.const(np.asarray(c), "const")
+                              for c in inner.consts]
+                    outs = run(inner.jaxpr, cnames, ins)
+                else:
+                    outs = run(inner, [], ins)
+                for var, name in zip(eqn.outvars, outs):
+                    env[var] = name
+                continue
+            handler = _HANDLERS.get(prim)
+            if handler is None:
+                raise _unsupported(eqn)
+            out = handler(g, eqn, ins)
+            if len(eqn.outvars) != 1:
+                raise _unsupported(eqn, "multi-output primitive")
+            env[eqn.outvars[0]] = out
+        return [get(v) for v in jaxpr.outvars]
+
+    jaxpr = closed_jaxpr.jaxpr
+    const_names = [g.const(np.asarray(c), "const") for c in closed_jaxpr.consts]
+    return run(jaxpr, const_names, input_names)
+
+
+# --- public API ------------------------------------------------------------
+
+
+def export_function(fn, example_args, path, input_names=None,
+                    param_arrays=None, param_names=None, model_name="mxnet_tpu"):
+    """Export ``fn(params, *inputs)`` (or ``fn(*inputs)`` when
+    ``param_arrays`` is None) to an ONNX file at ``path``."""
+    if param_arrays is not None:
+        closed = jax.make_jaxpr(fn)(list(param_arrays), *example_args)
+        n_params = len(param_arrays)
+    else:
+        closed = jax.make_jaxpr(fn)(*example_args)
+        n_params = 0
+
+    g = _Graph()
+    flat_in = closed.jaxpr.invars
+    if input_names is None:
+        input_names = [f"data{i}" if i else "data"
+                       for i in range(len(flat_in) - n_params)]
+    names = []
+    inputs_vi = []
+    for i, var in enumerate(flat_in):
+        if i < n_params:
+            pname = (param_names[i] if param_names is not None
+                     else f"param_{i}")
+            g.inits.append(_tensor(pname, np.asarray(param_arrays[i])))
+            names.append(pname)
+        else:
+            dname = input_names[i - n_params]
+            names.append(dname)
+            inputs_vi.append(_value_info(dname, var.aval.shape,
+                                         var.aval.dtype))
+
+    out_names = _translate(closed, names, g)
+    outputs_vi = []
+    final = []
+    for i, (oname, var) in enumerate(zip(out_names, closed.jaxpr.outvars)):
+        pub = f"output{i}" if i else "output"
+        g.node("Identity", [oname], outputs=[pub])
+        final.append(pub)
+        outputs_vi.append(_value_info(pub, var.aval.shape, var.aval.dtype))
+
+    graph = (b"".join(proto.field_bytes(1, n) for n in g.nodes)
+             + proto.field_str(2, model_name)
+             + b"".join(proto.field_bytes(5, t) for t in g.inits)
+             + b"".join(proto.field_bytes(11, v) for v in inputs_vi)
+             + b"".join(proto.field_bytes(12, v) for v in outputs_vi))
+    opset = proto.field_str(1, "") + proto.field_varint(2, 13)
+    model = (proto.field_varint(1, 8)              # ir_version 8
+             + proto.field_str(2, "mxnet_tpu")     # producer
+             + proto.field_str(3, "0.1")
+             + proto.field_bytes(7, graph)
+             + proto.field_bytes(8, opset))
+    with open(path, "wb") as f:
+        f.write(model)
+    return path
+
+
+def export_model(net, example_args, path, model_name=None, epoch=0):
+    """Export a (Hybrid)Block to ONNX (ref: mx.onnx.export_model).
+
+    ``example_args``: NDArray/ndarray example inputs defining input shapes.
+    Runs the block's forward once (eager, inference mode) to materialise
+    deferred-init params, then traces and translates.
+    """
+    from ..gluon.block import Block, _flatten_nd
+    from ..ndarray import NDArray
+    from ..parallel.functional import (FunctionalState, functional_call,
+                                       param_names_and_values)
+    from .. import autograd
+    from .. import random as _random
+
+    if not isinstance(example_args, (tuple, list)):
+        example_args = (example_args,)
+    nd_args = tuple(x if isinstance(x, NDArray) else NDArray(jnp.asarray(x))
+                    for x in example_args)
+    with autograd.pause():
+        Block.__call__(net, *nd_args)
+    names, plist, arrays = param_names_and_values(net)
+    leaves, tree = _flatten_nd(nd_args)
+    state = FunctionalState()
+    key = jax.random.PRNGKey(0)
+
+    def forward(params, *xs):
+        outs = functional_call(net, plist, list(params), tree, list(xs), key,
+                               False, state)
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    return export_function(
+        forward, tuple(l._data if isinstance(l, NDArray) else l
+                       for l in leaves),
+        path, param_arrays=list(arrays), param_names=list(names),
+        model_name=model_name or type(net).__name__)

@@ -1,0 +1,307 @@
+"""Minimal ONNX model reader + evaluator.
+
+ref: python/mxnet/onnx (onnx2mx import path).  Here the importer parses
+the ONNX binary directly (no onnx package in the image) and evaluates the
+graph with jax.numpy — enough to round-trip what export.py emits and to
+load small third-party inference models.  ``import_to_function`` returns
+``fn(*inputs) -> outputs``.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import proto
+
+_NP_DTYPE = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
+             7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+
+
+def _parse_tensor(buf: bytes):
+    dims, dtype, name, raw = [], 1, "", b""
+    i32, i64, f32 = [], [], []
+    for num, wt, v in proto.parse(buf):
+        if num == 1 and wt == 2:
+            dims.extend(proto.parse_packed_varints(v))
+        elif num == 1 and wt == 0:
+            dims.append(proto.unzigzag_int64(v))
+        elif num == 2:
+            dtype = v
+        elif num == 8:
+            name = v.decode()
+        elif num == 9:
+            raw = v
+        elif num == 4 and wt == 5:
+            f32.append(struct.unpack("<f", struct.pack("<I", v))[0])
+        elif num == 4 and wt == 2:
+            f32.extend(struct.unpack(f"<{len(v)//4}f", v))
+        elif num == 5 and wt == 2:
+            i32.extend(proto.parse_packed_varints(v))
+        elif num == 7 and wt == 2:
+            i64.extend(proto.parse_packed_varints(v))
+    np_dt = _NP_DTYPE.get(dtype)
+    if np_dt is None:
+        raise ValueError(f"tensor {name!r}: unsupported ONNX dtype {dtype}")
+    if raw:
+        arr = np.frombuffer(raw, dtype=np_dt).reshape(dims)
+    elif f32:
+        arr = np.asarray(f32, np_dt).reshape(dims)
+    elif i64 or i32:
+        arr = np.asarray(i64 or i32, np_dt).reshape(dims)
+    else:
+        arr = np.zeros(dims, np_dt)
+    return name, arr
+
+
+def _parse_attr(buf: bytes):
+    name, val = "", None
+    fields = dict()
+    ints = []
+    for num, wt, v in proto.parse(buf):
+        if num == 1:
+            name = v.decode()
+        elif num == 2:  # f (fixed32)
+            fields["f"] = struct.unpack("<f", struct.pack("<I", v))[0]
+        elif num == 3:
+            fields["i"] = proto.unzigzag_int64(v)
+        elif num == 4:
+            fields["s"] = v.decode()
+        elif num == 5:
+            fields["t"] = _parse_tensor(v)[1]
+        elif num == 8 and wt == 2:
+            ints.extend(proto.parse_packed_varints(v))
+        elif num == 8 and wt == 0:
+            ints.append(proto.unzigzag_int64(v))
+    if ints:
+        val = ints
+    else:
+        for k in ("i", "f", "s", "t"):
+            if k in fields:
+                val = fields[k]
+                break
+    return name, val
+
+
+def _parse_node(buf: bytes):
+    inputs, outputs, op_type, attrs = [], [], "", {}
+    for num, wt, v in proto.parse(buf):
+        if num == 1:
+            inputs.append(v.decode())
+        elif num == 2:
+            outputs.append(v.decode())
+        elif num == 4:
+            op_type = v.decode()
+        elif num == 5:
+            k, val = _parse_attr(v)
+            attrs[k] = val
+    return op_type, inputs, outputs, attrs
+
+
+def _parse_value_info_name(buf: bytes):
+    for num, wt, v in proto.parse(buf):
+        if num == 1:
+            return v.decode()
+    return ""
+
+
+def parse_model(path: str):
+    """→ (nodes, initializers, input_names, output_names)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    graph = None
+    for num, wt, v in proto.parse(data):
+        if num == 7:
+            graph = v
+    if graph is None:
+        raise ValueError("no GraphProto in model")
+    nodes, inits, ins, outs = [], {}, [], []
+    for num, wt, v in proto.parse(graph):
+        if num == 1:
+            nodes.append(_parse_node(v))
+        elif num == 5:
+            name, arr = _parse_tensor(v)
+            inits[name] = arr
+        elif num == 11:
+            ins.append(_parse_value_info_name(v))
+        elif num == 12:
+            outs.append(_parse_value_info_name(v))
+    return nodes, inits, ins, outs
+
+
+# --- evaluator -------------------------------------------------------------
+
+
+def _pool(x, kernel, strides, pads, op, count_include_pad=True):
+    ws = (1, 1) + tuple(kernel)
+    # ONNX default: strides of 1 along each spatial axis (NOT the kernel)
+    st = (1, 1) + tuple(strides or (1,) * len(kernel))
+    n = len(kernel)
+    pad_cfg = [(0, 0), (0, 0)] + [(pads[i], pads[i + n]) for i in range(n)] \
+        if pads else [(0, 0)] * (n + 2)
+    if op == "max":
+        init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+            else jnp.iinfo(x.dtype).min
+        return lax.reduce_window(x, init, lax.max, ws, st, pad_cfg)
+    s = lax.reduce_window(x, 0.0, lax.add, ws, st, pad_cfg)
+    if count_include_pad:
+        return s / float(np.prod(kernel))
+    ones = jnp.ones_like(x)
+    cnt = lax.reduce_window(ones, 0.0, lax.add, ws, st, pad_cfg)
+    return s / cnt
+
+
+def _eval_node(op, ins, attrs):
+    a = attrs.get
+    if op == "Identity":
+        return ins[0]
+    if op == "Add":
+        return ins[0] + ins[1]
+    if op == "Sub":
+        return ins[0] - ins[1]
+    if op == "Mul":
+        return ins[0] * ins[1]
+    if op == "Div":
+        return ins[0] / ins[1]
+    if op == "Mod":
+        return jnp.mod(ins[0], ins[1])
+    if op == "Max":
+        return jnp.maximum(ins[0], ins[1]) if len(ins) == 2 \
+            else jnp.max(jnp.stack(ins), 0)
+    if op == "Min":
+        return jnp.minimum(ins[0], ins[1]) if len(ins) == 2 \
+            else jnp.min(jnp.stack(ins), 0)
+    if op == "Neg":
+        return -ins[0]
+    if op in ("Exp", "Log", "Tanh", "Sqrt", "Abs", "Sign", "Floor", "Ceil",
+              "Sin", "Cos"):
+        return getattr(jnp, op.lower())(ins[0])
+    if op == "Round":
+        return jnp.round(ins[0])
+    if op == "Erf":
+        return jax.scipy.special.erf(ins[0])
+    if op == "Sigmoid":
+        return jax.nn.sigmoid(ins[0])
+    if op == "Reciprocal":
+        return 1.0 / ins[0]
+    if op == "Pow":
+        return jnp.power(ins[0], ins[1])
+    if op == "Not":
+        return jnp.logical_not(ins[0])
+    if op == "Equal":
+        return ins[0] == ins[1]
+    if op == "Greater":
+        return ins[0] > ins[1]
+    if op == "Less":
+        return ins[0] < ins[1]
+    if op == "GreaterOrEqual":
+        return ins[0] >= ins[1]
+    if op == "LessOrEqual":
+        return ins[0] <= ins[1]
+    if op == "Where":
+        return jnp.where(ins[0], ins[1], ins[2])
+    if op == "Clip":
+        lo = ins[1] if len(ins) > 1 else None
+        hi = ins[2] if len(ins) > 2 else None
+        return jnp.clip(ins[0], lo, hi)
+    if op == "Cast":
+        return ins[0].astype(_NP_DTYPE[a("to")])
+    if op == "Transpose":
+        return jnp.transpose(ins[0], a("perm"))
+    if op == "Reshape":
+        return jnp.reshape(ins[0], [int(d) for d in np.asarray(ins[1])])
+    if op == "Squeeze":
+        return jnp.squeeze(ins[0], tuple(int(d) for d in np.asarray(ins[1])))
+    if op == "Unsqueeze":
+        return jnp.expand_dims(ins[0],
+                               tuple(int(d) for d in np.asarray(ins[1])))
+    if op == "Expand":
+        return jnp.broadcast_to(
+            ins[0], np.broadcast_shapes(tuple(np.asarray(ins[1])),
+                                        ins[0].shape))
+    if op == "Concat":
+        return jnp.concatenate(ins, axis=a("axis"))
+    if op == "Slice":
+        starts = np.asarray(ins[1])
+        ends = np.asarray(ins[2])
+        axes = np.asarray(ins[3]) if len(ins) > 3 else np.arange(len(starts))
+        steps = np.asarray(ins[4]) if len(ins) > 4 else np.ones(len(starts),
+                                                               np.int64)
+        sl = [slice(None)] * ins[0].ndim
+        for s, e, ax, st in zip(starts, ends, axes, steps):
+            n = ins[0].shape[ax]
+            s, e, st = int(s), int(e), int(st)
+            e = None if (st < 0 and e < -n) else e
+            sl[int(ax)] = slice(s, e, st)
+        return ins[0][tuple(sl)]
+    if op == "Pad":
+        pads = np.asarray(ins[1])
+        n = len(pads) // 2
+        cfg = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
+        cval = float(np.asarray(ins[2])) if len(ins) > 2 else 0.0
+        return jnp.pad(ins[0], cfg, constant_values=cval)
+    if op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
+        fn = {"ReduceSum": jnp.sum, "ReduceMax": jnp.max,
+              "ReduceMin": jnp.min, "ReduceProd": jnp.prod}[op]
+        axes = tuple(int(d) for d in np.asarray(ins[1])) if len(ins) > 1 \
+            else tuple(a("axes") or range(ins[0].ndim))
+        return fn(ins[0], axis=axes, keepdims=bool(a("keepdims", 0)))
+    if op == "ArgMax":
+        return jnp.argmax(ins[0], axis=a("axis", 0)).astype(np.int64) \
+            if not a("keepdims", 0) else \
+            jnp.argmax(ins[0], axis=a("axis", 0), keepdims=True)
+    if op == "MatMul":
+        return jnp.matmul(ins[0], ins[1])
+    if op == "Gemm":
+        x = ins[0].T if a("transA") else ins[0]
+        w = ins[1].T if a("transB") else ins[1]
+        out = a("alpha", 1.0) * (x @ w)
+        if len(ins) > 2:
+            out = out + a("beta", 1.0) * ins[2]
+        return out
+    if op == "Conv":
+        nsp = ins[0].ndim - 2
+        strides = tuple(a("strides") or (1,) * nsp)
+        dil = tuple(a("dilations") or (1,) * nsp)
+        pads = a("pads") or [0] * (2 * nsp)
+        pad_cfg = [(pads[i], pads[i + nsp]) for i in range(nsp)]
+        out = lax.conv_general_dilated(
+            ins[0], ins[1], strides, pad_cfg, rhs_dilation=dil,
+            feature_group_count=a("group", 1))
+        if len(ins) > 2:
+            out = out + ins[2].reshape((1, -1) + (1,) * nsp)
+        return out
+    if op == "MaxPool":
+        return _pool(ins[0], a("kernel_shape"), a("strides"), a("pads"),
+                     "max")
+    if op == "AveragePool":
+        return _pool(ins[0], a("kernel_shape"), a("strides"), a("pads"),
+                     "avg", count_include_pad=bool(a("count_include_pad", 0)))
+    if op == "Relu":
+        return jnp.maximum(ins[0], 0)
+    if op == "Softmax":
+        return jax.nn.softmax(ins[0], axis=a("axis", -1))
+    if op == "Flatten":
+        ax = a("axis", 1)
+        return ins[0].reshape((int(np.prod(ins[0].shape[:ax])), -1))
+    raise NotImplementedError(f"ONNX import: unsupported op {op!r}")
+
+
+def import_to_function(path: str):
+    """Load an ONNX file → ``fn(*inputs) -> list of np.ndarray``."""
+    nodes, inits, in_names, out_names = parse_model(path)
+
+    def fn(*inputs):
+        env = {k: jnp.asarray(v) for k, v in inits.items()}
+        for name, x in zip(in_names, inputs):
+            env[name] = jnp.asarray(x)
+        for op, ins, outs, attrs in nodes:
+            vals = _eval_node(op, [env[i] for i in ins if i], dict(attrs))
+            env[outs[0]] = vals
+        return [np.asarray(env[o]) for o in out_names]
+
+    return fn

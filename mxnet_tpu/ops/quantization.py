@@ -64,7 +64,8 @@ def _quantized_matmul(a, b, scale_a, scale_b):
 @register_op("quantized_conv")
 def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
                     max_weight, kernel=None, stride=None, pad=None,
-                    num_filter=None, num_group=1, no_bias=True, layout=None):
+                    num_filter=None, num_group=1, no_bias=True, layout=None,
+                    dilate=None):
     """int8 convolution with int32 accumulation (ref: src/operator/
     quantization/quantized_conv.cc).  Same layout contract as Convolution;
     output is dequantised fp32 (the reference emits int32 + ranges — the
@@ -75,11 +76,13 @@ def _quantized_conv(data, weight, bias, min_data, max_data, min_weight,
     kernel = _tup(kernel, nd_)
     stride = _tup(stride, nd_) if stride else (1,) * nd_
     pad = _tup(pad, nd_) if pad else (0,) * nd_
+    dilate = _tup(dilate, nd_) if dilate else (1,) * nd_
     _, dnl, chan_last = _conv_layout(layout, nd_)
     dn = jax.lax.conv_dimension_numbers(data.shape, weight.shape, dnl)
     acc = jax.lax.conv_general_dilated(
         data.astype(jnp.int8), weight.astype(jnp.int8),
         window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate,
         dimension_numbers=dn, feature_group_count=num_group,
         preferred_element_type=jnp.int32)
     sx = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data)) / 127.0

@@ -1,0 +1,303 @@
+"""Storage manager — memory accounting + pooled host staging buffers.
+
+ref: src/storage/storage.cc — ``Storage::Get()->Alloc/Free``;
+src/storage/pooled_storage_manager.h — ``GPUPooledStorageManager`` (naive
+exact-size buckets) and ``GPUPooledRoundedStorageManager`` (power-of-two
+buckets below ``MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF``); knobs
+``MXNET_GPU_MEM_POOL_TYPE`` / ``MXNET_GPU_MEM_POOL_RESERVE``.
+
+TPU substitution: device (HBM) allocation inside compiled programs is
+planned by XLA and owned by PJRT — a user-level HBM pool would fight the
+runtime, so this build does NOT re-implement device pooling.  What stays
+the framework's job, and what this module provides:
+
+1. **Device-side accounting.**  Every live ``NDArray`` registers its
+   buffer bytes here, so live / peak / alloc-count introspection
+   (``storage.stats()``, ``mx.context.gpu_memory_info``) works even where
+   the PJRT plugin reports no ``memory_stats`` (the axon tunnel reports
+   none).  Counts are *logical tensor bytes held by the framework* — XLA
+   scratch and executable temps are intentionally out of scope (they are
+   visible via ``Context.memory_info`` where the plugin supports it).
+
+2. **Pooled host staging buffers.**  The data pipeline's batchify/pin
+   path and RecordIO readers reuse page-sized numpy buffers instead of
+   malloc churn, with the reference's two pooling strategies selected by
+   ``MXNET_GPU_MEM_POOL_TYPE``: ``Naive`` (exact-size free-lists) and
+   ``Round`` (power-of-two buckets below the linear cutoff).
+   ``MXNET_GPU_MEM_POOL_RESERVE`` caps the pool the same way the
+   reference reserves a fraction of device memory: the pool holds at most
+   ``(100 - reserve)%`` of ``MXNET_HOST_MEM_POOL_LIMIT_MB``.
+"""
+from __future__ import annotations
+
+import threading
+import weakref
+
+import numpy as np
+from jax import core as _jax_core
+
+__all__ = ["Storage", "Handle", "stats", "reset_peak", "pool_info",
+           "release_all", "on_create"]
+
+
+# ---------------------------------------------------------------------------
+# device-side accounting
+# ---------------------------------------------------------------------------
+
+class _DeviceStats:
+    __slots__ = ("live_bytes", "peak_bytes", "num_allocs", "num_frees",
+                 "live_arrays")
+
+    def __init__(self):
+        self.live_bytes = 0
+        self.peak_bytes = 0
+        self.num_allocs = 0
+        self.num_frees = 0
+        self.live_arrays = 0
+
+    def as_dict(self):
+        return {"live_bytes": self.live_bytes,
+                "peak_bytes": self.peak_bytes,
+                "num_allocs": self.num_allocs,
+                "num_frees": self.num_frees,
+                "live_arrays": self.live_arrays}
+
+
+_lock = threading.Lock()
+_by_device: dict[str, _DeviceStats] = {}
+# Buffers currently accounted, by id().  The finalizer is attached to the
+# BUFFER (jax/numpy array), not the NDArray wrapper: wrappers rebind
+# ``_data`` freely (in-place ops, out=, jit write-back) and several
+# wrappers can share one buffer (detach()) — tying lifetime to the buffer
+# makes the count exact under both, and id() reuse is safe because an
+# entry is removed at the instant its buffer is collected.
+_registered: set[int] = set()
+_enabled = True
+
+
+def set_accounting(enabled: bool):
+    """Toggle per-NDArray accounting (MXNET_STORAGE_ACCOUNTING knob)."""
+    global _enabled
+    _enabled = bool(enabled)
+
+
+def _dec(devkey: str, nbytes: int, bufkey: int):
+    with _lock:
+        if bufkey not in _registered:
+            return
+        _registered.discard(bufkey)
+        st = _by_device.get(devkey)
+        if st is not None:
+            st.live_bytes -= nbytes
+            st.num_frees += 1
+            st.live_arrays -= 1
+
+
+def on_create(nd) -> None:
+    """Register the buffer behind a freshly constructed NDArray.
+
+    Called from ``NDArray.__init__``; must stay cheap.  Tracers (abstract
+    values inside jit) and zero-size arrays are skipped; a buffer already
+    seen (shared or re-wrapped) costs one set lookup.
+    """
+    if not _enabled:
+        return
+    data = nd._data
+    if isinstance(data, _jax_core.Tracer):
+        return  # abstract value inside jit/vjp tracing — no buffer exists
+    nbytes = getattr(data, "nbytes", None)
+    if not nbytes or not isinstance(nbytes, int):
+        return
+    devkey = str(nd._ctx)
+    bufkey = id(data)
+    with _lock:
+        if bufkey in _registered:
+            return
+        _registered.add(bufkey)
+        st = _by_device.get(devkey)
+        if st is None:
+            st = _by_device[devkey] = _DeviceStats()
+        st.live_bytes += nbytes
+        st.num_allocs += 1
+        st.live_arrays += 1
+        if st.live_bytes > st.peak_bytes:
+            st.peak_bytes = st.live_bytes
+    try:
+        weakref.finalize(data, _dec, devkey, nbytes, bufkey)
+    except TypeError:  # non-weakref-able buffer type: drop the entry
+        _dec(devkey, nbytes, bufkey)
+
+
+def stats(device=None):
+    """Per-device accounting snapshot.
+
+    ``stats()`` → ``{devkey: {live_bytes, peak_bytes, ...}}``;
+    ``stats(ctx_or_key)`` → the one device's dict (zeros if unseen).
+    """
+    with _lock:
+        if device is None:
+            return {k: v.as_dict() for k, v in _by_device.items()}
+        key = device if isinstance(device, str) else str(device)
+        st = _by_device.get(key)
+        return st.as_dict() if st is not None else _DeviceStats().as_dict()
+
+
+def live_bytes(device=None) -> int:
+    with _lock:
+        if device is None:
+            return sum(st.live_bytes for st in _by_device.values())
+        key = device if isinstance(device, str) else str(device)
+        st = _by_device.get(key)
+        return st.live_bytes if st is not None else 0
+
+
+def reset_peak():
+    """Reset peak watermarks to current live bytes (profiler epoch reset)."""
+    with _lock:
+        for st in _by_device.values():
+            st.peak_bytes = st.live_bytes
+
+
+# ---------------------------------------------------------------------------
+# pooled host staging buffers
+# ---------------------------------------------------------------------------
+
+class Handle:
+    """An allocated host buffer (ref: ``Storage::Handle`` — dptr/size/ctx)."""
+
+    __slots__ = ("dptr", "size", "ctx", "_bucket")
+
+    def __init__(self, dptr, size, ctx, bucket):
+        self.dptr = dptr          # numpy uint8 view, length == size
+        self.size = size
+        self.ctx = ctx
+        self._bucket = bucket     # rounded size the pool stores it under
+
+
+class _HostPool:
+    """Free-list pool over page-sized numpy buffers.
+
+    Strategies (MXNET_GPU_MEM_POOL_TYPE):
+      - ``Naive``:  exact-size buckets (GPUPooledStorageManager);
+      - ``Round``:  power-of-two buckets below ``2**cutoff``, linear
+        (page-rounded) above (GPUPooledRoundedStorageManager);
+      - ``Unpooled``: passthrough malloc/free.
+    """
+
+    PAGE = 4096
+
+    def __init__(self):
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._held = 0          # bytes sitting in free lists
+        self._hits = 0
+        self._misses = 0
+        self._lock = threading.Lock()
+        self._configured = False
+        self._strategy = "Naive"
+        self._cutoff = 24
+        self._limit = 0
+
+    def _configure(self):
+        from . import config
+        self._strategy = str(config.get("MXNET_GPU_MEM_POOL_TYPE") or "Naive")
+        self._cutoff = int(config.get("MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF") or 24)
+        reserve = int(config.get("MXNET_GPU_MEM_POOL_RESERVE") or 5)
+        limit_mb = int(config.get("MXNET_HOST_MEM_POOL_LIMIT_MB") or 256)
+        self._limit = limit_mb * (1 << 20) * max(0, 100 - reserve) // 100
+        self._configured = True
+
+    def _bucket_of(self, nbytes: int) -> int:
+        if self._strategy == "Round":
+            if nbytes <= 0:
+                return self.PAGE
+            if nbytes < (1 << self._cutoff):
+                return 1 << max(nbytes - 1, 1).bit_length()
+            # linear region: round up to page
+        return -(-max(nbytes, 1) // self.PAGE) * self.PAGE
+
+    def alloc(self, nbytes: int, ctx=None) -> Handle:
+        if not self._configured:
+            self._configure()
+        if self._strategy == "Unpooled":
+            buf = np.empty(max(nbytes, 1), dtype=np.uint8)
+            return Handle(buf[:nbytes], nbytes, ctx, -1)
+        bucket = self._bucket_of(nbytes)
+        with self._lock:
+            lst = self._free.get(bucket)
+            if lst:
+                buf = lst.pop()
+                self._held -= bucket
+                self._hits += 1
+            else:
+                buf = None
+                self._misses += 1
+        if buf is None:
+            buf = np.empty(bucket, dtype=np.uint8)
+        return Handle(buf[:nbytes], nbytes, ctx, bucket)
+
+    def free(self, handle: Handle):
+        if handle._bucket < 0:
+            return
+        buf = handle.dptr.base if handle.dptr.base is not None else handle.dptr
+        bucket, handle._bucket = handle._bucket, -1  # double-free guard
+        with self._lock:
+            if self._held + bucket > self._limit:
+                return  # over reserve cap — drop to the allocator
+            self._free.setdefault(bucket, []).append(buf)
+            self._held += bucket
+
+    def release_all(self):
+        with self._lock:
+            self._free.clear()
+            self._held = 0
+
+    def info(self):
+        with self._lock:
+            return {"strategy": self._strategy,
+                    "held_bytes": self._held,
+                    "limit_bytes": self._limit,
+                    "hits": self._hits,
+                    "misses": self._misses,
+                    "buckets": {k: len(v) for k, v in self._free.items()}}
+
+
+_pool = _HostPool()
+
+
+class Storage:
+    """Singleton facade matching the reference's ``Storage::Get()`` API."""
+
+    _instance = None
+
+    @classmethod
+    def get(cls) -> "Storage":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def alloc(self, size: int, ctx=None) -> Handle:
+        return _pool.alloc(size, ctx)
+
+    def free(self, handle: Handle):
+        _pool.free(handle)
+
+    def direct_free(self, handle: Handle):
+        """Bypass the pool (ref: Storage::DirectFree)."""
+        handle._bucket = -1
+
+    def release_all(self, ctx=None):
+        _pool.release_all()
+
+    def stats(self, device=None):
+        return stats(device)
+
+    def pool_info(self):
+        return _pool.info()
+
+
+def pool_info():
+    return _pool.info()
+
+
+def release_all():
+    _pool.release_all()

@@ -2,6 +2,7 @@
 (ref: src/operator/custom/custom.cc; tests/python/unittest/test_operator.py
 test_custom_op), storage introspection, packed gradient compression."""
 import logging
+import os
 
 import numpy as np
 import pytest
@@ -168,3 +169,69 @@ def test_quantize_net_calibrated():
     assert agree >= 0.8, agree
     rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
     assert rel < 0.2, rel
+
+
+def test_quantize_net_dense_activation_and_dilated_conv():
+    """Fused activations survive quantization, and dilated convs keep their
+    dilation (regression: both were silently dropped)."""
+    from mxnet_tpu.contrib.quantization import quantize_net
+    mx.random.seed(0)
+    rng = np.random.RandomState(2)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, 3, padding=2, dilation=2, in_channels=3),
+            gluon.nn.GlobalAvgPool2D(), gluon.nn.Flatten(),
+            gluon.nn.Dense(6, in_units=8, activation="relu"))
+    net.initialize(mx.init.Xavier())
+    calib = [rng.randn(4, 3, 12, 12).astype(np.float32) for _ in range(3)]
+    test = mx.nd.array(rng.randn(8, 3, 12, 12).astype(np.float32))
+    ref = net(test).asnumpy()
+    assert (ref >= 0).all()  # relu through the Dense
+
+    quantize_net(net, calib_data=calib)
+    got = net(test).asnumpy()
+    assert got.shape == ref.shape  # dilation preserved → same spatial math
+    assert (got >= 0).all()  # activation still applied after quantization
+    rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.25, rel
+
+
+def test_quantize_net_on_hybridized_net():
+    """quantize_net after hybridize()+forward: stale jit caches must not
+    serve the old float graph (regression)."""
+    from mxnet_tpu.contrib.quantization import quantize_net, QuantizedDense
+    mx.random.seed(0)
+    rng = np.random.RandomState(3)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=6))
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(rng.randn(5, 6).astype(np.float32))
+    net.hybridize()
+    net(x)  # builds the compiled float forward
+    calib = [rng.randn(4, 6).astype(np.float32) for _ in range(2)]
+    quantize_net(net, calib_data=calib)
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert kinds == ["QuantizedDense"]
+    # the forward must now run the quantized graph, not the stale jit cache
+    float_ref = x.asnumpy() @ np.zeros((6, 4), np.float32)  # shape check only
+    got = net(x).asnumpy()
+    assert got.shape == float_ref.shape
+    q = next(iter(net._children.values()))
+    manual = QuantizedDense.forward(q, mx.nd.array(x.asnumpy())).asnumpy()
+    assert np.allclose(got, manual, atol=1e-6)
+
+
+def test_opperf_harness():
+    """benchmark/opperf.py: the per-op sweep runs and reports timings
+    (ref: benchmark/opperf/opperf.py — run_performance_test)."""
+    import importlib.util as iu
+    spec = iu.spec_from_file_location(
+        "opperf", os.path.join(os.path.dirname(__file__), "..",
+                               "benchmark", "opperf.py"))
+    opperf = iu.module_from_spec(spec)
+    spec.loader.exec_module(opperf)
+    res = opperf.run_performance_test(ops={"exp", "dot", "Convolution"},
+                                      warmup=1, runs=2)
+    assert len(res) == 3
+    for r in res:
+        assert "avg_time_ms" in r, r
+        assert r["avg_time_ms"] > 0

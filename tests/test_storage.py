@@ -1,0 +1,205 @@
+"""Storage manager: accounting, host buffer pool, memory introspection.
+
+ref test model: tests/cpp/storage/storage_test.cc (alloc/free/pool reuse)
++ mx.context.gpu_memory_info API surface.
+"""
+import gc
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import storage
+
+
+def test_ndarray_accounting_live_and_peak():
+    before = storage.live_bytes()
+    xs = [mx.nd.array(np.ones((64, 64), np.float32)) for _ in range(4)]
+    live = storage.live_bytes()
+    assert live >= before + 4 * 64 * 64 * 4
+    st = storage.stats()
+    assert any(v["peak_bytes"] >= v["live_bytes"] for v in st.values())
+    del xs
+    gc.collect()
+    after = storage.live_bytes()
+    assert after <= live - 4 * 64 * 64 * 4
+
+
+def test_detach_does_not_double_count():
+    x = mx.nd.array(np.ones((256, 256), np.float32))
+    live = storage.live_bytes()
+    y = x.detach()  # shares the underlying buffer
+    assert storage.live_bytes() == live
+    del x
+    gc.collect()
+    assert storage.live_bytes() == live  # y still holds the buffer
+    del y
+    gc.collect()
+    assert storage.live_bytes() <= live - 256 * 256 * 4
+
+
+def test_inplace_ops_do_not_corrupt_accounting():
+    """a += 1 rebinds a._data; the finalizer rides the buffer, not the
+    wrapper, so counts stay exact (regression: wrapper-keyed accounting
+    double-freed)."""
+    base = storage.live_bytes()
+    a = mx.nd.array(np.ones((128, 128), np.float32))
+    nbytes = 128 * 128 * 4
+    for _ in range(3):
+        a += 1.0
+        gc.collect()
+    live = storage.live_bytes()
+    # exactly one live buffer for `a` (temps collected), never negative
+    assert base + nbytes <= live <= base + 2 * nbytes
+    del a
+    gc.collect()
+    assert storage.live_bytes() <= live - nbytes
+
+
+def test_accounting_per_device_keys():
+    x = mx.nd.array(np.ones(8, np.float32))
+    key = str(x.context)
+    assert storage.stats(key)["live_bytes"] > 0
+    assert storage.stats(key)["num_allocs"] > 0
+    del x
+
+
+def test_reset_peak():
+    x = mx.nd.array(np.ones((128, 128), np.float32))
+    key = str(x.context)
+    storage.reset_peak()
+    st = storage.stats(key)
+    assert st["peak_bytes"] == st["live_bytes"]
+    del x
+
+
+def test_host_pool_naive_reuse():
+    s = storage.Storage.get()
+    h1 = s.alloc(10000)
+    base1 = h1.dptr.base if h1.dptr.base is not None else h1.dptr
+    assert h1.size == 10000
+    s.free(h1)
+    h2 = s.alloc(10000)
+    base2 = h2.dptr.base if h2.dptr.base is not None else h2.dptr
+    assert base2 is base1  # recycled from the free list
+    s.free(h2)
+    info = storage.pool_info()
+    assert info["hits"] >= 1
+
+
+def test_host_pool_round_strategy(monkeypatch):
+    monkeypatch.setenv("MXNET_GPU_MEM_POOL_TYPE", "Round")
+    pool = storage._HostPool()
+    h = pool.alloc(5000)
+    assert h._bucket == 8192  # next power of two
+    pool.free(h)
+    h2 = pool.alloc(6000)  # different size, same pow2 bucket → reuse
+    assert h2._bucket == 8192
+    assert pool.info()["hits"] == 1
+    # linear region above the cutoff rounds to pages
+    big = pool.alloc((1 << 24) + 5)
+    assert big._bucket % 4096 == 0 and big._bucket >= (1 << 24) + 5
+
+
+def test_host_pool_respects_limit(monkeypatch):
+    monkeypatch.setenv("MXNET_HOST_MEM_POOL_LIMIT_MB", "1")
+    monkeypatch.setenv("MXNET_GPU_MEM_POOL_RESERVE", "0")
+    pool = storage._HostPool()
+    h = pool.alloc(2 << 20)  # 2MB > 1MB cap
+    pool.free(h)
+    assert pool.info()["held_bytes"] == 0  # dropped, not retained
+
+
+def test_unpooled_strategy(monkeypatch):
+    monkeypatch.setenv("MXNET_GPU_MEM_POOL_TYPE", "Unpooled")
+    pool = storage._HostPool()
+    h = pool.alloc(4096)
+    pool.free(h)
+    assert pool.info()["held_bytes"] == 0
+
+
+def test_double_free_is_harmless():
+    s = storage.Storage.get()
+    h = s.alloc(4096)
+    s.free(h)
+    s.free(h)  # second free must be a no-op, not a duplicate pool entry
+    h1 = s.alloc(4096)
+    h2 = s.alloc(4096)
+    b1 = h1.dptr.base if h1.dptr.base is not None else h1.dptr
+    b2 = h2.dptr.base if h2.dptr.base is not None else h2.dptr
+    assert b1 is not b2
+    s.free(h1)
+    s.free(h2)
+
+
+def test_direct_free():
+    s = storage.Storage.get()
+    h = s.alloc(4096)
+    held0 = storage.pool_info()["held_bytes"]  # after the pop
+    s.direct_free(h)
+    s.free(h)  # after direct_free this is a no-op
+    assert storage.pool_info()["held_bytes"] == held0
+
+
+def test_gpu_memory_info_fallback():
+    free, total = mx.context.gpu_memory_info(0)
+    assert total > 0  # capacity knob fallback when PJRT has no stats
+    assert 0 <= free <= total
+
+
+def test_context_memory_info_framework_keys():
+    x = mx.nd.array(np.ones(16, np.float32))
+    info = x.context.memory_info()
+    assert "framework_live_bytes" in info
+    assert info["framework_live_bytes"] > 0
+    del x
+
+
+def test_storage_release_all():
+    s = storage.Storage.get()
+    h = s.alloc(8192)
+    s.free(h)
+    storage.release_all()
+    assert storage.pool_info()["held_bytes"] == 0
+
+
+def test_accounting_toggle():
+    storage.set_accounting(False)
+    before = storage.stats()
+    x = mx.nd.array(np.ones((32, 32), np.float32))
+    try:
+        key = str(x.context)
+        assert storage.stats(key)["num_allocs"] == \
+            before.get(key, {"num_allocs": 0})["num_allocs"]
+    finally:
+        storage.set_accounting(True)
+        del x
+
+
+def test_image_record_iter_uses_pool(tmp_path):
+    from PIL import Image
+
+    from mxnet_tpu import io as mio, recordio
+
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        img = rng.randint(0, 255, (40, 40, 3), np.uint8)
+        import io as _io
+        buf = _io.BytesIO()
+        Image.fromarray(img).save(buf, format="PNG")
+        hdr = recordio.IRHeader(0, float(i % 4), i, 0)
+        w.write_idx(i, recordio.pack(hdr, buf.getvalue()))
+    w.close()
+
+    hits0 = storage.pool_info()["hits"]
+    it = mio.ImageRecordIter(rec, data_shape=(3, 32, 32), batch_size=4,
+                             path_imgidx=idx)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    # second batch re-used the first batch's pooled buffer
+    assert storage.pool_info()["hits"] >= hits0 + 1
+    it.close()
