@@ -3,9 +3,10 @@
 ref: benchmark/opperf/opperf.py — the reference sweeps registered ops by
 category with default input configs and reports per-op fwd/bwd latency.
 Same shape here: curated categories over the op registry, each op timed
-in eager dispatch (the MXImperativeInvokeEx-equivalent path) and under
-jit (the hybridize/CachedOp path), so the dispatch overhead the engine
-design is meant to amortise is visible per op.
+through eager dispatch (the MXImperativeInvokeEx-equivalent path, which
+internally hits the per-op jit cache after warmup) — so the number is
+Python dispatch + compiled-kernel execution, the per-op cost a
+hybridize/TrainStep whole-graph compile amortises away.
 
 Usage:
     python benchmark/opperf.py                    # all categories, table
@@ -114,7 +115,7 @@ def op_configs(size="small"):
 
 
 def time_op(name, inputs_fn, kwargs, warmup=3, runs=20):
-    """Time one op: eager dispatch and compiled-cache-hit latency."""
+    """Average eager-dispatch latency (post-warmup: per-op jit cache hit)."""
     inputs = inputs_fn()
     for _ in range(warmup):
         out = nd.invoke(name, *inputs, **kwargs)

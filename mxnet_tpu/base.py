@@ -87,3 +87,31 @@ class Registry:
 
     def keys(self):
         return sorted(self._entries)
+
+
+def load_native_lib(so_name: str, source_cc: str):
+    """dlopen a native core from mxnet_tpu/_lib, building it via ``make -C
+    src`` first if the shared object is missing (ref: libmxnet.so loading
+    in python/mxnet/base.py _load_lib).  Returns the ctypes CDLL or None —
+    callers fall back to their pure-Python twin.  Shared by recordio and
+    the storage pool so the build bootstrap lives in one place."""
+    import ctypes
+    import os
+    import subprocess
+
+    pkg = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(pkg, "_lib", so_name)
+    if not os.path.exists(path):
+        src = os.path.join(os.path.dirname(pkg), "src")
+        if os.path.exists(os.path.join(src, source_cc)):
+            try:
+                subprocess.run(["make", "-C", src], capture_output=True,
+                               timeout=120, check=False)
+            except Exception:
+                pass
+    if not os.path.exists(path):
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError:
+        return None

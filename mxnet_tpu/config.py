@@ -73,6 +73,10 @@ KNOBS = {k.name: k for k in [
          "two; above, to a page multiple."),
     Knob("MXNET_HOST_MEM_POOL_LIMIT_MB", 256, int,
          "Upper bound on host staging buffers retained by the pool."),
+    Knob("MXNET_ENGINE_TRACK_BYTES_MB", 64, int,
+         "Byte budget for the waitall tracking ring: newest arrays are "
+         "held (strongly) up to this budget so waitall stays a true "
+         "barrier without pinning unbounded HBM."),
     Knob("MXNET_STORAGE_ACCOUNTING", 1, int,
          "1 = every NDArray registers its bytes with the storage manager "
          "(mx.storage.stats(), gpu_memory_info fallback); 0 disables."),

@@ -159,9 +159,12 @@ def gpu_memory_info(device_id: int = 0):
     if not total:
         # PJRT plugin reports no memory_stats (axon tunnel): fall back to
         # the configured HBM capacity minus framework-accounted live bytes.
-        from . import config
+        # tpu(N)/gpu(N) are compat aliases for the same accelerator, so sum
+        # both accounting keys.
+        from . import config, storage
         total = int(config.get("MXNET_TPU_HBM_CAPACITY_MB")) << 20
-        used = stats.get("framework_live_bytes", 0)
+        used = (storage.live_bytes(f"tpu({device_id})")
+                + storage.live_bytes(f"gpu({device_id})"))
     return (max(0, total - used), total)
 
 

@@ -136,9 +136,25 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
 
     # The rewrite changes the forward graph: drop any compiled caches and
     # run calibration eagerly (range collectors read concrete values);
-    # hybridization state is restored after the swap.
-    was_active = bool(getattr(net, "_active", False))
-    was_flags = dict(getattr(net, "_flags", {}) or {})
+    # hybridization state is restored after the swap.  The container may
+    # be a plain Block (nn.Sequential) whose hybridize() only cascades, so
+    # detect "was hybridized" by scanning the tree for any active block.
+    def _any_active(b):
+        if getattr(b, "_active", False):
+            return True
+        return any(_any_active(c) for c in b._children.values())
+
+    def _first_flags(b):
+        if getattr(b, "_active", False):
+            return dict(getattr(b, "_flags", {}) or {})
+        for c in b._children.values():
+            f = _first_flags(c)
+            if f is not None:
+                return f
+        return None
+
+    was_active = _any_active(net)
+    was_flags = _first_flags(net) or {}
     net.hybridize(False)
 
     # 1) wrap targets in range collectors
@@ -150,13 +166,22 @@ def quantize_net(net, calib_data=None, calib_mode="naive",
 
     _walk_swap(net, wrap)
 
-    # 2) run calibration batches
-    for batch in calib_data:
-        x = batch[0] if isinstance(batch, (tuple, list)) else batch
-        if not isinstance(x, NDArray):
-            from .. import ndarray as F
-            x = F.array(x)
-        net(x)
+    # 2) run calibration batches; if anything throws, unwrap the
+    # collectors and restore hybridization so the caller's net survives
+    try:
+        for batch in calib_data:
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            if not isinstance(x, NDArray):
+                from .. import ndarray as F
+                x = F.array(x)
+            net(x)
+    except Exception:
+        _walk_swap(net, lambda c: c.inner
+                   if isinstance(c, _RangeCollector) else None)
+        net._invalidate_cache()
+        if was_active:
+            net.hybridize(True, **was_flags)
+        raise
 
     # 3) swap collectors for quantized layers
     def swap(child):

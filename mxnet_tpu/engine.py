@@ -13,22 +13,30 @@ and data dependencies order execution.  This module therefore only supplies the
   trace+compile (hybridize) subsumes engine bulking.
 
 A bounded ring of recently produced arrays backs ``waitall``; PJRT guarantees
-program order per device so blocking on the newest arrays is a full barrier.
-The ring holds weak references — tracking must not extend buffer lifetime
-(256 pinned activations would hold real HBM).
+program order per device, so blocking on the NEWEST arrays barriers
+everything dispatched before them.  That ordering is what lets the ring be
+small: entries are evicted oldest-first once the ring exceeds a byte budget
+(MXNET_ENGINE_TRACK_BYTES_MB) — an evicted (older) op is covered by any
+newer entry — so tracking never pins more than the budget of HBM while
+``waitall`` remains a true barrier even for outputs the user dropped.
 """
 from __future__ import annotations
 
 import collections
 import threading
-import weakref
 
 import jax
+from jax import core as _jax_core
 
 __all__ = ["waitall", "wait_to_read", "track", "set_bulk_size", "bulk"]
 
 _LOCK = threading.Lock()
-_RECENT = collections.deque(maxlen=256)
+# Per-device rings: devkey → deque[(array, nbytes)], newest on the right.
+# Per-device because PJRT's dispatch-order guarantee is per device — the
+# "newest entry covers evicted older ones" eviction argument is only sound
+# within one device's stream.
+_RECENT: dict = {}
+_RECENT_BYTES: dict = {}
 _bulk_size = 0
 
 # MXNET_ENGINE_TYPE=NaiveEngine → synchronous dispatch (every op blocks),
@@ -37,6 +45,7 @@ _bulk_size = 0
 from . import config as _config  # noqa: E402
 
 _NAIVE = _config.naive_engine()
+_TRACK_BYTES = int(_config.get("MXNET_ENGINE_TRACK_BYTES_MB") or 64) << 20
 
 
 def track(arr):
@@ -47,12 +56,28 @@ def track(arr):
         except Exception:
             pass
         return arr
+    if not isinstance(arr, jax.Array) or isinstance(arr, _jax_core.Tracer):
+        return arr  # numpy results / tracers: nothing asynchronous to track
     try:
-        ref = weakref.ref(arr)
-    except TypeError:
-        return arr  # non-weakref-able (plain numpy on cpu ctx): nothing async
+        devs = arr.devices()
+        devkey = next(iter(devs)) if len(devs) == 1 else frozenset(devs)
+    except Exception:
+        devkey = None
+    nbytes = getattr(arr, "nbytes", 0) or 0
     with _LOCK:
-        _RECENT.append(ref)
+        ring = _RECENT.get(devkey)
+        if ring is None:
+            ring = _RECENT[devkey] = collections.deque()
+            _RECENT_BYTES[devkey] = 0
+        ring.append((arr, nbytes))
+        _RECENT_BYTES[devkey] += nbytes
+        # evict oldest beyond the byte budget (and a generous count cap);
+        # always keep the newest entry — within one device's stream it
+        # alone barriers everything dispatched before it.
+        while len(ring) > 1 and (_RECENT_BYTES[devkey] > _TRACK_BYTES
+                                 or len(ring) > 256):
+            _, old = ring.popleft()
+            _RECENT_BYTES[devkey] -= old
     return arr
 
 
@@ -63,12 +88,10 @@ def wait_to_read(arr):
 def waitall():
     """Block until all dispatched work has completed (ref: MXNDArrayWaitAll)."""
     with _LOCK:
-        pending = list(_RECENT)
+        pending = [a for ring in _RECENT.values() for a, _ in ring]
         _RECENT.clear()
-    for ref in pending:
-        a = ref()
-        if a is None:
-            continue  # collected — its work is done or unobservable
+        _RECENT_BYTES.clear()
+    for a in pending:
         try:
             jax.block_until_ready(a)
         except Exception:  # deleted/donated buffers are already "done"

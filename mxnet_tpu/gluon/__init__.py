@@ -9,6 +9,7 @@ from .parameter import Parameter, Constant, ParameterDict
 from .trainer import Trainer
 from . import parameter
 from . import contrib
+from . import utils
 
 __all__ = ["nn", "rnn", "loss", "data", "model_zoo", "Block", "HybridBlock",
            "SymbolBlock", "Parameter", "Constant", "ParameterDict", "Trainer"]

@@ -1,0 +1,92 @@
+"""gluon.utils (ref: python/mxnet/gluon/utils.py — split_data,
+split_and_load, clip_global_norm, download helpers)."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..ndarray import NDArray
+from .. import ndarray as nd
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split one batch along ``batch_axis`` into ``num_slice`` pieces
+    (ref: split_data)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        lo = i * step
+        hi = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(lo, hi)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch and load each slice onto one context (ref:
+    split_and_load).  On TPU the usual fast path is the sharded TrainStep;
+    this utility keeps reference training loops working verbatim."""
+    if not isinstance(data, NDArray):
+        data = nd.array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the joint L2 norm ≤ max_norm; returns the norm
+    (ref: clip_global_norm — the PTB recipe's gradient clip)."""
+    if not arrays:
+        return 0.0
+    total = 0.0
+    sq = [float((a * a).sum().asnumpy()) for a in arrays]
+    total = float(np.sqrt(np.sum(sq)))
+    if check_isfinite and not np.isfinite(total):
+        import warnings
+        warnings.warn("nan or inf found in gradients — clip skipped")
+        return total
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total
+
+
+def check_sha1(filename, sha1_hash):
+    """ref: check_sha1."""
+    import hashlib
+    h = hashlib.sha1()
+    with open(filename, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, **kwargs):
+    """ref: download.  This environment has no egress; the API exists so
+    reference scripts fail with a clear message instead of an
+    AttributeError, and works where egress is available."""
+    import os
+    import urllib.request
+    fname = path or url.split("/")[-1]
+    if os.path.isdir(fname):
+        fname = os.path.join(fname, url.split("/")[-1])
+    if os.path.exists(fname) and not overwrite and (
+            sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    try:
+        urllib.request.urlretrieve(url, fname)
+    except Exception as exc:
+        raise IOError(
+            f"download({url!r}) failed: {exc} (this environment may have "
+            f"no network egress — place the file at {fname!r} manually)")
+    return fname

@@ -1,0 +1,318 @@
+"""mx.image — legacy image API.
+
+ref: python/mxnet/image/image.py — imdecode/imread/imresize/resize_short/
+fixed_crop/center_crop/random_crop/color_normalize, the Augmenter classes
++ CreateAugmenter, and class ImageIter (raw-file or RecordIO backed).
+
+TPU-native notes: decode runs on host via PIL (the reference uses OpenCV
+on host too — decode never belonged on the accelerator); arrays are HWC
+uint8/float NDArrays like the reference, and ImageIter yields NCHW float
+batches ready for device transfer.
+"""
+from __future__ import annotations
+
+import io as _pyio
+import os
+
+import numpy as np
+
+from .ndarray import NDArray
+from . import ndarray as nd
+
+__all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "Augmenter",
+           "ResizeAug", "ForceResizeAug", "HorizontalFlipAug", "CastAug",
+           "ColorNormalizeAug", "RandomCropAug", "CenterCropAug",
+           "CreateAugmenter", "ImageIter"]
+
+
+def _pil():
+    from PIL import Image
+    return Image
+
+
+def _to_np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode an image byte buffer → HWC uint8 NDArray (ref: imdecode)."""
+    if isinstance(buf, NDArray):
+        buf = buf.asnumpy().tobytes()
+    im = _pil().open(_pyio.BytesIO(bytes(buf)))
+    im = im.convert("RGB" if flag else "L")
+    arr = np.asarray(im)
+    if not flag:
+        arr = arr[..., None]
+    elif not to_rgb:
+        arr = arr[..., ::-1]  # BGR like OpenCV default
+    return nd.array(np.ascontiguousarray(arr).astype(np.uint8))
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file (ref: imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    """Resize HWC image to (w, h) (ref: imresize)."""
+    arr = _to_np(src)
+    squeeze = arr.shape[-1] == 1
+    im = _pil().fromarray(arr[..., 0] if squeeze else arr.astype(np.uint8))
+    im = im.resize((int(w), int(h)))
+    out = np.asarray(im)
+    if squeeze:
+        out = out[..., None]
+    return nd.array(out.astype(arr.dtype))
+
+
+def resize_short(src, size, interp=1):
+    """Resize so the SHORT side equals ``size`` (ref: resize_short)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(h * size / w)
+    else:
+        new_w, new_h = int(w * size / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    """Crop a fixed region, optionally resizing (ref: fixed_crop)."""
+    arr = _to_np(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    out_nd = nd.array(np.ascontiguousarray(out))
+    if size is not None and (w, h) != tuple(size):
+        out_nd = imresize(out_nd, size[0], size[1], interp)
+    return out_nd
+
+
+def center_crop(src, size, interp=1):
+    """→ (cropped, (x0, y0, w, h)) (ref: center_crop)."""
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    cw, ch = size
+    x0 = max(0, (w - cw) // 2)
+    y0 = max(0, (h - ch) // 2)
+    cw, ch = min(cw, w), min(ch, h)
+    return fixed_crop(src, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
+
+
+def random_crop(src, size, interp=1, rng=None):
+    """→ (cropped, (x0, y0, w, h)) (ref: random_crop)."""
+    rng = rng or np.random
+    arr = _to_np(src)
+    h, w = arr.shape[:2]
+    cw, ch = min(size[0], w), min(size[1], h)
+    x0 = int(rng.randint(0, w - cw + 1))
+    y0 = int(rng.randint(0, h - ch + 1))
+    return fixed_crop(src, x0, y0, cw, ch, size, interp), (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None):
+    """(src - mean) / std on HWC float (ref: color_normalize)."""
+    out = src.astype("float32") if isinstance(src, NDArray) \
+        else nd.array(_to_np(src).astype(np.float32))
+    mean = mean if isinstance(mean, NDArray) else nd.array(np.asarray(mean))
+    out = out - mean
+    if std is not None:
+        std = std if isinstance(std, NDArray) else nd.array(np.asarray(std))
+        out = out / std
+    return out
+
+
+# --- augmenters (ref: class Augmenter + subclasses) -------------------------
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+    def dumps(self):
+        import json
+        return json.dumps([type(self).__name__,
+                           {k: (list(v) if isinstance(v, tuple) else v)
+                            for k, v in self._kwargs.items()
+                            if isinstance(v, (int, float, str, tuple, list))}])
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self._size, self._interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self._size, self._interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self._size, self._interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self._size[0], self._size[1], self._interp)
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p, rng=None):
+        super().__init__(p=p)
+        self._p = p
+        self._rng = rng or np.random
+
+    def __call__(self, src):
+        if self._rng.rand() < self._p:
+            return nd.array(np.ascontiguousarray(_to_np(src)[:, ::-1]))
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, dtype="float32"):
+        super().__init__(dtype=dtype)
+        self._dtype = dtype
+
+    def __call__(self, src):
+        return src.astype(self._dtype) if isinstance(src, NDArray) \
+            else nd.array(_to_np(src).astype(self._dtype))
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self._mean, self._std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self._mean, self._std)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=1, rng=None):
+        super().__init__(size=size)
+        self._size, self._interp = size, interp
+        self._rng = rng
+
+    def __call__(self, src):
+        return random_crop(src, self._size, self._interp, self._rng)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=1):
+        super().__init__(size=size)
+        self._size, self._interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self._size, self._interp)[0]
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
+                    mean=None, std=None, **kwargs):
+    """Standard augmenter list (ref: CreateAugmenter; unsupported reference
+    options are accepted and ignored, matching its permissive kwargs)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize))
+    crop = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop))
+    else:
+        auglist.append(CenterCropAug(crop))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter:
+    """ref: image.ImageIter — batches from RecordIO or an imglist.
+
+    RecordIO mode (``path_imgrec``): delegates record reading to
+    ``mx.io.ImageRecordIter``'s machinery is NOT used — this class applies
+    its own ``aug_list`` per reference semantics.
+    imglist mode: ``imglist`` = [[label, relpath], ...] under ``path_root``.
+    """
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imgidx=None, imglist=None, path_root="",
+                 shuffle=False, aug_list=None, label_width=1, seed=0,
+                 **kwargs):
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self._label_width = label_width
+        self._rng = np.random.RandomState(seed)
+        self._aug = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self._shuffle = shuffle
+        self._rec = None
+        if path_imgrec is not None:
+            from . import recordio
+            if path_imgidx is None:
+                path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+            self._rec = recordio.MXIndexedRecordIO(path_imgidx, path_imgrec,
+                                                   "r")
+            self._items = list(self._rec.keys)
+        elif imglist is not None:
+            self._items = [(float(l[0]) if not isinstance(l[0], (list, tuple))
+                            else np.asarray(l[0], np.float32),
+                            os.path.join(path_root, l[1])) for l in imglist]
+        else:
+            raise ValueError("need path_imgrec or imglist")
+        self.reset()
+
+    def reset(self):
+        self._order = list(range(len(self._items)))
+        if self._shuffle:
+            self._rng.shuffle(self._order)
+        self._cur = 0
+
+    def _read(self, i):
+        if self._rec is not None:
+            from . import recordio
+            s = self._rec.read_idx(self._items[i])
+            hdr, img = recordio.unpack_img(s)
+            label = np.asarray(hdr.label, np.float32).ravel()
+            return label, nd.array(img.astype(np.uint8))
+        label, path = self._items[i]
+        return np.asarray(label, np.float32).ravel(), imread(path)
+
+    def next(self):
+        if self._cur >= len(self._order):
+            raise StopIteration
+        idxs = self._order[self._cur:self._cur + self.batch_size]
+        pad = self.batch_size - len(idxs)
+        if pad:
+            idxs = idxs + self._order[:pad]
+        self._cur += self.batch_size
+        datas, labels = [], []
+        for i in idxs:
+            label, img = self._read(i)
+            for aug in self._aug:
+                img = aug(img)
+            arr = _to_np(img).astype(np.float32)
+            datas.append(arr.transpose(2, 0, 1))  # HWC → CHW
+            labels.append(label[0] if self._label_width == 1
+                          else label[:self._label_width])
+        from .io import DataBatch
+        return DataBatch([nd.array(np.stack(datas))],
+                         [nd.array(np.stack(labels).astype(np.float32))],
+                         pad=pad)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self
+
+    def close(self):
+        if self._rec is not None:
+            self._rec.close()
+            self._rec = None

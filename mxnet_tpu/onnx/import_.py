@@ -205,8 +205,8 @@ def _eval_node(op, ins, attrs):
     if op == "Where":
         return jnp.where(ins[0], ins[1], ins[2])
     if op == "Clip":
-        lo = ins[1] if len(ins) > 1 else None
-        hi = ins[2] if len(ins) > 2 else None
+        lo = ins[1] if len(ins) > 1 and ins[1] is not None else None
+        hi = ins[2] if len(ins) > 2 and ins[2] is not None else None
         return jnp.clip(ins[0], lo, hi)
     if op == "Cast":
         return ins[0].astype(_NP_DTYPE[a("to")])
@@ -228,9 +228,10 @@ def _eval_node(op, ins, attrs):
     if op == "Slice":
         starts = np.asarray(ins[1])
         ends = np.asarray(ins[2])
-        axes = np.asarray(ins[3]) if len(ins) > 3 else np.arange(len(starts))
-        steps = np.asarray(ins[4]) if len(ins) > 4 else np.ones(len(starts),
-                                                               np.int64)
+        axes = (np.asarray(ins[3]) if len(ins) > 3 and ins[3] is not None
+                else np.arange(len(starts)))
+        steps = (np.asarray(ins[4]) if len(ins) > 4 and ins[4] is not None
+                 else np.ones(len(starts), np.int64))
         sl = [slice(None)] * ins[0].ndim
         for s, e, ax, st in zip(starts, ends, axes, steps):
             n = ins[0].shape[ax]
@@ -242,13 +243,15 @@ def _eval_node(op, ins, attrs):
         pads = np.asarray(ins[1])
         n = len(pads) // 2
         cfg = [(int(pads[i]), int(pads[i + n])) for i in range(n)]
-        cval = float(np.asarray(ins[2])) if len(ins) > 2 else 0.0
+        cval = (float(np.asarray(ins[2]))
+                if len(ins) > 2 and ins[2] is not None else 0.0)
         return jnp.pad(ins[0], cfg, constant_values=cval)
     if op in ("ReduceSum", "ReduceMax", "ReduceMin", "ReduceProd"):
         fn = {"ReduceSum": jnp.sum, "ReduceMax": jnp.max,
               "ReduceMin": jnp.min, "ReduceProd": jnp.prod}[op]
-        axes = tuple(int(d) for d in np.asarray(ins[1])) if len(ins) > 1 \
-            else tuple(a("axes") or range(ins[0].ndim))
+        axes = (tuple(int(d) for d in np.asarray(ins[1]))
+                if len(ins) > 1 and ins[1] is not None
+                else tuple(a("axes") or range(ins[0].ndim)))
         return fn(ins[0], axis=axes, keepdims=bool(a("keepdims", 0)))
     if op == "ArgMax":
         return jnp.argmax(ins[0], axis=a("axis", 0)).astype(np.int64) \
@@ -260,7 +263,7 @@ def _eval_node(op, ins, attrs):
         x = ins[0].T if a("transA") else ins[0]
         w = ins[1].T if a("transB") else ins[1]
         out = a("alpha", 1.0) * (x @ w)
-        if len(ins) > 2:
+        if len(ins) > 2 and ins[2] is not None:
             out = out + a("beta", 1.0) * ins[2]
         return out
     if op == "Conv":
@@ -272,7 +275,7 @@ def _eval_node(op, ins, attrs):
         out = lax.conv_general_dilated(
             ins[0], ins[1], strides, pad_cfg, rhs_dilation=dil,
             feature_group_count=a("group", 1))
-        if len(ins) > 2:
+        if len(ins) > 2 and ins[2] is not None:
             out = out + ins[2].reshape((1, -1) + (1,) * nsp)
         return out
     if op == "MaxPool":
@@ -300,7 +303,10 @@ def import_to_function(path: str):
         for name, x in zip(in_names, inputs):
             env[name] = jnp.asarray(x)
         for op, ins, outs, attrs in nodes:
-            vals = _eval_node(op, [env[i] for i in ins if i], dict(attrs))
+            # empty string = omitted optional input (ONNX convention);
+            # keep the slot as None so later inputs stay in position
+            vals = _eval_node(op, [env[i] if i else None for i in ins],
+                              dict(attrs))
             env[outs[0]] = vals
         return [np.asarray(env[o]) for o in out_names]
 

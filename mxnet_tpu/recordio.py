@@ -16,7 +16,6 @@ import ctypes
 import io as _pyio
 import os
 import struct
-import subprocess
 from collections import namedtuple
 
 import numpy as np
@@ -25,26 +24,13 @@ __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader",
            "pack", "unpack", "pack_img", "unpack_img"]
 
 _MAGIC = 0xCED7230A
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_LIB_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                         "_lib", "librecordio.so")
 
 
 def _load_native():
     """dlopen the native core, building it first if possible."""
-    if not os.path.exists(_LIB_PATH):
-        src = os.path.join(_REPO, "src")
-        if os.path.exists(os.path.join(src, "recordio.cc")):
-            try:
-                subprocess.run(["make", "-C", src], capture_output=True,
-                               timeout=120, check=False)
-            except Exception:
-                pass
-    if not os.path.exists(_LIB_PATH):
-        return None
-    try:
-        lib = ctypes.CDLL(_LIB_PATH)
-    except OSError:
+    from .base import load_native_lib
+    lib = load_native_lib("librecordio.so", "recordio.cc")
+    if lib is None:
         return None
     lib.rio_open.restype = ctypes.c_void_p
     lib.rio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]

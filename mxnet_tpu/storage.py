@@ -165,13 +165,27 @@ def reset_peak():
 class Handle:
     """An allocated host buffer (ref: ``Storage::Handle`` — dptr/size/ctx)."""
 
-    __slots__ = ("dptr", "size", "ctx", "_bucket")
+    __slots__ = ("dptr", "size", "ctx", "_bucket", "_ptr")
 
-    def __init__(self, dptr, size, ctx, bucket):
+    def __init__(self, dptr, size, ctx, bucket, ptr=None):
         self.dptr = dptr          # numpy uint8 view, length == size
         self.size = size
         self.ctx = ctx
         self._bucket = bucket     # rounded size the pool stores it under
+        self._ptr = ptr           # native pool address (None: python pool)
+
+
+def _pool_config():
+    """(strategy, round_cutoff, limit_bytes) from the MXNET_* knobs —
+    shared by the python and native pools so the reserve formula lives
+    in one place."""
+    from . import config
+    strategy = str(config.get("MXNET_GPU_MEM_POOL_TYPE") or "Naive")
+    cutoff = int(config.get("MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF") or 24)
+    reserve = int(config.get("MXNET_GPU_MEM_POOL_RESERVE") or 5)
+    limit_mb = int(config.get("MXNET_HOST_MEM_POOL_LIMIT_MB") or 256)
+    limit = limit_mb * (1 << 20) * max(0, 100 - reserve) // 100
+    return strategy, cutoff, limit
 
 
 class _HostPool:
@@ -198,12 +212,7 @@ class _HostPool:
         self._limit = 0
 
     def _configure(self):
-        from . import config
-        self._strategy = str(config.get("MXNET_GPU_MEM_POOL_TYPE") or "Naive")
-        self._cutoff = int(config.get("MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF") or 24)
-        reserve = int(config.get("MXNET_GPU_MEM_POOL_RESERVE") or 5)
-        limit_mb = int(config.get("MXNET_HOST_MEM_POOL_LIMIT_MB") or 256)
-        self._limit = limit_mb * (1 << 20) * max(0, 100 - reserve) // 100
+        self._strategy, self._cutoff, self._limit = _pool_config()
         self._configured = True
 
     def _bucket_of(self, nbytes: int) -> int:
@@ -236,15 +245,23 @@ class _HostPool:
         return Handle(buf[:nbytes], nbytes, ctx, bucket)
 
     def free(self, handle: Handle):
-        if handle._bucket < 0:
-            return
-        buf = handle.dptr.base if handle.dptr.base is not None else handle.dptr
-        bucket, handle._bucket = handle._bucket, -1  # double-free guard
         with self._lock:
+            if handle._bucket < 0:
+                return
+            buf = (handle.dptr.base if handle.dptr.base is not None
+                   else handle.dptr)
+            # guard fields flip under the lock so concurrent frees of one
+            # handle cannot both pass
+            bucket, handle._bucket = handle._bucket, -1
+            handle.dptr = None  # view must not outlive the pooled buffer
             if self._held + bucket > self._limit:
                 return  # over reserve cap — drop to the allocator
             self._free.setdefault(bucket, []).append(buf)
             self._held += bucket
+
+    def direct_free(self, handle: Handle):
+        with self._lock:
+            handle._bucket = -1  # numpy buffer: the GC reclaims it
 
     def release_all(self):
         with self._lock:
@@ -253,7 +270,7 @@ class _HostPool:
 
     def info(self):
         with self._lock:
-            return {"strategy": self._strategy,
+            return {"strategy": self._strategy, "native": False,
                     "held_bytes": self._held,
                     "limit_bytes": self._limit,
                     "hits": self._hits,
@@ -261,7 +278,102 @@ class _HostPool:
                     "buckets": {k: len(v) for k, v in self._free.items()}}
 
 
-_pool = _HostPool()
+class _NativePool:
+    """ctypes binding over src/storage_pool.cc (the native free-list pool,
+    parity with the reference's C++ pooled storage managers).  Same
+    interface as ``_HostPool``; selected automatically when the shared
+    object builds/loads, unless the strategy is Unpooled."""
+
+    def __init__(self, lib):
+        self._lib = lib
+        self._pool = None
+        self._strategy = "Naive"
+        self._limit = 0
+        self._lock = threading.Lock()
+
+    def _configure(self):
+        self._strategy, cutoff, limit = _pool_config()
+        self._limit = limit
+        self._pool = self._lib.sp_create(
+            1 if self._strategy == "Round" else 0, limit, cutoff)
+
+    def alloc(self, nbytes: int, ctx=None) -> Handle:
+        import ctypes
+        with self._lock:
+            if self._pool is None:
+                self._configure()
+        if self._strategy == "Unpooled":
+            buf = np.empty(max(nbytes, 1), dtype=np.uint8)
+            return Handle(buf[:nbytes], nbytes, ctx, -1)
+        bucket = ctypes.c_int64(0)
+        ptr = self._lib.sp_alloc(self._pool, max(nbytes, 1),
+                                 ctypes.byref(bucket))
+        if not ptr:
+            raise MemoryError(f"native pool: alloc({nbytes}) failed")
+        cbuf = (ctypes.c_uint8 * bucket.value).from_address(ptr)
+        arr = np.frombuffer(cbuf, dtype=np.uint8, count=bucket.value)
+        return Handle(arr[:nbytes], nbytes, ctx, bucket.value, ptr)
+
+    def _sever(self, handle: Handle):
+        """Detach handle fields under the lock; returns (ptr, bucket) or
+        (None, -1) if another thread already freed it."""
+        with self._lock:
+            ptr, handle._ptr = handle._ptr, None
+            bucket, handle._bucket = handle._bucket, -1
+            handle.dptr = None
+            return ptr, bucket
+
+    def free(self, handle: Handle):
+        ptr, bucket = self._sever(handle)
+        if ptr is not None:
+            self._lib.sp_free(self._pool, ptr, bucket)
+
+    def direct_free(self, handle: Handle):
+        ptr, _ = self._sever(handle)
+        if ptr is not None:
+            self._lib.sp_free(self._pool, ptr, -1)
+
+    def release_all(self):
+        if self._pool is not None:
+            self._lib.sp_release_all(self._pool)
+
+    def info(self):
+        import ctypes
+        held = ctypes.c_int64(0)
+        hits = ctypes.c_int64(0)
+        misses = ctypes.c_int64(0)
+        if self._pool is not None:
+            self._lib.sp_info(self._pool, ctypes.byref(held),
+                              ctypes.byref(hits), ctypes.byref(misses))
+        return {"strategy": self._strategy, "native": True,
+                "held_bytes": held.value, "limit_bytes": self._limit,
+                "hits": hits.value, "misses": misses.value,
+                "buckets": {}}  # native pool does not expose per-bucket fill
+
+
+def _load_native_pool():
+    """dlopen src/storage_pool.cc's library (building if needed), or None."""
+    import ctypes
+
+    from .base import load_native_lib
+    lib = load_native_lib("libstoragepool.so", "storage_pool.cc")
+    if lib is None:
+        return None
+    lib.sp_create.restype = ctypes.c_void_p
+    lib.sp_create.argtypes = [ctypes.c_int, ctypes.c_int64, ctypes.c_int]
+    lib.sp_alloc.restype = ctypes.c_void_p
+    lib.sp_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                             ctypes.POINTER(ctypes.c_int64)]
+    lib.sp_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+    lib.sp_release_all.argtypes = [ctypes.c_void_p]
+    lib.sp_info.argtypes = [ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                            ctypes.POINTER(ctypes.c_int64),
+                            ctypes.POINTER(ctypes.c_int64)]
+    lib.sp_destroy.argtypes = [ctypes.c_void_p]
+    return _NativePool(lib)
+
+
+_pool = _load_native_pool() or _HostPool()
 
 
 class Storage:
@@ -283,7 +395,7 @@ class Storage:
 
     def direct_free(self, handle: Handle):
         """Bypass the pool (ref: Storage::DirectFree)."""
-        handle._bucket = -1
+        _pool.direct_free(handle)
 
     def release_all(self, ctx=None):
         _pool.release_all()

@@ -1,0 +1,124 @@
+// Native host storage pool.
+//
+// ref: src/storage/pooled_storage_manager.h — GPUPooledStorageManager
+// (exact-size free lists) and GPUPooledRoundedStorageManager (power-of-two
+// buckets below MXNET_GPU_MEM_POOL_ROUND_LINEAR_CUTOFF).  On TPU the
+// device side is owned by PJRT, so the native pool manages HOST staging
+// memory (page-aligned, reused across batches) — the same role the
+// reference's CPU pinned pool plays for its data pipeline.  Bound from
+// Python via ctypes (mxnet_tpu/storage.py); the pure-Python numpy pool is
+// the fallback when this library is absent.
+//
+// Build: make -C src   (produces ../mxnet_tpu/_lib/libstoragepool.so)
+#include <cstdint>
+#include <cstdlib>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kPage = 4096;
+
+struct Pool {
+  // strategy: 0 = Naive (exact/page buckets), 1 = Round (pow2 < cutoff)
+  int strategy = 0;
+  int round_cutoff = 24;
+  int64_t limit = 0;       // max bytes retained in free lists
+  int64_t held = 0;
+  int64_t hits = 0;
+  int64_t misses = 0;
+  std::unordered_map<int64_t, std::vector<void*>> free_lists;
+  std::mutex mu;
+
+  int64_t BucketOf(int64_t nbytes) const {
+    if (nbytes < 1) nbytes = 1;
+    if (strategy == 1 && nbytes < (int64_t{1} << round_cutoff)) {
+      int64_t b = 1;
+      while (b < nbytes) b <<= 1;
+      return b < 2 ? 2 : b;
+    }
+    return (nbytes + kPage - 1) / kPage * kPage;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sp_create(int strategy, int64_t limit_bytes, int round_cutoff) {
+  Pool* p = new Pool();
+  p->strategy = strategy;
+  p->limit = limit_bytes;
+  p->round_cutoff = round_cutoff;
+  return p;
+}
+
+void sp_destroy(void* pool) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (!p) return;
+  for (auto& kv : p->free_lists)
+    for (void* ptr : kv.second) std::free(ptr);
+  delete p;
+}
+
+// Returns a page-aligned pointer; *bucket_out is the rounded size the
+// caller must hand back to sp_free.
+void* sp_alloc(void* pool, int64_t nbytes, int64_t* bucket_out) {
+  Pool* p = static_cast<Pool*>(pool);
+  const int64_t bucket = p->BucketOf(nbytes);
+  *bucket_out = bucket;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    auto it = p->free_lists.find(bucket);
+    if (it != p->free_lists.end() && !it->second.empty()) {
+      void* ptr = it->second.back();
+      it->second.pop_back();
+      p->held -= bucket;
+      ++p->hits;
+      return ptr;
+    }
+    ++p->misses;
+  }
+  void* ptr = nullptr;
+  if (posix_memalign(&ptr, kPage, static_cast<size_t>(bucket)) != 0)
+    return nullptr;
+  return ptr;
+}
+
+void sp_free(void* pool, void* ptr, int64_t bucket) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (!ptr) return;
+  if (bucket < 0) {  // DirectFree: bypass the pool entirely
+    std::free(ptr);
+    return;
+  }
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    if (p->held + bucket <= p->limit) {
+      p->free_lists[bucket].push_back(ptr);
+      p->held += bucket;
+      return;
+    }
+  }
+  std::free(ptr);
+}
+
+void sp_release_all(void* pool) {
+  Pool* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lk(p->mu);
+  for (auto& kv : p->free_lists)
+    for (void* ptr : kv.second) std::free(ptr);
+  p->free_lists.clear();
+  p->held = 0;
+}
+
+void sp_info(void* pool, int64_t* held, int64_t* hits, int64_t* misses) {
+  Pool* p = static_cast<Pool*>(pool);
+  std::lock_guard<std::mutex> lk(p->mu);
+  *held = p->held;
+  *hits = p->hits;
+  *misses = p->misses;
+}
+
+}  // extern "C"

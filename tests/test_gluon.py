@@ -263,3 +263,24 @@ def test_block_repr_and_summary(capsys):
     net.summary(nd.ones((1, 3)))
     out = capsys.readouterr().out
     assert "Total params" in out
+
+
+def test_gluon_utils_split_and_clip():
+    """gluon.utils (ref: python/mxnet/gluon/utils.py)."""
+    from mxnet_tpu.gluon import utils
+    x = mx.nd.array(np.arange(24, dtype=np.float32).reshape(6, 4))
+    parts = utils.split_data(x, 3)
+    assert [p.shape for p in parts] == [(2, 4)] * 3
+    np.testing.assert_array_equal(parts[1].asnumpy(), x.asnumpy()[2:4])
+    with pytest.raises(ValueError):
+        utils.split_data(x, 4)  # uneven
+    loaded = utils.split_and_load(x, [mx.cpu(), mx.cpu()])
+    assert len(loaded) == 2 and loaded[0].shape == (3, 4)
+
+    grads = [mx.nd.array(np.full((4,), 3.0, np.float32)),
+             mx.nd.array(np.full((2,), 4.0, np.float32))]
+    total = utils.clip_global_norm(grads, 1.0)
+    expect = np.sqrt(9 * 4 + 16 * 2)
+    assert abs(total - expect) < 1e-4
+    new_norm = np.sqrt(sum(float((g * g).sum().asnumpy()) for g in grads))
+    assert abs(new_norm - 1.0) < 1e-3  # rescaled to max_norm

@@ -1,0 +1,122 @@
+"""mx.image legacy API (ref: python/mxnet/image/image.py;
+tests/python/unittest/test_image.py)."""
+import io as _pyio
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, recordio
+
+
+@pytest.fixture()
+def jpeg_bytes():
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 255, (48, 64, 3), np.uint8)
+    buf = _pyio.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")  # lossless for exactness
+    return buf.getvalue(), arr
+
+
+def test_imdecode_shapes_and_grayscale(jpeg_bytes):
+    raw, arr = jpeg_bytes
+    img = image.imdecode(raw)
+    assert img.shape == (48, 64, 3)
+    np.testing.assert_array_equal(img.asnumpy(), arr)
+    gray = image.imdecode(raw, flag=0)
+    assert gray.shape == (48, 64, 1)
+    bgr = image.imdecode(raw, to_rgb=False)
+    np.testing.assert_array_equal(bgr.asnumpy(), arr[..., ::-1])
+
+
+def test_imread_and_resize(tmp_path, jpeg_bytes):
+    raw, arr = jpeg_bytes
+    p = str(tmp_path / "x.png")
+    with open(p, "wb") as f:
+        f.write(raw)
+    img = image.imread(p)
+    assert img.shape == (48, 64, 3)
+    small = image.imresize(img, 32, 24)
+    assert small.shape == (24, 32, 3)
+    short = image.resize_short(img, 24)
+    assert min(short.shape[:2]) == 24
+
+
+def test_crops(jpeg_bytes):
+    _, arr = jpeg_bytes
+    img = mx.nd.array(arr)
+    fixed = image.fixed_crop(img, 4, 2, 16, 12)
+    np.testing.assert_array_equal(fixed.asnumpy(), arr[2:14, 4:20])
+    c, (x0, y0, w, h) = image.center_crop(img, (32, 32))
+    assert c.shape == (32, 32, 3) and w == 32 and h == 32
+    r, box = image.random_crop(img, (16, 16),
+                               rng=np.random.RandomState(1))
+    assert r.shape == (16, 16, 3)
+
+
+def test_color_normalize(jpeg_bytes):
+    _, arr = jpeg_bytes
+    out = image.color_normalize(mx.nd.array(arr.astype(np.float32)),
+                                mean=np.array([1.0, 2.0, 3.0], np.float32),
+                                std=np.array([2.0, 2.0, 2.0], np.float32))
+    np.testing.assert_allclose(
+        out.asnumpy(), (arr.astype(np.float32) - [1, 2, 3]) / 2.0, rtol=1e-6)
+
+
+def test_augmenter_list_and_dumps():
+    augs = image.CreateAugmenter((3, 24, 24), resize=28, rand_mirror=True,
+                                 mean=True, std=True)
+    kinds = [type(a).__name__ for a in augs]
+    assert kinds == ["ResizeAug", "CenterCropAug", "HorizontalFlipAug",
+                     "CastAug", "ColorNormalizeAug"]
+    assert all(isinstance(a.dumps(), str) for a in augs)
+    rng = np.random.RandomState(0)
+    img = mx.nd.array(rng.randint(0, 255, (40, 50, 3), np.uint8))
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (24, 24, 3)
+    assert out.dtype == np.float32
+
+
+def test_image_iter_imglist_mode(tmp_path):
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    imglist = []
+    for i in range(6):
+        arr = rng.randint(0, 255, (36, 36, 3), np.uint8)
+        name = f"im{i}.png"
+        Image.fromarray(arr).save(str(tmp_path / name))
+        imglist.append([i % 3, name])
+    it = image.ImageIter(batch_size=4, data_shape=(3, 24, 24),
+                         imglist=imglist, path_root=str(tmp_path))
+    batch = next(it)
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    assert batch.label[0].shape == (4,)
+    batch2 = next(it)
+    assert batch2.pad == 2  # 6 items, round to batch 4
+    with pytest.raises(StopIteration):
+        next(it)
+
+
+def test_image_iter_record_mode(tmp_path):
+    from PIL import Image
+    rec = str(tmp_path / "d.rec")
+    idx = str(tmp_path / "d.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(8):
+        buf = _pyio.BytesIO()
+        Image.fromarray(rng.randint(0, 255, (40, 40, 3), np.uint8)) \
+            .save(buf, format="JPEG")
+        w.write_idx(i, recordio.pack(recordio.IRHeader(0, float(i % 4), i, 0),
+                                     buf.getvalue()))
+    w.close()
+    it = image.ImageIter(batch_size=4, data_shape=(3, 32, 32),
+                         path_imgrec=rec, path_imgidx=idx, shuffle=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (4, 3, 32, 32)
+    it.close()

@@ -235,3 +235,22 @@ def test_opperf_harness():
     for r in res:
         assert "avg_time_ms" in r, r
         assert r["avg_time_ms"] > 0
+
+
+def test_quantize_net_survives_calibration_failure():
+    """A bad calibration batch must not leave collector wrappers or lost
+    hybridization behind (regression)."""
+    from mxnet_tpu.contrib.quantization import quantize_net
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=6))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.ones((2, 6), np.float32))
+    net(x)
+    with pytest.raises(Exception):
+        quantize_net(net, calib_data=[np.ones((2, 3), np.float32)])  # bad shape
+    kinds = [type(c).__name__ for c in net._children.values()]
+    assert kinds == ["Dense"]  # collectors unwrapped
+    assert getattr(net, "_active", False)  # hybridization restored
+    out = net(x)
+    assert out.shape == (2, 4)

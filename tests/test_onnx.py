@@ -130,3 +130,29 @@ def test_export_function_plain(tmp_path):
     got = mx.onnx.import_to_function(path)(x)[0]
     np.testing.assert_allclose(got, np.tanh(x) * 2 + x.sum(1, keepdims=True),
                                atol=1e-5)
+
+
+def test_import_handles_omitted_optional_inputs(tmp_path):
+    """Empty-string input names (ONNX omitted-optional convention) keep
+    later inputs in position (regression: Clip(x, '', max) mis-bound)."""
+    from mxnet_tpu.onnx import proto
+    from mxnet_tpu.onnx.export import _node, _tensor, _value_info
+    import numpy as np
+    nodes = proto.field_bytes(1, _node(
+        "Clip", ["data", "", "himax"], ["output"], "clip0", {}))
+    graph = (nodes
+             + proto.field_str(2, "t")
+             + proto.field_bytes(5, _tensor("himax",
+                                            np.asarray(2.0, np.float32)))
+             + proto.field_bytes(11, _value_info("data", (4,), np.float32))
+             + proto.field_bytes(12, _value_info("output", (4,), np.float32)))
+    model = (proto.field_varint(1, 8) + proto.field_bytes(7, graph)
+             + proto.field_bytes(8, proto.field_str(1, "")
+                                 + proto.field_varint(2, 13)))
+    p = str(tmp_path / "clip.onnx")
+    with open(p, "wb") as f:
+        f.write(model)
+    fn = mx.onnx.import_to_function(p)
+    x = np.array([-5.0, 0.5, 3.0, 10.0], np.float32)
+    got = fn(x)[0]
+    np.testing.assert_allclose(got, np.minimum(x, 2.0))  # clip from above only

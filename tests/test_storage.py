@@ -42,16 +42,21 @@ def test_inplace_ops_do_not_corrupt_accounting():
     """a += 1 rebinds a._data; the finalizer rides the buffer, not the
     wrapper, so counts stay exact (regression: wrapper-keyed accounting
     double-freed)."""
+    from mxnet_tpu import engine
+    engine.waitall()  # purge prior tests' tracked arrays and garbage
+    gc.collect()
     base = storage.live_bytes()
     a = mx.nd.array(np.ones((128, 128), np.float32))
     nbytes = 128 * 128 * 4
     for _ in range(3):
         a += 1.0
-        gc.collect()
+    engine.waitall()  # drop the tracking ring's strong refs to the temps
+    gc.collect()
     live = storage.live_bytes()
     # exactly one live buffer for `a` (temps collected), never negative
     assert base + nbytes <= live <= base + 2 * nbytes
     del a
+    engine.waitall()
     gc.collect()
     assert storage.live_bytes() <= live - nbytes
 
@@ -73,15 +78,21 @@ def test_reset_peak():
     del x
 
 
+def _buf_identity(h):
+    """Backend-agnostic identity of the memory behind a handle."""
+    if h._ptr is not None:
+        return h._ptr
+    return id(h.dptr.base if h.dptr.base is not None else h.dptr)
+
+
 def test_host_pool_naive_reuse():
     s = storage.Storage.get()
     h1 = s.alloc(10000)
-    base1 = h1.dptr.base if h1.dptr.base is not None else h1.dptr
+    ident1 = _buf_identity(h1)
     assert h1.size == 10000
     s.free(h1)
     h2 = s.alloc(10000)
-    base2 = h2.dptr.base if h2.dptr.base is not None else h2.dptr
-    assert base2 is base1  # recycled from the free list
+    assert _buf_identity(h2) == ident1  # recycled from the free list
     s.free(h2)
     info = storage.pool_info()
     assert info["hits"] >= 1
@@ -125,9 +136,7 @@ def test_double_free_is_harmless():
     s.free(h)  # second free must be a no-op, not a duplicate pool entry
     h1 = s.alloc(4096)
     h2 = s.alloc(4096)
-    b1 = h1.dptr.base if h1.dptr.base is not None else h1.dptr
-    b2 = h2.dptr.base if h2.dptr.base is not None else h2.dptr
-    assert b1 is not b2
+    assert _buf_identity(h1) != _buf_identity(h2)
     s.free(h1)
     s.free(h2)
 
@@ -203,3 +212,52 @@ def test_image_record_iter_uses_pool(tmp_path):
     # second batch re-used the first batch's pooled buffer
     assert storage.pool_info()["hits"] >= hits0 + 1
     it.close()
+
+
+def test_native_pool_loaded_and_roundtrips():
+    """The C++ pool (src/storage_pool.cc) builds, loads, and serves
+    aligned reusable buffers (ref: pooled_storage_manager.h)."""
+    pool = storage._load_native_pool()
+    if pool is None:
+        pytest.skip("native pool library unavailable (no toolchain)")
+    h = pool.alloc(5000)
+    assert h._ptr is not None and h._ptr % 4096 == 0  # page-aligned
+    h.dptr[:] = 7
+    assert int(h.dptr[4999]) == 7
+    addr = h._ptr
+    pool.free(h)
+    assert h.dptr is None and h._ptr is None  # free severs the view
+    h2 = pool.alloc(6000)  # same page-rounded bucket (8192) → same memory
+    assert h2._ptr == addr
+    assert pool.info()["native"] and pool.info()["hits"] == 1
+    pool.direct_free(h2)
+    assert pool.info()["held_bytes"] == 0
+
+
+def test_waitall_ring_tracks_dropped_outputs():
+    """waitall must barrier work whose outputs the user dropped: the ring
+    holds strong refs (bounded by MXNET_ENGINE_TRACK_BYTES_MB), and
+    waitall clears it (regression: weakref ring skipped dropped work)."""
+    from mxnet_tpu import engine
+    engine.waitall()
+    for _ in range(4):
+        mx.nd.array(np.ones((32, 32), np.float32)) + 1.0  # result dropped
+    with engine._LOCK:
+        held = sum(len(r) for r in engine._RECENT.values())
+    assert held >= 1  # dropped outputs still tracked
+    engine.waitall()
+    with engine._LOCK:
+        assert not engine._RECENT and not engine._RECENT_BYTES
+
+
+def test_waitall_ring_byte_budget():
+    """Tracking never pins more than the configured budget (newest kept)."""
+    from mxnet_tpu import engine
+    engine.waitall()
+    big = np.ones((1024, 1024), np.float32)  # 4MB each
+    for _ in range(3):
+        mx.nd.array(big) * 2.0
+    with engine._LOCK:
+        total = sum(engine._RECENT_BYTES.values())
+    assert total <= engine._TRACK_BYTES + big.nbytes
+    engine.waitall()

@@ -1,0 +1,76 @@
+"""KVStore bandwidth microbenchmark.
+
+ref: tools/bandwidth/measure.py — measures push/pull throughput of a
+kvstore across devices for a range of array sizes; used to size
+gradient-aggregation traffic.  TPU-native: the same sweep over the
+collective-backed kvstore (ICI on real hardware; on CPU it exercises the
+virtual mesh).
+
+    python tools/bandwidth.py [--kvstore device] [--sizes 1e5,1e6,1e7]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import engine  # noqa: E402
+
+
+def measure(kv_type="device", sizes=(100_000, 1_000_000, 10_000_000),
+            repeat=10, emit_json=False):
+    kv = mx.kv.create(kv_type)
+    results = []
+    for n in sizes:
+        n = int(n)
+        key = f"bw_{n}"
+        grad = mx.nd.array(np.random.RandomState(0).randn(n)
+                           .astype(np.float32))
+        kv.init(key, mx.nd.zeros((n,)))
+        out = mx.nd.zeros((n,))
+        kv.push(key, grad)          # warm the compiled path
+        kv.pull(key, out=out)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            # each push chains on the previous pull so no iteration can be
+            # served from a cached/idempotent result
+            kv.push(key, out + 1.0)
+            kv.pull(key, out=out)
+        out.wait_to_read()
+        engine.waitall()
+        dt = (time.perf_counter() - t0) / repeat
+        nbytes = n * 4
+        gbps = 2 * nbytes / dt / 1e9  # push + pull
+        results.append({"size": n, "bytes": nbytes,
+                        "avg_roundtrip_ms": round(dt * 1e3, 3),
+                        "GB_per_s": round(gbps, 3)})
+    for r in results:
+        if emit_json:
+            print(json.dumps(r))
+        else:
+            print(f"size {r['size']:>12,}  {r['avg_roundtrip_ms']:>10.3f} ms"
+                  f"  {r['GB_per_s']:>8.3f} GB/s")
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--kvstore", default="device")
+    ap.add_argument("--sizes", default="1e5,1e6,1e7")
+    ap.add_argument("--repeat", type=int, default=10)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+    sizes = [int(float(s)) for s in args.sizes.split(",")]
+    measure(args.kvstore, sizes, args.repeat, args.json)
+
+
+if __name__ == "__main__":
+    main()
