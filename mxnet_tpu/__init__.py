@@ -51,6 +51,7 @@ from . import callback
 from . import operator
 from . import contrib
 from . import image
+from . import util
 ndarray.sparse = sparse      # mx.nd.sparse, matching the reference layout
 from . import numpy as np           # mx.np — numpy-semantics frontend
 from . import numpy_extension as npx  # mx.npx — set_np + neural ops

@@ -2,5 +2,5 @@
 from . import vision
 from .dataloader import DataLoader, default_batchify_fn
 from .dataset import ArrayDataset, Dataset, RecordFileDataset, SimpleDataset
-from .sampler import (BatchSampler, IntervalSampler, RandomSampler, Sampler,
-                      SequentialSampler)
+from .sampler import (BatchSampler, FixedBucketSampler, IntervalSampler,
+                      RandomSampler, Sampler, SequentialSampler)

@@ -4,7 +4,7 @@ from __future__ import annotations
 import numpy as np
 
 __all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
-           "IntervalSampler"]
+           "IntervalSampler", "FixedBucketSampler"]
 
 
 class Sampler:
@@ -95,3 +95,68 @@ class BatchSampler(Sampler):
         if self._last_batch == "discard":
             return n // self._batch_size
         return (n + len(self._prev)) // self._batch_size
+
+
+class FixedBucketSampler(Sampler):
+    """Batch sampler that buckets variable-length sequences (ref: the
+    reference's bucketing story — BucketingModule /
+    gluonnlp.data.FixedBucketSampler; SURVEY §5.7).  On TPU this is
+    load-bearing: padding every batch to the corpus max would waste MXU
+    cycles AND force XLA recompiles per shape — fixed buckets give a
+    small, closed set of compiled shapes.
+
+    lengths: per-sample sequence lengths.
+    num_buckets: bucket boundaries are evenly spaced over the length range.
+    Yields lists of sample indices; every index lands in the tightest
+    bucket whose key >= its length.
+    """
+
+    def __init__(self, lengths, batch_size, num_buckets=10, shuffle=False,
+                 seed=0):
+        import numpy as _np
+        self._lengths = _np.asarray(lengths)
+        self._batch_size = batch_size
+        self._shuffle = shuffle
+        self._rng = _np.random.RandomState(seed)
+        lo, hi = int(self._lengths.min()), int(self._lengths.max())
+        num_buckets = max(1, min(num_buckets, hi - lo + 1))
+        step = max(1, -(-(hi - lo + 1) // num_buckets))
+        self._keys = [min(lo + step * (i + 1) - 1, hi)
+                      for i in range(num_buckets)]
+        self._buckets = [[] for _ in self._keys]
+        for idx, ln in enumerate(self._lengths):
+            for b, key in enumerate(self._keys):
+                if ln <= key:
+                    self._buckets[b].append(idx)
+                    break
+        self._batches = []
+        for b in self._buckets:
+            for i in range(0, len(b), batch_size):
+                self._batches.append(b[i:i + batch_size])
+
+    @property
+    def bucket_keys(self):
+        return list(self._keys)
+
+    def __iter__(self):
+        order = list(range(len(self._batches)))
+        if self._shuffle:
+            self._rng.shuffle(order)
+            for i in order:
+                batch = list(self._batches[i])
+                self._rng.shuffle(batch)
+                yield batch
+        else:
+            for i in order:
+                yield list(self._batches[i])
+
+    def __len__(self):
+        return len(self._batches)
+
+    def stats(self):
+        """Human-readable bucket fill summary (ref: FixedBucketSampler
+        __repr__ statistics)."""
+        lines = []
+        for key, b in zip(self._keys, self._buckets):
+            lines.append(f"len<={key}: {len(b)} samples")
+        return "\n".join(lines)

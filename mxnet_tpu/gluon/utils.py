@@ -47,9 +47,12 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     (ref: clip_global_norm — the PTB recipe's gradient clip)."""
     if not arrays:
         return 0.0
-    total = 0.0
-    sq = [float((a * a).sum().asnumpy()) for a in arrays]
-    total = float(np.sqrt(np.sum(sq)))
+    # accumulate squared norms ON DEVICE; one host sync for the total
+    # (ref: multi_sum_sq + the single blocking read in clip_global_norm)
+    acc = (arrays[0] * arrays[0]).sum()
+    for a in arrays[1:]:
+        acc = acc + (a * a).sum()
+    total = float(np.sqrt(float(acc.asnumpy())))
     if check_isfinite and not np.isfinite(total):
         import warnings
         warnings.warn("nan or inf found in gradients — clip skipped")

@@ -289,8 +289,8 @@ class ImageIter:
             raise StopIteration
         idxs = self._order[self._cur:self._cur + self.batch_size]
         pad = self.batch_size - len(idxs)
-        if pad:
-            idxs = idxs + self._order[:pad]
+        while len(idxs) < self.batch_size:  # datasets smaller than a batch
+            idxs = idxs + self._order[:self.batch_size - len(idxs)]
         self._cur += self.batch_size
         datas, labels = [], []
         for i in idxs:

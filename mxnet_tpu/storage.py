@@ -165,7 +165,8 @@ def reset_peak():
 class Handle:
     """An allocated host buffer (ref: ``Storage::Handle`` — dptr/size/ctx)."""
 
-    __slots__ = ("dptr", "size", "ctx", "_bucket", "_ptr")
+    __slots__ = ("dptr", "size", "ctx", "_bucket", "_ptr", "_fin",
+                 "__weakref__")
 
     def __init__(self, dptr, size, ctx, bucket, ptr=None):
         self.dptr = dptr          # numpy uint8 view, length == size
@@ -173,6 +174,7 @@ class Handle:
         self.ctx = ctx
         self._bucket = bucket     # rounded size the pool stores it under
         self._ptr = ptr           # native pool address (None: python pool)
+        self._fin = None          # leak guard for native buffers
 
 
 def _pool_config():
@@ -312,7 +314,14 @@ class _NativePool:
             raise MemoryError(f"native pool: alloc({nbytes}) failed")
         cbuf = (ctypes.c_uint8 * bucket.value).from_address(ptr)
         arr = np.frombuffer(cbuf, dtype=np.uint8, count=bucket.value)
-        return Handle(arr[:nbytes], nbytes, ctx, bucket.value, ptr)
+        handle = Handle(arr[:nbytes], nbytes, ctx, bucket.value, ptr)
+        # A dropped handle must not leak the malloc'd block (the python
+        # pool's numpy buffers are GC-owned; native ones are not) — the
+        # finalizer returns it to the pool, and free()/direct_free()
+        # detach it first so explicit frees never double-free.
+        handle._fin = weakref.finalize(handle, self._lib.sp_free,
+                                       self._pool, ptr, bucket.value)
+        return handle
 
     def _sever(self, handle: Handle):
         """Detach handle fields under the lock; returns (ptr, bucket) or
@@ -321,6 +330,9 @@ class _NativePool:
             ptr, handle._ptr = handle._ptr, None
             bucket, handle._bucket = handle._bucket, -1
             handle.dptr = None
+            fin, handle._fin = handle._fin, None
+            if fin is not None:
+                fin.detach()
             return ptr, bucket
 
     def free(self, handle: Handle):

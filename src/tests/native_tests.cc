@@ -1,0 +1,130 @@
+// Unit tests for the native runtime components.
+//
+// ref: tests/cpp/ — the reference unit-tests its C++ core (engine,
+// storage) with googletest.  This image ships no gtest, so these are
+// plain assert-style tests with a main(); `make -C src test` builds and
+// runs them, and tests/test_native_cpp.py invokes that from pytest so
+// the python suite gates on them too.
+#include <cassert>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+// --- storage pool ----------------------------------------------------------
+
+extern "C" {
+void* sp_create(int strategy, int64_t limit_bytes, int round_cutoff);
+void sp_destroy(void* pool);
+void* sp_alloc(void* pool, int64_t nbytes, int64_t* bucket_out);
+void sp_free(void* pool, void* ptr, int64_t bucket);
+void sp_release_all(void* pool);
+void sp_info(void* pool, int64_t* held, int64_t* hits, int64_t* misses);
+
+void* rio_open(const char* path, int writable);
+void rio_close(void* handle);
+int64_t rio_write(void* handle, const char* data, uint64_t len);
+int64_t rio_read(void* handle, const char** out);
+int rio_seek(void* handle, int64_t pos);
+int64_t rio_tell(void* handle);
+}
+
+static int tests_run = 0;
+#define CHECK_TRUE(cond)                                                   \
+  do {                                                                     \
+    ++tests_run;                                                           \
+    if (!(cond)) {                                                         \
+      std::fprintf(stderr, "FAILED %s:%d: %s\n", __FILE__, __LINE__,       \
+                   #cond);                                                 \
+      return 1;                                                            \
+    }                                                                      \
+  } while (0)
+
+static int TestPoolReuse() {
+  void* p = sp_create(/*strategy=*/0, /*limit=*/1 << 20, 24);
+  int64_t b1 = 0, b2 = 0;
+  void* a = sp_alloc(p, 5000, &b1);
+  CHECK_TRUE(a != nullptr);
+  CHECK_TRUE(b1 == 8192);  // page-rounded
+  CHECK_TRUE(reinterpret_cast<uintptr_t>(a) % 4096 == 0);
+  std::memset(a, 0xAB, 5000);
+  sp_free(p, a, b1);
+  void* b = sp_alloc(p, 6000, &b2);
+  CHECK_TRUE(b == a);      // same bucket → recycled
+  int64_t held, hits, misses;
+  sp_info(p, &held, &hits, &misses);
+  CHECK_TRUE(hits == 1 && misses == 1);
+  sp_free(p, b, b2);
+  sp_info(p, &held, &hits, &misses);
+  CHECK_TRUE(held == 8192);
+  sp_release_all(p);
+  sp_info(p, &held, &hits, &misses);
+  CHECK_TRUE(held == 0);
+  sp_destroy(p);
+  return 0;
+}
+
+static int TestPoolRoundStrategy() {
+  void* p = sp_create(/*strategy=*/1, /*limit=*/1 << 24, 10);
+  int64_t b = 0;
+  void* a = sp_alloc(p, 600, &b);
+  CHECK_TRUE(b == 1024);   // pow2 below cutoff 2^10
+  sp_free(p, a, b);
+  void* c = sp_alloc(p, 2000, &b);  // above cutoff → page rounding
+  CHECK_TRUE(b == 4096);
+  sp_free(p, c, b);
+  sp_destroy(p);
+  return 0;
+}
+
+static int TestPoolLimit() {
+  void* p = sp_create(0, /*limit=*/4096, 24);
+  int64_t b = 0;
+  void* a = sp_alloc(p, 8192, &b);
+  sp_free(p, a, b);  // 8192 > limit → freed, not pooled
+  int64_t held, hits, misses;
+  sp_info(p, &held, &hits, &misses);
+  CHECK_TRUE(held == 0);
+  sp_destroy(p);
+  return 0;
+}
+
+static int TestRecordIORoundtrip() {
+  const char* path = "/tmp/native_test.rec";
+  void* w = rio_open(path, 1);
+  CHECK_TRUE(w != nullptr);
+  const std::string r1 = "hello record";
+  std::string r2(1000, 'x');
+  r2[0] = 'y';
+  CHECK_TRUE(rio_write(w, r1.data(), r1.size()) >= 0);
+  int64_t pos2 = rio_tell(w);
+  CHECK_TRUE(rio_write(w, r2.data(), r2.size()) >= 0);
+  rio_close(w);
+
+  void* r = rio_open(path, 0);
+  const char* out = nullptr;
+  int64_t n = rio_read(r, &out);
+  CHECK_TRUE(n == static_cast<int64_t>(r1.size()));
+  CHECK_TRUE(std::memcmp(out, r1.data(), n) == 0);
+  n = rio_read(r, &out);
+  CHECK_TRUE(n == static_cast<int64_t>(r2.size()));
+  CHECK_TRUE(out[0] == 'y' && out[999] == 'x');
+  n = rio_read(r, &out);
+  CHECK_TRUE(n < 0);  // EOF
+  rio_seek(r, pos2);
+  n = rio_read(r, &out);
+  CHECK_TRUE(n == static_cast<int64_t>(r2.size()));
+  rio_close(r);
+  std::remove(path);
+  return 0;
+}
+
+int main() {
+  if (TestPoolReuse()) return 1;
+  if (TestPoolRoundStrategy()) return 1;
+  if (TestPoolLimit()) return 1;
+  if (TestRecordIORoundtrip()) return 1;
+  std::printf("native tests: %d checks passed\n", tests_run);
+  return 0;
+}

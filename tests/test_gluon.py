@@ -284,3 +284,26 @@ def test_gluon_utils_split_and_clip():
     assert abs(total - expect) < 1e-4
     new_norm = np.sqrt(sum(float((g * g).sum().asnumpy()) for g in grads))
     assert abs(new_norm - 1.0) < 1e-3  # rescaled to max_norm
+
+
+def test_fixed_bucket_sampler():
+    """Bucketing for variable-length sequences (ref: SURVEY §5.7 — the
+    reference's bucketing story; fixed shape set avoids XLA recompiles)."""
+    from mxnet_tpu.gluon.data import FixedBucketSampler
+    rng = np.random.RandomState(0)
+    lengths = rng.randint(5, 120, size=200)
+    s = FixedBucketSampler(lengths, batch_size=16, num_buckets=5,
+                           shuffle=True)
+    seen = []
+    for batch in s:
+        assert len(batch) <= 16
+        blens = lengths[batch]
+        # every sample fits its bucket key, and the batch spans ONE bucket
+        keys = [k for k in s.bucket_keys if blens.max() <= k]
+        assert keys, (blens.max(), s.bucket_keys)
+        tight = keys[0]
+        assert all(l <= tight for l in blens)
+        seen.extend(batch)
+    assert sorted(seen) == list(range(200))  # exact cover, no dupes
+    assert len(s) == sum(1 for _ in s)
+    assert "samples" in s.stats()

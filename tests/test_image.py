@@ -120,3 +120,20 @@ def test_image_iter_record_mode(tmp_path):
     assert len(batches) == 2
     assert batches[0].data[0].shape == (4, 3, 32, 32)
     it.close()
+
+
+def test_image_iter_dataset_smaller_than_batch(tmp_path):
+    """pad wraps the tiny dataset to a FULL batch (regression: short
+    batch with overstated pad)."""
+    from PIL import Image
+    rng = np.random.RandomState(0)
+    imglist = []
+    for i in range(2):
+        Image.fromarray(rng.randint(0, 255, (36, 36, 3), np.uint8)) \
+            .save(str(tmp_path / f"t{i}.png"))
+        imglist.append([i, f"t{i}.png"])
+    it = image.ImageIter(batch_size=8, data_shape=(3, 24, 24),
+                         imglist=imglist, path_root=str(tmp_path))
+    b = next(it)
+    assert b.data[0].shape == (8, 3, 24, 24)
+    assert b.pad == 6

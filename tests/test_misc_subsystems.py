@@ -254,3 +254,23 @@ def test_quantize_net_survives_calibration_failure():
     assert getattr(net, "_active", False)  # hybridization restored
     out = net(x)
     assert out.shape == (2, 4)
+
+
+def test_util_module():
+    """mx.util surface (ref: python/mxnet/util.py)."""
+    import tempfile
+    d = tempfile.mkdtemp()
+    mx.util.makedirs(os.path.join(d, "a/b/c"))
+    assert os.path.isdir(os.path.join(d, "a/b/c"))
+    mx.util.makedirs(os.path.join(d, "a/b/c"))  # idempotent
+    assert mx.util.getenv("MXNET_ENGINE_TYPE") == "ThreadedEnginePerDevice"
+    mx.util.setenv("MXNET_TEST_DUMMY", "42")
+    assert os.environ["MXNET_TEST_DUMMY"] == "42"
+    assert mx.util.is_np_array() in (True, False)
+
+    @mx.util.use_np
+    def np_mode_fn():
+        return mx.util.is_np_array()
+
+    assert np_mode_fn() is True
+    assert mx.util.is_np_array() is False  # reset after the call

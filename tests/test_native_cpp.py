@@ -1,0 +1,22 @@
+"""Gate the native C++ unit tests from pytest.
+
+ref: tests/cpp/ — the reference's googletest suites (engine ordering,
+storage pooling) run inside CI alongside the python tests; here
+``make -C src test`` builds src/tests/native_tests.cc against both native
+cores and the python suite fails if any check fails.
+"""
+import os
+import shutil
+import subprocess
+
+import pytest
+
+
+def test_native_cpp_suite():
+    src = os.path.join(os.path.dirname(__file__), "..", "src")
+    if shutil.which("make") is None or shutil.which("g++") is None:
+        pytest.skip("no native toolchain")
+    res = subprocess.run(["make", "-C", src, "test"], capture_output=True,
+                         text=True, timeout=300)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "checks passed" in res.stdout

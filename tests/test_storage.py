@@ -261,3 +261,18 @@ def test_waitall_ring_byte_budget():
         total = sum(engine._RECENT_BYTES.values())
     assert total <= engine._TRACK_BYTES + big.nbytes
     engine.waitall()
+
+
+def test_native_pool_dropped_handle_does_not_leak():
+    """A Handle dropped without free() returns its native buffer to the
+    pool via the finalizer (regression: posix_memalign leak)."""
+    pool = storage._load_native_pool()
+    if pool is None:
+        pytest.skip("native pool library unavailable")
+    h = pool.alloc(7000)
+    addr = h._ptr
+    del h
+    gc.collect()
+    h2 = pool.alloc(7000)  # finalizer returned the buffer → pool hit
+    assert h2._ptr == addr
+    pool.free(h2)

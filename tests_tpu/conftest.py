@@ -1,0 +1,14 @@
+"""TPU re-run harness: same seeding as tests/conftest.py but WITHOUT the
+XLA:CPU platform pin — the whole point is running on the accelerator
+(ref: tests/python/gpu/test_operator_gpu.py setup)."""
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import mxnet_tpu as mx
+
+    np.random.seed(0)
+    mx.random.seed(0)
+    yield
