@@ -52,6 +52,10 @@ from . import operator
 from . import contrib
 from . import image
 from . import util
+from . import runtime
+from . import test_utils
+from . import visualization
+from . import visualization as viz
 ndarray.sparse = sparse      # mx.nd.sparse, matching the reference layout
 from . import numpy as np           # mx.np — numpy-semantics frontend
 from . import numpy_extension as npx  # mx.npx — set_np + neural ops

@@ -89,6 +89,9 @@ class Registry:
         return sorted(self._entries)
 
 
+_native_lib_cache: dict = {}
+
+
 def load_native_lib(so_name: str, source_cc: str):
     """dlopen a native core from mxnet_tpu/_lib, building it via ``make -C
     src`` first if the shared object is missing (ref: libmxnet.so loading
@@ -98,6 +101,9 @@ def load_native_lib(so_name: str, source_cc: str):
     import ctypes
     import os
     import subprocess
+
+    if so_name in _native_lib_cache:  # memoized, incl. failures (None) —
+        return _native_lib_cache[so_name]  # never re-runs `make` per call
 
     pkg = os.path.dirname(os.path.abspath(__file__))
     path = os.path.join(pkg, "_lib", so_name)
@@ -109,9 +115,11 @@ def load_native_lib(so_name: str, source_cc: str):
                                timeout=120, check=False)
             except Exception:
                 pass
-    if not os.path.exists(path):
-        return None
-    try:
-        return ctypes.CDLL(path)
-    except OSError:
-        return None
+    lib = None
+    if os.path.exists(path):
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError:
+            lib = None
+    _native_lib_cache[so_name] = lib
+    return lib

@@ -97,6 +97,23 @@ def _unflatten_nd(tree, leaves):
     return _walk(tree)
 
 
+class _HookHandle:
+    """Removable hook registration (ref: mxnet.gluon.utils.HookHandle)."""
+
+    __slots__ = ("_hooks", "_hook")
+
+    def __init__(self, hooks, hook):
+        self._hooks = hooks
+        self._hook = hook
+
+    def detach(self):
+        if self._hook is not None and self._hook in self._hooks:
+            self._hooks.remove(self._hook)
+        self._hook = None
+
+    remove = detach  # torch-style alias
+
+
 class Block:
     """Base neural-network container (ref: gluon/block.py — class Block)."""
 
@@ -135,11 +152,11 @@ class Block:
 
     def register_forward_hook(self, hook):
         self._forward_hooks.append(hook)
-        return hook
+        return _HookHandle(self._forward_hooks, hook)
 
     def register_forward_pre_hook(self, hook):
         self._forward_pre_hooks.append(hook)
-        return hook
+        return _HookHandle(self._forward_pre_hooks, hook)
 
     @property
     def prefix(self):
@@ -245,20 +262,57 @@ class Block:
         raise NotImplementedError
 
     def summary(self, *inputs):
-        """Print a per-block param-count table (ref: Block.summary)."""
+        """Print a per-block table (ref: Block.summary).  With example
+        ``inputs``, runs one hooked forward and includes each block's
+        output shape, like the reference; without inputs, prints the
+        param-count table only."""
+        shapes = {}
+        if inputs:
+            removers = []
+
+            def _capture(blk, _args, out):
+                leaf = out[0] if isinstance(out, (tuple, list)) else out
+                if hasattr(leaf, "shape"):
+                    shapes[id(blk)] = tuple(leaf.shape)
+
+            def _hook_all(b):
+                removers.append(b.register_forward_hook(_capture))
+                for c in b._children.values():
+                    _hook_all(c)
+
+            _hook_all(self)
+            try:
+                from .. import autograd as _ag
+                with _ag.pause():
+                    Block.__call__(self, *inputs)
+            finally:
+                for r in removers:
+                    r.detach()
+
         rows = []
+
         def _walk(b, depth):
             n = sum(int(np.prod(p.shape)) for p in b._params.values()
                     if p.shape is not None)
-            rows.append(("  " * depth + type(b).__name__, b.name, n))
+            rows.append(("  " * depth + type(b).__name__, b.name, n,
+                         shapes.get(id(b), "")))
             for c in b._children.values():
                 _walk(c, depth + 1)
         _walk(self, 0)
         total = sum(int(np.prod(p.shape)) for p in self.collect_params().values()
                     if p.shape is not None)
-        lines = [f"{'Layer':<40}{'Name':<28}{'Params':>12}", "-" * 80]
-        lines += [f"{a:<40}{b:<28}{c:>12}" for a, b, c in rows]
-        lines += ["-" * 80, f"{'Total params:':<68}{total:>12}"]
+        shp = bool(shapes)
+        hdr = f"{'Layer':<34}{'Name':<24}{'Params':>10}"
+        if shp:
+            hdr += f"  {'Output Shape'}"
+        lines = [hdr, "-" * (80 if shp else 68)]
+        for a, b, c, s in rows:
+            line = f"{a:<34}{b:<24}{c:>10}"
+            if shp:
+                line += f"  {s}"
+            lines.append(line)
+        lines += ["-" * (80 if shp else 68),
+                  f"{'Total params:':<58}{total:>10}"]
         print("\n".join(lines))
 
     def __repr__(self):

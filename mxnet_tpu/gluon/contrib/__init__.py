@@ -1,2 +1,3 @@
 """gluon.contrib (ref: python/mxnet/gluon/contrib/) — experimental blocks."""
 from . import nn
+from . import estimator

@@ -316,10 +316,13 @@ class _NativePool:
         arr = np.frombuffer(cbuf, dtype=np.uint8, count=bucket.value)
         handle = Handle(arr[:nbytes], nbytes, ctx, bucket.value, ptr)
         # A dropped handle must not leak the malloc'd block (the python
-        # pool's numpy buffers are GC-owned; native ones are not) — the
-        # finalizer returns it to the pool, and free()/direct_free()
-        # detach it first so explicit frees never double-free.
-        handle._fin = weakref.finalize(handle, self._lib.sp_free,
+        # pool's numpy buffers are GC-owned; native ones are not).  The
+        # finalizer rides the base VIEW, not the Handle: any escaped
+        # dptr-derived view keeps `arr` alive through its .base chain, so
+        # GC reclamation can never free memory a live view still sees.
+        # Explicit free()/direct_free() detach it (the caller asserts no
+        # views remain — the documented pool contract).
+        handle._fin = weakref.finalize(arr, self._lib.sp_free,
                                        self._pool, ptr, bucket.value)
         return handle
 
@@ -327,12 +330,15 @@ class _NativePool:
         """Detach handle fields under the lock; returns (ptr, bucket) or
         (None, -1) if another thread already freed it."""
         with self._lock:
-            ptr, handle._ptr = handle._ptr, None
-            bucket, handle._bucket = handle._bucket, -1
-            handle.dptr = None
+            # detach BEFORE dropping dptr: clearing the view may collect
+            # the base array immediately (refcounting) and a still-armed
+            # finalizer would return the buffer a second time
             fin, handle._fin = handle._fin, None
             if fin is not None:
                 fin.detach()
+            ptr, handle._ptr = handle._ptr, None
+            bucket, handle._bucket = handle._bucket, -1
+            handle.dptr = None
             return ptr, bucket
 
     def free(self, handle: Handle):

@@ -1,0 +1,39 @@
+"""mx.viz — network summaries.
+
+ref: python/mxnet/visualization.py — ``print_summary`` (layer table with
+output shapes and parameter counts) and ``plot_network`` (graphviz).
+Here ``print_summary`` works on Gluon blocks (the graph IS the block
+tree + traced forward); ``plot_network`` requires graphviz and raises a
+clear error when it is unavailable in the image.
+"""
+from __future__ import annotations
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(block, shape=None, **kwargs):
+    """Print a layer-by-layer summary of a Gluon block.
+
+    ``shape``: optional input shape (or list of shapes) INCLUDING batch
+    dim, e.g. ``(1, 3, 224, 224)`` — mirrors the reference's shape dict.
+    With a shape, ``Block.summary`` runs one hooked forward and the table
+    includes per-layer output shapes; without, it prints param counts
+    only.
+    """
+    import numpy as np
+
+    from . import ndarray as nd
+
+    if shape is None:
+        return block.summary()
+    shapes = shape if isinstance(shape, (list, tuple)) and shape and \
+        isinstance(shape[0], (list, tuple)) else [shape]
+    inputs = [nd.array(np.zeros(s, np.float32)) for s in shapes]
+    return block.summary(*inputs)
+
+
+def plot_network(*args, **kwargs):
+    raise NotImplementedError(
+        "plot_network renders via graphviz, which this image does not "
+        "ship; use print_summary (layer table) or mx.onnx.export_model "
+        "and an external viewer (ref: visualization.py plot_network)")

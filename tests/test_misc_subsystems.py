@@ -274,3 +274,32 @@ def test_util_module():
 
     assert np_mode_fn() is True
     assert mx.util.is_np_array() is False  # reset after the call
+
+
+def test_runtime_features():
+    """mx.runtime.Features (ref: python/mxnet/runtime.py)."""
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("CPU")
+    assert not feats.is_enabled("CUDA")
+    assert feats.is_enabled("INT8")
+    assert "RECORDIO_NATIVE" in feats
+    with pytest.raises(RuntimeError, match="unknown feature"):
+        feats.is_enabled("WARP_DRIVE")
+    assert mx.runtime.feature_list()
+
+
+def test_visualization_print_summary(capsys):
+    """mx.viz.print_summary (ref: visualization.py)."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4, activation="relu"),
+            gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    mx.viz.print_summary(net, shape=(1, 4))
+    out = capsys.readouterr().out
+    assert "Dense" in out
+    assert "(1, 2)" in out  # hooked forward captured output shapes
+    mx.viz.print_summary(net)  # shape-less form: param table only
+    out2 = capsys.readouterr().out
+    assert "Total params" in out2
+    with pytest.raises(NotImplementedError, match="graphviz"):
+        mx.viz.plot_network(net)
