@@ -281,11 +281,17 @@ class Block:
                     _hook_all(c)
 
             _hook_all(self)
+            # dry_run keeps the WHOLE tree eager: hybridized children must
+            # not serve (or build) jit caches — hooks only fire on real
+            # eager calls, and a warm child cache would skip them.
+            prev_dry = getattr(_naming, "dry_run", False)
+            _naming.dry_run = True
             try:
                 from .. import autograd as _ag
                 with _ag.pause():
                     Block.__call__(self, *inputs)
             finally:
+                _naming.dry_run = prev_dry
                 for r in removers:
                     r.detach()
 

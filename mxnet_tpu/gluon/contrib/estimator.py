@@ -92,6 +92,9 @@ class CheckpointHandler(EventHandler):
         self.best = None
         os.makedirs(model_dir, exist_ok=True)
 
+    def train_begin(self, est):
+        self.best = None  # a reused handler must not carry a prior run's best
+
     def epoch_end(self, est):
         import os
         path = os.path.join(self.model_dir,
@@ -120,6 +123,11 @@ class EarlyStoppingHandler(EventHandler):
         self.mode = mode
         self.patience = patience
         self.min_delta = min_delta
+        self.best = None
+        self.bad_epochs = 0
+
+    def train_begin(self, est):
+        # a reused handler restarts fresh for each fit()
         self.best = None
         self.bad_epochs = 0
 

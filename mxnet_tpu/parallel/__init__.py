@@ -18,7 +18,8 @@ from .moe import MoEFFN, moe_dispatch
 from .pipeline import PipelineStack, gpipe
 from .sequence import ring_attention, sp_attention, ulysses_attention
 from .step import EvalStep, TrainStep
-from .checkpoint import load_train_step, save_train_step
+from .checkpoint import (load_train_step, load_train_step_sharded,
+                         save_train_step, save_train_step_sharded)
 
 __all__ = [
     "load_train_step", "save_train_step",

@@ -32,9 +32,10 @@ def _detect():
         backend = jax.default_backend()
     except Exception:
         backend = "unknown"
-    add("TPU", backend not in ("cpu", "unknown"))
+    # the axon tunnel registers TPU devices under the 'axon' platform name
+    add("TPU", backend in ("tpu", "axon"))
     add("CPU", True)
-    add("CUDA", False)          # reference parity: reports absent
+    add("CUDA", backend in ("gpu", "cuda"))
     add("CUDNN", False)
     add("MKLDNN", False)
     add("BF16", True)           # native on TPU; emulated on XLA:CPU

@@ -303,3 +303,18 @@ def test_visualization_print_summary(capsys):
     assert "Total params" in out2
     with pytest.raises(NotImplementedError, match="graphviz"):
         mx.viz.plot_network(net)
+
+
+def test_summary_on_warm_hybridized_net(capsys):
+    """summary must capture child output shapes even when the children's
+    jit caches are warm (regression: hooks skipped on cache hits)."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(8, in_units=4), gluon.nn.Dense(2, in_units=8))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.ones((3, 4), np.float32))
+    net(x)  # warm the compiled path
+    net(x)
+    net.summary(x)
+    out = capsys.readouterr().out
+    assert "(3, 8)" in out and "(3, 2)" in out  # child shapes present
