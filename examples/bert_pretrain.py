@@ -77,7 +77,8 @@ def main():
     x, labels = synthetic_batch(rng, args.batch_size, args.seq_len, n_pred,
                                 vocab)
     print("compiling...")
-    step(x, labels).asnumpy()
+    loss = step(x, labels)
+    loss.asnumpy()
     t0 = time.perf_counter()
     for i in range(args.num_steps):
         loss = step(x, labels)

@@ -23,6 +23,7 @@ from .checkpoint import (load_train_step, load_train_step_sharded,
 
 __all__ = [
     "load_train_step", "save_train_step",
+    "load_train_step_sharded", "save_train_step_sharded",
     "AXES", "MeshScope", "current_mesh", "default_mesh", "make_mesh",
     "named_sharding", "replicated",
     "ShardingRules", "batch_spec", "fsdp_rules", "param_sharding",

@@ -194,6 +194,7 @@ def _v2_manifest(step):
         "aux_names": [step._names[i] for i in step._aux_idx],
         "optimizer": type(step.optimizer).__name__,
         "shapes": [list(a.shape) for a in step._train_arrays],
+        "aux_shapes": [list(a.shape) for a in step._aux_arrays],
         "state_counts": [len(s) for s in step._states],
     }
 
@@ -226,13 +227,12 @@ def save_train_step_sharded(step, directory, async_save=True):
         ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
     ckptr.save(path, args=ocp.args.StandardSave(_sharded_tree(step)),
                force=True)
-    # the manifest is what restore VALIDATES against (the orbax target is
-    # model-derived, so it cannot catch model/checkpoint mismatches)
+    # the manifest is what restore VALIDATES and REMAPS against (the
+    # orbax target alone cannot catch model/checkpoint mismatches, and
+    # positional order is not stable across processes — gluon name
+    # counters are process-global)
     if jax.process_index() == 0:
-        import os as _os
-        with open(_os.path.join(_os.path.dirname(path),
-                                _os.path.basename(path) + ".manifest.json"),
-                  "w") as f:
+        with open(path + ".manifest.json", "w") as f:
             json.dump(_v2_manifest(step), f)
     return ckptr
 
@@ -250,55 +250,93 @@ def load_train_step_sharded(step, directory):
     ocp = _orbax()
     path = os.path.abspath(str(directory))
 
-    # validate against the saved manifest BEFORE restoring — the orbax
-    # target below is model-derived, so it alone cannot detect a
-    # checkpoint that came from a different model or optimizer
+    # The manifest drives BOTH validation and slot remapping: positional
+    # order is not stable across processes (gluon name counters are
+    # process-global, and param_names_and_values sorts lexicographically,
+    # so dense9/dense10 order flips) — pair saved↔model slots by natural
+    # order exactly like v1's load_train_step.
     mpath = path + ".manifest.json"
-    if os.path.exists(mpath):
-        with open(mpath) as f:
-            man = json.load(f)
-        names = [step._names[i] for i in step._train_idx]
-        if len(man["train_names"]) != len(names):
+    if not os.path.exists(mpath):
+        raise ValueError(
+            f"missing {mpath}: v2 checkpoints are written with a manifest "
+            f"(save_train_step_sharded); cannot validate or remap without it")
+    with open(mpath) as f:
+        man = json.load(f)
+    names = [step._names[i] for i in step._train_idx]
+    saved_names = man["train_names"]
+    if len(saved_names) != len(names):
+        raise ValueError(
+            f"checkpoint/model mismatch: file has {len(saved_names)} "
+            f"trainable params, model expects {len(names)}")
+    pairs = list(zip(_natural_order(saved_names), _natural_order(names)))
+    for sk, wk in pairs:
+        if _norm_name(saved_names[sk]) != _norm_name(names[wk]) \
+                or tuple(man["shapes"][sk]) != \
+                tuple(step._train_arrays[wk].shape):
             raise ValueError(
-                f"checkpoint/model mismatch: file has "
-                f"{len(man['train_names'])} trainable params, model "
-                f"expects {len(names)}")
-        for sk, wk in zip(_natural_order(man["train_names"]),
-                          _natural_order(names)):
-            if _norm_name(man["train_names"][sk]) != _norm_name(names[wk]) \
-                    or tuple(man["shapes"][sk]) != \
-                    tuple(step._train_arrays[wk].shape):
-                raise ValueError(
-                    f"checkpoint/model mismatch: saved "
-                    f"{man['train_names'][sk]!r} {man['shapes'][sk]} vs "
-                    f"model {names[wk]!r} "
-                    f"{tuple(step._train_arrays[wk].shape)}")
-        if man["optimizer"] != type(step.optimizer).__name__:
+                f"checkpoint/model mismatch: saved {saved_names[sk]!r} "
+                f"{man['shapes'][sk]} vs model {names[wk]!r} "
+                f"{tuple(step._train_arrays[wk].shape)}")
+    if man["optimizer"] != type(step.optimizer).__name__:
+        raise ValueError(
+            f"optimizer mismatch: checkpoint={man['optimizer']} "
+            f"step={type(step.optimizer).__name__}")
+    aux_names = [step._names[i] for i in step._aux_idx]
+    saved_aux = man["aux_names"]
+    if len(saved_aux) != len(aux_names):
+        raise ValueError(
+            f"checkpoint/model mismatch: file has {len(saved_aux)} aux "
+            f"arrays, model expects {len(aux_names)}")
+    aux_pairs = list(zip(_natural_order(saved_aux),
+                         _natural_order(aux_names)))
+    for sk, wk in aux_pairs:
+        if _norm_name(saved_aux[sk]) != _norm_name(aux_names[wk]) \
+                or tuple(man["aux_shapes"][sk]) != \
+                tuple(step._aux_arrays[wk].shape):
             raise ValueError(
-                f"optimizer mismatch: checkpoint={man['optimizer']} "
-                f"step={type(step.optimizer).__name__}")
+                f"checkpoint/model mismatch: saved aux {saved_aux[sk]!r} "
+                f"{man['aux_shapes'][sk]} vs model {aux_names[wk]!r} "
+                f"{tuple(step._aux_arrays[wk].shape)}")
 
-    def _abstract(a):
-        if isinstance(a, (int, np.integer)) or np.isscalar(a):
-            return a
+    # Build the restore target with the FILE's keys (saved names/order),
+    # each slot shaped+sharded for the model array it will land in.
+    def _sds(a):
         return jax.ShapeDtypeStruct(a.shape, a.dtype,
                                     sharding=getattr(a, "sharding", None))
 
-    target = jax.tree.map(_abstract, _sharded_tree(step))
+    tgt_params, tgt_states, tgt_aux = {}, {}, {}
+    for sk, wk in pairs:
+        key = f"{sk:06d}.{_norm_name(saved_names[sk])}"
+        tgt_params[key] = _sds(step._train_arrays[wk])
+        for j in range(man["state_counts"][sk]):
+            tgt_states[f"{sk:06d}.{j:02d}"] = _sds(step._states[wk][j]) \
+                if j < len(step._states[wk]) else None
+    if any(v is None for v in tgt_states.values()):
+        raise ValueError("checkpoint/model mismatch: optimizer state "
+                         "slot counts differ")
+    for sk, wk in aux_pairs:
+        key = f"{sk:06d}.{_norm_name(saved_aux[sk])}"
+        tgt_aux[key] = _sds(step._aux_arrays[wk])
+    target = {"params": tgt_params, "states": tgt_states, "aux": tgt_aux,
+              "num_update": 0}
+
     ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
     restored = ckptr.restore(path, args=ocp.args.StandardRestore(target))
 
-    n_train = len(step._train_arrays)
-    pkeys = sorted(restored["params"])
-    step._train_arrays = [restored["params"][k] for k in pkeys]
-    new_states = []
-    for k in range(n_train):
-        js = sorted(j for j in restored["states"]
-                    if j.startswith(f"{k:06d}."))
-        new_states.append(tuple(restored["states"][j] for j in js))
+    new_train = list(step._train_arrays)
+    new_states = list(step._states)
+    for sk, wk in pairs:
+        key = f"{sk:06d}.{_norm_name(saved_names[sk])}"
+        new_train[wk] = restored["params"][key]
+        new_states[wk] = tuple(restored["states"][f"{sk:06d}.{j:02d}"]
+                               for j in range(man["state_counts"][sk]))
+    step._train_arrays = new_train
     step._states = tuple(new_states)
-    akeys = sorted(restored["aux"])
-    step._aux_arrays = [restored["aux"][k] for k in akeys]
+    new_aux = list(step._aux_arrays)
+    for sk, wk in aux_pairs:
+        key = f"{sk:06d}.{_norm_name(saved_aux[sk])}"
+        new_aux[wk] = restored["aux"][key]
+    step._aux_arrays = new_aux
     step._num_update = int(restored["num_update"])
     step.optimizer.num_update = step._num_update
     import jax.numpy as jnp

@@ -168,3 +168,49 @@ def test_sharded_v2_preserves_shardings(tmp_path):
     after = [a.sharding for a in step._train_arrays]
     for b, a in zip(before, after):
         assert b.is_equivalent_to(a, 2) or b == a
+
+
+def test_sharded_v2_remaps_across_counter_orders(tmp_path):
+    """Gluon name counters are process-global, so lexicographic param
+    order differs between saver and loader (dense10 < dense9 vs a fresh
+    process's dense1 < dense2).  The manifest's natural-order remap must
+    land every weight in the right slot (regression: positional
+    restore)."""
+    from mxnet_tpu.parallel.checkpoint import (load_train_step_sharded,
+                                               save_train_step_sharded)
+    d = str(tmp_path / "ck_order")
+
+    def _wide_net():
+        # >10 same-type layers: lexicographic sort of the saver's names
+        # crosses the 9→10 digit boundary
+        net = nn.HybridSequential()
+        for _ in range(11):
+            net.add(nn.Dense(6, in_units=6, activation="relu"))
+        net.add(nn.Dense(3, in_units=6))
+        net.initialize()
+        return net
+
+    mx.random.seed(5)
+    netA = _wide_net()
+    sA = _step_for(netA, "sgd", learning_rate=0.1)
+    rng = np.random.RandomState(0)
+    batches = [(rng.randn(8, 6).astype(np.float32),
+                rng.randint(0, 3, (8,))) for _ in range(6)]
+    for x, y in batches[:3]:
+        sA(x, y)
+    ref = [float(sA(x, y).asnumpy()) for x, y in batches[3:]]
+    # re-save from the state BEFORE those reference steps
+    mx.random.seed(5)
+    netA2 = _wide_net()
+    sA2 = _step_for(netA2, "sgd", learning_rate=0.1)
+    for x, y in batches[:3]:
+        sA2(x, y)
+    save_train_step_sharded(sA2, d, async_save=False)
+
+    mx.random.seed(77)
+    netB = _wide_net()   # fresh counters, different init
+    sB = _step_for(netB, "sgd", learning_rate=0.1)
+    sB(*batches[0])
+    load_train_step_sharded(sB, d)
+    resumed = [float(sB(x, y).asnumpy()) for x, y in batches[3:]]
+    np.testing.assert_allclose(resumed, ref, rtol=1e-5, atol=1e-6)

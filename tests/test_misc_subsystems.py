@@ -280,7 +280,11 @@ def test_runtime_features():
     """mx.runtime.Features (ref: python/mxnet/runtime.py)."""
     feats = mx.runtime.Features()
     assert feats.is_enabled("CPU")
-    assert not feats.is_enabled("CUDA")
+    import jax
+    assert feats.is_enabled("CUDA") == (jax.default_backend()
+                                        in ("gpu", "cuda"))
+    assert feats.is_enabled("TPU") == (jax.default_backend()
+                                       in ("tpu", "axon"))
     assert feats.is_enabled("INT8")
     assert "RECORDIO_NATIVE" in feats
     with pytest.raises(RuntimeError, match="unknown feature"):
