@@ -205,6 +205,38 @@ _CONCAT = ["concatenate", "stack", "vstack", "hstack", "dstack",
            "column_stack", "split", "array_split", "vsplit", "hsplit",
            "dsplit"]
 
+# Long tail of numpy API delegated wholesale (ref: src/operator/numpy/ —
+# the reference mirrors most of numpy; names jnp lacks are skipped by the
+# hasattr guard below).
+_EXTRA = [
+    "logspace", "indices", "tri", "diagonal", "positive", "heaviside",
+    "angle", "conj", "conjugate", "unwrap", "sinc", "nanstd", "nanvar",
+    "nanargmax", "nanargmin", "nancumsum", "nancumprod",
+    "digitize", "partition", "argpartition", "lexsort", "union1d",
+    "intersect1d", "setdiff1d", "setxor1d", "isin", "broadcast_arrays",
+    # NOTE: fill_diagonal / put_along_axis are deliberately absent — jnp
+    # requires inplace=False (immutable arrays) so plain delegation can't
+    # honor numpy's mutate-in-place contract.
+    "append", "resize", "trim_zeros", "gradient", "iscomplex", "isreal",
+    "iscomplexobj", "isrealobj", "nextafter", "spacing", "ldexp", "frexp",
+    "modf", "deg2rad", "rad2deg", "invert", "argwhere", "extract",
+    "choose", "compress", "select", "signbit",
+    "float_power", "divmod", "cov", "corrcoef", "convolve", "correlate",
+    "empty_like", "ascontiguousarray", "copy", "rollaxis", "block",
+    "promote_types", "result_type", "can_cast", "apply_along_axis",
+    "apply_over_axes", "vectorize", "triu_indices", "tril_indices",
+    "triu_indices_from", "tril_indices_from", "diag_indices",
+    "diag_indices_from", "unravel_index", "ravel_multi_index", "ix_",
+    "packbits", "unpackbits", "poly", "polyadd",
+    "polyder", "polyfit", "polyint", "polymul", "polysub", "polyval",
+]
+
+# dtype objects pass through as-is (they are types, not functions)
+for _dt in ["float16", "float64", "uint16", "uint32", "uint64", "int16",
+            "complex64", "complex128"]:
+    if not hasattr(sys.modules[__name__], _dt) and hasattr(jnp, _dt):
+        setattr(sys.modules[__name__], _dt, getattr(jnp, _dt))
+
 _this = sys.modules[__name__]
 
 
@@ -279,7 +311,7 @@ def _delegate(name):
     return wrapper
 
 
-for _n in (_UNARY + _BINARY + _SHAPE + _OTHER + _REDUCE + _CONCAT):
+for _n in (_UNARY + _BINARY + _SHAPE + _OTHER + _REDUCE + _CONCAT + _EXTRA):
     if not hasattr(_this, _n) and hasattr(jnp, _n):
         setattr(_this, _n, _delegate(_n))
 

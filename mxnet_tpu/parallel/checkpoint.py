@@ -289,9 +289,13 @@ def load_train_step_sharded(step, directory):
             f"arrays, model expects {len(aux_names)}")
     aux_pairs = list(zip(_natural_order(saved_aux),
                          _natural_order(aux_names)))
+    # manifests written before aux_shapes existed: fall back to the
+    # model's own shapes (name check still applies)
+    aux_shapes = man.get("aux_shapes") or \
+        {sk: list(step._aux_arrays[wk].shape) for sk, wk in aux_pairs}
     for sk, wk in aux_pairs:
         if _norm_name(saved_aux[sk]) != _norm_name(aux_names[wk]) \
-                or tuple(man["aux_shapes"][sk]) != \
+                or tuple(aux_shapes[sk]) != \
                 tuple(step._aux_arrays[wk].shape):
             raise ValueError(
                 f"checkpoint/model mismatch: saved aux {saved_aux[sk]!r} "
@@ -306,14 +310,16 @@ def load_train_step_sharded(step, directory):
 
     tgt_params, tgt_states, tgt_aux = {}, {}, {}
     for sk, wk in pairs:
+        if man["state_counts"][sk] != len(step._states[wk]):
+            raise ValueError(
+                f"checkpoint/model mismatch: param {saved_names[sk]!r} has "
+                f"{man['state_counts'][sk]} optimizer state slots in the "
+                f"checkpoint, {len(step._states[wk])} in the model (same "
+                f"optimizer class configured differently?)")
         key = f"{sk:06d}.{_norm_name(saved_names[sk])}"
         tgt_params[key] = _sds(step._train_arrays[wk])
         for j in range(man["state_counts"][sk]):
-            tgt_states[f"{sk:06d}.{j:02d}"] = _sds(step._states[wk][j]) \
-                if j < len(step._states[wk]) else None
-    if any(v is None for v in tgt_states.values()):
-        raise ValueError("checkpoint/model mismatch: optimizer state "
-                         "slot counts differ")
+            tgt_states[f"{sk:06d}.{j:02d}"] = _sds(step._states[wk][j])
     for sk, wk in aux_pairs:
         key = f"{sk:06d}.{_norm_name(saved_aux[sk])}"
         tgt_aux[key] = _sds(step._aux_arrays[wk])

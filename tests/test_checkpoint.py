@@ -214,3 +214,23 @@ def test_sharded_v2_remaps_across_counter_orders(tmp_path):
     load_train_step_sharded(sB, d)
     resumed = [float(sB(x, y).asnumpy()) for x, y in batches[3:]]
     np.testing.assert_allclose(resumed, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sharded_v2_state_slot_mismatch_raises(tmp_path):
+    """Fewer state slots in the checkpoint than the model must raise, not
+    silently drop the model's optimizer state (regression)."""
+    from mxnet_tpu.parallel.checkpoint import (load_train_step_sharded,
+                                               save_train_step_sharded)
+    d = str(tmp_path / "ck_slots")
+    mx.random.seed(1)
+    netA = _net(1)
+    sA = _step_for(netA, "sgd", learning_rate=0.1, momentum=0.0)  # 0 slots
+    sA(*_batches(1)[0])
+    save_train_step_sharded(sA, d, async_save=False)
+
+    mx.random.seed(1)
+    netB = _net(1)
+    sB = _step_for(netB, "sgd", learning_rate=0.1, momentum=0.9)  # 1 slot
+    sB(*_batches(1)[0])
+    with pytest.raises(ValueError, match="state slots"):
+        load_train_step_sharded(sB, d)

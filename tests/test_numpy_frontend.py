@@ -238,3 +238,22 @@ def test_np_autograd_through_shape_methods():
         y = (x.astype("float32") ** 2).sum()
     y.backward()
     _close(x.grad, 2 * onp.ones((2, 3)))
+
+
+def test_extended_delegation_surface():
+    """The long-tail numpy delegations (ref: src/operator/numpy/ breadth)
+    return numpy-frontend arrays and correct values."""
+    np_ = mnp
+    a = np_.array([[1.0, 2.0], [3.0, 4.0]])
+    assert float(np_.trace(a)) == 5.0
+    g = np_.gradient(np_.array([1.0, 2.0, 4.0, 7.0]))
+    onp.testing.assert_allclose(onp.asarray(g), [1.0, 1.5, 2.5, 3.0])
+    s = np_.select([np_.array([True, False])], [np_.array([1.0, 2.0])], 0.0)
+    onp.testing.assert_allclose(onp.asarray(s), [1.0, 0.0])
+    r, c = np_.triu_indices(3)
+    assert onp.asarray(r).shape == (6,)
+    cov = np_.cov(np_.array([[1.0, 2.0, 3.0], [2.0, 4.0, 6.0]]))
+    assert onp.asarray(cov).shape == (2, 2)
+    # dtype objects are types, not wrapped callables
+    x = np_.array([1, 2], dtype=np_.float64)
+    assert str(x.dtype) in ("float64", "float32")  # x64 may be disabled
