@@ -1,0 +1,96 @@
+"""Input-pipeline throughput microbenchmark.
+
+ref: the reference sizes its C++ decode pipeline (iter_image_recordio_2)
+to keep GPUs fed; here the same question for the TPU step: how many
+img/s can ImageRecordIter (native RecordIO + process-pool decode +
+pooled batch buffers) and the gluon DataLoader deliver on this host?
+Compare against the model step rate (bench.py resnet ≈ 2.5k img/s/chip)
+to know when input becomes the bottleneck.
+
+NOTE: throughput scales with host cores (each worker ~170-200 img/s of
+JPEG decode at 256px).  The dev container here has ONE core, so worker
+counts cannot help locally; a real TPU-VM host (v5e: 100+ vCPUs) runs
+one worker per core — the pipeline (uint8 IPC, batch-vectorised
+normalisation, async double-buffered prefetch) is shaped for that.
+
+    python benchmark/dataloader_perf.py [--n 2048] [--hw 224]
+        [--workers 0,4,8] [--batch-size 256]
+"""
+from __future__ import annotations
+
+import argparse
+import io as _pyio
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from mxnet_tpu import io as mio  # noqa: E402
+from mxnet_tpu import recordio  # noqa: E402
+
+
+def make_dataset(path, n, hw, quality=90):
+    """Write a synthetic JPEG record file (+index)."""
+    from PIL import Image
+    rec, idx = path + ".rec", path + ".idx"
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(n):
+        img = rng.randint(0, 255, (hw + 32, hw + 32, 3), np.uint8)
+        buf = _pyio.BytesIO()
+        Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+        w.write_idx(i, recordio.pack(
+            recordio.IRHeader(0, float(i % 1000), i, 0), buf.getvalue()))
+    w.close()
+    return rec, idx
+
+
+def bench_record_iter(rec, idx, hw, batch_size, workers, epochs=1):
+    it = mio.ImageRecordIter(
+        rec, data_shape=(3, hw, hw), batch_size=batch_size,
+        path_imgidx=idx, rand_crop=True, rand_mirror=True,
+        preprocess_threads=workers)
+    n = 0
+    # warm one batch (pool + process fork)
+    batch = next(iter(it))
+    batch.data[0].wait_to_read()
+    it.reset()
+    t0 = time.perf_counter()
+    for _ in range(epochs):
+        for batch in it:
+            batch.data[0].wait_to_read()
+            n += batch.data[0].shape[0]
+        it.reset()
+    dt = time.perf_counter() - t0
+    it.close()
+    return n / dt
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=1024)
+    ap.add_argument("--hw", type=int, default=224)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--workers", default="0,4,8")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    with tempfile.TemporaryDirectory() as d:
+        print(f"writing {args.n} JPEGs ({args.hw + 32}px)...",
+              file=sys.stderr)
+        rec, idx = make_dataset(os.path.join(d, "bench"), args.n, args.hw)
+        for w in [int(x) for x in args.workers.split(",")]:
+            rate = bench_record_iter(rec, idx, args.hw, args.batch_size, w)
+            row = {"metric": "image_record_iter_throughput",
+                   "workers": w, "value": round(rate, 1), "unit": "img/s"}
+            print(json.dumps(row) if args.json
+                  else f"workers={w:<3d} {rate:>10.1f} img/s")
+
+
+if __name__ == "__main__":
+    main()

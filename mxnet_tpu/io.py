@@ -235,6 +235,7 @@ class ImageRecordIter(DataIter):
         self._rng = np.random.RandomState(seed)
         self._round = round_batch
         self._inflight = None  # previous batch's pooled buffer handle
+        self._pending = None   # (keys, AsyncResult) prefetched batch
         self._pool = None
         if preprocess_threads and preprocess_threads > 1:
             import multiprocessing as mp
@@ -247,39 +248,17 @@ class ImageRecordIter(DataIter):
             s, iscolor=0 if self._shape[0] == 1 else 1)
         return header, img
 
-    def _augment(self, img):
-        from PIL import Image
-        c, h, w = self._shape
-        if self._resize > 0:
-            im = Image.fromarray(img)
-            short = min(im.size)
-            scale = self._resize / short
-            im = im.resize((max(1, round(im.size[0] * scale)),
-                            max(1, round(im.size[1] * scale))))
-            img = np.asarray(im)
-        ih, iw = img.shape[:2]
-        if ih < h or iw < w:
-            im = Image.fromarray(img).resize((max(w, iw), max(h, ih)))
-            img = np.asarray(im)
-            ih, iw = img.shape[:2]
-        if self._rand_crop:
-            y0 = self._rng.randint(0, ih - h + 1)
-            x0 = self._rng.randint(0, iw - w + 1)
-        else:
-            y0, x0 = (ih - h) // 2, (iw - w) // 2
-        img = img[y0:y0 + h, x0:x0 + w]
-        if self._rand_mirror and self._rng.rand() < 0.5:
-            img = img[:, ::-1]
-        if img.ndim == 2:
-            img = np.stack([img] * c, axis=-1)
-        img = (img.astype(np.float32) - self._mean) / self._std
-        return np.ascontiguousarray(img.transpose(2, 0, 1))  # CHW
+    def _augment(self, img, rng=None):
+        return _augment_img(img, self._shape, self._resize, self._rand_crop,
+                            self._rand_mirror, self._mean, self._std,
+                            rng if rng is not None else self._rng)
 
     def reset(self):
         self._order = list(self._keys)
         if self._shuffle:
             self._rng.shuffle(self._order)
         self._cursor = 0
+        self._pending = None  # drop any prefetched batch from a past epoch
 
     @property
     def provide_data(self):
@@ -293,6 +272,7 @@ class ImageRecordIter(DataIter):
 
     def close(self):
         """Release the record reader and the worker pool."""
+        self._pending = None
         if getattr(self, "_inflight", None) is not None:
             from . import storage
             storage.Storage.get().free(self._inflight)
@@ -314,23 +294,54 @@ class ImageRecordIter(DataIter):
     def __exit__(self, *exc):
         self.close()
 
-    def next(self):
-        if self._cursor >= len(self._order):
-            raise StopIteration
-        keys = self._order[self._cursor:self._cursor + self.batch_size]
+    def _keys_at(self, cursor):
+        """Keys (padded) for the batch starting at ``cursor``, or None."""
+        if cursor >= len(self._order):
+            return None, 0
+        keys = self._order[cursor:cursor + self.batch_size]
         pad = self.batch_size - len(keys)
         if pad and not self._round:
+            return None, 0
+        while len(keys) < self.batch_size:
+            keys = keys + self._order[:self.batch_size - len(keys)]
+        return keys, pad
+
+    def _issue(self, keys):
+        """Kick off decode+augment of ``keys`` in the worker pool; the
+        workers do the whole per-image pipeline (ref:
+        ImageRecordIOParser2's decode threads) — the parent only
+        assembles.  Per-item seeds keep augmentation deterministic."""
+        iscolor = 0 if self._shape[0] == 1 else 1
+        seeds = self._rng.randint(0, 2 ** 31, size=len(keys))
+        args = [(self._idx_path, self._rec_path, k, iscolor, self._shape,
+                 self._resize, self._rand_crop, self._rand_mirror,
+                 int(s)) for k, s in zip(keys, seeds)]
+        return self._pool.map_async(_decode_augment_one, args)
+
+    def next(self):
+        keys, pad = self._keys_at(self._cursor)
+        if keys is None:
             raise StopIteration
-        if pad:
-            keys = keys + self._order[:pad]
         self._cursor += self.batch_size
-        if self._pool is not None:
-            iscolor = 0 if self._shape[0] == 1 else 1
-            decoded = self._pool.map(_decode_one,
-                                     [(self._idx_path, self._rec_path, k,
-                                       iscolor) for k in keys])
+        pooled = self._pool is not None
+        if pooled:
+            # async double-buffering: this batch was (usually) issued at
+            # the END of the previous next(), so the workers decoded it
+            # while the training step consumed that batch; workers return
+            # uint8 (4× lighter IPC), normalisation happens below
+            if self._pending is not None and self._pending[0] == keys:
+                decoded = self._pending[1].get()
+            else:
+                decoded = self._issue(keys).get()
+            self._pending = None
+            nxt, _ = self._keys_at(self._cursor)
+            if nxt is not None:
+                self._pending = (nxt, self._issue(nxt))
         else:
-            decoded = [self._decode(k) for k in keys]
+            decoded = []
+            for k in keys:
+                hdr, img = self._decode(k)
+                decoded.append((hdr, self._augment(img)))
         # Batch buffers come from the pooled host allocator (ref:
         # iter_batchloader.h out_ double-buffer): the PREVIOUS batch's
         # buffer recycles now — its device copy had a full batch interval
@@ -346,8 +357,16 @@ class ImageRecordIter(DataIter):
         handle = storage.Storage.get().alloc(nbytes)
         imgs = handle.dptr.view(np.float32).reshape(
             (self.batch_size, c, h, w))
-        for i, (_, img) in enumerate(decoded):
-            imgs[i] = self._augment(img)
+        if pooled:
+            # one vectorised normalisation pass over the whole uint8 batch
+            # straight into the pooled buffer (the ufunc casts u8→f32
+            # during the subtract — no batch-sized f32 temp)
+            u8 = np.stack([chw for _, chw in decoded])
+            np.subtract(u8, self._mean.reshape(1, -1, 1, 1), out=imgs)
+            np.divide(imgs, self._std.reshape(1, -1, 1, 1), out=imgs)
+        else:
+            for i, (_, chw) in enumerate(decoded):
+                imgs[i] = chw
         lw = self._label_width
 
         def lab(h):
@@ -368,15 +387,65 @@ class ImageRecordIter(DataIter):
 _worker_rec = {}
 
 
-def _decode_one(args):
-    """Pool worker: each process opens its own reader lazily (fds don't
-    survive fork safely for concurrent seeks)."""
-    idx_path, rec_path, key, iscolor = args
+def _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror, rng):
+    """resize-short → crop → mirror → CHW **uint8** (ref:
+    image_aug_default.cc DefaultImageAugmenter).  Stays uint8 so the
+    worker→parent IPC ships 4× fewer bytes; the float conversion +
+    mean/std normalisation runs vectorised over the whole batch in the
+    parent (one SIMD pass into the pooled buffer)."""
+    from PIL import Image
+    c, h, w = shape
+    if resize > 0:
+        im = Image.fromarray(img)
+        short = min(im.size)
+        scale = resize / short
+        im = im.resize((max(1, round(im.size[0] * scale)),
+                        max(1, round(im.size[1] * scale))))
+        img = np.asarray(im)
+    ih, iw = img.shape[:2]
+    if ih < h or iw < w:
+        im = Image.fromarray(img).resize((max(w, iw), max(h, ih)))
+        img = np.asarray(im)
+        ih, iw = img.shape[:2]
+    if rand_crop:
+        y0 = rng.randint(0, ih - h + 1)
+        x0 = rng.randint(0, iw - w + 1)
+    else:
+        y0, x0 = (ih - h) // 2, (iw - w) // 2
+    img = img[y0:y0 + h, x0:x0 + w]
+    if rand_mirror and rng.rand() < 0.5:
+        img = img[:, ::-1]
+    if img.ndim == 2:
+        img = np.stack([img] * c, axis=-1)
+    return np.ascontiguousarray(img.transpose(2, 0, 1))  # CHW uint8
+
+
+def _augment_img(img, shape, resize, rand_crop, rand_mirror, mean, std,
+                 rng):
+    """Full per-image pipeline incl. normalisation → CHW float32 (the
+    single-process path)."""
+    chw = _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror, rng)
+    mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
+    std = np.asarray(std, np.float32).reshape(-1, 1, 1)
+    return (chw.astype(np.float32) - mean) / std
+
+
+def _decode_augment_one(args):
+    """Pool worker: full per-image pipeline — record read, JPEG decode,
+    augment — so the parent only assembles batches (ref:
+    iter_image_recordio_2.cc decode thread pool).  Each process opens its
+    own reader lazily (fds don't survive fork safely for concurrent
+    seeks)."""
+    (idx_path, rec_path, key, iscolor, shape, resize, rand_crop,
+     rand_mirror, seed) = args
     rec = _worker_rec.get(rec_path)
     if rec is None:
         rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
         _worker_rec[rec_path] = rec
-    return recordio.unpack_img(rec.read_idx(key), iscolor=iscolor)
+    header, img = recordio.unpack_img(rec.read_idx(key), iscolor=iscolor)
+    chw = _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror,
+                       np.random.RandomState(seed))
+    return header, chw
 
 
 class ResizeIter(DataIter):

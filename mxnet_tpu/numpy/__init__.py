@@ -223,17 +223,20 @@ _EXTRA = [
     "choose", "compress", "select", "signbit",
     "float_power", "divmod", "cov", "corrcoef", "convolve", "correlate",
     "empty_like", "ascontiguousarray", "copy", "rollaxis", "block",
-    "promote_types", "result_type", "can_cast", "apply_along_axis",
-    "apply_over_axes", "vectorize", "triu_indices", "tril_indices",
+    "apply_along_axis", "apply_over_axes", "triu_indices", "tril_indices",
     "triu_indices_from", "tril_indices_from", "diag_indices",
     "diag_indices_from", "unravel_index", "ravel_multi_index", "ix_",
     "packbits", "unpackbits", "poly", "polyadd",
     "polyder", "polyfit", "polyint", "polymul", "polysub", "polyval",
 ]
 
-# dtype objects pass through as-is (they are types, not functions)
-for _dt in ["float16", "float64", "uint16", "uint32", "uint64", "int16",
-            "complex64", "complex128"]:
+# dtype objects and non-array-returning utilities pass through raw (they
+# return dtypes/functions, so the ndarray wrapper — and its autograd vjp
+# path — must not touch them)
+_PASSTHROUGH = ["float16", "float64", "uint16", "uint32", "uint64",
+                "int16", "complex64", "complex128", "promote_types",
+                "can_cast", "vectorize"]
+for _dt in _PASSTHROUGH:
     if not hasattr(sys.modules[__name__], _dt) and hasattr(jnp, _dt):
         setattr(sys.modules[__name__], _dt, getattr(jnp, _dt))
 
@@ -354,9 +357,14 @@ def asarray(a, dtype=None):
     return array(a, dtype=dtype)
 
 
-__all__ = (["ndarray", "array", "asarray", "zeros", "ones", "full", "empty",
-            "zeros_like", "ones_like", "full_like", "arange", "linspace",
-            "eye", "identity", "meshgrid", "transpose", "asnumpy", "shape",
-            "ndim", "size", "result_type", "random", "linalg",
-            "pi", "e", "inf", "nan", "newaxis"]
-           + _UNARY + _BINARY + _SHAPE + _OTHER + _REDUCE + _CONCAT)
+# only names that actually resolved (the hasattr(jnp, ...) guard skips
+# entries this jax version lacks) — a star-import must never NameError
+__all__ = [n for n in
+           (["ndarray", "array", "asarray", "zeros", "ones", "full",
+             "empty", "zeros_like", "ones_like", "full_like", "arange",
+             "linspace", "eye", "identity", "meshgrid", "transpose",
+             "asnumpy", "shape", "ndim", "size", "result_type", "random",
+             "linalg", "pi", "e", "inf", "nan", "newaxis"]
+            + _UNARY + _BINARY + _SHAPE + _OTHER + _REDUCE + _CONCAT
+            + _EXTRA + _PASSTHROUGH)
+           if hasattr(sys.modules[__name__], n)]

@@ -299,7 +299,7 @@ def load_train_step_sharded(step, directory):
                 tuple(step._aux_arrays[wk].shape):
             raise ValueError(
                 f"checkpoint/model mismatch: saved aux {saved_aux[sk]!r} "
-                f"{man['aux_shapes'][sk]} vs model {aux_names[wk]!r} "
+                f"{aux_shapes[sk]} vs model {aux_names[wk]!r} "
                 f"{tuple(step._aux_arrays[wk].shape)}")
 
     # Build the restore target with the FILE's keys (saved names/order),
