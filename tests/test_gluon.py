@@ -362,3 +362,25 @@ def test_estimator_early_stopping(caplog):
             EarlyStoppingHandler("val_loss", patience=1)])
     # lr=0 → no improvement → stops long before 50 epochs
     assert est.current_epoch < 10
+
+
+def test_mnist_real_idx_files_load(tmp_path):
+    """When real IDX files exist the dataset reads THEM, not the synthetic
+    stand-in (Weak #5 contract: gates run on real data where available)."""
+    import gzip
+    import struct
+    from mxnet_tpu.gluon.data.vision import datasets
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (50, 28, 28), np.uint8)
+    labs = rng.randint(0, 10, 50).astype(np.uint8)
+    with gzip.open(str(tmp_path / "train-images-idx3-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 50, 28, 28))
+        f.write(imgs.tobytes())
+    with gzip.open(str(tmp_path / "train-labels-idx1-ubyte.gz"), "wb") as f:
+        f.write(struct.pack(">II", 2049, 50))
+        f.write(labs.tobytes())
+    ds = datasets.MNIST(root=str(tmp_path), train=True)
+    assert len(ds) == 50  # not the synthetic 8192
+    x, y = ds[3]
+    np.testing.assert_array_equal(np.asarray(x).squeeze(), imgs[3])
+    assert int(y) == int(labs[3])
