@@ -14,6 +14,8 @@ BASELINE.md configs 2-5.  The whole train step (fwd+bwd+optimizer) is one XLA
 program via parallel.TrainStep; matmul precision bf16 puts the FLOPs on the MXU.
 """
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -24,6 +26,13 @@ BASELINE_TOK_S = 3000.0    # BASELINE.md: BERT-base >=3k tokens/s/chip bar
 BASELINE_LSTM_TOK_S = 30000.0  # BASELINE.md config 3: V100 cuDNN-RNN "order";
                                # ~20-40k wps for the 2x650 PTB medium recipe
 BASELINE_SSD_IMG_S = 40.0  # BASELINE.md config 5: >=40 img/s/chip train bar
+
+# single source of truth for metric names: the success path (each bench's
+# JSON line) and the wedge error path must emit the same names
+_METRIC_NAMES = {"resnet": "resnet50_train_throughput",
+                 "bert": "bert_base_pretrain_throughput",
+                 "lstm": "lstm_lm_train_throughput",
+                 "ssd": "ssd512_train_throughput"}
 
 
 def _setup():
@@ -71,7 +80,7 @@ def bench_resnet():
     # global batch is data-parallel over every device: report PER-CHIP rate
     img_s = batch * iters / dt / len(jax.devices())
     print(json.dumps({
-        "metric": "resnet50_train_throughput",
+        "metric": _METRIC_NAMES["resnet"],
         "value": round(img_s, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
@@ -132,7 +141,7 @@ def bench_bert():
     # global batch is data-parallel over every device: report PER-CHIP rate
     tok_s = batch * seq_len * iters / dt / len(jax.devices())
     print(json.dumps({
-        "metric": "bert_base_pretrain_throughput",
+        "metric": _METRIC_NAMES["bert"],
         "value": round(tok_s, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
@@ -183,7 +192,7 @@ def bench_lstm():
 
     tok_s = batch * bptt * iters / dt / len(jax.devices())
     print(json.dumps({
-        "metric": "lstm_lm_train_throughput",
+        "metric": _METRIC_NAMES["lstm"],
         "value": round(tok_s, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_LSTM_TOK_S, 4),
@@ -248,7 +257,7 @@ def bench_ssd():
 
     img_s = batch * iters / dt / len(jax.devices())
     print(json.dumps({
-        "metric": "ssd512_train_throughput",
+        "metric": _METRIC_NAMES["ssd"],
         "value": round(img_s, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_SSD_IMG_S, 4),
@@ -257,6 +266,59 @@ def bench_ssd():
 
 BENCHES = {"resnet": bench_resnet, "bert": bench_bert,
            "lstm": bench_lstm, "ssd": bench_ssd}
+assert set(BENCHES) == set(_METRIC_NAMES)
+
+# The axon PJRT tunnel can wedge so hard that even `jax.devices()` hangs
+# forever (see PERF.md "environment" notes).  Everything below therefore runs
+# the actual benchmark in a *subprocess* behind a timeout-guarded backend
+# probe, with bounded retry/backoff, so a wedged tunnel yields a parseable
+# {"error": ...} JSON line instead of a hung driver or a raw traceback.
+PROBE_TIMEOUT_S = int(os.environ.get("MXTPU_BENCH_PROBE_TIMEOUT", "120"))
+BENCH_TIMEOUT_S = int(os.environ.get("MXTPU_BENCH_TIMEOUT", "1500"))
+PROBE_BACKOFFS_S = (0, 30, 60, 120)  # ~3.5 min of probing before giving up
+
+
+def _probe_backend():
+    """Check the default jax backend responds, in a killable subprocess."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=PROBE_TIMEOUT_S)
+    except subprocess.TimeoutExpired:
+        return None, "probe timed out (backend wedged)"
+    if r.returncode != 0:
+        err = (r.stderr or "").strip().splitlines()
+        return None, err[-1] if err else "probe failed"
+    return r.stdout.strip(), None
+
+
+def _emit_error(names, reason):
+    for name in names:
+        print(json.dumps({"metric": _METRIC_NAMES[name], "value": None,
+                          "unit": "error", "vs_baseline": None,
+                          "error": f"backend unavailable: {reason}"}))
+
+
+def _run_inner(name):
+    """Run one bench in a subprocess; forward its JSON line. True on success."""
+    env = dict(os.environ, MXTPU_BENCH_INNER="1")
+    try:
+        r = subprocess.run([sys.executable, os.path.abspath(__file__), name],
+                           capture_output=True, text=True,
+                           timeout=BENCH_TIMEOUT_S, env=env)
+    except subprocess.TimeoutExpired:
+        return False, "bench subprocess timed out"
+    if r.returncode != 0:
+        err = (r.stderr or "bench subprocess failed").strip().splitlines()
+        return False, err[-1] if err else "bench subprocess failed"
+    emitted = False
+    for line in r.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            print(line, flush=True)
+            emitted = True
+    return emitted, None if emitted else "no JSON line produced"
 
 
 def main():
@@ -265,8 +327,40 @@ def main():
         print(f"unknown benchmark {which!r} "
               f"(expected {'|'.join(BENCHES)}|all)", file=sys.stderr)
         sys.exit(1)
-    for fn in (BENCHES.values() if which == "all" else [BENCHES[which]]):
-        fn()
+    names = list(BENCHES) if which == "all" else [which]
+
+    if os.environ.get("MXTPU_BENCH_INNER"):
+        # inner mode: actually run (we are already inside the watchdog)
+        for name in names:
+            BENCHES[name]()
+        return
+
+    # orchestrator mode: probe the backend with bounded backoff first
+    platform = reason = None
+    for backoff in PROBE_BACKOFFS_S:
+        if backoff:
+            print(f"# backend probe failed ({reason}); retrying in "
+                  f"{backoff}s", file=sys.stderr, flush=True)
+            time.sleep(backoff)
+        platform, reason = _probe_backend()
+        if platform is not None:
+            break
+    if platform is None:
+        _emit_error(names, reason)
+        return
+
+    for name in names:
+        ok, err = _run_inner(name)
+        if not ok:  # one bounded retry: transient wedges often clear
+            print(f"# {name} failed ({err}); retrying once",
+                  file=sys.stderr, flush=True)
+            platform, reason = _probe_backend()
+            if platform is None:
+                _emit_error([name], reason)
+                continue
+            ok, err = _run_inner(name)
+        if not ok:
+            _emit_error([name], err)
 
 
 if __name__ == "__main__":

@@ -112,7 +112,7 @@ class FixedBucketSampler(Sampler):
     """
 
     def __init__(self, lengths, batch_size, num_buckets=10, shuffle=False,
-                 seed=0):
+                 seed=0, last_batch="keep"):
         import numpy as _np
         self._lengths = _np.asarray(lengths)
         self._batch_size = batch_size
@@ -129,10 +129,27 @@ class FixedBucketSampler(Sampler):
                 if ln <= key:
                     self._buckets[b].append(idx)
                     break
+        # Trailing partial batches reintroduce the per-shape XLA recompile
+        # this sampler exists to avoid: pass last_batch="pad" (tops the tail
+        # up by re-sampling from the same bucket — duplicates samples, so
+        # training only) or "discard" for TPU training loops.  The default
+        # "keep" emits the ragged tail, preserving exact-cover semantics
+        # for eval consumers.
+        if last_batch not in ("pad", "discard", "keep"):
+            raise ValueError("last_batch must be pad/discard/keep")
         self._batches = []
         for b in self._buckets:
             for i in range(0, len(b), batch_size):
-                self._batches.append(b[i:i + batch_size])
+                tail = b[i:i + batch_size]
+                if len(tail) < batch_size:
+                    if last_batch == "discard":
+                        continue
+                    if last_batch == "pad":
+                        j = 0
+                        while len(tail) < batch_size:
+                            tail.append(b[j % len(b)])
+                            j += 1
+                self._batches.append(tail)
 
     @property
     def bucket_keys(self):

@@ -74,6 +74,36 @@ def _to_nd(arr):
     return arr if isinstance(arr, NDArray) else nd.array(arr)
 
 
+_STAGING_RECYCLES = None
+
+
+def _staging_recycles():
+    """Whether pooled host staging buffers may be recycled after wrapping.
+
+    On some backends (jax's CPU backend) the host→device conversion is
+    ZERO-COPY for aligned numpy buffers, so the wrapped device array aliases
+    the pooled staging buffer and recycling it would silently corrupt batches
+    a consumer still holds.  Probe once per process: wrap a pooled buffer,
+    mutate the host side, and see if the wrapped array changed.  Recycle only
+    when the conversion provably copies.
+    """
+    global _STAGING_RECYCLES
+    if _STAGING_RECYCLES is None:
+        from . import storage
+        st = storage.Storage.get()
+        hdl = st.alloc(256)
+        buf = hdl.dptr.view(np.float32)[:16]
+        buf[:] = 1.0
+        arr = _to_nd(buf)
+        before = arr.asnumpy().copy()
+        buf[:] = 2.0
+        aliased = bool((arr.asnumpy() != before).any())
+        del arr
+        st.free(hdl)
+        _STAGING_RECYCLES = not aliased
+    return _STAGING_RECYCLES
+
+
 class NDArrayIter(DataIter):
     """ref: io.NDArrayIter — batches over in-memory arrays with pad/discard/
     roll_over last-batch handling and optional shuffle."""
@@ -345,18 +375,23 @@ class ImageRecordIter(DataIter):
         # Batch buffers come from the pooled host allocator (ref:
         # iter_batchloader.h out_ double-buffer): the PREVIOUS batch's
         # buffer recycles now — its device copy had a full batch interval
-        # to complete, and the jnp.asarray conversion in nd.array copies
-        # (measured: no host aliasing on cpu or tpu backends), so next()
-        # never blocks on the transfer.
+        # to complete.  Recycling is only safe when nd.array's host→device
+        # conversion copies; on zero-copy backends the device array would
+        # alias the pool, so each batch gets a fresh buffer the NDArray
+        # owns instead (see _staging_recycles).
         from . import storage
-        if self._inflight is not None:
-            storage.Storage.get().free(self._inflight)
-            self._inflight = None
         c, h, w = self._shape
-        nbytes = self.batch_size * c * h * w * 4
-        handle = storage.Storage.get().alloc(nbytes)
-        imgs = handle.dptr.view(np.float32).reshape(
-            (self.batch_size, c, h, w))
+        if _staging_recycles():
+            if self._inflight is not None:
+                storage.Storage.get().free(self._inflight)
+                self._inflight = None
+            nbytes = self.batch_size * c * h * w * 4
+            handle = storage.Storage.get().alloc(nbytes)
+            imgs = handle.dptr.view(np.float32).reshape(
+                (self.batch_size, c, h, w))
+        else:
+            handle = None
+            imgs = np.empty((self.batch_size, c, h, w), np.float32)
         if pooled:
             # one vectorised normalisation pass over the whole uint8 batch
             # straight into the pooled buffer (the ufunc casts u8→f32

@@ -18,8 +18,11 @@ from jax import lax
 
 from . import proto
 
+import ml_dtypes
+
 _NP_DTYPE = {1: np.float32, 2: np.uint8, 3: np.int8, 6: np.int32,
-             7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64}
+             7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+             16: ml_dtypes.bfloat16}
 
 
 def _parse_tensor(buf: bytes):

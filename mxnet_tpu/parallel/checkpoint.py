@@ -230,10 +230,17 @@ def save_train_step_sharded(step, directory, async_save=True):
     # the manifest is what restore VALIDATES and REMAPS against (the
     # orbax target alone cannot catch model/checkpoint mismatches, and
     # positional order is not stable across processes — gluon name
-    # counters are process-global)
+    # counters are process-global).  Written temp-then-rename so a crash
+    # mid-write never leaves a truncated json next to a valid orbax dir.
+    # NOTE: like the orbax directory itself, the manifest lives on a
+    # filesystem that must be SHARED across processes on multi-host runs
+    # (process 0 writes it; every process reads it at restore).
     if jax.process_index() == 0:
-        with open(path + ".manifest.json", "w") as f:
+        mpath = path + ".manifest.json"
+        tmp = mpath + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(_v2_manifest(step), f)
+        os.replace(tmp, mpath)
     return ckptr
 
 

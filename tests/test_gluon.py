@@ -293,7 +293,7 @@ def test_fixed_bucket_sampler():
     rng = np.random.RandomState(0)
     lengths = rng.randint(5, 120, size=200)
     s = FixedBucketSampler(lengths, batch_size=16, num_buckets=5,
-                           shuffle=True)
+                           shuffle=True)  # default "keep": exact cover
     seen = []
     for batch in s:
         assert len(batch) <= 16
@@ -307,6 +307,23 @@ def test_fixed_bucket_sampler():
     assert sorted(seen) == list(range(200))  # exact cover, no dupes
     assert len(s) == sum(1 for _ in s)
     assert "samples" in s.stats()
+    # "pad": every batch full (fixed compiled shape set), padding re-samples
+    # strictly from within ONE bucket, and every sample still appears
+    sp = FixedBucketSampler(lengths, batch_size=16, num_buckets=5,
+                            last_batch="pad")
+    covered = []
+    for batch in sp:
+        assert len(batch) == 16
+        assert any(set(batch) <= set(b) for b in sp._buckets)
+        covered.extend(batch)
+    assert set(covered) == set(range(200))
+    # "discard": full batches only, no dupes
+    sd = FixedBucketSampler(lengths, batch_size=16, num_buckets=5,
+                            last_batch="discard")
+    dropped = [b for b in sd]
+    assert all(len(b) == 16 for b in dropped)
+    flat = [i for b in dropped for i in b]
+    assert len(flat) == len(set(flat))
 
 
 def test_estimator_fit_and_handlers(tmp_path, caplog):

@@ -235,3 +235,25 @@ def test_image_record_iter_label_width_and_close(tmp_path):
     with _pytest.raises(TypeError, match="unsupported options"):
         io.ImageRecordIter(path_imgrec=f, data_shape=(3, 16, 16),
                            batch_size=2, not_a_real_option=1)
+
+
+def test_image_record_iter_batch_survives_next(tmp_path):
+    """Regression: a batch held across next() must keep its own data.
+
+    On zero-copy backends (jax CPU) nd.array may alias the pooled host
+    staging buffer; recycling that buffer used to overwrite the previous
+    batch's NDArray in place (advisor round-3 high finding).  The iterator
+    now probes for aliasing and only recycles when the conversion copies.
+    """
+    root = str(tmp_path / "imgs")
+    _make_img_tree(root, n_classes=2, per_class=4)
+    prefix = str(tmp_path / "alias")
+    import tools.im2rec as im2rec
+    im2rec.pack(prefix, root)
+    it = io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                            data_shape=(3, 16, 16), batch_size=4)
+    itr = iter(it)
+    b1 = next(itr)
+    snap = b1.data[0].asnumpy().copy()
+    next(itr)  # would recycle b1's buffer
+    np.testing.assert_array_equal(b1.data[0].asnumpy(), snap)
