@@ -1,8 +1,54 @@
 """TPU re-run harness: same seeding as tests/conftest.py but WITHOUT the
 XLA:CPU platform pin — the whole point is running on the accelerator
-(ref: tests/python/gpu/test_operator_gpu.py setup)."""
+(ref: tests/python/gpu/test_operator_gpu.py setup).
+
+This conftest imports before any test module, so two things happen here:
+  * tests/ lands on sys.path for the `from test_X import *` re-run trick;
+  * accelerator tolerances are patched into mxnet_tpu.test_utils BEFORE
+    the star-imports capture the symbols (TPU transcendentals differ from
+    host libm by more than the CPU suite's tight defaults — the reference
+    widens per-context in check_consistency the same way).
+"""
+import os
+import sys
+
 import numpy as np
 import pytest
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+sys.path.insert(0, os.path.join(_repo, "tests"))
+
+import mxnet_tpu.test_utils as _tu
+
+_cpu_aae = _tu.assert_almost_equal
+
+
+def _aae_accel(a, b, rtol=1e-4, atol=1e-5, **kw):
+    return _cpu_aae(a, b, rtol=max(rtol, 2e-3), atol=max(atol, 2e-4), **kw)
+
+
+_cpu_cng = _tu.check_numeric_gradient
+
+
+def _cng_accel(op, inputs, kwargs=None, grad_inputs=None, eps=None,
+               rtol=2e-2, atol=2e-3, n_samples=8, seed=0):
+    return _cpu_cng(op, inputs, kwargs=kwargs, grad_inputs=grad_inputs,
+                    eps=eps, rtol=max(rtol, 5e-2), atol=max(atol, 5e-3),
+                    n_samples=n_samples, seed=seed)
+
+
+_tu.assert_almost_equal = _aae_accel
+_tu.check_numeric_gradient = _cng_accel
+
+
+def require_accelerator():
+    """Module-level guard: skip the whole file unless jax is on a chip."""
+    import jax
+
+    if jax.default_backend() == "cpu":
+        pytest.skip("TPU re-run suite needs an accelerator backend",
+                    allow_module_level=True)
 
 
 @pytest.fixture(autouse=True)

@@ -1,4 +1,4 @@
-"""Re-run the CPU op suites on the real TPU context.
+"""Re-run the CPU op + autograd suites on the real TPU context.
 
 ref: tests/python/gpu/test_operator_gpu.py — the reference's key
 portability trick is `from test_operator import *` with the default
@@ -9,52 +9,16 @@ run it against the chip with
 
     python -m pytest tests_tpu/ -q
 
-from a shell whose JAX_PLATFORMS is the default axon/TPU.
+from a shell whose JAX_PLATFORMS is the default axon/TPU.  sys.path and
+accelerator tolerances are set up by tests_tpu/conftest.py before this
+module imports.
 """
-import os
-import sys
-
-import pytest
-
-_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, _repo)
-sys.path.insert(0, os.path.join(_repo, "tests"))
-
-# Accelerator numerics: TPU transcendental implementations differ from
-# host libm by more than the CPU suite's tight defaults.  The reference
-# does the same for its GPU re-runs (check_consistency widens tolerances
-# per context, test_utils.default_tols per dtype) — widen before the
-# star-imports below capture the symbols.
-import mxnet_tpu.test_utils as _tu
-
-_cpu_aae = _tu.assert_almost_equal
-
-
-def _aae_accel(a, b, rtol=1e-4, atol=1e-5, **kw):
-    return _cpu_aae(a, b, rtol=max(rtol, 2e-3), atol=max(atol, 2e-4), **kw)
-
-
-_tu.assert_almost_equal = _aae_accel
-
-_cpu_cng = _tu.check_numeric_gradient
-
-
-def _cng_accel(op, inputs, kwargs=None, grad_inputs=None, eps=None,
-               rtol=2e-2, atol=2e-3, n_samples=8, seed=0):
-    return _cpu_cng(op, inputs, kwargs=kwargs, grad_inputs=grad_inputs,
-                    eps=eps, rtol=max(rtol, 5e-2), atol=max(atol, 5e-3),
-                    n_samples=n_samples, seed=seed)
-
-
-_tu.check_numeric_gradient = _cng_accel
-
 import jax
+import pytest
 
 if jax.default_backend() == "cpu":
     pytest.skip("TPU re-run suite needs an accelerator backend",
                 allow_module_level=True)
 
-# the reference's import-star trick: every test in these modules now
-# re-runs against the accelerator default context
 from test_operator import *          # noqa: F401,F403,E402
 from test_autograd import *          # noqa: F401,F403,E402

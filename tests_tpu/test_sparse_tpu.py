@@ -1,0 +1,10 @@
+"""Re-run the sparse storage suite (row_sparse/csr over BCOO) on the
+real TPU chip (ref: tests/python/gpu/test_kvstore_gpu.py sparse rows)."""
+import jax
+import pytest
+
+if jax.default_backend() == "cpu":
+    pytest.skip("TPU re-run suite needs an accelerator backend",
+                allow_module_level=True)
+
+from test_sparse import *            # noqa: F401,F403,E402

@@ -1,0 +1,10 @@
+"""Re-run the end-to-end convergence gates on the real TPU chip
+(ref: tests/python/train/ re-run under GPU context)."""
+import jax
+import pytest
+
+if jax.default_backend() == "cpu":
+    pytest.skip("TPU re-run suite needs an accelerator backend",
+                allow_module_level=True)
+
+from test_train import *             # noqa: F401,F403,E402
