@@ -84,6 +84,26 @@ def _flatten_nd(value):
     return leaves, tree
 
 
+def _tree_to_json(tree):
+    """Output-tree structure as plain json types (lists for tuples).
+    Static leaves must be json-serializable — true for every framework
+    output structure (Nones/scalars); anything else fails loudly here
+    rather than at import time."""
+    if tree == "*":
+        return "*"
+    if isinstance(tree, tuple) and len(tree) == 2 and tree[0] == "#":
+        return ["#", tree[1]]
+    return [_tree_to_json(t) for t in tree]
+
+
+def _tree_from_json(tree):
+    if tree == "*":
+        return "*"
+    if isinstance(tree, list) and len(tree) == 2 and tree[0] == "#":
+        return ("#", tree[1])
+    return tuple(_tree_from_json(t) for t in tree)
+
+
 def _unflatten_nd(tree, leaves):
     it = iter(leaves)
 
@@ -474,6 +494,10 @@ class HybridBlock(Block):
         training = _autograd.is_training()
         sig = (tree, training,
                tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+        # remember the call signature so export() can retrace for serving
+        # (plain tuples: this is the hot path, avals are built in export)
+        self._export_info = (tree, tuple(
+            (tuple(l.shape), l.dtype) for l in leaves))
         key = _random.next_key()
 
         if _autograd.is_recording():
@@ -513,14 +537,70 @@ class HybridBlock(Block):
 
     # ---------------------------------------------------------------- export --
     def export(self, path, epoch=0):
-        """ref: HybridBlock.export — graph json + params. The TPU-native
-        artifact is the param file plus a json descriptor naming the block
-        class (graphs are recompiled from code, not deserialized)."""
+        """ref: HybridBlock.export — graph json + params.
+
+        The TPU-native graph artifact is a serialized StableHLO program
+        (jax.export) of the block's inference forward with parameters as
+        inputs, plus the structural-name param file.  The pair reloads into
+        a servable callable WITHOUT the defining Python class via
+        ``SymbolBlock.imports`` (ref: model-symbol.json / model-0000.params
+        round-trip).  The block must have run at least one hybridized
+        forward so input shapes are known — same precondition as the
+        reference's export.
+        """
         import json
+        import os
+
         params_file = f"{path}-{epoch:04d}.params"
         self.save_parameters(params_file)
+        # file references are BASENAMES resolved against the json's own
+        # directory at import time, so the artifact directory is relocatable
         meta = {"framework": "mxnet_tpu", "block": type(self).__name__,
-                "prefix": self._prefix, "params": params_file}
+                "prefix": self._prefix,
+                "params": os.path.basename(params_file)}
+        if getattr(self, "_export_info", None) is not None:
+            tree, leaf_sig = self._export_info
+            names, plist = self._param_list()
+            # param order in the graph is _param_list order; the .params
+            # file keys are STRUCTURAL names — record the mapping so imports
+            # can feed arrays in graph order whatever the name counters say
+            by_id = {id(p): sn
+                     for sn, p in self._collect_params_with_prefix().items()}
+            try:
+                struct_order = [by_id[id(p)] for p in plist]
+            except KeyError:
+                struct_order = None  # params outside the tree: graph skipped
+            if struct_order is not None:
+                param_avals = [jax.ShapeDtypeStruct(p.data().shape,
+                                                    p.data()._data.dtype)
+                               for p in plist]
+                leaf_avals = [jax.ShapeDtypeStruct(s, d)
+                              for s, d in leaf_sig]
+                sig = (tree, False,
+                       tuple((tuple(a.shape), str(a.dtype))
+                             for a in leaf_avals))
+                if self._jit_fn is None:
+                    self._out_trees, self._aux_idx, self._n_out = {}, {}, {}
+                    self._jit_fn = self._build_jit()
+                jit_fn = self._jit_fn
+
+                def serve(param_arrays, *leaves):
+                    # inference mode: fixed key (dropout off), no aux writes
+                    return jit_fn(param_arrays, jax.random.key(0), False,
+                                  tree, sig, *leaves)
+
+                exp = jax.export.export(jax.jit(serve),
+                                        platforms=("cpu", "tpu"))(
+                    param_avals, *leaf_avals)
+                graph_file = f"{path}-graph.bin"
+                # raw StableHLO bytes on disk + json-only metadata: the
+                # artifact stays non-executable at load time (no pickle)
+                with open(graph_file, "wb") as f:
+                    f.write(exp.serialize())
+                meta["graph"] = os.path.basename(graph_file)
+                meta["out_tree"] = _tree_to_json(self._out_trees[sig])
+                meta["n_out"] = self._n_out[sig]
+                meta["param_order"] = struct_order
         with open(f"{path}-symbol.json", "w") as f:
             json.dump(meta, f, indent=2)
         return f"{path}-symbol.json", params_file
@@ -547,6 +627,53 @@ class SymbolBlock(HybridBlock):
 
     @staticmethod
     def imports(symbol_file, input_names=None, param_file=None, ctx=None):
-        raise NotImplementedError(
-            "mxnet_tpu recompiles graphs from code; load params with "
-            "Block.load_parameters and reconstruct the model class")
+        """Reconstruct a servable block from ``HybridBlock.export`` output
+        WITHOUT the defining Python class (ref: SymbolBlock.imports over
+        model-symbol.json + model-0000.params).
+
+        The graph is the serialized StableHLO program export wrote next to
+        the json descriptor; params load by structural name and feed the
+        graph in its recorded order.  ``input_names`` is accepted for API
+        compatibility (the graph's positional signature is authoritative).
+        """
+        import json
+        import os
+
+        from .. import ndarray as ndmod
+
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        graph_file = meta.get("graph")
+        if not graph_file:
+            raise ValueError(
+                f"{symbol_file} has no serialized graph — it predates "
+                "graph export; re-export the model after one hybridized "
+                "forward (or rebuild the model class and use "
+                "load_parameters)")
+        base = os.path.dirname(os.path.abspath(symbol_file))
+        with open(os.path.join(base, graph_file), "rb") as f:
+            exported = jax.export.deserialize(f.read())
+        params_path = param_file or os.path.join(base, meta["params"])
+        loaded = ndmod.load(params_path)
+        missing = [n for n in meta["param_order"] if n not in loaded]
+        if missing:
+            raise ValueError(
+                f"params file {params_path} is missing graph inputs "
+                f"{missing}")
+        param_arrays = [loaded[n]._data for n in meta["param_order"]]
+        out_tree = _tree_from_json(meta["out_tree"])
+        n_out = meta["n_out"]
+
+        def fn(*args):
+            leaves_nd, _ = _flatten_nd(args)
+            outs = exported.call(param_arrays,
+                                 *[l._data for l in leaves_nd])
+            out_nds = tuple(NDArray(o) for o in outs[:n_out])
+            return _unflatten_nd(out_tree, out_nds)
+
+        blk = SymbolBlock(fn)
+        for name, arr in loaded.items():
+            p = Parameter(name, shape=arr.shape, dtype=None)
+            p._data = arr
+            blk._params._params[name] = p
+        return blk
