@@ -97,10 +97,26 @@ class Trainer:
         if self._kvstore is not None and self._update_on_kvstore:
             # server-side update (ref: kvstore_dist_server.h DataHandleEx):
             # push grads, the store applies the optimizer, pull new weights
-            # (local optimizer states stay unallocated — the store owns them)
+            # (local optimizer states stay unallocated — the store owns them).
+            # sparse-grad params push row_sparse and pull back ONLY the
+            # touched rows (ref: trainer.py _row_sparse_pull) — the lazy
+            # update leaves every other row untouched server-side too.
+            multi = self._kvstore.num_workers > 1
             for i, p in enumerate(self._params):
-                self._kvstore.push(i, _dense_grad(p))
-                self._kvstore.pull(i, out=p.data())
+                if p._grad_stype == "row_sparse":
+                    g = p.grad()  # row_sparse view of the tape grad
+                    self._kvstore.push(i, g)
+                    if multi:
+                        # other workers' pushes touch rows outside our
+                        # local row set — pull the whole weight or this
+                        # worker serves stale rows next forward
+                        self._kvstore.pull(i, out=p.data())
+                    else:
+                        self._kvstore.row_sparse_pull(i, out=p.data(),
+                                                      row_ids=g.indices)
+                else:
+                    self._kvstore.push(i, _dense_grad(p))
+                    self._kvstore.pull(i, out=p.data())
             return
         if not self._states_ready:
             self._init_states()

@@ -148,11 +148,17 @@ class KVStore:
     # ---------------------------------------------------------------- push --
     def push(self, key, value, priority=0):
         """ref: KVStore::Push — merge pushed values into the store; with an
-        optimizer attached (update_on_kvstore), run the update server-side."""
+        optimizer attached (update_on_kvstore), run the update server-side.
+        row_sparse values take the lazy path: only pushed rows are merged
+        and updated (ref: kvstore_dist_server.h DataHandleRowSparse)."""
+        from ..sparse import RowSparseNDArray
         keys, vals = self._key_value_lists(key, value)
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise KeyError(f"key '{k}' was not init()ed")
+            if any(isinstance(v, RowSparseNDArray) for v in vlist):
+                self._push_rsp(k, vlist)
+                continue
             arrs = [v._data if isinstance(v, NDArray) else jnp.asarray(v)
                     for v in vlist]
             merged = arrs[0] if len(arrs) == 1 else _sum_arrays(arrs)
@@ -179,22 +185,55 @@ class KVStore:
                     merged = distributed.all_sum(merged)
             stored = self._store[k]
             if self._optimizer is not None:
-                # dense per-key optimizer index so string keys get distinct
-                # update counts / state slots (ref: kvstore_dist_server.h
-                # keys are ps-lite ints; here any hashable key works)
-                # digit keys keep their value; string keys get negative
-                # indices, a namespace no digit key can collide with
-                idx = self._key_index.setdefault(
-                    k, int(k) if k.isdigit() else -(len(self._key_index) + 1))
-                if k not in self._opt_states:
-                    self._opt_states[k] = \
-                        self._optimizer.create_state_multi_precision(idx, stored)
-                self._optimizer.update_multi_precision(
-                    idx, stored, NDArray(merged), self._opt_states[k])
+                self._server_update(k, stored, NDArray(merged))
             elif self._updater is not None:
                 self._updater(k, NDArray(merged), stored)
             else:
                 stored._data = merged
+
+    def _server_update(self, k, stored, grad):
+        """Apply the attached optimizer server-side (ref:
+        kvstore_dist_server.h DataHandleEx).  Dense per-key optimizer index
+        so string keys get distinct update counts / state slots: digit keys
+        keep their value; string keys get negative indices, a namespace no
+        digit key can collide with."""
+        idx = self._key_index.setdefault(
+            k, int(k) if k.isdigit() else -(len(self._key_index) + 1))
+        if k not in self._opt_states:
+            self._opt_states[k] = \
+                self._optimizer.create_state_multi_precision(idx, stored)
+        self._optimizer.update_multi_precision(
+            idx, stored, grad, self._opt_states[k])
+
+    def _push_rsp(self, k, vlist):
+        """row_sparse push: union-merge pushed row sets, then lazy-update or
+        store only those rows (ref: kvstore_dist_server.h
+        DataHandleRowSparse; comm.h CommCPU::ReduceRowSparse)."""
+        from .. import sparse as _sp
+        if self._compression is not None:
+            raise ValueError(
+                "gradient compression does not support row_sparse push "
+                "(the reference restricts 2bit to dense too)")
+        merged = vlist[0]
+        for v in vlist[1:]:
+            merged = _sp.add(merged, v)
+        if self._is_dist:
+            # cross-process reduce rides the dense wire format (row sets
+            # differ per worker; variable-length allgather would fight XLA's
+            # static shapes — SURVEY §7.0's "let the compiler schedule it")
+            from .. import distributed
+            dense = distributed.all_sum(merged.tostype("default")._data)
+            merged = _sp.cast_storage(NDArray(dense), "row_sparse")
+        stored = self._store[k]
+        if self._optimizer is not None:
+            self._server_update(k, stored, merged)
+        elif self._updater is not None:
+            self._updater(k, merged, stored)
+        else:
+            # merge ONLY the pushed rows (DataHandleRowSparse semantics);
+            # densifying here would zero every absent row of the store
+            stored._data = stored._data.at[merged._indices].set(
+                merged._data.astype(stored._data.dtype))
 
     # ---------------------------------------------------------------- pull --
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
@@ -220,13 +259,58 @@ class KVStore:
 
     def pushpull(self, key, value, out=None, priority=0):
         """ref: KVStore::PushPull (fused, the dist_sync_device fast path)."""
+        from ..sparse import RowSparseNDArray
+        if out is None and any(isinstance(v, RowSparseNDArray)
+                               for v in _as_list(value)):
+            raise ValueError(
+                "pushpull with a row_sparse value needs an explicit dense "
+                "out= (a dense pull cannot land in sparse storage); or use "
+                "push + row_sparse_pull(row_ids=...)")
         self.push(key, value, priority)
         self.pull(key, out=out if out is not None else value, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Sparse pull degenerates to dense pull (TPU arrays are dense;
-        ref: KVStoreLocal::PullRowSparse)."""
-        return self.pull(key, out=out, priority=priority)
+        """Pull only the requested rows as row_sparse (ref:
+        KVStoreLocal::PullRowSparse) — the communication-shaped pull a
+        sparse-embedding Trainer issues after each push.  Without row_ids
+        the pull degenerates to dense."""
+        from ..sparse import RowSparseNDArray
+        if row_ids is None:
+            return self.pull(key, out=out, priority=priority)
+        keys = [str(k) for k in _as_list(key)]
+        rids = _as_list(row_ids)
+        if len(rids) == 1 and len(keys) > 1:
+            rids = rids * len(keys)
+        if len(rids) != len(keys):
+            raise ValueError(
+                f"row_sparse_pull: {len(rids)} row_id lists for "
+                f"{len(keys)} keys")
+        results = []
+        for k, rid in zip(keys, rids):
+            if k not in self._store:
+                raise KeyError(f"key '{k}' was not init()ed")
+            ridx = jnp.unique(jnp.asarray(
+                rid._data if isinstance(rid, NDArray) else rid, jnp.int32))
+            stored = self._store[k]
+            results.append(RowSparseNDArray(
+                stored._data[ridx], ridx, tuple(stored.shape)))
+        if out is not None:
+            outs = _as_list(out)
+            if len(outs) % len(results) != 0:
+                raise ValueError(
+                    f"row_sparse_pull: {len(outs)} outputs for "
+                    f"{len(results)} keys")
+            per_key = len(outs) // len(results)
+            for i, o in enumerate(outs):
+                r = results[i // per_key]
+                if isinstance(o, RowSparseNDArray):
+                    o._data, o._indices = r._data, r._indices
+                    o.shape = r.shape
+                else:  # dense target: overwrite just the pulled rows
+                    o._data = o._data.at[r._indices].set(
+                        r._data.astype(o._data.dtype))
+            return None
+        return results if len(results) > 1 else results[0]
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

@@ -244,3 +244,140 @@ def test_trainer_kvstore_paths_with_sparse_grad():
     loss.backward()
     tr2.allreduce_grads()
     tr2.update(1)
+
+
+def test_row_sparse_pull_row_ids():
+    """kvstore.row_sparse_pull(row_ids) returns ONLY the requested rows
+    (ref: KVStoreLocal::PullRowSparse)."""
+    from mxnet_tpu import kvstore as kv_mod
+    kv = kv_mod.create("local")
+    w = np.arange(20, dtype=np.float32).reshape(5, 4)
+    kv.init("w", mx.nd.array(w))
+    out = kv.row_sparse_pull("w", row_ids=mx.nd.array([3, 1, 3], dtype=np.int32))
+    assert out.stype == "row_sparse"
+    np.testing.assert_array_equal(np.asarray(out._indices), [1, 3])
+    np.testing.assert_allclose(np.asarray(out._data), w[[1, 3]])
+    # dense out target: only pulled rows overwritten
+    tgt = mx.nd.array(np.full((5, 4), -1.0, np.float32))
+    kv.row_sparse_pull("w", out=tgt, row_ids=mx.nd.array([0], dtype=np.int32))
+    got = tgt.asnumpy()
+    np.testing.assert_allclose(got[0], w[0])
+    np.testing.assert_allclose(got[1:], -1.0)
+
+
+def test_kvstore_rsp_push_lazy_server_update():
+    """Pushing a row_sparse grad with a server-side optimizer touches ONLY
+    the pushed rows (ref: kvstore_dist_server.h DataHandleRowSparse)."""
+    from mxnet_tpu import kvstore as kv_mod
+    kv = kv_mod.create("local")
+    w0 = np.ones((6, 3), np.float32)
+    kv.init("0", mx.nd.array(w0))
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+    g = sparse.row_sparse_array(
+        (np.full((2, 3), 2.0, np.float32), np.array([1, 4], np.int32)),
+        shape=(6, 3))
+    kv.push("0", g)
+    got = kv.pull("0").asnumpy()
+    expect = w0.copy()
+    expect[[1, 4]] -= 0.5 * 2.0
+    np.testing.assert_allclose(got, expect)
+    # merging two rsp pushes in one call union-sums rows
+    g2 = sparse.row_sparse_array(
+        (np.ones((1, 3), np.float32), np.array([4], np.int32)), shape=(6, 3))
+    kv.push("0", [g, g2])
+    got2 = kv.pull("0").asnumpy()
+    expect[[1]] -= 0.5 * 2.0
+    expect[[4]] -= 0.5 * 3.0
+    np.testing.assert_allclose(got2, expect)
+
+
+def test_rsp_nd_values_update_matches_dense():
+    """N-D row_sparse values (vocab, d1, d2) through cast/add/adagrad —
+    lazy rows match a dense adagrad update on the touched rows."""
+    rng = np.random.RandomState(3)
+    dense = rng.randn(8, 2, 3).astype(np.float32)
+    dense[[0, 2, 5]] = 0.0
+    rsp = sparse.cast_storage(mx.nd.array(dense), "row_sparse")
+    assert rsp._data.shape[1:] == (2, 3)
+    np.testing.assert_allclose(rsp.todense().asnumpy(), dense)
+    s = sparse.add(rsp, rsp)
+    np.testing.assert_allclose(s.todense().asnumpy(), 2 * dense)
+    w = mx.nd.array(rng.randn(8, 2, 3).astype(np.float32))
+    h = mx.nd.array(np.zeros((8, 2, 3), np.float32))
+    w_ref = w.asnumpy().copy()
+    h_ref = h.asnumpy().copy()
+    new_w = sparse.adagrad_update(w, rsp, h, lr=0.1)
+    touched = np.asarray(rsp._indices)
+    g = dense[touched]
+    h_ref[touched] += g ** 2
+    w_ref[touched] -= 0.1 * g / (np.sqrt(h_ref[touched]) + 1e-7)
+    np.testing.assert_allclose(new_w.asnumpy(), w_ref, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(h.asnumpy(), h_ref, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("opt_name", ["sgd", "adagrad"])
+def test_embedding_sparse_grad_trains_like_dense(opt_name):
+    """THE sparse path that matters (SURVEY §2.2 sparse row): an Embedding
+    with sparse_grad=True trains through Trainer + kvstore row_sparse_pull
+    and matches the dense-grad model parameter-for-parameter (wd=0 makes
+    lazy and dense updates identical)."""
+    from mxnet_tpu import gluon, autograd
+
+    def build(sparse_grad, seed):
+        mx.random.seed(seed)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Embedding(50, 8, sparse_grad=sparse_grad),
+                gluon.nn.Dense(4, flatten=False, in_units=8))
+        net.initialize()
+        return net
+
+    a = build(True, 11)
+    b = build(False, 11)
+    # identical init
+    for (ka, pa), (kb, pb) in zip(
+            sorted(a._collect_params_with_prefix().items()),
+            sorted(b._collect_params_with_prefix().items())):
+        pb.set_data(mx.nd.array(pa.data().asnumpy()))
+    tr_a = gluon.Trainer(a.collect_params(), opt_name,
+                         {"learning_rate": 0.1}, kvstore="device",
+                         update_on_kvstore=True)
+    tr_b = gluon.Trainer(b.collect_params(), opt_name,
+                         {"learning_rate": 0.1}, kvstore="device",
+                         update_on_kvstore=True)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randint(0, 50, (16, 6)).astype(np.int32))
+    y = mx.nd.array(rng.randn(16, 6, 4).astype(np.float32))
+    losses_a, losses_b = [], []
+    for step in range(5):
+        for net, tr, acc in ((a, tr_a, losses_a), (b, tr_b, losses_b)):
+            with autograd.record():
+                loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            tr.step(1)
+            acc.append(float(loss.asnumpy()))
+    np.testing.assert_allclose(losses_a, losses_b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        a[0].weight.data().asnumpy(), b[0].weight.data().asnumpy(),
+        rtol=1e-5, atol=1e-6)
+    assert losses_a[-1] < losses_a[0]  # actually learning
+
+
+def test_kvstore_rsp_push_no_optimizer_merges_rows():
+    """Optimizer-less rsp push must merge ONLY the pushed rows (regression:
+    densified replace zeroed the rest of the store)."""
+    from mxnet_tpu import kvstore as kv_mod
+    kv = kv_mod.create("local")
+    kv.init("w", mx.nd.array(np.ones((5, 4), np.float32)))
+    g = sparse.row_sparse_array(
+        (np.full((1, 4), 9.0, np.float32), np.array([1], np.int32)),
+        shape=(5, 4))
+    kv.push("w", g)
+    got = kv.pull("w").asnumpy()
+    np.testing.assert_allclose(got[1], 9.0)
+    np.testing.assert_allclose(got[[0, 2, 3, 4]], 1.0)
+    # pushpull with sparse value and no dense out is rejected clearly
+    with pytest.raises(ValueError, match="row_sparse"):
+        kv.pushpull("w", g)
+    with pytest.raises(ValueError, match="row_id"):
+        kv.row_sparse_pull(["w", "w", "w"],
+                           row_ids=[mx.nd.array([0]), mx.nd.array([1])])
