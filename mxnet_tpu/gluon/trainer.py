@@ -101,9 +101,12 @@ class Trainer:
             # sparse-grad params push row_sparse and pull back ONLY the
             # touched rows (ref: trainer.py _row_sparse_pull) — the lazy
             # update leaves every other row untouched server-side too.
+            # Optimizers without a lazy rsp update (supports_sparse=False,
+            # e.g. LAMB) keep the dense wire, exactly as before.
             multi = self._kvstore.num_workers > 1
+            sparse_ok = getattr(self._optimizer, "supports_sparse", False)
             for i, p in enumerate(self._params):
-                if p._grad_stype == "row_sparse":
+                if p._grad_stype == "row_sparse" and sparse_ok:
                     g = p.grad()  # row_sparse view of the tape grad
                     self._kvstore.push(i, g)
                     if multi:

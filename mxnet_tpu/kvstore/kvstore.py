@@ -156,10 +156,15 @@ class KVStore:
         for k, vlist in zip(keys, vals):
             if k not in self._store:
                 raise KeyError(f"key '{k}' was not init()ed")
-            if any(isinstance(v, RowSparseNDArray) for v in vlist):
+            if all(isinstance(v, RowSparseNDArray) for v in vlist):
                 self._push_rsp(k, vlist)
                 continue
-            arrs = [v._data if isinstance(v, NDArray) else jnp.asarray(v)
+            # mixed dense+rsp lists ride the dense wire (a partial rsp merge
+            # has no well-defined row set)
+            arrs = [v.tostype("default")._data
+                    if isinstance(v, RowSparseNDArray)
+                    else (v._data if isinstance(v, NDArray)
+                          else jnp.asarray(v))
                     for v in vlist]
             merged = arrs[0] if len(arrs) == 1 else _sum_arrays(arrs)
             if self._compression is not None:

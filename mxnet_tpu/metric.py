@@ -14,7 +14,7 @@ from .ndarray import NDArray
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy", "F1",
            "MAE", "MSE", "RMSE", "CrossEntropy", "NegativeLogLikelihood",
            "Perplexity", "PearsonCorrelation", "Loss", "Torch", "Caffe",
-           "CustomMetric", "create", "np"]
+           "CustomMetric", "VOCMApMetric", "VOC07MApMetric", "create", "np"]
 
 _REGISTRY = {}
 
@@ -352,6 +352,139 @@ class CustomMetric(EvalMetric):
                 self.num_inst += 1
 
 
+class VOCMApMetric(EvalMetric):
+    """PASCAL-VOC mean average precision over detection outputs
+    (ref: the reference ecosystem's gluoncv.utils.metrics.VOCMApMetric —
+    BASELINE config 5's quality bar is mAP parity).
+
+    update(labels, preds):
+      preds:  (B, N, 6) rows ``[cls_id, score, x1, y1, x2, y2]``
+              (MultiBoxDetection output; cls_id < 0 is padding/background)
+      labels: (B, M, 5+) rows ``[cls, x1, y1, x2, y2, (difficult)]``
+              (cls < 0 is padding; difficult boxes are excluded)
+
+    AP per class is area under the interpolated precision-recall curve
+    (VOC2010+ all-points); see VOC07MApMetric for 11-point interpolation.
+    """
+
+    def __init__(self, iou_thresh=0.5, class_names=None, name="mAP",
+                 **kwargs):
+        self.iou_thresh = iou_thresh
+        self.class_names = class_names
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self._scores = {}   # cls -> list of detection scores
+        self._match = {}    # cls -> list of 1 (tp) / 0 (fp), same order
+        self._npos = {}     # cls -> number of non-difficult gt boxes
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    @staticmethod
+    def _iou(box, boxes):
+        """IoU of one (4,) box against (K, 4) corner boxes."""
+        ix1 = _np.maximum(box[0], boxes[:, 0])
+        iy1 = _np.maximum(box[1], boxes[:, 1])
+        ix2 = _np.minimum(box[2], boxes[:, 2])
+        iy2 = _np.minimum(box[3], boxes[:, 3])
+        iw = _np.maximum(ix2 - ix1, 0.0)
+        ih = _np.maximum(iy2 - iy1, 0.0)
+        inter = iw * ih
+        a = (box[2] - box[0]) * (box[3] - box[1])
+        b = (boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])
+        return inter / _np.maximum(a + b - inter, 1e-12)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            lab, det = _to_np(label), _to_np(pred)
+            for b in range(det.shape[0]):
+                self._update_image(lab[b], det[b])
+        self.num_inst = 1  # aggregate metric: get() recomputes from state
+
+    def _update_image(self, lab, det):
+        gt = lab[lab[:, 0] >= 0]
+        difficult = (gt[:, 5] > 0 if gt.shape[1] > 5
+                     else _np.zeros(len(gt), bool))
+        dets = det[det[:, 0] >= 0]
+        classes = set(gt[:, 0].astype(int)) | set(dets[:, 0].astype(int))
+        for c in classes:
+            gmask = gt[:, 0].astype(int) == c
+            gboxes = gt[gmask, 1:5]
+            gdiff = difficult[gmask]
+            self._npos[c] = self._npos.get(c, 0) + int((~gdiff).sum())
+            dmask = dets[:, 0].astype(int) == c
+            d = dets[dmask]
+            if len(d) == 0:
+                continue
+            order = _np.argsort(-d[:, 1])
+            d = d[order]
+            used = _np.zeros(len(gboxes), bool)
+            sc = self._scores.setdefault(c, [])
+            mt = self._match.setdefault(c, [])
+            for row in d:
+                if len(gboxes) == 0:
+                    sc.append(float(row[1]))
+                    mt.append(0)
+                    continue
+                ious = self._iou(row[2:6], gboxes)
+                j = int(ious.argmax())
+                if ious[j] >= self.iou_thresh and gdiff[j]:
+                    continue  # difficult match: neither tp nor fp (VOC rule)
+                hit = ious[j] >= self.iou_thresh and not used[j]
+                sc.append(float(row[1]))
+                mt.append(1 if hit else 0)
+                if hit:
+                    used[j] = True
+
+    def _average_precision(self, rec, prec):
+        """All-points interpolated AUC (VOC2010+)."""
+        mrec = _np.concatenate([[0.0], rec, [1.0]])
+        mpre = _np.concatenate([[0.0], prec, [0.0]])
+        for i in range(len(mpre) - 2, -1, -1):
+            mpre[i] = max(mpre[i], mpre[i + 1])
+        idx = _np.where(mrec[1:] != mrec[:-1])[0]
+        return float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
+
+    def get(self):
+        aps, names = [], []
+        for c in sorted(self._npos):
+            npos = self._npos[c]
+            if npos == 0:
+                continue
+            scores = _np.asarray(self._scores.get(c, []), _np.float64)
+            match = _np.asarray(self._match.get(c, []), _np.float64)
+            if len(scores) == 0:
+                ap = 0.0
+            else:
+                order = _np.argsort(-scores)
+                tp = _np.cumsum(match[order])
+                fp = _np.cumsum(1.0 - match[order])
+                rec = tp / npos
+                prec = tp / _np.maximum(tp + fp, 1e-12)
+                ap = self._average_precision(rec, prec)
+            aps.append(ap)
+            names.append(self.class_names[c] if self.class_names
+                         else f"class{c}")
+        mean = float(_np.mean(aps)) if aps else float("nan")
+        return names + [self.name], aps + [mean]
+
+    def get_map(self):
+        """The scalar mAP (last entry of get())."""
+        return self.get()[1][-1]
+
+
+class VOC07MApMetric(VOCMApMetric):
+    """11-point interpolated AP (the VOC2007 protocol; ref: gluoncv
+    VOC07MApMetric)."""
+
+    def _average_precision(self, rec, prec):
+        ap = 0.0
+        for t in _np.arange(0.0, 1.1, 0.1):
+            p = prec[rec >= t].max() if (rec >= t).any() else 0.0
+            ap += p / 11.0
+        return float(ap)
+
+
 def np_metric(numpy_feval, name="custom", allow_extra_outputs=False):
     """ref: metric.np — wrap a numpy feval into a CustomMetric factory."""
     return CustomMetric(numpy_feval, name, allow_extra_outputs)
@@ -374,3 +507,6 @@ _REGISTRY["perplexity"] = Perplexity
 _REGISTRY["pearsonr"] = PearsonCorrelation
 _REGISTRY["loss"] = Loss
 _REGISTRY["composite"] = CompositeEvalMetric
+_REGISTRY["map"] = VOCMApMetric
+_REGISTRY["vocmapmetric"] = VOCMApMetric
+_REGISTRY["voc07mapmetric"] = VOC07MApMetric

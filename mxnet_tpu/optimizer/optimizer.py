@@ -67,6 +67,11 @@ class Optimizer:
     states saved with ``multi_precision=False`` must be reloaded with it
     passed explicitly, else ``Trainer.load_states`` fails its count check."""
 
+    # True only on optimizers whose update() dispatches row_sparse grads to
+    # a lazy update (SGD/Adam/AdaGrad); Trainer falls back to the dense wire
+    # for the rest (ref: the reference's std_update-vs-lazy_update split)
+    supports_sparse = False
+
     def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
                  clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
                  begin_num_update=0, multi_precision=None, param_dict=None,
@@ -167,6 +172,8 @@ class Optimizer:
 class SGD(Optimizer):
     """ref: class SGD → sgd_update / sgd_mom_update ops."""
 
+    supports_sparse = True
+
     def __init__(self, momentum=0.0, lazy_update=False, **kwargs):
         super().__init__(**kwargs)
         self.momentum = momentum
@@ -206,6 +213,8 @@ class SGD(Optimizer):
 class NAG(SGD):
     """ref: class NAG → nag_mom_update."""
 
+    supports_sparse = False
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
@@ -219,6 +228,8 @@ class NAG(SGD):
 @register
 class Adam(Optimizer):
     """ref: class Adam → adam_update op."""
+
+    supports_sparse = True
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, lazy_update=False, **kwargs):
@@ -255,6 +266,8 @@ class Adam(Optimizer):
 @register
 class AdamW(Adam):
     """ref: contrib adamw_update — decoupled weight decay."""
+
+    supports_sparse = False
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -447,6 +460,8 @@ class RMSProp(Optimizer):
 @register
 class AdaGrad(Optimizer):
     """ref: class AdaGrad → adagrad_update."""
+
+    supports_sparse = True
 
     def __init__(self, learning_rate=0.01, eps=1e-7, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)

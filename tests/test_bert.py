@@ -116,3 +116,28 @@ def test_bert_named_configs():
     # 12 layers, 768 units registered without initialization cost concerns
     assert len(net.encoder.layers) == 12
     assert net.encoder.layers[0].ffn1._units == 3072
+
+
+def test_bert_mlm_accuracy_gate():
+    """Quality gate with teeth (BASELINE config 4): after memorizing a fixed
+    masked batch, masked-LM top-1 accuracy must beat chance (1/vocab = 2%)
+    by a wide margin — a garbage-but-decreasing loss cannot pass this."""
+    mx.random.seed(1)
+    net = _tiny_bert(dropout=0.0)
+    loss_blk = BERTPretrainLoss()
+
+    def loss_fn(out, lab):
+        return loss_blk(out[3], out[2], *lab)
+
+    mesh = parallel.make_mesh(dp=8)
+    opt = mx.optimizer.create("lamb", learning_rate=0.02)
+    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh)
+    rng = np.random.RandomState(4)
+    tok, tt, vl, mp, ml, mw, nl = _batch(rng)
+    for _ in range(60):
+        step((tok, tt, vl, mp), (ml, mw, nl))
+    step.sync_params_to_net()
+    mlm_scores = net(tok, tt, vl, mp)[3].asnumpy()
+    pred = mlm_scores.argmax(axis=-1)
+    acc = float((pred == ml.asnumpy()).mean())
+    assert acc >= 0.5, f"masked-LM accuracy {acc:.3f} vs chance 0.02"

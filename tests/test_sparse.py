@@ -381,3 +381,36 @@ def test_kvstore_rsp_push_no_optimizer_merges_rows():
     with pytest.raises(ValueError, match="row_id"):
         kv.row_sparse_pull(["w", "w", "w"],
                            row_ids=[mx.nd.array([0]), mx.nd.array([1])])
+
+
+def test_sparse_grad_with_dense_only_optimizer_falls_back():
+    """Optimizers without a lazy rsp update (LAMB) must keep working with
+    sparse-grad params via the dense wire (regression)."""
+    from mxnet_tpu import gluon, autograd
+    emb = gluon.nn.Embedding(10, 3, sparse_grad=True)
+    emb.initialize()
+    tr = gluon.Trainer(emb.collect_params(), "lamb",
+                       {"learning_rate": 0.01}, kvstore="device",
+                       update_on_kvstore=True)
+    w0 = emb.weight.data().asnumpy().copy()
+    x = mx.nd.array(np.array([1, 2], np.int32))
+    with autograd.record():
+        loss = (emb(x) ** 2).sum()
+    loss.backward()
+    tr.step(1)
+    assert not np.allclose(emb.weight.data().asnumpy(), w0)
+
+
+def test_kvstore_mixed_dense_rsp_push_densifies():
+    """A mixed dense+rsp push merges on the dense wire (regression)."""
+    from mxnet_tpu import kvstore as kv_mod
+    kv = kv_mod.create("local")
+    kv.init("w", mx.nd.array(np.zeros((4, 2), np.float32)))
+    g_rsp = sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([2], np.int32)), shape=(4, 2))
+    g_dense = mx.nd.array(np.full((4, 2), 0.5, np.float32))
+    kv.push("w", [g_rsp, g_dense])
+    got = kv.pull("w").asnumpy()
+    expect = np.full((4, 2), 0.5, np.float32)
+    expect[2] += 1.0
+    np.testing.assert_allclose(got, expect)
