@@ -29,18 +29,29 @@ def _bn_axis(layout):
 
 
 class BasicBlockV1(HybridBlock):
-    """ref: class BasicBlockV1."""
+    """ref: class BasicBlockV1.
+
+    ``fused=True`` (NHWC only) folds the bn1+relu pair into the second
+    conv via the Pallas NormReluConv2D kernel (PERF.md: the normalized
+    activation never reaches HBM)."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
+        self._fused = fused
+        if fused:
+            assert layout == "NHWC", "fused resnet blocks need NHWC"
+            self.conv1 = _conv3x3(channels, stride, in_channels, layout)
+            self.f2 = nn.NormReluConv2D(channels, 3, in_channels=channels)
+            self.bn2 = nn.BatchNorm(axis=ax)
+        else:
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(_conv3x3(channels, stride, in_channels, layout))
+            self.body.add(nn.BatchNorm(axis=ax))
+            self.body.add(nn.Activation("relu"))
+            self.body.add(_conv3x3(channels, 1, channels, layout))
+            self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
@@ -53,30 +64,49 @@ class BasicBlockV1(HybridBlock):
     def forward(self, x):
         from .... import ndarray as F
         residual = x
-        x = self.body(x)
+        if self._fused:
+            x = self.bn2(self.f2(self.conv1(x)))
+        else:
+            x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
         return F.Activation(x + residual, act_type="relu")
 
 
 class BottleneckV1(HybridBlock):
-    """ref: class BottleneckV1 (the ResNet-50 block)."""
+    """ref: class BottleneckV1 (the ResNet-50 block).
+
+    ``fused=True`` (NHWC only) folds bn1+relu into the 3×3 and bn2+relu
+    into the closing 1×1 via the Pallas NormReluConv2D kernel — the two
+    largest activations of the block never reach HBM (PERF.md)."""
 
     def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
         ax = _bn_axis(layout)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
-                                use_bias=False, layout=layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
-        self.body.add(nn.BatchNorm(axis=ax))
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
-                                use_bias=False, layout=layout))
-        self.body.add(nn.BatchNorm(axis=ax))
+        self._fused = fused
+        if fused:
+            assert layout == "NHWC", "fused resnet blocks need NHWC"
+            self.conv1 = nn.Conv2D(channels // 4, kernel_size=1,
+                                   strides=stride, use_bias=False,
+                                   layout=layout)
+            self.f2 = nn.NormReluConv2D(channels // 4, 3,
+                                        in_channels=channels // 4)
+            self.f3 = nn.NormReluConv2D(channels, 1,
+                                        in_channels=channels // 4)
+            self.bn3 = nn.BatchNorm(axis=ax)
+        else:
+            self.body = nn.HybridSequential(prefix="")
+            self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride,
+                                    use_bias=False, layout=layout))
+            self.body.add(nn.BatchNorm(axis=ax))
+            self.body.add(nn.Activation("relu"))
+            self.body.add(_conv3x3(channels // 4, 1, channels // 4, layout))
+            self.body.add(nn.BatchNorm(axis=ax))
+            self.body.add(nn.Activation("relu"))
+            self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1,
+                                    use_bias=False, layout=layout))
+            self.body.add(nn.BatchNorm(axis=ax))
         if downsample:
             self.downsample = nn.HybridSequential(prefix="")
             self.downsample.add(nn.Conv2D(channels, kernel_size=1, strides=stride,
@@ -89,7 +119,10 @@ class BottleneckV1(HybridBlock):
     def forward(self, x):
         from .... import ndarray as F
         residual = x
-        x = self.body(x)
+        if self._fused:
+            x = self.bn3(self.f3(self.f2(self.conv1(x))))
+        else:
+            x = self.body(x)
         if self.downsample:
             residual = self.downsample(residual)
         return F.Activation(x + residual, act_type="relu")
@@ -168,7 +201,7 @@ class ResNetV1(HybridBlock):
     """ref: class ResNetV1."""
 
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 layout="NCHW", **kwargs):
+                 layout="NCHW", fused=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         self._layout = layout
@@ -187,19 +220,21 @@ class ResNetV1(HybridBlock):
                 stride = 1 if i == 0 else 2
                 self.features.add(self._make_layer(
                     block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i], layout=layout))
+                    in_channels=channels[i], layout=layout, fused=fused))
             self.features.add(nn.GlobalAvgPool2D(layout=layout))
             self.output = nn.Dense(classes, in_units=channels[-1])
 
     def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0, layout="NCHW"):
+                    in_channels=0, layout="NCHW", fused=False):
+        kw = {"fused": fused} if fused else {}
         layer = nn.HybridSequential(prefix=f"stage{stage_index}_")
         with layer.name_scope():
             layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, layout=layout, prefix=""))
+                            in_channels=in_channels, layout=layout,
+                            prefix="", **kw))
             for _ in range(layers - 1):
                 layer.add(block(channels, 1, False, in_channels=channels,
-                                layout=layout, prefix=""))
+                                layout=layout, prefix="", **kw))
         return layer
 
     def forward(self, x):

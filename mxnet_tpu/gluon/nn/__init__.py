@@ -3,8 +3,10 @@ from ..block import Block, HybridBlock, SymbolBlock
 from .activations import *
 from .basic_layers import *
 from .conv_layers import *
+from .fused import *
 
-from . import activations, basic_layers, conv_layers
+from . import activations, basic_layers, conv_layers, fused
 
 __all__ = (["Block", "HybridBlock", "SymbolBlock"]
-           + activations.__all__ + basic_layers.__all__ + conv_layers.__all__)
+           + activations.__all__ + basic_layers.__all__ + conv_layers.__all__
+           + fused.__all__)

@@ -1,0 +1,76 @@
+"""TPU-fused layers (no reference counterpart — SURVEY §7.0.2 territory).
+
+NormReluConv2D folds BatchNorm(+residual)+ReLU INTO the following
+convolution via the Pallas kernel in ops/pallas/fused_conv.py, so the
+normalized activation never reaches HBM.  NHWC only, 1×1/3×3 stride-1 —
+the ResNet residual-block hot path.  Weights are HWIO (the TPU-native
+conv layout); this layer is an opt-in performance variant, so its
+parameter layout intentionally differs from Conv2D+BatchNorm pairs.
+"""
+from __future__ import annotations
+
+from ... import autograd as _autograd
+from ...ndarray import NDArray
+from ..block import HybridBlock
+
+__all__ = ["NormReluConv2D"]
+
+
+class NormReluConv2D(HybridBlock):
+    """out = conv(relu(bn(x) [+ residual]), weight) in one fused kernel.
+
+    Owns the BN affine/running stats of its INPUT channels plus the conv
+    weight producing ``channels`` outputs.  ``residual`` (optional second
+    call argument) is added after the affine, before the relu — the
+    ResNet v1 block-tail pattern.  Dispatches through the FusedNormReluConv
+    registered op so eager autograd and hybridize both see one taped node.
+    """
+
+    def __init__(self, channels, kernel_size, in_channels=0, momentum=0.9,
+                 epsilon=1e-5, relu=True, weight_initializer=None,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if kernel_size not in (1, 3):
+            raise ValueError("NormReluConv2D supports kernel_size 1 or 3")
+        self._channels = channels
+        self._k = kernel_size
+        self._momentum = momentum
+        self._eps = epsilon
+        self._relu = relu
+        self.weight = self.params.get(
+            "weight",
+            shape=(kernel_size, kernel_size, in_channels, channels),
+            init=weight_initializer, allow_deferred_init=True)
+        self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                     init="ones", allow_deferred_init=True)
+        self.beta = self.params.get("beta", shape=(in_channels,),
+                                    init="zeros", allow_deferred_init=True)
+        self.running_mean = self.params.get(
+            "running_mean", shape=(in_channels,), init="zeros",
+            allow_deferred_init=True, differentiable=False)
+        self.running_var = self.params.get(
+            "running_var", shape=(in_channels,), init="ones",
+            allow_deferred_init=True, differentiable=False)
+
+    def infer_shape(self, x, *args):
+        ci = x.shape[-1]
+        self.weight.shape = (self._k, self._k, ci, self._channels)
+        for p in (self.gamma, self.beta, self.running_mean,
+                  self.running_var):
+            p.shape = (ci,)
+
+    def hybrid_forward(self, F, x, *args, **params):
+        residual = args[0] if args else None
+        extra = (residual,) if residual is not None else ()
+        out, new_mm, new_mv = F.FusedNormReluConv(
+            x, params["weight"], params["gamma"], params["beta"],
+            params["running_mean"], params["running_var"], *extra,
+            eps=self._eps, momentum=self._momentum, relu=self._relu)
+        if _autograd.is_training():
+            self.running_mean._data = NDArray(new_mm.detach()._data)
+            self.running_var._data = NDArray(new_mv.detach()._data)
+        return out
+
+    def __repr__(self):
+        return (f"NormReluConv2D({self._k}x{self._k}, "
+                f"channels={self._channels})")

@@ -154,8 +154,13 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        sparse_ok = getattr(self._optimizer, "supports_sparse", False)
         for i, p in enumerate(self._params):
-            self._optimizer.update_multi_precision(i, p.data(), p.grad(),
+            # dense-only optimizers get the dense tape buffer even for
+            # sparse-grad params (p.grad() would hand them an rsp view)
+            g = p.grad() if sparse_ok or p._grad_stype != "row_sparse" \
+                else _dense_grad(p)
+            self._optimizer.update_multi_precision(i, p.data(), g,
                                                    self._states[i])
 
     def zero_grad(self):

@@ -440,8 +440,7 @@ class VOCMApMetric(EvalMetric):
         """All-points interpolated AUC (VOC2010+)."""
         mrec = _np.concatenate([[0.0], rec, [1.0]])
         mpre = _np.concatenate([[0.0], prec, [0.0]])
-        for i in range(len(mpre) - 2, -1, -1):
-            mpre[i] = max(mpre[i], mpre[i + 1])
+        mpre = _np.maximum.accumulate(mpre[::-1])[::-1]  # precision envelope
         idx = _np.where(mrec[1:] != mrec[:-1])[0]
         return float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
 

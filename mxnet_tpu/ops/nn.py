@@ -289,6 +289,40 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
     return out, new_mm, new_mv
 
 
+@register_op("FusedNormReluConv", aliases=("fused_norm_relu_conv",))
+def _fused_norm_relu_conv(data, weight, gamma, beta, moving_mean,
+                          moving_var, residual=None, eps=1e-5, momentum=0.9,
+                          relu=True, training=None):
+    """BatchNorm(+residual)+ReLU folded into the following conv via the
+    Pallas kernel (ops/pallas/fused_conv.py) — the normalized activation
+    never reaches HBM.  NHWC data, HWIO weight, 1x1/3x3 stride-1.
+
+    Functional like BatchNorm: returns (out, new_moving_mean,
+    new_moving_var); the gluon NormReluConv2D layer threads the aux state.
+    """
+    from .pallas.fused_conv import norm_relu_conv
+
+    if training is None:
+        training = _autograd.is_training()
+    axes = tuple(range(data.ndim - 1))  # NHWC: all but channels
+    if training:
+        mean, var = _moments(data, axes)
+        new_mm = moving_mean * momentum + \
+            jax.lax.stop_gradient(mean).astype(moving_mean.dtype) * (1 - momentum)
+        new_mv = moving_var * momentum + \
+            jax.lax.stop_gradient(var).astype(moving_var.dtype) * (1 - momentum)
+    else:
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+        new_mm, new_mv = moving_mean, moving_var
+    inv = jax.lax.rsqrt(var + eps)
+    scale = gamma.astype(jnp.float32) * inv
+    shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * scale
+    out = norm_relu_conv(data, scale, shift, weight, residual=residual,
+                         relu=relu)
+    return out, new_mm, new_mv
+
+
 @register_op("LayerNorm", aliases=("layer_norm",))
 def _layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
     """ref: src/operator/nn/layer_norm-inl.h — LayerNormCompute."""

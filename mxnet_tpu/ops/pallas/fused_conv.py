@@ -1,0 +1,352 @@
+"""Fused norm→relu→conv Pallas kernel — the ResNet HBM-floor breaker.
+
+PERF.md's round-3 accounting: the ResNet-50 step is HBM-bound at 44 GB,
+of which ~12 GB is BN/relu/residual kLoop fusions.  XLA cannot fuse
+elementwise producers INTO a convolution custom-call, so every
+``relu(bn(y) [+res])`` materialises a full activation tensor that the next
+conv immediately re-reads.  These kernels apply the normalize(+residual)
++relu prologue ON LOAD inside the conv itself — the normalized activation
+never exists in HBM, in forward OR backward (both backward kernels
+recompute the prologue from the raw input, flash-attention style).
+
+Scope (the ResNet residual-block hot path, SURVEY §7.0.2):
+  * NHWC, HWIO weights, kernel 1×1 or 3×3, stride 1, SAME padding,
+    groups=1.  Stride-2 and the 7×7 stem stay on the XLA conv.
+  * ``scale``/``shift`` are per-channel affine terms ALREADY folded from
+    BN statistics (gamma/sqrt(var+eps), beta-mean*scale).  They stay in
+    the autograd graph, so the batch-statistics paths of BN gradients
+    flow through d(scale)/d(shift) automatically.
+
+ref: src/operator/nn/convolution.cc + batch_norm.cc — the reference runs
+these as separate cuDNN calls with the same materialisation; no
+counterpart kernel exists there.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["norm_relu_conv", "norm_relu_conv_reference", "supports"]
+
+
+def supports(kh, kw, stride, groups=1):
+    """True when the fused kernel covers this conv configuration."""
+    return (kh, kw) in ((1, 1), (3, 3)) and stride == 1 and groups == 1
+
+
+def _prologue(x, scale, shift, res, relu):
+    """X = relu(x*scale + shift [+ res]) in f32 — shared by all 3 kernels."""
+    pre = x.astype(jnp.float32) * scale + shift
+    if res is not None:
+        pre = pre + res.astype(jnp.float32)
+    return jnp.maximum(pre, 0.0) if relu else pre
+
+
+# ------------------------------------------------------------- forward ------
+def _fwd_kernel(x_ref, scale_ref, shift_ref, w_ref, *rest, k, relu,
+                has_res):
+    if has_res:
+        r_ref, o_ref = rest
+    else:
+        (o_ref,) = rest
+        r_ref = None
+    h, w_dim, ci = x_ref.shape[1], x_ref.shape[2], x_ref.shape[3]
+    X = _prologue(x_ref[0], scale_ref[0], shift_ref[0],
+                  r_ref[0] if has_res else None, relu)
+    if k == 1:
+        acc = X.reshape(h * w_dim, ci) @ w_ref[0, 0].astype(jnp.float32)
+    else:
+        pad = k // 2
+        Xp = jnp.pad(X, ((pad, pad), (pad, pad), (0, 0)))
+        acc = None
+        for ky in range(k):
+            for kx in range(k):
+                patch = lax.slice(Xp, (ky, kx, 0),
+                                  (ky + h, kx + w_dim, ci))
+                term = patch.reshape(h * w_dim, ci) @ \
+                    w_ref[ky, kx].astype(jnp.float32)
+                acc = term if acc is None else acc + term
+    o_ref[0] = acc.reshape(h, w_dim, -1).astype(o_ref.dtype)
+
+
+def _pick_block_co(co, want):
+    """Largest divisor of co that is <= want (grid tiles must cover co
+    exactly — a non-dividing block would leave tail channels unwritten)."""
+    for d in range(min(want, co), 0, -1):
+        if co % d == 0:
+            return d
+    return 1
+
+
+def _fwd(x, scale, shift, w, res, relu, block_co, interpret):
+    n, h, wd, ci = x.shape
+    k, _, _, co = w.shape
+    block_co = _pick_block_co(co, block_co)
+    inputs = [x, scale.reshape(1, ci), shift.reshape(1, ci), w]
+    in_specs = [
+        pl.BlockSpec((1, h, wd, ci), lambda nb, cb: (nb, 0, 0, 0)),
+        pl.BlockSpec((1, ci), lambda nb, cb: (0, 0)),
+        pl.BlockSpec((1, ci), lambda nb, cb: (0, 0)),
+        pl.BlockSpec((k, k, ci, block_co), lambda nb, cb: (0, 0, 0, cb)),
+    ]
+    if res is not None:
+        inputs.append(res)
+        in_specs.append(
+            pl.BlockSpec((1, h, wd, ci), lambda nb, cb: (nb, 0, 0, 0)))
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, k=k, relu=relu,
+                          has_res=res is not None),
+        grid=(n, co // block_co),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, h, wd, block_co),
+                               lambda nb, cb: (nb, 0, 0, cb)),
+        out_shape=jax.ShapeDtypeStruct((n, h, wd, co), x.dtype),
+        interpret=interpret,
+    )(*inputs)
+
+
+# ---------------------------------------------------------- backward dX -----
+def _dx_kernel(x_ref, scale_ref, shift_ref, w_ref, do_ref, *rest, k, relu,
+               has_res):
+    """dx (+dres) for one sample; also per-sample dscale/dshift partials.
+
+    G = dO ⋆ flip(W) (the full correlation); the relu mask and the affine
+    chain rule are the epilogue: dx = G·mask·scale, dres = G·mask,
+    dscale_n = Σ G·mask·x, dshift_n = Σ G·mask.
+    """
+    if has_res:
+        r_ref, dx_ref, dres_ref, dsc_ref, dsh_ref = rest
+    else:
+        dx_ref, dsc_ref, dsh_ref = rest
+        r_ref = dres_ref = None
+    h, wd, ci = x_ref.shape[1], x_ref.shape[2], x_ref.shape[3]
+    co = do_ref.shape[3]
+    do = do_ref[0].astype(jnp.float32)
+    if k == 1:
+        G = do.reshape(h * wd, co) @ \
+            w_ref[0, 0].astype(jnp.float32).T
+    else:
+        pad = k // 2
+        dop = jnp.pad(do, ((pad, pad), (pad, pad), (0, 0)))
+        G = None
+        for ky in range(k):
+            for kx in range(k):
+                patch = lax.slice(dop, (ky, kx, 0), (ky + h, kx + wd, co))
+                # correlate with the 180°-flipped tap
+                term = patch.reshape(h * wd, co) @ \
+                    w_ref[k - 1 - ky, k - 1 - kx].astype(jnp.float32).T
+                G = term if G is None else G + term
+    G = G.reshape(h, wd, ci)
+    x = x_ref[0].astype(jnp.float32)
+    scale = scale_ref[0]
+    if relu:
+        pre = x * scale + shift_ref[0]
+        if has_res:
+            pre = pre + r_ref[0].astype(jnp.float32)
+        Gm = jnp.where(pre > 0.0, G, 0.0)
+    else:
+        Gm = G
+    dx_ref[0] = (Gm * scale).astype(dx_ref.dtype)
+    if has_res:
+        dres_ref[0] = Gm.astype(dres_ref.dtype)
+    dsc_ref[0] = jnp.sum(Gm * x, axis=(0, 1))
+    dsh_ref[0] = jnp.sum(Gm, axis=(0, 1))
+
+
+def _dx(x, scale, shift, w, res, do, relu, interpret):
+    n, h, wd, ci = x.shape
+    k = w.shape[0]
+    has_res = res is not None
+    inputs = [x, scale.reshape(1, ci), shift.reshape(1, ci), w, do]
+    in_specs = [
+        pl.BlockSpec((1, h, wd, ci), lambda nb: (nb, 0, 0, 0)),
+        pl.BlockSpec((1, ci), lambda nb: (0, 0)),
+        pl.BlockSpec((1, ci), lambda nb: (0, 0)),
+        pl.BlockSpec(w.shape, lambda nb: (0, 0, 0, 0)),
+        pl.BlockSpec((1, h, wd, do.shape[3]), lambda nb: (nb, 0, 0, 0)),
+    ]
+    if has_res:
+        inputs.append(res)
+        in_specs.append(
+            pl.BlockSpec((1, h, wd, ci), lambda nb: (nb, 0, 0, 0)))
+    out_specs = [pl.BlockSpec((1, h, wd, ci), lambda nb: (nb, 0, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct(x.shape, x.dtype)]
+    if has_res:
+        out_specs.append(
+            pl.BlockSpec((1, h, wd, ci), lambda nb: (nb, 0, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct(x.shape, res.dtype))
+    out_specs += [pl.BlockSpec((1, ci), lambda nb: (nb, 0)),
+                  pl.BlockSpec((1, ci), lambda nb: (nb, 0))]
+    out_shape += [jax.ShapeDtypeStruct((n, ci), jnp.float32),
+                  jax.ShapeDtypeStruct((n, ci), jnp.float32)]
+    outs = pl.pallas_call(
+        functools.partial(_dx_kernel, k=k, relu=relu, has_res=has_res),
+        grid=(n,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(*inputs)
+    if has_res:
+        dx, dres, dsc, dsh = outs
+    else:
+        dx, dsc, dsh = outs
+        dres = None
+    # per-sample partials -> channel totals (tiny (N, Ci) reduce in XLA)
+    return dx, dres, dsc.sum(axis=0), dsh.sum(axis=0)
+
+
+# ---------------------------------------------------------- backward dW -----
+def _dw_kernel(x_ref, scale_ref, shift_ref, do_ref, *rest, k, relu,
+               has_res, n):
+    """dW accumulated over samples: grid (co_tiles, N), acc in VMEM."""
+    if has_res:
+        r_ref, dw_ref, acc_ref = rest
+    else:
+        dw_ref, acc_ref = rest
+        r_ref = None
+    nb = pl.program_id(1)
+
+    @pl.when(nb == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    h, wd, ci = x_ref.shape[1], x_ref.shape[2], x_ref.shape[3]
+    tco = do_ref.shape[3]
+    X = _prologue(x_ref[0], scale_ref[0], shift_ref[0],
+                  r_ref[0] if has_res else None, relu)
+    do = do_ref[0].astype(jnp.float32).reshape(h * wd, tco)
+    if k == 1:
+        acc_ref[0, 0] += X.reshape(h * wd, ci).T @ do
+    else:
+        pad = k // 2
+        Xp = jnp.pad(X, ((pad, pad), (pad, pad), (0, 0)))
+        for ky in range(k):
+            for kx in range(k):
+                patch = lax.slice(Xp, (ky, kx, 0), (ky + h, kx + wd, ci))
+                acc_ref[ky, kx] += patch.reshape(h * wd, ci).T @ do
+
+    @pl.when(nb == n - 1)
+    def _finish():
+        dw_ref[...] = acc_ref[...].astype(dw_ref.dtype)
+
+
+def _dw(x, scale, shift, res, do, k, co, relu, block_co, interpret):
+    n, h, wd, ci = x.shape
+    block_co = _pick_block_co(co, block_co)
+    has_res = res is not None
+    inputs = [x, scale.reshape(1, ci), shift.reshape(1, ci), do]
+    in_specs = [
+        pl.BlockSpec((1, h, wd, ci), lambda cb, nb: (nb, 0, 0, 0)),
+        pl.BlockSpec((1, ci), lambda cb, nb: (0, 0)),
+        pl.BlockSpec((1, ci), lambda cb, nb: (0, 0)),
+        pl.BlockSpec((1, h, wd, block_co), lambda cb, nb: (nb, 0, 0, cb)),
+    ]
+    if has_res:
+        inputs.append(res)
+        in_specs.append(
+            pl.BlockSpec((1, h, wd, ci), lambda cb, nb: (nb, 0, 0, 0)))
+    return pl.pallas_call(
+        functools.partial(_dw_kernel, k=k, relu=relu, has_res=has_res, n=n),
+        grid=(co // block_co, n),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((k, k, ci, block_co),
+                               lambda cb, nb: (0, 0, 0, cb)),
+        out_shape=jax.ShapeDtypeStruct((k, k, ci, co), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((k, k, ci, block_co), jnp.float32)],
+        interpret=interpret,
+    )(*inputs)
+
+
+# ----------------------------------------------------------- public api -----
+def norm_relu_conv_reference(x, scale, shift, w, residual=None, relu=True):
+    """XLA twin of the fused kernel (test oracle + fallback path)."""
+    pre = x.astype(jnp.float32) * scale + shift
+    if residual is not None:
+        pre = pre + residual.astype(jnp.float32)
+    X = jnp.maximum(pre, 0.0) if relu else pre
+    out = lax.conv_general_dilated(
+        X.astype(x.dtype), w, (1, 1), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=jnp.float32)
+    return out.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _core(x, scale, shift, w, relu, block_co, interpret):
+    out, _ = _fwd_rule(x, scale, shift, w, relu, block_co, interpret)
+    return out
+
+
+def _fwd_rule(x, scale, shift, w, relu, block_co, interpret):
+    out = _fwd(x, scale.astype(jnp.float32), shift.astype(jnp.float32), w,
+               None, relu, block_co, interpret)
+    return out, (x, scale, shift, w)
+
+
+def _bwd_rule(relu, block_co, interpret, resd, do):
+    x, scale, shift, w = resd
+    s32 = scale.astype(jnp.float32)
+    h32 = shift.astype(jnp.float32)
+    dx, _, dsc, dsh = _dx(x, s32, h32, w, None, do, relu, interpret)
+    dw = _dw(x, s32, h32, None, do, w.shape[0], w.shape[3], relu,
+             block_co, interpret)
+    return (dx, dsc.astype(scale.dtype), dsh.astype(shift.dtype),
+            dw.astype(w.dtype))
+
+
+_core.defvjp(_fwd_rule, _bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _core_res(x, scale, shift, w, residual, relu, block_co, interpret):
+    out, _ = _fwd_res_rule(x, scale, shift, w, residual, relu, block_co,
+                           interpret)
+    return out
+
+
+def _fwd_res_rule(x, scale, shift, w, residual, relu, block_co, interpret):
+    out = _fwd(x, scale.astype(jnp.float32), shift.astype(jnp.float32), w,
+               residual, relu, block_co, interpret)
+    return out, (x, scale, shift, w, residual)
+
+
+def _bwd_res_rule(relu, block_co, interpret, resd, do):
+    x, scale, shift, w, residual = resd
+    s32 = scale.astype(jnp.float32)
+    h32 = shift.astype(jnp.float32)
+    dx, dres, dsc, dsh = _dx(x, s32, h32, w, residual, do, relu, interpret)
+    dw = _dw(x, s32, h32, residual, do, w.shape[0], w.shape[3], relu,
+             block_co, interpret)
+    return (dx, dsc.astype(scale.dtype), dsh.astype(shift.dtype),
+            dw.astype(w.dtype), dres)
+
+
+_core_res.defvjp(_fwd_res_rule, _bwd_res_rule)
+
+
+def norm_relu_conv(x, scale, shift, w, residual=None, relu=True,
+                   block_co=128, interpret=None):
+    """conv(relu(x·scale + shift [+ residual]), w) without materialising
+    the normalized activation (forward or backward).
+
+    x: (N, H, W, Ci) raw pre-norm activations; scale/shift: (Ci,) affine
+    folded from BN stats (keep them in the traced graph so stat gradients
+    flow); w: (k, k, Ci, Co) HWIO with k in {1, 3}; stride 1, SAME.
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU.
+    """
+    k = w.shape[0]
+    if not supports(k, w.shape[1], 1):
+        raise ValueError(f"fused kernel supports 1x1/3x3 stride-1; got "
+                         f"{w.shape[:2]}")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if residual is None:
+        return _core(x, scale, shift, w, relu, block_co, interpret)
+    return _core_res(x, scale, shift, w, residual, relu, block_co,
+                     interpret)

@@ -414,3 +414,19 @@ def test_kvstore_mixed_dense_rsp_push_densifies():
     expect = np.full((4, 2), 0.5, np.float32)
     expect[2] += 1.0
     np.testing.assert_allclose(got, expect)
+
+
+def test_sparse_grad_local_update_dense_only_optimizer():
+    """Trainer.update() (non-kvstore path) with a dense-only optimizer and
+    a sparse-grad param must use the dense buffer (regression)."""
+    from mxnet_tpu import gluon, autograd
+    emb = gluon.nn.Embedding(10, 3, sparse_grad=True)
+    emb.initialize()
+    tr = gluon.Trainer(emb.collect_params(), "lamb",
+                       {"learning_rate": 0.01}, kvstore=None)
+    w0 = emb.weight.data().asnumpy().copy()
+    x = mx.nd.array(np.array([1, 2], np.int32))
+    with autograd.record():
+        ((emb(x) ** 2).sum()).backward()
+    tr.step(1)
+    assert not np.allclose(emb.weight.data().asnumpy(), w0)

@@ -1,0 +1,10 @@
+"""Re-run the Pallas fused norm-relu-conv suite with kernels compiled
+NATIVELY on TPU (CPU runs them in interpreter mode)."""
+import jax
+import pytest
+
+if jax.default_backend() == "cpu":
+    pytest.skip("TPU re-run suite needs an accelerator backend",
+                allow_module_level=True)
+
+from test_fused_conv import *        # noqa: F401,F403,E402
