@@ -56,7 +56,7 @@ def bench_resnet():
     # channel-last: the TPU-native layout (features on lanes; see PERF.md).
     # MXTPU_BENCH_FUSED=1 swaps in the Pallas fused norm-relu-conv blocks
     # (A/B knob while the fused path earns its keep on-chip).
-    fused = bool(int(os.environ.get("MXTPU_BENCH_FUSED", "0")))
+    fused = bool(int(os.environ.get("MXTPU_BENCH_FUSED") or "0"))
     net = resnet50_v1(layout="NHWC", fused=fused)
     net.initialize()
     net.cast("bfloat16")  # bf16 compute, fp32 master weights in the optimizer

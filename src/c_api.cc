@@ -1,0 +1,269 @@
+// C ABI slice over the mxnet_tpu runtime (ref: src/c_api/c_api.cc —
+// MXNDArrayCreate / MXImperativeInvokeEx / MXNDArraySyncCopyToCPU).
+//
+// The reference's C API *is* its engine; here the runtime is Python/JAX,
+// so the C surface embeds CPython and drives the same op registry a
+// Python caller uses — a non-Python client links this library and
+// invokes any registered operator end-to-end (see tests/c_api_smoke.c).
+//
+// Scope (the VERDICT round-3 "C ABI slice"): float32 NDArrays, op
+// invocation by registry name with JSON-encoded attrs, host copy-out.
+// Handles are opaque pointers owning a CPython reference; every entry
+// point takes the GIL, so the library is safe to call from any single
+// client thread at a time.
+//
+// Environment contract: the embedded interpreter resolves imports via
+// PYTHONPATH (point it at the repo root and the site-packages holding
+// jax), exactly like an embedded CPython anywhere.
+
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+
+namespace {
+
+std::string g_last_error;
+
+void capture_py_error(const char *fallback) {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  if (value != nullptr) {
+    PyObject *s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char *c = PyUnicode_AsUTF8(s);
+      g_last_error = c != nullptr ? c : fallback;
+      Py_DECREF(s);
+    } else {
+      g_last_error = fallback;
+    }
+  } else {
+    g_last_error = fallback;
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+}
+
+PyObject *g_nd_module = nullptr;  // mxnet_tpu.ndarray
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() : state(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state); }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *mxtpu_last_error() { return g_last_error.c_str(); }
+
+// Start the interpreter and import the framework.  Returns 0 on success.
+int mxtpu_init() {
+  if (g_nd_module != nullptr) return 0;
+  bool fresh = !Py_IsInitialized();
+  if (fresh) {
+    Py_InitializeEx(0);
+  }
+  {
+    Gil gil;
+    g_nd_module = PyImport_ImportModule("mxnet_tpu.ndarray");
+    if (g_nd_module == nullptr) {
+      capture_py_error("import mxnet_tpu.ndarray failed (set PYTHONPATH)");
+      return -1;
+    }
+  }
+  if (fresh) {
+    // Py_InitializeEx leaves the init thread holding the GIL; release it
+    // so later calls (this thread or any other) can PyGILState_Ensure.
+    PyEval_SaveThread();
+  }
+  return 0;
+}
+
+// Create a float32 NDArray from a host buffer.  Returns an opaque handle
+// (owning reference) or NULL.
+void *mxtpu_ndarray_create(const float *data, const long *shape, int ndim) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return nullptr;
+  }
+  Gil gil;
+  long total = 1;
+  PyObject *shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    total *= shape[i];
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLong(shape[i]));
+  }
+  // bytes -> nd.frombuffer-equivalent: build via nd.array(list) is O(n)
+  // Python objects; instead go through the buffer protocol with a
+  // memoryview over the C data and numpy.frombuffer.
+  PyObject *np = PyImport_ImportModule("numpy");
+  if (np == nullptr) {
+    capture_py_error("import numpy failed");
+    Py_DECREF(shp);
+    return nullptr;
+  }
+  PyObject *mv = PyMemoryView_FromMemory(
+      reinterpret_cast<char *>(const_cast<float *>(data)),
+      total * static_cast<long>(sizeof(float)), PyBUF_READ);
+  PyObject *arr = PyObject_CallMethod(np, "frombuffer", "Os", mv, "float32");
+  Py_DECREF(mv);
+  Py_DECREF(np);
+  if (arr == nullptr) {
+    capture_py_error("numpy.frombuffer failed");
+    Py_DECREF(shp);
+    return nullptr;
+  }
+  PyObject *reshaped = PyObject_CallMethod(arr, "reshape", "O", shp);
+  Py_DECREF(arr);
+  Py_DECREF(shp);
+  if (reshaped == nullptr) {
+    capture_py_error("reshape failed");
+    return nullptr;
+  }
+  PyObject *nd = PyObject_CallMethod(g_nd_module, "array", "O", reshaped);
+  Py_DECREF(reshaped);
+  if (nd == nullptr) {
+    capture_py_error("nd.array failed");
+    return nullptr;
+  }
+  return nd;
+}
+
+int mxtpu_ndarray_free(void *handle) {
+  if (handle == nullptr) return -1;
+  Gil gil;
+  Py_DECREF(reinterpret_cast<PyObject *>(handle));
+  return 0;
+}
+
+int mxtpu_ndarray_ndim(void *handle) {
+  Gil gil;
+  PyObject *shp = PyObject_GetAttrString(
+      reinterpret_cast<PyObject *>(handle), "shape");
+  if (shp == nullptr) {
+    capture_py_error("no shape");
+    return -1;
+  }
+  int n = static_cast<int>(PyTuple_Size(shp));
+  Py_DECREF(shp);
+  return n;
+}
+
+int mxtpu_ndarray_shape(void *handle, long *out) {
+  Gil gil;
+  PyObject *shp = PyObject_GetAttrString(
+      reinterpret_cast<PyObject *>(handle), "shape");
+  if (shp == nullptr) {
+    capture_py_error("no shape");
+    return -1;
+  }
+  int n = static_cast<int>(PyTuple_Size(shp));
+  for (int i = 0; i < n; ++i) {
+    out[i] = PyLong_AsLong(PyTuple_GET_ITEM(shp, i));
+  }
+  Py_DECREF(shp);
+  return n;
+}
+
+// Blocking device->host copy of a float32 array (ref:
+// MXNDArraySyncCopyToCPU).  capacity is the element count of out.
+int mxtpu_ndarray_to_host(void *handle, float *out, long capacity) {
+  Gil gil;
+  PyObject *np_arr = PyObject_CallMethod(
+      reinterpret_cast<PyObject *>(handle), "asnumpy", nullptr);
+  if (np_arr == nullptr) {
+    capture_py_error("asnumpy failed");
+    return -1;
+  }
+  PyObject *f32 = PyObject_CallMethod(np_arr, "astype", "s", "float32");
+  Py_DECREF(np_arr);
+  if (f32 == nullptr) {
+    capture_py_error("astype failed");
+    return -1;
+  }
+  PyObject *bytes = PyObject_CallMethod(f32, "tobytes", nullptr);
+  Py_DECREF(f32);
+  if (bytes == nullptr) {
+    capture_py_error("tobytes failed");
+    return -1;
+  }
+  long nbytes = static_cast<long>(PyBytes_Size(bytes));
+  long nelem = nbytes / static_cast<long>(sizeof(float));
+  if (nelem > capacity) {
+    Py_DECREF(bytes);
+    g_last_error = "output buffer too small";
+    return -1;
+  }
+  std::memcpy(out, PyBytes_AsString(bytes), nbytes);
+  Py_DECREF(bytes);
+  return static_cast<int>(nelem);
+}
+
+// Invoke a registered operator by name (ref: MXImperativeInvokeEx).
+// args: NDArray handles; kwargs_json: JSON object of op attrs ("" or
+// NULL for none).  Returns the (first) output NDArray handle or NULL.
+void *mxtpu_invoke(const char *op_name, void **args, int nargs,
+                   const char *kwargs_json) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return nullptr;
+  }
+  Gil gil;
+  PyObject *invoke = PyObject_GetAttrString(g_nd_module, "invoke");
+  if (invoke == nullptr) {
+    capture_py_error("nd.invoke missing");
+    return nullptr;
+  }
+  PyObject *pos = PyTuple_New(nargs + 1);
+  PyTuple_SET_ITEM(pos, 0, PyUnicode_FromString(op_name));
+  for (int i = 0; i < nargs; ++i) {
+    PyObject *a = reinterpret_cast<PyObject *>(args[i]);
+    Py_INCREF(a);
+    PyTuple_SET_ITEM(pos, i + 1, a);
+  }
+  PyObject *kw = nullptr;
+  if (kwargs_json != nullptr && kwargs_json[0] != '\0') {
+    PyObject *json = PyImport_ImportModule("json");
+    kw = json != nullptr
+             ? PyObject_CallMethod(json, "loads", "s", kwargs_json)
+             : nullptr;
+    Py_XDECREF(json);
+    if (kw == nullptr || !PyDict_Check(kw)) {
+      capture_py_error("kwargs_json is not a JSON object");
+      Py_XDECREF(kw);
+      Py_DECREF(pos);
+      Py_DECREF(invoke);
+      return nullptr;
+    }
+  }
+  PyObject *res = PyObject_Call(invoke, pos, kw);
+  Py_XDECREF(kw);
+  Py_DECREF(pos);
+  Py_DECREF(invoke);
+  if (res == nullptr) {
+    capture_py_error("op invocation failed");
+    return nullptr;
+  }
+  if (PyTuple_Check(res)) {  // multi-output op: hand back the first
+    PyObject *first = PyTuple_GET_ITEM(res, 0);
+    Py_INCREF(first);
+    Py_DECREF(res);
+    return first;
+  }
+  return res;
+}
+
+int mxtpu_shutdown() {
+  if (g_nd_module != nullptr) {
+    Gil gil;
+    Py_CLEAR(g_nd_module);
+  }
+  // the interpreter stays up (jax/XLA teardown at Py_Finalize is not
+  // worth the risk for a long-lived serving process; the OS reclaims)
+  return 0;
+}
+
+}  // extern "C"
