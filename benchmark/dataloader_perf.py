@@ -2,19 +2,24 @@
 
 ref: the reference sizes its C++ decode pipeline (iter_image_recordio_2)
 to keep GPUs fed; here the same question for the TPU step: how many
-img/s can ImageRecordIter (native RecordIO + process-pool decode +
-pooled batch buffers) and the gluon DataLoader deliver on this host?
-Compare against the model step rate (bench.py resnet ≈ 2.5k img/s/chip)
-to know when input becomes the bottleneck.
+img/s can ImageRecordIter deliver on this host?  Compare against the
+model step rate (bench.py resnet ≈ 2.5k img/s/chip) to know when input
+becomes the bottleneck.
 
-NOTE: throughput scales with host cores (each worker ~170-200 img/s of
-JPEG decode at 256px).  The dev container here has ONE core, so worker
-counts cannot help locally; a real TPU-VM host (v5e: 100+ vCPUs) runs
-one worker per core — the pipeline (uint8 IPC, batch-vectorised
-normalisation, async double-buffered prefetch) is shaped for that.
+Three decode paths (see mxnet_tpu/io.py):
+  native — src/image_decode.cc: whole-batch JPEG decode in N native
+           threads (no GIL/IPC), in-thread resize/crop/mirror;
+  pil    — the process-pool PIL fallback;
+  raw    — pre-decoded uint8 records (im2rec --raw): memcpy + crop only.
+
+Throughput scales with host cores for the JPEG paths (~300 img/s/core of
+photo-like 256px decode; random-noise JPEGs are ~1.5x slower).  The dev
+container has ONE core; a real TPU-VM host (v5e: 100+ vCPUs) runs one
+native thread per core.  The raw path is IO/memcpy-bound and sustains
+thousands of img/s on a single core.
 
     python benchmark/dataloader_perf.py [--n 2048] [--hw 224]
-        [--workers 0,4,8] [--batch-size 256]
+        [--threads 0,4,8] [--batch-size 256] [--paths native,pil,raw]
 """
 from __future__ import annotations
 
@@ -34,30 +39,43 @@ from mxnet_tpu import io as mio  # noqa: E402
 from mxnet_tpu import recordio  # noqa: E402
 
 
-def make_dataset(path, n, hw, quality=90):
-    """Write a synthetic JPEG record file (+index)."""
+def make_dataset(path, n, hw, quality=90, raw=False, noise=False):
+    """Write a synthetic record file (+index).  Default images are
+    photo-like (low-frequency structure, realistic JPEG cost); --noise
+    packs incompressible noise (decode worst case)."""
     from PIL import Image
     rec, idx = path + ".rec", path + ".idx"
     w = recordio.MXIndexedRecordIO(idx, rec, "w")
     rng = np.random.RandomState(0)
+    s = hw + 32
+    yy, xx = np.mgrid[0:s, 0:s]
     for i in range(n):
-        img = rng.randint(0, 255, (hw + 32, hw + 32, 3), np.uint8)
-        buf = _pyio.BytesIO()
-        Image.fromarray(img).save(buf, format="JPEG", quality=quality)
-        w.write_idx(i, recordio.pack(
-            recordio.IRHeader(0, float(i % 1000), i, 0), buf.getvalue()))
+        if noise:
+            img = rng.randint(0, 255, (s, s, 3), np.uint8)
+        else:
+            base = (np.sin(xx / (18 + i % 9)) * 60
+                    + np.cos(yy / (14 + i % 7)) * 60 + 128)
+            img = np.clip(np.stack([base, np.roll(base, i % 32, 0),
+                                    np.roll(base, i % 32, 1)], -1)
+                          + rng.randn(s, s, 3) * 8, 0, 255).astype(np.uint8)
+        hdr = recordio.IRHeader(0, float(i % 1000), i, 0)
+        if raw:
+            w.write_idx(i, recordio.pack_img(hdr, img, img_fmt=".raw"))
+        else:
+            buf = _pyio.BytesIO()
+            Image.fromarray(img).save(buf, format="JPEG", quality=quality)
+            w.write_idx(i, recordio.pack(hdr, buf.getvalue()))
     w.close()
     return rec, idx
 
 
-def bench_record_iter(rec, idx, hw, batch_size, workers, epochs=1):
+def bench_record_iter(rec, idx, hw, batch_size, threads, native, epochs=1):
     it = mio.ImageRecordIter(
         rec, data_shape=(3, hw, hw), batch_size=batch_size,
         path_imgidx=idx, rand_crop=True, rand_mirror=True,
-        preprocess_threads=workers)
+        preprocess_threads=threads, use_native_decode=native)
     n = 0
-    # warm one batch (pool + process fork)
-    batch = next(iter(it))
+    batch = next(iter(it))  # warm (pool fork / lib load)
     batch.data[0].wait_to_read()
     it.reset()
     t0 = time.perf_counter()
@@ -76,20 +94,38 @@ def main():
     ap.add_argument("--n", type=int, default=1024)
     ap.add_argument("--hw", type=int, default=224)
     ap.add_argument("--batch-size", type=int, default=128)
-    ap.add_argument("--workers", default="0,4,8")
+    ap.add_argument("--threads", "--workers", default="0,4,8")
+    ap.add_argument("--paths", default="native,pil,raw")
+    ap.add_argument("--noise", action="store_true")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
+    paths = args.paths.split(",")
     with tempfile.TemporaryDirectory() as d:
-        print(f"writing {args.n} JPEGs ({args.hw + 32}px)...",
-              file=sys.stderr)
-        rec, idx = make_dataset(os.path.join(d, "bench"), args.n, args.hw)
-        for w in [int(x) for x in args.workers.split(",")]:
-            rate = bench_record_iter(rec, idx, args.hw, args.batch_size, w)
-            row = {"metric": "image_record_iter_throughput",
-                   "workers": w, "value": round(rate, 1), "unit": "img/s"}
-            print(json.dumps(row) if args.json
-                  else f"workers={w:<3d} {rate:>10.1f} img/s")
+        datasets = {}
+        if "native" in paths or "pil" in paths:
+            print(f"writing {args.n} JPEGs ({args.hw + 32}px)...",
+                  file=sys.stderr)
+            datasets["jpeg"] = make_dataset(os.path.join(d, "bj"), args.n,
+                                            args.hw, noise=args.noise)
+        if "raw" in paths:
+            print(f"writing {args.n} raw records...", file=sys.stderr)
+            datasets["raw"] = make_dataset(os.path.join(d, "br"), args.n,
+                                           args.hw, raw=True,
+                                           noise=args.noise)
+        for path in paths:
+            rec, idx = datasets["raw" if path == "raw" else "jpeg"]
+            # native=True raises if the .so is unbuilt (never silently
+            # measure pil under a 'native' label); raw auto-selects
+            native = {"native": True, "pil": False}.get(path)
+            for t in [int(x) for x in args.threads.split(",")]:
+                rate = bench_record_iter(rec, idx, args.hw, args.batch_size,
+                                         t, native=native)
+                row = {"metric": "image_record_iter_throughput",
+                       "path": path, "threads": t,
+                       "value": round(rate, 1), "unit": "img/s"}
+                print(json.dumps(row) if args.json
+                      else f"{path:<7s} threads={t:<3d} {rate:>9.1f} img/s")
 
 
 if __name__ == "__main__":

@@ -217,20 +217,45 @@ class CSVIter(DataIter):
         return self._it.provide_label
 
 
+_NATIVE_DECODE = None
+
+
+def _native_decoder():
+    """Load src/image_decode.cc's batch JPEG pipeline (decode threads of
+    the reference's iter_image_recordio_2.cc).  None when unbuilt."""
+    global _NATIVE_DECODE
+    if _NATIVE_DECODE is None:
+        import ctypes
+        path = os.path.join(os.path.dirname(__file__), "_lib",
+                            "libimagedecode.so")
+        try:
+            _NATIVE_DECODE = ctypes.CDLL(path)
+        except OSError:
+            _NATIVE_DECODE = False
+    return _NATIVE_DECODE or None
+
+
 class ImageRecordIter(DataIter):
     """Packed-record image pipeline (ref: iter_image_recordio_2.cc —
     ImageRecordIOParser2; API: mx.io.ImageRecordIter).
 
-    Decodes with PIL in ``preprocess_threads`` worker processes, applies
-    resize/center-crop (or random-crop/mirror when ``rand_crop``/
-    ``rand_mirror``), mean/std normalisation, and yields NCHW float batches.
+    Decode paths, fastest available first:
+      * native (default when built): one ctypes call per batch decodes
+        every JPEG record in ``preprocess_threads`` NATIVE threads (no
+        GIL, no fork/IPC) with in-thread resize-short/crop/mirror —
+        ``use_native_decode=False`` opts out;
+      * raw records (``pack_img(img_fmt=".raw")``) skip decode entirely
+        (numpy crop/mirror — the pre-decoded uint8 fast path);
+      * PIL, in ``preprocess_threads`` worker processes, otherwise.
+    Then mean/std normalisation, yielding NCHW float batches.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, path_imgidx=None,
                  shuffle=False, rand_crop=False, rand_mirror=False,
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=-1, preprocess_threads=0, seed=0,
-                 round_batch=True, label_width=1, **kwargs):
+                 round_batch=True, label_width=1, use_native_decode=None,
+                 **kwargs):
         super().__init__(batch_size)
         _IGNORED_OK = {"prefetch_buffer", "data_name", "label_name",
                        "verify_decode", "num_parts", "part_index",
@@ -265,12 +290,28 @@ class ImageRecordIter(DataIter):
         self._rng = np.random.RandomState(seed)
         self._round = round_batch
         self._inflight = None  # previous batch's pooled buffer handle
-        self._pending = None   # (keys, AsyncResult) prefetched batch
+        self._pending = None   # (keys, future/AsyncResult) prefetched batch
         self._pool = None
-        if preprocess_threads and preprocess_threads > 1:
+        self._native = None
+        self._executor = None  # lazy single prefetch thread (native path)
+        self._nthreads = max(int(preprocess_threads or 0), 1)
+        if use_native_decode is not False and self._shape[0] == 3:
+            self._native = _native_decoder()
+        if use_native_decode is True and self._native is None:
+            raise RuntimeError(
+                "use_native_decode=True but libimagedecode.so is not "
+                "built (run `make -C src`)")
+        if self._native is None and preprocess_threads \
+                and preprocess_threads > 1:
             import multiprocessing as mp
             self._pool = mp.get_context("fork").Pool(preprocess_threads)
         self.reset()
+
+    def _decode_executor(self):
+        if self._executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._executor = ThreadPoolExecutor(1)
+        return self._executor
 
     def _decode(self, key):
         s = self._rec.read_idx(key)
@@ -283,7 +324,75 @@ class ImageRecordIter(DataIter):
                             self._rand_mirror, self._mean, self._std,
                             rng if rng is not None else self._rng)
 
+    def _native_batch(self, keys, rng):
+        """Whole-batch decode through src/image_decode.cc: JPEG records in
+        native threads, raw records via numpy; non-JPEG/non-raw (PNG) and
+        JPEGs libjpeg cannot convert (CMYK) fall back to PIL per image.
+        Returns (headers, (n,C,H,W) uint8).  ``rng`` is a per-batch
+        RandomState so a prefetch thread never races the iterator's."""
+        import ctypes
+        c, h, w = self._shape
+        n = len(keys)
+        out = np.empty((n, c, h, w), np.uint8)
+        headers = [None] * n
+        blobs, jpeg_idx = [], []
+        for i, k in enumerate(keys):
+            hdr, payload = recordio.unpack(self._rec.read_idx(k))
+            headers[i] = hdr
+            if payload[:3] == b"\xff\xd8\xff":
+                jpeg_idx.append(i)
+                blobs.append(payload)
+            else:
+                # raw or PNG: the python path handles both cheaply
+                img = recordio.img_from_payload(payload, iscolor=1)
+                out[i] = _crop_aug_u8(img, self._shape, self._resize,
+                                      self._rand_crop, self._rand_mirror,
+                                      rng)
+        if jpeg_idx:
+            lib = self._native
+            m = len(blobs)
+            # bytes are immutable and the C side is const: pass their
+            # buffers directly, no per-blob copy (blobs stays alive here)
+            ptrs = (ctypes.c_char_p * m)(*blobs)
+            sizes = (ctypes.c_long * m)(*[len(b) for b in blobs])
+            cxv = -2 if self._rand_crop else -1
+            mrv = 2 if self._rand_mirror else 0
+            cx = (ctypes.c_int * m)(*([cxv] * m))
+            cy = (ctypes.c_int * m)(*([cxv] * m))
+            mir = (ctypes.c_uint8 * m)(*([mrv] * m))
+            seeds = (ctypes.c_uint32 * m)(
+                *[int(s) for s in rng.randint(1, 2 ** 31, size=m)])
+            dec = np.empty((m, c, h, w), np.uint8)
+            ok = np.empty((m,), np.uint8)
+            lib.mxtpu_decode_batch(
+                ptrs, sizes, m, h, w, self._resize, cx, cy, mir, seeds,
+                dec.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                self._nthreads)
+            for j, i in enumerate(jpeg_idx):
+                if ok[j]:
+                    out[i] = dec[j]
+                else:
+                    # e.g. CMYK JPEG: PIL's convert("RGB") handles what
+                    # libjpeg's colorspace conversion won't
+                    img = recordio.img_from_payload(blobs[j], iscolor=1)
+                    out[i] = _crop_aug_u8(img, self._shape, self._resize,
+                                          self._rand_crop,
+                                          self._rand_mirror, rng)
+        return headers, out
+
+    def _drain_pending(self):
+        """Wait out an in-flight prefetch future (native path) so the
+        stateful record reader is never used from two threads."""
+        pend = getattr(self, "_pending", None)
+        if pend is not None and hasattr(pend[1], "result"):
+            try:
+                pend[1].result()
+            except Exception:
+                pass
+
     def reset(self):
+        self._drain_pending()
         self._order = list(self._keys)
         if self._shuffle:
             self._rng.shuffle(self._order)
@@ -311,6 +420,9 @@ class ImageRecordIter(DataIter):
             self._pool.terminate()
             self._pool.join()
             self._pool = None
+        if getattr(self, "_executor", None) is not None:
+            self._executor.shutdown(wait=True)
+            self._executor = None
         if getattr(self, "_rec", None) is not None:
             self._rec.close()
             self._rec = None
@@ -354,7 +466,26 @@ class ImageRecordIter(DataIter):
             raise StopIteration
         self._cursor += self.batch_size
         pooled = self._pool is not None
-        if pooled:
+        u8_batch = None
+        if self._native is not None:
+            # double-buffering like the pool path: the ctypes call
+            # releases the GIL, so a single prefetch thread decodes batch
+            # N+1 while the training step consumes batch N
+            if self._pending is not None and self._pending[0] == keys:
+                headers, u8_batch = self._pending[1].result()
+            else:
+                self._drain_pending()  # the reader is stateful: never
+                # let the prefetch thread and this one seek concurrently
+                headers, u8_batch = self._native_batch(
+                    keys, np.random.RandomState(self._rng.randint(2 ** 31)))
+            self._pending = None
+            nxt, _ = self._keys_at(self._cursor)
+            if nxt is not None:
+                self._pending = (nxt, self._decode_executor().submit(
+                    self._native_batch, nxt,
+                    np.random.RandomState(self._rng.randint(2 ** 31))))
+            decoded = list(zip(headers, u8_batch))
+        elif pooled:
             # async double-buffering: this batch was (usually) issued at
             # the END of the previous next(), so the workers decoded it
             # while the training step consumed that batch; workers return
@@ -392,11 +523,12 @@ class ImageRecordIter(DataIter):
         else:
             handle = None
             imgs = np.empty((self.batch_size, c, h, w), np.float32)
-        if pooled:
+        if u8_batch is not None or pooled:
             # one vectorised normalisation pass over the whole uint8 batch
             # straight into the pooled buffer (the ufunc casts u8→f32
             # during the subtract — no batch-sized f32 temp)
-            u8 = np.stack([chw for _, chw in decoded])
+            u8 = u8_batch if u8_batch is not None \
+                else np.stack([chw for _, chw in decoded])
             np.subtract(u8, self._mean.reshape(1, -1, 1, 1), out=imgs)
             np.divide(imgs, self._std.reshape(1, -1, 1, 1), out=imgs)
         else:

@@ -257,3 +257,59 @@ def test_image_record_iter_batch_survives_next(tmp_path):
     snap = b1.data[0].asnumpy().copy()
     next(itr)  # would recycle b1's buffer
     np.testing.assert_array_equal(b1.data[0].asnumpy(), snap)
+
+
+def test_native_decode_matches_pil_path(tmp_path):
+    """The native batch decoder (src/image_decode.cc) must agree with the
+    PIL path on deterministic configs (both are libjpeg underneath)."""
+    from mxnet_tpu.io import _native_decoder
+    if _native_decoder() is None:
+        import pytest as _pytest
+        _pytest.skip("libimagedecode.so not built")
+    from mxnet_tpu import recordio as rio
+    from PIL import Image
+    import io as pyio
+    f, fi = str(tmp_path / "j.rec"), str(tmp_path / "j.idx")
+    w = rio.MXIndexedRecordIO(fi, f, "w")
+    rng = np.random.RandomState(0)
+    for i in range(6):
+        img = (rng.rand(40, 48, 3) * 255).astype(np.uint8)
+        w.write_idx(i, rio.pack_img(rio.IRHeader(0, float(i), i, 0), img,
+                                    img_fmt=".jpg", quality=95))
+    w.close()
+    kw = dict(path_imgrec=f, data_shape=(3, 32, 32), batch_size=6)
+    nat = next(iter(io.ImageRecordIter(**kw)))
+    pil = next(iter(io.ImageRecordIter(use_native_decode=False, **kw)))
+    np.testing.assert_allclose(nat.data[0].asnumpy(), pil.data[0].asnumpy(),
+                               atol=1.0)  # identical decode, center crop
+    np.testing.assert_array_equal(nat.label[0].asnumpy(),
+                                  pil.label[0].asnumpy())
+    # random augmentation draws inside the kernel: shapes + variety
+    it = io.ImageRecordIter(rand_crop=True, rand_mirror=True, **kw)
+    b = next(iter(it))
+    assert b.data[0].shape == (6, 3, 32, 32)
+
+
+def test_raw_records_roundtrip_and_iterate(tmp_path):
+    """pack_img(img_fmt='.raw') stores pre-decoded uint8: unpack is exact
+    and ImageRecordIter consumes raw records without a decoder."""
+    from mxnet_tpu import recordio as rio
+    rng = np.random.RandomState(1)
+    img = (rng.rand(36, 40, 3) * 255).astype(np.uint8)
+    s = rio.pack_img(rio.IRHeader(0, 2.0, 0, 0), img, img_fmt=".raw")
+    hdr, back = rio.unpack_img(s)
+    np.testing.assert_array_equal(back, img)
+    assert hdr.label == 2.0
+
+    f, fi = str(tmp_path / "r.rec"), str(tmp_path / "r.idx")
+    w = rio.MXIndexedRecordIO(fi, f, "w")
+    for i in range(4):
+        arr = (rng.rand(36, 40, 3) * 255).astype(np.uint8)
+        w.write_idx(i, rio.pack_img(rio.IRHeader(0, float(i), i, 0), arr,
+                                    img_fmt=".raw"))
+    w.close()
+    it = io.ImageRecordIter(path_imgrec=f, data_shape=(3, 32, 32),
+                            batch_size=4)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 32, 32)
+    np.testing.assert_array_equal(b.label[0].asnumpy(), [0, 1, 2, 3])

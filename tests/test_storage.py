@@ -209,8 +209,14 @@ def test_image_record_iter_uses_pool(tmp_path):
     batches = list(it)
     assert len(batches) == 2
     assert batches[0].data[0].shape == (4, 3, 32, 32)
-    # second batch re-used the first batch's pooled buffer
-    assert storage.pool_info()["hits"] >= hits0 + 1
+    if mio._staging_recycles():
+        # second batch re-used the first batch's pooled buffer
+        assert storage.pool_info()["hits"] >= hits0 + 1
+    else:
+        # zero-copy backend: recycling is (correctly) disabled — the
+        # previous batch must keep its own data instead (see
+        # test_image_record_iter_batch_survives_next)
+        assert storage.pool_info()["hits"] == hits0
     it.close()
 
 

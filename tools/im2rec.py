@@ -55,7 +55,7 @@ def read_list(path):
                 yield int(parts[0]), float(parts[1]), parts[-1]
 
 
-def pack(prefix, root, resize=0, quality=95, color=1):
+def pack(prefix, root, resize=0, quality=95, color=1, raw=False):
     from mxnet_tpu import recordio
     import numpy as np
     from PIL import Image
@@ -76,8 +76,12 @@ def pack(prefix, root, resize=0, quality=95, color=1):
                 img = img.resize((max(1, round(img.size[0] * scale)),
                                   max(1, round(img.size[1] * scale))))
             header = recordio.IRHeader(0, label, idx, 0)
+            # --raw: store pre-decoded uint8 pixels — the loader then does
+            # memcpy+crop instead of JPEG decode (pack with --resize to
+            # bound record size; bytes-for-CPU trade for TPU feed rate)
+            fmt = ".raw" if raw else ".jpg"
             rec.write_idx(idx, recordio.pack_img(
-                header, np.asarray(img), quality=quality))
+                header, np.asarray(img), quality=quality, img_fmt=fmt))
             count += 1
         except Exception as e:  # noqa: BLE001 - skip bad images like the ref
             print(f"skipping {p}: {e}", file=sys.stderr)
@@ -95,13 +99,15 @@ def main(argv=None):
     p.add_argument("--train-ratio", type=float, default=1.0)
     p.add_argument("--no-shuffle", action="store_true")
     p.add_argument("--gray", action="store_true")
+    p.add_argument("--raw", action="store_true",
+                   help="store pre-decoded uint8 pixels (pair with --resize)")
     a = p.parse_args(argv)
     if a.list:
         make_list(a.prefix, a.root, train_ratio=a.train_ratio,
                   shuffle=not a.no_shuffle)
     else:
         pack(a.prefix, a.root, resize=a.resize, quality=a.quality,
-             color=0 if a.gray else 1)
+             color=0 if a.gray else 1, raw=a.raw)
 
 
 if __name__ == "__main__":
