@@ -118,7 +118,12 @@ def main():
             # native=True raises if the .so is unbuilt (never silently
             # measure pil under a 'native' label); raw auto-selects
             native = {"native": True, "pil": False}.get(path)
-            for t in [int(x) for x in args.threads.split(",")]:
+            # the raw path is a per-image numpy loop (memcpy-bound) — a
+            # thread sweep would relabel the same single-thread config
+            threads = [int(x) for x in args.threads.split(",")]
+            if path == "raw":
+                threads = threads[:1]
+            for t in threads:
                 rate = bench_record_iter(rec, idx, args.hw, args.batch_size,
                                          t, native=native)
                 row = {"metric": "image_record_iter_throughput",

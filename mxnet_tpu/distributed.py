@@ -88,7 +88,8 @@ def all_sum(array):
     if jax.process_count() == 1:
         return jnp.asarray(array)
     from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(jnp.asarray(array))
+    gathered = jnp.asarray(
+        multihost_utils.process_allgather(jnp.asarray(array)))
     return jnp.sum(gathered, axis=0)
 
 
@@ -100,7 +101,7 @@ def all_gather(array):
     if jax.process_count() == 1:
         return jnp.asarray(array)[None]
     from jax.experimental import multihost_utils
-    return multihost_utils.process_allgather(jnp.asarray(array))
+    return jnp.asarray(multihost_utils.process_allgather(jnp.asarray(array)))
 
 
 def broadcast(array, root=0):
@@ -110,5 +111,8 @@ def broadcast(array, root=0):
     if jax.process_count() == 1:
         return jnp.asarray(array)
     from jax.experimental import multihost_utils
-    return multihost_utils.broadcast_one_to_all(
-        jnp.asarray(array), is_source=jax.process_index() == root)
+    # broadcast_one_to_all returns HOST numpy under the gloo CPU backend:
+    # normalize to a device array so no caller ever stores numpy where
+    # jax-only APIs (.at[], donation) are later used
+    return jnp.asarray(multihost_utils.broadcast_one_to_all(
+        jnp.asarray(array), is_source=jax.process_index() == root))

@@ -217,22 +217,12 @@ class CSVIter(DataIter):
         return self._it.provide_label
 
 
-_NATIVE_DECODE = None
-
-
 def _native_decoder():
     """Load src/image_decode.cc's batch JPEG pipeline (decode threads of
-    the reference's iter_image_recordio_2.cc).  None when unbuilt."""
-    global _NATIVE_DECODE
-    if _NATIVE_DECODE is None:
-        import ctypes
-        path = os.path.join(os.path.dirname(__file__), "_lib",
-                            "libimagedecode.so")
-        try:
-            _NATIVE_DECODE = ctypes.CDLL(path)
-        except OSError:
-            _NATIVE_DECODE = False
-    return _NATIVE_DECODE or None
+    the reference's iter_image_recordio_2.cc), auto-building like every
+    other native core.  None when unbuildable."""
+    from .base import load_native_lib
+    return load_native_lib("libimagedecode.so", "image_decode.cc")
 
 
 class ImageRecordIter(DataIter):
@@ -298,6 +288,11 @@ class ImageRecordIter(DataIter):
         if use_native_decode is not False and self._shape[0] == 3:
             self._native = _native_decoder()
         if use_native_decode is True and self._native is None:
+            if self._shape[0] != 3:
+                raise RuntimeError(
+                    "use_native_decode=True: the native decode path only "
+                    "produces 3-channel output (got data_shape "
+                    f"{self._shape})")
             raise RuntimeError(
                 "use_native_decode=True but libimagedecode.so is not "
                 "built (run `make -C src`)")

@@ -260,7 +260,9 @@ def img_from_payload(payload, iscolor=1):
         if iscolor and c == 1:
             img = np.repeat(img, 3, axis=2)
         elif not iscolor and c == 3:
-            img = img.mean(axis=2).astype(np.uint8)[:, :, None]
+            # ITU-R 601 luma, matching PIL convert("L") on encoded records
+            img = np.dot(img, np.array([0.299, 0.587, 0.114])) \
+                .astype(np.uint8)[:, :, None]
         return img if img.shape[2] > 1 else img[:, :, 0]
     from PIL import Image
     img = Image.open(_pyio.BytesIO(payload))

@@ -80,6 +80,24 @@ def main():
     ref = distributed.broadcast(flat, root=0)
     np.testing.assert_allclose(np.asarray(ref), flat, rtol=1e-6, atol=1e-6)
 
+    # --- row_sparse push across workers: each rank touches a DIFFERENT
+    # row; the dist reduce must union them (densified wire, see
+    # kvstore._push_rsp) and the lazy server update must move only the
+    # union of pushed rows
+    from mxnet_tpu import sparse
+    kv3 = mx.kv.create("dist_sync")
+    kv3.set_optimizer(mx.optimizer.create("sgd", learning_rate=1.0))
+    w0 = np.zeros((n + 2, 3), np.float32)
+    kv3.init("emb", mx.nd.array(w0))
+    g_rsp = sparse.row_sparse_array(
+        (np.full((1, 3), 1.0, np.float32), np.array([r], np.int32)),
+        shape=(n + 2, 3))
+    kv3.push("emb", g_rsp)
+    got = kv3.pull("emb").asnumpy()
+    expect = w0.copy()
+    expect[:n] -= 1.0          # every worker's row moved by -lr*1
+    np.testing.assert_allclose(got, expect)
+
     print(f"worker {r}/{n} OK", flush=True)
     return 0
 

@@ -934,6 +934,7 @@ COVERED_ELSEWHERE = {
     "moe_ffn": "tests/test_moe.py",
     "flash_attention": "tests/test_flash_attention.py",
     "quantized_conv": "tests/test_misc_subsystems.py",
+    "FusedNormReluConv": "tests/test_fused_conv.py",
 }
 
 
