@@ -2,8 +2,8 @@
 
 NormReluConv2D folds BatchNorm(+residual)+ReLU INTO the following
 convolution via the Pallas kernel in ops/pallas/fused_conv.py, so the
-normalized activation never reaches HBM.  NHWC only, 1×1/3×3 stride-1 —
-the ResNet residual-block hot path.  Weights are HWIO (the TPU-native
+normalized activation never reaches HBM.  NHWC only, 1×1/3×3, stride 1
+or 2 — the ResNet residual-block hot path.  Weights are HWIO (the TPU-native
 conv layout); this layer is an opt-in performance variant, so its
 parameter layout intentionally differs from Conv2D+BatchNorm pairs.
 """
@@ -26,14 +26,17 @@ class NormReluConv2D(HybridBlock):
     registered op so eager autograd and hybridize both see one taped node.
     """
 
-    def __init__(self, channels, kernel_size, in_channels=0, momentum=0.9,
-                 epsilon=1e-5, relu=True, weight_initializer=None,
-                 prefix=None, params=None):
+    def __init__(self, channels, kernel_size, strides=1, in_channels=0,
+                 momentum=0.9, epsilon=1e-5, relu=True,
+                 weight_initializer=None, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         if kernel_size not in (1, 3):
             raise ValueError("NormReluConv2D supports kernel_size 1 or 3")
+        if strides not in (1, 2):
+            raise ValueError("NormReluConv2D supports strides 1 or 2")
         self._channels = channels
         self._k = kernel_size
+        self._strides = strides
         self._momentum = momentum
         self._eps = epsilon
         self._relu = relu
@@ -65,7 +68,8 @@ class NormReluConv2D(HybridBlock):
         out, new_mm, new_mv = F.FusedNormReluConv(
             x, params["weight"], params["gamma"], params["beta"],
             params["running_mean"], params["running_var"], *extra,
-            eps=self._eps, momentum=self._momentum, relu=self._relu)
+            eps=self._eps, momentum=self._momentum, relu=self._relu,
+            stride=self._strides)
         if _autograd.is_training():
             self.running_mean._data = NDArray(new_mm.detach()._data)
             self.running_var._data = NDArray(new_mv.detach()._data)
@@ -73,4 +77,4 @@ class NormReluConv2D(HybridBlock):
 
     def __repr__(self):
         return (f"NormReluConv2D({self._k}x{self._k}, "
-                f"channels={self._channels})")
+                f"channels={self._channels}, strides={self._strides})")

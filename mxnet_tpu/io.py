@@ -245,10 +245,10 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=-1, preprocess_threads=0, seed=0,
                  round_batch=True, label_width=1, use_native_decode=None,
-                 **kwargs):
+                 num_parts=1, part_index=0, **kwargs):
         super().__init__(batch_size)
         _IGNORED_OK = {"prefetch_buffer", "data_name", "label_name",
-                       "verify_decode", "num_parts", "part_index",
+                       "verify_decode",
                        "shuffle_chunk_size", "shuffle_chunk_seed",
                        "inter_method", "dtype", "ctx", "device_id"}
         unknown = set(kwargs) - _IGNORED_OK
@@ -268,6 +268,18 @@ class ImageRecordIter(DataIter):
         self._keys = list(self._rec.keys)
         if not self._keys:
             raise IOError(f"empty or unindexed record file {path_imgrec!r}")
+        # data sharding (ref: ImageRecordIter num_parts/part_index — one
+        # iterator per worker/loader process reads a disjoint key slice;
+        # this is also how the raw path scales across host cores)
+        if not 0 <= part_index < num_parts:
+            raise ValueError(f"part_index {part_index} outside "
+                             f"num_parts {num_parts}")
+        if num_parts > 1:
+            self._keys = self._keys[part_index::num_parts]
+            if not self._keys:
+                raise IOError(
+                    f"part {part_index}/{num_parts} of {path_imgrec!r} "
+                    f"is empty")
         self._shuffle = shuffle
         self._rand_crop = rand_crop
         self._rand_mirror = rand_mirror

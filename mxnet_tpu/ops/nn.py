@@ -292,10 +292,10 @@ def _batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0
 @register_op("FusedNormReluConv", aliases=("fused_norm_relu_conv",))
 def _fused_norm_relu_conv(data, weight, gamma, beta, moving_mean,
                           moving_var, residual=None, eps=1e-5, momentum=0.9,
-                          relu=True, training=None):
+                          relu=True, stride=1, training=None):
     """BatchNorm(+residual)+ReLU folded into the following conv via the
     Pallas kernel (ops/pallas/fused_conv.py) — the normalized activation
-    never reaches HBM.  NHWC data, HWIO weight, 1x1/3x3 stride-1.
+    never reaches HBM.  NHWC data, HWIO weight, 1x1/3x3, stride 1 or 2.
 
     Functional like BatchNorm: returns (out, new_moving_mean,
     new_moving_var); the gluon NormReluConv2D layer threads the aux state.
@@ -319,7 +319,7 @@ def _fused_norm_relu_conv(data, weight, gamma, beta, moving_mean,
     scale = gamma.astype(jnp.float32) * inv
     shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * scale
     out = norm_relu_conv(data, scale, shift, weight, residual=residual,
-                         relu=relu)
+                         relu=relu, stride=stride)
     return out, new_mm, new_mv
 
 

@@ -10,8 +10,8 @@ never exists in HBM, in forward OR backward (both backward kernels
 recompute the prologue from the raw input, flash-attention style).
 
 Scope (the ResNet residual-block hot path, SURVEY §7.0.2):
-  * NHWC, HWIO weights, kernel 1×1 or 3×3, stride 1, SAME padding,
-    groups=1.  Stride-2 and the 7×7 stem stay on the XLA conv.
+  * NHWC, HWIO weights, kernel 1×1 or 3×3, stride 1 or 2, SAME
+    padding, groups=1.  The 7×7 stem stays on the XLA conv.
   * ``scale``/``shift`` are per-channel affine terms ALREADY folded from
     BN statistics (gamma/sqrt(var+eps), beta-mean*scale).  They stay in
     the autograd graph, so the batch-statistics paths of BN gradients
@@ -36,7 +36,18 @@ __all__ = ["norm_relu_conv", "norm_relu_conv_reference", "supports"]
 
 def supports(kh, kw, stride, groups=1):
     """True when the fused kernel covers this conv configuration."""
-    return (kh, kw) in ((1, 1), (3, 3)) and stride == 1 and groups == 1
+    return (kh, kw) in ((1, 1), (3, 3)) and stride in (1, 2) and groups == 1
+
+
+def _out_dim(n, stride):
+    """SAME-padding output extent."""
+    return -(-n // stride)
+
+
+def _same_pads(n, k, stride):
+    """(pad_lo, pad_hi) of SAME padding along one spatial dim."""
+    total = max((_out_dim(n, stride) - 1) * stride + k - n, 0)
+    return total // 2, total - total // 2
 
 
 def _prologue(x, scale, shift, res, relu):
@@ -48,7 +59,21 @@ def _prologue(x, scale, shift, res, relu):
 
 
 # ------------------------------------------------------------- forward ------
-def _fwd_kernel(x_ref, scale_ref, shift_ref, w_ref, *rest, k, relu,
+def _taps(Xp, h, w_dim, ci, k, stride):
+    """Yield (ky, kx, patch) with patch = the (Ho, Wo, Ci) strided window
+    of the padded input under tap (ky, kx) — the 9 shifted views whose
+    matmuls sum to the convolution."""
+    ho, wo = _out_dim(h, stride), _out_dim(w_dim, stride)
+    for ky in range(k):
+        for kx in range(k):
+            patch = lax.slice(Xp, (ky, kx, 0),
+                              (ky + stride * (ho - 1) + 1,
+                               kx + stride * (wo - 1) + 1, ci),
+                              (stride, stride, 1))
+            yield ky, kx, patch
+
+
+def _fwd_kernel(x_ref, scale_ref, shift_ref, w_ref, *rest, k, stride, relu,
                 has_res):
     if has_res:
         r_ref, o_ref = rest
@@ -56,22 +81,21 @@ def _fwd_kernel(x_ref, scale_ref, shift_ref, w_ref, *rest, k, relu,
         (o_ref,) = rest
         r_ref = None
     h, w_dim, ci = x_ref.shape[1], x_ref.shape[2], x_ref.shape[3]
+    ho, wo = _out_dim(h, stride), _out_dim(w_dim, stride)
     X = _prologue(x_ref[0], scale_ref[0], shift_ref[0],
                   r_ref[0] if has_res else None, relu)
-    if k == 1:
+    if k == 1 and stride == 1:
         acc = X.reshape(h * w_dim, ci) @ w_ref[0, 0].astype(jnp.float32)
     else:
-        pad = k // 2
-        Xp = jnp.pad(X, ((pad, pad), (pad, pad), (0, 0)))
+        py, _py2 = _same_pads(h, k, stride)
+        px, _px2 = _same_pads(w_dim, k, stride)
+        Xp = jnp.pad(X, ((py, _py2), (px, _px2), (0, 0)))
         acc = None
-        for ky in range(k):
-            for kx in range(k):
-                patch = lax.slice(Xp, (ky, kx, 0),
-                                  (ky + h, kx + w_dim, ci))
-                term = patch.reshape(h * w_dim, ci) @ \
-                    w_ref[ky, kx].astype(jnp.float32)
-                acc = term if acc is None else acc + term
-    o_ref[0] = acc.reshape(h, w_dim, -1).astype(o_ref.dtype)
+        for ky, kx, patch in _taps(Xp, h, w_dim, ci, k, stride):
+            term = patch.reshape(ho * wo, ci) @ \
+                w_ref[ky, kx].astype(jnp.float32)
+            acc = term if acc is None else acc + term
+    o_ref[0] = acc.reshape(ho, wo, -1).astype(o_ref.dtype)
 
 
 def _pick_block_co(co, want):
@@ -83,9 +107,10 @@ def _pick_block_co(co, want):
     return 1
 
 
-def _fwd(x, scale, shift, w, res, relu, block_co, interpret):
+def _fwd(x, scale, shift, w, res, relu, stride, block_co, interpret):
     n, h, wd, ci = x.shape
     k, _, _, co = w.shape
+    ho, wo = _out_dim(h, stride), _out_dim(wd, stride)
     block_co = _pick_block_co(co, block_co)
     inputs = [x, scale.reshape(1, ci), shift.reshape(1, ci), w]
     in_specs = [
@@ -99,20 +124,20 @@ def _fwd(x, scale, shift, w, res, relu, block_co, interpret):
         in_specs.append(
             pl.BlockSpec((1, h, wd, ci), lambda nb, cb: (nb, 0, 0, 0)))
     return pl.pallas_call(
-        functools.partial(_fwd_kernel, k=k, relu=relu,
+        functools.partial(_fwd_kernel, k=k, stride=stride, relu=relu,
                           has_res=res is not None),
         grid=(n, co // block_co),
         in_specs=in_specs,
-        out_specs=pl.BlockSpec((1, h, wd, block_co),
+        out_specs=pl.BlockSpec((1, ho, wo, block_co),
                                lambda nb, cb: (nb, 0, 0, cb)),
-        out_shape=jax.ShapeDtypeStruct((n, h, wd, co), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((n, ho, wo, co), x.dtype),
         interpret=interpret,
     )(*inputs)
 
 
 # ---------------------------------------------------------- backward dX -----
-def _dx_kernel(x_ref, scale_ref, shift_ref, w_ref, do_ref, *rest, k, relu,
-               has_res):
+def _dx_kernel(x_ref, scale_ref, shift_ref, w_ref, do_ref, *rest, k, stride,
+               relu, has_res):
     """dx (+dres) for one sample; also per-sample dscale/dshift partials.
 
     G = dO ⋆ flip(W) (the full correlation); the relu mask and the affine
@@ -126,13 +151,27 @@ def _dx_kernel(x_ref, scale_ref, shift_ref, w_ref, do_ref, *rest, k, relu,
         r_ref = dres_ref = None
     h, wd, ci = x_ref.shape[1], x_ref.shape[2], x_ref.shape[3]
     co = do_ref.shape[3]
+    ho, wo = _out_dim(h, stride), _out_dim(wd, stride)
     do = do_ref[0].astype(jnp.float32)
-    if k == 1:
+    if k == 1 and stride == 1:
         G = do.reshape(h * wd, co) @ \
             w_ref[0, 0].astype(jnp.float32).T
     else:
-        pad = k // 2
-        dop = jnp.pad(do, ((pad, pad), (pad, pad), (0, 0)))
+        if stride == 1:
+            dod = do
+        else:
+            # transposed conv: dilate dO by the stride (zeros between
+            # output positions), then full-correlate with flipped taps
+            dod = jnp.zeros((stride * (ho - 1) + 1,
+                             stride * (wo - 1) + 1, co), jnp.float32)
+            dod = dod.at[::stride, ::stride].set(do)
+        py, _ = _same_pads(h, k, stride)
+        px, _ = _same_pads(wd, k, stride)
+        ply = k - 1 - py
+        plx = k - 1 - px
+        pry = h + k - 1 - dod.shape[0] - ply
+        prx = wd + k - 1 - dod.shape[1] - plx
+        dop = jnp.pad(dod, ((ply, pry), (plx, prx), (0, 0)))
         G = None
         for ky in range(k):
             for kx in range(k):
@@ -158,7 +197,7 @@ def _dx_kernel(x_ref, scale_ref, shift_ref, w_ref, do_ref, *rest, k, relu,
     dsh_ref[0] = jnp.sum(Gm, axis=(0, 1))
 
 
-def _dx(x, scale, shift, w, res, do, relu, interpret):
+def _dx(x, scale, shift, w, res, do, relu, stride, interpret):
     n, h, wd, ci = x.shape
     k = w.shape[0]
     has_res = res is not None
@@ -168,7 +207,8 @@ def _dx(x, scale, shift, w, res, do, relu, interpret):
         pl.BlockSpec((1, ci), lambda nb: (0, 0)),
         pl.BlockSpec((1, ci), lambda nb: (0, 0)),
         pl.BlockSpec(w.shape, lambda nb: (0, 0, 0, 0)),
-        pl.BlockSpec((1, h, wd, do.shape[3]), lambda nb: (nb, 0, 0, 0)),
+        pl.BlockSpec((1, do.shape[1], do.shape[2], do.shape[3]),
+                     lambda nb: (nb, 0, 0, 0)),
     ]
     if has_res:
         inputs.append(res)
@@ -185,7 +225,8 @@ def _dx(x, scale, shift, w, res, do, relu, interpret):
     out_shape += [jax.ShapeDtypeStruct((n, ci), jnp.float32),
                   jax.ShapeDtypeStruct((n, ci), jnp.float32)]
     outs = pl.pallas_call(
-        functools.partial(_dx_kernel, k=k, relu=relu, has_res=has_res),
+        functools.partial(_dx_kernel, k=k, stride=stride, relu=relu,
+                          has_res=has_res),
         grid=(n,),
         in_specs=in_specs,
         out_specs=out_specs,
@@ -202,8 +243,8 @@ def _dx(x, scale, shift, w, res, do, relu, interpret):
 
 
 # ---------------------------------------------------------- backward dW -----
-def _dw_kernel(x_ref, scale_ref, shift_ref, do_ref, *rest, k, relu,
-               has_res, n):
+def _dw_kernel(x_ref, scale_ref, shift_ref, do_ref, *rest, k, stride,
+               relu, has_res, n):
     """dW accumulated over samples: grid (co_tiles, N), acc in VMEM."""
     if has_res:
         r_ref, dw_ref, acc_ref = rest
@@ -218,25 +259,26 @@ def _dw_kernel(x_ref, scale_ref, shift_ref, do_ref, *rest, k, relu,
 
     h, wd, ci = x_ref.shape[1], x_ref.shape[2], x_ref.shape[3]
     tco = do_ref.shape[3]
+    ho, wo = _out_dim(h, stride), _out_dim(wd, stride)
     X = _prologue(x_ref[0], scale_ref[0], shift_ref[0],
                   r_ref[0] if has_res else None, relu)
-    do = do_ref[0].astype(jnp.float32).reshape(h * wd, tco)
-    if k == 1:
+    do = do_ref[0].astype(jnp.float32).reshape(ho * wo, tco)
+    if k == 1 and stride == 1:
         acc_ref[0, 0] += X.reshape(h * wd, ci).T @ do
     else:
-        pad = k // 2
-        Xp = jnp.pad(X, ((pad, pad), (pad, pad), (0, 0)))
-        for ky in range(k):
-            for kx in range(k):
-                patch = lax.slice(Xp, (ky, kx, 0), (ky + h, kx + wd, ci))
-                acc_ref[ky, kx] += patch.reshape(h * wd, ci).T @ do
+        py, py2 = _same_pads(h, k, stride)
+        px, px2 = _same_pads(wd, k, stride)
+        Xp = jnp.pad(X, ((py, py2), (px, px2), (0, 0)))
+        for ky, kx, patch in _taps(Xp, h, wd, ci, k, stride):
+            acc_ref[ky, kx] += patch.reshape(ho * wo, ci).T @ do
 
     @pl.when(nb == n - 1)
     def _finish():
         dw_ref[...] = acc_ref[...].astype(dw_ref.dtype)
 
 
-def _dw(x, scale, shift, res, do, k, co, relu, block_co, interpret):
+def _dw(x, scale, shift, res, do, k, co, relu, stride, block_co,
+        interpret):
     n, h, wd, ci = x.shape
     block_co = _pick_block_co(co, block_co)
     has_res = res is not None
@@ -245,14 +287,16 @@ def _dw(x, scale, shift, res, do, k, co, relu, block_co, interpret):
         pl.BlockSpec((1, h, wd, ci), lambda cb, nb: (nb, 0, 0, 0)),
         pl.BlockSpec((1, ci), lambda cb, nb: (0, 0)),
         pl.BlockSpec((1, ci), lambda cb, nb: (0, 0)),
-        pl.BlockSpec((1, h, wd, block_co), lambda cb, nb: (nb, 0, 0, cb)),
+        pl.BlockSpec((1, do.shape[1], do.shape[2], block_co),
+                     lambda cb, nb: (nb, 0, 0, cb)),
     ]
     if has_res:
         inputs.append(res)
         in_specs.append(
             pl.BlockSpec((1, h, wd, ci), lambda cb, nb: (nb, 0, 0, 0)))
     return pl.pallas_call(
-        functools.partial(_dw_kernel, k=k, relu=relu, has_res=has_res, n=n),
+        functools.partial(_dw_kernel, k=k, stride=stride, relu=relu,
+                          has_res=has_res, n=n),
         grid=(co // block_co, n),
         in_specs=in_specs,
         out_specs=pl.BlockSpec((k, k, ci, block_co),
@@ -264,37 +308,39 @@ def _dw(x, scale, shift, res, do, k, co, relu, block_co, interpret):
 
 
 # ----------------------------------------------------------- public api -----
-def norm_relu_conv_reference(x, scale, shift, w, residual=None, relu=True):
+def norm_relu_conv_reference(x, scale, shift, w, residual=None, relu=True,
+                             stride=1):
     """XLA twin of the fused kernel (test oracle + fallback path)."""
     pre = x.astype(jnp.float32) * scale + shift
     if residual is not None:
         pre = pre + residual.astype(jnp.float32)
     X = jnp.maximum(pre, 0.0) if relu else pre
     out = lax.conv_general_dilated(
-        X.astype(x.dtype), w, (1, 1), "SAME",
+        X.astype(x.dtype), w, (stride, stride), "SAME",
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=jnp.float32)
     return out.astype(x.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
-def _core(x, scale, shift, w, relu, block_co, interpret):
-    out, _ = _fwd_rule(x, scale, shift, w, relu, block_co, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _core(x, scale, shift, w, relu, stride, block_co, interpret):
+    out, _ = _fwd_rule(x, scale, shift, w, relu, stride, block_co,
+                       interpret)
     return out
 
 
-def _fwd_rule(x, scale, shift, w, relu, block_co, interpret):
+def _fwd_rule(x, scale, shift, w, relu, stride, block_co, interpret):
     out = _fwd(x, scale.astype(jnp.float32), shift.astype(jnp.float32), w,
-               None, relu, block_co, interpret)
+               None, relu, stride, block_co, interpret)
     return out, (x, scale, shift, w)
 
 
-def _bwd_rule(relu, block_co, interpret, resd, do):
+def _bwd_rule(relu, stride, block_co, interpret, resd, do):
     x, scale, shift, w = resd
     s32 = scale.astype(jnp.float32)
     h32 = shift.astype(jnp.float32)
-    dx, _, dsc, dsh = _dx(x, s32, h32, w, None, do, relu, interpret)
-    dw = _dw(x, s32, h32, None, do, w.shape[0], w.shape[3], relu,
+    dx, _, dsc, dsh = _dx(x, s32, h32, w, None, do, relu, stride, interpret)
+    dw = _dw(x, s32, h32, None, do, w.shape[0], w.shape[3], relu, stride,
              block_co, interpret)
     return (dx, dsc.astype(scale.dtype), dsh.astype(shift.dtype),
             dw.astype(w.dtype))
@@ -303,26 +349,29 @@ def _bwd_rule(relu, block_co, interpret, resd, do):
 _core.defvjp(_fwd_rule, _bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
-def _core_res(x, scale, shift, w, residual, relu, block_co, interpret):
-    out, _ = _fwd_res_rule(x, scale, shift, w, residual, relu, block_co,
-                           interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _core_res(x, scale, shift, w, residual, relu, stride, block_co,
+              interpret):
+    out, _ = _fwd_res_rule(x, scale, shift, w, residual, relu, stride,
+                           block_co, interpret)
     return out
 
 
-def _fwd_res_rule(x, scale, shift, w, residual, relu, block_co, interpret):
+def _fwd_res_rule(x, scale, shift, w, residual, relu, stride, block_co,
+                  interpret):
     out = _fwd(x, scale.astype(jnp.float32), shift.astype(jnp.float32), w,
-               residual, relu, block_co, interpret)
+               residual, relu, stride, block_co, interpret)
     return out, (x, scale, shift, w, residual)
 
 
-def _bwd_res_rule(relu, block_co, interpret, resd, do):
+def _bwd_res_rule(relu, stride, block_co, interpret, resd, do):
     x, scale, shift, w, residual = resd
     s32 = scale.astype(jnp.float32)
     h32 = shift.astype(jnp.float32)
-    dx, dres, dsc, dsh = _dx(x, s32, h32, w, residual, do, relu, interpret)
+    dx, dres, dsc, dsh = _dx(x, s32, h32, w, residual, do, relu, stride,
+                             interpret)
     dw = _dw(x, s32, h32, residual, do, w.shape[0], w.shape[3], relu,
-             block_co, interpret)
+             stride, block_co, interpret)
     return (dx, dsc.astype(scale.dtype), dsh.astype(shift.dtype),
             dw.astype(w.dtype), dres)
 
@@ -330,23 +379,23 @@ def _bwd_res_rule(relu, block_co, interpret, resd, do):
 _core_res.defvjp(_fwd_res_rule, _bwd_res_rule)
 
 
-def norm_relu_conv(x, scale, shift, w, residual=None, relu=True,
+def norm_relu_conv(x, scale, shift, w, residual=None, relu=True, stride=1,
                    block_co=128, interpret=None):
     """conv(relu(x·scale + shift [+ residual]), w) without materialising
     the normalized activation (forward or backward).
 
     x: (N, H, W, Ci) raw pre-norm activations; scale/shift: (Ci,) affine
     folded from BN stats (keep them in the traced graph so stat gradients
-    flow); w: (k, k, Ci, Co) HWIO with k in {1, 3}; stride 1, SAME.
+    flow); w: (k, k, Ci, Co) HWIO with k in {1, 3}; stride 1 or 2, SAME.
     ``interpret=None`` auto-selects the Pallas interpreter off-TPU.
     """
     k = w.shape[0]
-    if not supports(k, w.shape[1], 1):
-        raise ValueError(f"fused kernel supports 1x1/3x3 stride-1; got "
-                         f"{w.shape[:2]}")
+    if not supports(k, w.shape[1], stride):
+        raise ValueError(f"fused kernel supports 1x1/3x3 stride 1/2; got "
+                         f"{w.shape[:2]} stride {stride}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     if residual is None:
-        return _core(x, scale, shift, w, relu, block_co, interpret)
-    return _core_res(x, scale, shift, w, residual, relu, block_co,
+        return _core(x, scale, shift, w, relu, stride, block_co, interpret)
+    return _core_res(x, scale, shift, w, residual, relu, stride, block_co,
                      interpret)

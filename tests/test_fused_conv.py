@@ -213,3 +213,43 @@ def test_non_power_of_two_channels():
                   .sum())(w)
     np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
                                rtol=2e-3, atol=2e-3)
+
+
+@pytest.mark.parametrize("k,stride,h", [(3, 2, 8), (3, 2, 9), (1, 2, 8)])
+def test_kernel_stride2_parity(k, stride, h):
+    """Stride-2 (the resnet downsample 3x3s): fwd + all grads match the
+    XLA composition, incl. odd spatial extents."""
+    rng = np.random.RandomState(7)
+    n, ci, co = 2, 8, 16
+    x = jnp.asarray(rng.randn(n, h, h, ci).astype(np.float32))
+    sc = jnp.asarray(rng.rand(ci).astype(np.float32) + 0.5)
+    sh = jnp.asarray(rng.randn(ci).astype(np.float32) * 0.1)
+    w = jnp.asarray(rng.randn(k, k, ci, co).astype(np.float32) * 0.2)
+    of = norm_relu_conv(x, sc, sh, w, stride=stride, block_co=8)
+    orf = norm_relu_conv_reference(x, sc, sh, w, stride=stride)
+    np.testing.assert_allclose(np.asarray(of), np.asarray(orf),
+                               rtol=2e-4, atol=2e-4)
+    gf = jax.grad(lambda *a: (norm_relu_conv(*a, stride=stride, block_co=8)
+                              .astype(jnp.float32) ** 2).sum(),
+                  argnums=(0, 1, 2, 3))(x, sc, sh, w)
+    gr = jax.grad(lambda *a: (norm_relu_conv_reference(*a, stride=stride)
+                              .astype(jnp.float32) ** 2).sum(),
+                  argnums=(0, 1, 2, 3))(x, sc, sh, w)
+    for i, (a, b) in enumerate(zip(gf, gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3,
+                                   err_msg=f"grad {i}")
+
+
+def test_layer_stride2():
+    """NormReluConv2D(strides=2) halves spatial dims and trains."""
+    layer = nn.NormReluConv2D(8, 3, strides=2, in_channels=4)
+    layer.initialize()
+    x = mx.nd.array(np.random.RandomState(0).randn(2, 8, 8, 4)
+                    .astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = layer(x)
+        (out * out).sum().backward()
+    assert out.shape == (2, 4, 4, 8)
+    assert np.isfinite(x.grad.asnumpy()).all()

@@ -313,3 +313,29 @@ def test_raw_records_roundtrip_and_iterate(tmp_path):
     b = next(iter(it))
     assert b.data[0].shape == (4, 3, 32, 32)
     np.testing.assert_array_equal(b.label[0].asnumpy(), [0, 1, 2, 3])
+
+
+def test_image_record_iter_num_parts(tmp_path):
+    """num_parts/part_index shard the key space disjointly and exactly
+    (ref: ImageRecordIter partitioned reading)."""
+    from mxnet_tpu import recordio as rio
+    f, fi = str(tmp_path / "p.rec"), str(tmp_path / "p.idx")
+    w = rio.MXIndexedRecordIO(fi, f, "w")
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        img = (rng.rand(16, 16, 3) * 255).astype(np.uint8)
+        w.write_idx(i, rio.pack_img(rio.IRHeader(0, float(i), i, 0), img,
+                                    img_fmt=".raw"))
+    w.close()
+    seen = []
+    for part in range(3):
+        it = io.ImageRecordIter(path_imgrec=f, data_shape=(3, 16, 16),
+                                batch_size=2, num_parts=3, part_index=part,
+                                round_batch=False)
+        for b in it:
+            seen.extend(b.label[0].asnumpy().astype(int).tolist())
+    assert sorted(seen) == sorted(set(seen))  # disjoint
+    assert len(seen) >= 8  # only sub-batch tails may drop
+    with pytest.raises(ValueError, match="part_index"):
+        io.ImageRecordIter(path_imgrec=f, data_shape=(3, 16, 16),
+                           batch_size=2, num_parts=2, part_index=2)
