@@ -17,6 +17,15 @@ Scope (the ResNet residual-block hot path, SURVEY §7.0.2):
     the autograd graph, so the batch-statistics paths of BN gradients
     flow through d(scale)/d(shift) automatically.
 
+Why block-INTERNAL fusion only (analysis, round 4): folding a block's
+tail (bn3+residual+relu) into the NEXT block's 1×1 looks tempting, but
+ResNet v1 reuses that tail output as the next block's residual — it must
+materialise regardless, and the folded prologue would then read BOTH the
+wide y3 (C channels) and the previous activation instead of one C/4
+tensor, i.e. MORE traffic.  The winnable reads are exactly the two
+block-internal ones (bn1+relu into the 3×3, bn2+relu into the closing
+1×1), which is what this kernel family covers.
+
 ref: src/operator/nn/convolution.cc + batch_norm.cc — the reference runs
 these as separate cuDNN calls with the same materialisation; no
 counterpart kernel exists there.
