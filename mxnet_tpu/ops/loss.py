@@ -76,3 +76,39 @@ def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
         jnp.take_along_axis(a_t, s2[:, None], axis=1)[:, 0],
     )
     return -ll
+
+
+@jax.custom_vjp
+def _make_loss_core(data, scale):
+    return data
+
+
+def _make_loss_fwd(data, scale):
+    return data, scale
+
+
+def _make_loss_bwd(scale, g):
+    # the reference's MakeLoss backward IGNORES the head gradient and
+    # writes grad_scale itself (make_loss.cc) — do exactly that
+    return (jnp.broadcast_to(scale, g.shape).astype(g.dtype),
+            jnp.zeros_like(scale))
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register_op("make_loss", aliases=("MakeLoss",))
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+               normalization="null"):
+    """ref: src/operator/make_loss.cc — mark an output as a loss head:
+    forward is identity; backward REPLACES the incoming gradient with
+    ``grad_scale`` (normalized per batch/valid count when requested),
+    exactly like the reference."""
+    scale = jnp.asarray(grad_scale, jnp.float32)
+    if normalization == "batch":
+        scale = scale / data.shape[0]
+    elif normalization == "valid":
+        valid = jnp.maximum(jnp.sum((data > valid_thresh)
+                                    .astype(jnp.float32)), 1.0)
+        scale = scale / valid
+    return _make_loss_core(data, scale)

@@ -9,6 +9,8 @@ Boxes are corner-format (xmin, ymin, xmax, ymax) normalised to [0,1].
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -247,3 +249,93 @@ def _roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
         return jnp.max(expanded, axis=(1, 2))
 
     return jax.vmap(one)(rois)
+
+
+@register_op("Proposal", aliases=("_contrib_Proposal", "proposal"))
+def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """ref: src/operator/contrib/proposal-inl.h — RPN proposal generation
+    (Faster-RCNN family).  TPU-native: fixed-shape masked pipeline — no
+    data-dependent filtering; below-minimum / suppressed proposals carry
+    score -inf and the fixed top-k pads with the best survivors.
+
+    cls_prob (N, 2A, H, W): [background..., foreground...] per anchor;
+    bbox_pred (N, 4A, H, W); im_info (N, 3) rows [height, width, scale].
+    Returns rois (N*post_nms_top_n, 5) rows [batch_idx, x1, y1, x2, y2]
+    (+ scores (N*post, 1) when output_score).
+    """
+    if iou_loss:
+        raise NotImplementedError(
+            "Proposal(iou_loss=True) — the IoU-loss corner-offset box "
+            "decoding is not implemented; the default ctr/size transform "
+            "is (fail loudly rather than decode with the wrong transform)")
+    a = len(scales) * len(ratios)
+    n, _, h, w = cls_prob.shape
+
+    # base anchors centered on stride cells (reference GenerateAnchors)
+    base = []
+    cx = cy = (feature_stride - 1) / 2.0
+    for r in ratios:
+        size = feature_stride * feature_stride
+        size_r = round(math.sqrt(size / r))
+        ws0, hs0 = size_r, round(size_r * r)
+        for s in scales:
+            ws, hs = ws0 * s, hs0 * s
+            base.append([cx - (ws - 1) / 2, cy - (hs - 1) / 2,
+                         cx + (ws - 1) / 2, cy + (hs - 1) / 2])
+    base = jnp.asarray(base, jnp.float32)                      # (A, 4)
+    sx = jnp.arange(w, dtype=jnp.float32) * feature_stride
+    sy = jnp.arange(h, dtype=jnp.float32) * feature_stride
+    shift = jnp.stack(jnp.meshgrid(sx, sy, indexing="xy"), -1)  # (H, W, 2)
+    shift = jnp.tile(shift, (1, 1, 2)).reshape(h * w, 1, 4)
+    anchors = (base[None] + shift).reshape(-1, 4)              # (H*W*A, 4)
+
+    def one(scores_img, deltas_img, info):
+        # foreground scores: channels [A:2A], layout (A, H, W) -> (HWA,)
+        fg = scores_img[a:].transpose(1, 2, 0).reshape(-1)
+        d = deltas_img.reshape(a, 4, h, w).transpose(2, 3, 0, 1) \
+            .reshape(-1, 4)
+        widths = anchors[:, 2] - anchors[:, 0] + 1.0
+        heights = anchors[:, 3] - anchors[:, 1] + 1.0
+        ctr_x = anchors[:, 0] + 0.5 * (widths - 1)
+        ctr_y = anchors[:, 1] + 0.5 * (heights - 1)
+        pred_ctr_x = d[:, 0] * widths + ctr_x
+        pred_ctr_y = d[:, 1] * heights + ctr_y
+        pred_w = jnp.exp(d[:, 2]) * widths
+        pred_h = jnp.exp(d[:, 3]) * heights
+        boxes = jnp.stack([pred_ctr_x - 0.5 * (pred_w - 1),
+                           pred_ctr_y - 0.5 * (pred_h - 1),
+                           pred_ctr_x + 0.5 * (pred_w - 1),
+                           pred_ctr_y + 0.5 * (pred_h - 1)], -1)
+        # clip to image, drop boxes below the scaled minimum size
+        boxes = jnp.clip(boxes,
+                         jnp.zeros((4,), jnp.float32),
+                         jnp.stack([info[1] - 1, info[0] - 1,
+                                    info[1] - 1, info[0] - 1]))
+        min_size = rpn_min_size * info[2]
+        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + 1 >= min_size))
+        s = jnp.where(keep, fg, -jnp.inf)
+        k_pre = min(rpn_pre_nms_top_n, s.shape[0])
+        top_s, top_i = jax.lax.top_k(s, k_pre)
+        top_b = boxes[top_i]
+        nms_keep = _nms_single(top_b, top_s, threshold, -1)
+        s2 = jnp.where(nms_keep, top_s, -jnp.inf)
+        k_post = min(rpn_post_nms_top_n, s2.shape[0])
+        out_s, out_i = jax.lax.top_k(s2, k_post)
+        out_b = top_b[out_i]
+        pad = rpn_post_nms_top_n - k_post
+        if pad:
+            out_b = jnp.pad(out_b, ((0, pad), (0, 0)))
+            out_s = jnp.pad(out_s, (0, pad), constant_values=-jnp.inf)
+        return out_b, out_s
+
+    boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(n, dtype=jnp.float32),
+                           rpn_post_nms_top_n)[:, None]
+    rois = jnp.concatenate([batch_idx, boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois

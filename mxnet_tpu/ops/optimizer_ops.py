@@ -187,3 +187,43 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.
     new_mom = momentum * mom - lr * g
     new_w32 = weight32 + new_mom
     return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+@register_op("multi_sgd_update")
+def _multi_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                      clip_gradient=None, num_weights=None):
+    """ref: src/operator/contrib/multi_sgd — fused multi-tensor SGD over
+    interleaved (weight_0, grad_0, weight_1, grad_1, ...).  On TPU the
+    whole-model fusion lives in parallel.TrainStep; this op exists for
+    API parity and small eager sweeps — XLA still compiles the chain into
+    few kernels.  Returns the updated weights, positionally."""
+    n = num_weights if num_weights is not None else len(arrays) // 2
+    lrs = [lrs] * n if isinstance(lrs, (int, float)) else list(lrs)
+    wds = [wds] * n if isinstance(wds, (int, float)) else list(wds)
+    outs = []
+    for i in range(n):
+        w, g = arrays[2 * i], arrays[2 * i + 1]
+        g = _apply_wd(g.astype(w.dtype), w, wds[i], rescale_grad,
+                      clip_gradient)
+        outs.append(w - lrs[i] * g)
+    return tuple(outs) if n > 1 else outs[0]
+
+
+@register_op("multi_mp_sgd_update")
+def _multi_mp_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
+                         clip_gradient=None, num_weights=None):
+    """ref: multi_mp_sgd_update — fp32 master-weight variant over
+    interleaved (weight, grad, master) triples.  Returns (weight',
+    master') pairs flattened positionally."""
+    n = num_weights if num_weights is not None else len(arrays) // 3
+    lrs = [lrs] * n if isinstance(lrs, (int, float)) else list(lrs)
+    wds = [wds] * n if isinstance(wds, (int, float)) else list(wds)
+    outs = []
+    for i in range(n):
+        w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        g32 = _apply_wd(g.astype(jnp.float32), m, wds[i], rescale_grad,
+                        clip_gradient)
+        m_new = m - lrs[i] * g32
+        outs.append(m_new.astype(w.dtype))
+        outs.append(m_new)
+    return tuple(outs)

@@ -978,7 +978,8 @@ def _covered_names():
                   "lamb_update_phase1", "lamb_update_phase2", "mp_sgd_update",
                   "mp_sgd_mom_update", "_contrib_box_iou", "_contrib_box_nms",
                   "MultiBoxPrior", "ROIPooling", "MultiBoxTarget",
-                  "MultiBoxDetection", "_foreach_marker"})
+                  "MultiBoxDetection", "_foreach_marker", "make_loss",
+                  "multi_sgd_update", "multi_mp_sgd_update", "Proposal"})
     return names
 
 
@@ -993,3 +994,48 @@ def test_registry_coverage():
     missing = sorted({n for n in OPS
                       if id(OPS[n]) not in covered_fns})
     assert not missing, f"ops with no test coverage: {missing}"
+
+
+def test_make_loss_grad_semantics():
+    """make_loss: forward identity, backward grad_scale (ref:
+    src/operator/make_loss.cc)."""
+    x = nd(np.array([1.0, -2.0, 3.0], np.float32))
+    out = invoke("make_loss", x, grad_scale=1.0)
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy())
+    x.attach_grad()
+    with autograd.record():
+        y = invoke("make_loss", x, grad_scale=0.5)
+        y.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((3,), 0.5))
+    # the backward REPLACES the head gradient (reference MakeLoss): a
+    # consumer rescaling the loss head must not change dx
+    with autograd.record():
+        z = invoke("make_loss", x, grad_scale=0.5) * 2.0
+        z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((3,), 0.5))
+
+
+def test_multi_sgd_update_matches_singles():
+    """Fused multi-tensor SGD == per-tensor sgd_update (ref: multi_sgd)."""
+    rng = np.random.RandomState(0)
+    ws = [nd(rng.randn(4, 3).astype(np.float32)) for _ in range(3)]
+    gs = [nd(rng.randn(4, 3).astype(np.float32)) for _ in range(3)]
+    lrs, wds = [0.1, 0.2, 0.05], [0.0, 0.01, 0.1]
+    interleaved = [a for pair in zip(ws, gs) for a in pair]
+    outs = invoke("multi_sgd_update", *interleaved, lrs=lrs, wds=wds,
+                  num_weights=3)
+    for i in range(3):
+        ref = invoke("sgd_update", ws[i], gs[i], lr=lrs[i], wd=wds[i])
+        np.testing.assert_allclose(outs[i].asnumpy(), ref.asnumpy(),
+                                   rtol=1e-6, atol=1e-6)
+    # mp variant keeps an fp32 master
+    w16 = nd(rng.randn(4, 3).astype(np.float32)).astype("bfloat16")
+    g16 = nd(rng.randn(4, 3).astype(np.float32)).astype("bfloat16")
+    m32 = w16.astype("float32")
+    w2, m2 = invoke("multi_mp_sgd_update", w16, g16, m32, lrs=0.1, wds=0.0,
+                    num_weights=1)
+    assert str(w2.dtype) == "bfloat16"
+    np.testing.assert_allclose(m2.asnumpy(),
+                               m32.asnumpy() - 0.1 * g16.astype("float32").asnumpy(),
+                               rtol=1e-2, atol=1e-2)

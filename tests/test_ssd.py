@@ -173,3 +173,36 @@ def test_ssd_train_reaches_ap_gate():
     metric.update(label_nd, mx.nd.array(det))
     ap = metric.get_map()
     assert ap >= 0.5, f"detection mAP {ap:.3f} below the 0.5 gate"
+
+
+def test_proposal_op():
+    """RPN Proposal (ref: proposal-inl.h): fixed-shape rois from anchors +
+    deltas, min-size filtering, NMS, per-batch indices."""
+    rng = np.random.RandomState(0)
+    n, a, h, w = 2, 6, 4, 4  # scales x ratios = 2*3 = 6 anchors/cell
+    from mxnet_tpu.ndarray import invoke
+    cls_prob = mx.nd.array(rng.rand(n, 2 * a, h, w).astype(np.float32))
+    bbox_pred = mx.nd.array((rng.randn(n, 4 * a, h, w) * 0.1)
+                            .astype(np.float32))
+    im_info = mx.nd.array(np.array([[64, 64, 1.0], [64, 64, 1.0]],
+                                   np.float32))
+    rois = invoke("Proposal", cls_prob, bbox_pred, im_info,
+                  rpn_pre_nms_top_n=40, rpn_post_nms_top_n=8,
+                  threshold=0.7, rpn_min_size=4,
+                  scales=(4, 8), ratios=(0.5, 1, 2), feature_stride=16)
+    r = rois.asnumpy()
+    assert r.shape == (n * 8, 5)
+    # batch indices partition the rows
+    np.testing.assert_array_equal(r[:8, 0], 0.0)
+    np.testing.assert_array_equal(r[8:, 0], 1.0)
+    # boxes clipped into the image
+    assert (r[:, 1:] >= 0).all() and (r[:, [1, 3]] <= 63).all() \
+        and (r[:, [2, 4]] <= 63).all()
+    # output_score variant
+    rois2, scores = invoke("Proposal", cls_prob, bbox_pred, im_info,
+                           rpn_post_nms_top_n=8, rpn_min_size=4,
+                           scales=(4, 8), ratios=(0.5, 1, 2),
+                           output_score=True)
+    assert scores.shape == (n * 8, 1)
+    s = scores.asnumpy().reshape(n, 8)
+    assert (np.diff(s, axis=1) <= 1e-6).all()  # sorted by score
