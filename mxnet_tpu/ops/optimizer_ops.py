@@ -189,6 +189,20 @@ def _mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0, wd=0.
     return new_w32.astype(weight.dtype), new_mom, new_w32
 
 
+def _per_weight(vals, n, name, op="multi_sgd_update"):
+    """lrs/wds are REQUIRED by the reference op; a scalar broadcasts,
+    a sequence must match num_weights (ADVICE r4: None used to surface
+    as an opaque ``list(None)`` TypeError)."""
+    if vals is None:
+        raise ValueError(f"{op} requires {name} (scalar or one per weight)")
+    if isinstance(vals, (int, float)):
+        return [vals] * n
+    vals = list(vals)
+    if len(vals) != n:
+        raise ValueError(f"{name} has {len(vals)} entries for {n} weights")
+    return vals
+
+
 @register_op("multi_sgd_update")
 def _multi_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
                       clip_gradient=None, num_weights=None):
@@ -198,8 +212,7 @@ def _multi_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
     API parity and small eager sweeps — XLA still compiles the chain into
     few kernels.  Returns the updated weights, positionally."""
     n = num_weights if num_weights is not None else len(arrays) // 2
-    lrs = [lrs] * n if isinstance(lrs, (int, float)) else list(lrs)
-    wds = [wds] * n if isinstance(wds, (int, float)) else list(wds)
+    lrs, wds = _per_weight(lrs, n, "lrs"), _per_weight(wds, n, "wds")
     outs = []
     for i in range(n):
         w, g = arrays[2 * i], arrays[2 * i + 1]
@@ -216,8 +229,8 @@ def _multi_mp_sgd_update(*arrays, lrs=None, wds=None, rescale_grad=1.0,
     interleaved (weight, grad, master) triples.  Returns (weight',
     master') pairs flattened positionally."""
     n = num_weights if num_weights is not None else len(arrays) // 3
-    lrs = [lrs] * n if isinstance(lrs, (int, float)) else list(lrs)
-    wds = [wds] * n if isinstance(wds, (int, float)) else list(wds)
+    lrs = _per_weight(lrs, n, "lrs", "multi_mp_sgd_update")
+    wds = _per_weight(wds, n, "wds", "multi_mp_sgd_update")
     outs = []
     for i in range(n):
         w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]

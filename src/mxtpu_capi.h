@@ -1,0 +1,96 @@
+/* mxtpu C ABI (ref: include/mxnet/c_api.h — the MX* surface all language
+ * bindings sit on).  This is the TPU-native slice: handles are opaque
+ * pointers owning a CPython reference into the embedded mxnet_tpu runtime;
+ * every entry point acquires the GIL, so the library is safe from any
+ * single client thread at a time.
+ *
+ * Error contract: failing calls return NULL / negative and set a
+ * thread-global message readable via mxtpu_last_error() (ref:
+ * MXGetLastError). */
+#ifndef MXTPU_CAPI_H_
+#define MXTPU_CAPI_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---- lifecycle ---------------------------------------------------------- */
+
+/* Start the embedded interpreter and import the framework.  Idempotent.
+ * Imports resolve via PYTHONPATH (repo root + site-packages with jax). */
+int mxtpu_init(void);
+int mxtpu_shutdown(void);
+const char *mxtpu_last_error(void);
+
+/* ---- NDArray ------------------------------------------------------------ */
+
+/* Create an NDArray by COPYING ndim-dimensional host data (ref:
+ * MXNDArraySyncCopyFromCPU — same copy-in semantics: the caller's buffer
+ * is free to be reused or freed the moment the call returns).
+ * dtype: "float32" | "float16" | "bfloat16" | "int32" | "int64" |
+ * "uint8" | "int8".  data is raw bytes in that dtype's layout (bfloat16
+ * = high 16 bits of the IEEE f32 pattern).  float64 is rejected: the
+ * runtime computes in 32-bit (no f64 datapath on TPU) and a silent
+ * downcast under an f64 label would corrupt byte-level round-trips. */
+void *mxtpu_ndarray_create_dtype(const void *data, const long *shape,
+                                 int ndim, const char *dtype);
+
+/* float32 convenience wrapper over mxtpu_ndarray_create_dtype. */
+void *mxtpu_ndarray_create(const float *data, const long *shape, int ndim);
+
+int mxtpu_ndarray_free(void *handle);
+int mxtpu_ndarray_ndim(void *handle);
+/* Writes the shape into out (caller sizes it via mxtpu_ndarray_ndim);
+ * returns ndim. */
+int mxtpu_ndarray_shape(void *handle, long *out);
+/* Writes the dtype name (as above) into out; returns 0. */
+int mxtpu_ndarray_dtype(void *handle, char *out, int capacity);
+
+/* Blocking device->host copy converted to float32 (ref:
+ * MXNDArraySyncCopyToCPU).  capacity in ELEMENTS; returns elements
+ * copied. */
+int mxtpu_ndarray_to_host(void *handle, float *out, long capacity);
+/* Blocking device->host copy in the array's OWN dtype; capacity in
+ * BYTES; returns bytes copied. */
+long mxtpu_ndarray_to_host_bytes(void *handle, void *out, long capacity);
+
+/* ---- operator invocation ------------------------------------------------ */
+
+/* Invoke a registered operator by name (ref: MXImperativeInvokeEx).
+ * args: NDArray handles; kwargs_json: JSON object of op attrs (NULL or
+ * "" for none).  Returns the FIRST output handle — for multi-output ops
+ * (BatchNorm, the fused conv family, ...) the remaining outputs are
+ * DISCARDED; use mxtpu_invoke_n when you need them. */
+void *mxtpu_invoke(const char *op_name, void **args, int nargs,
+                   const char *kwargs_json);
+
+/* Multi-output invoke: fills outs[0..n) with owned handles and returns
+ * n, the op's output count (even when n > out_capacity — in that case
+ * only out_capacity handles are written, the rest are released; call
+ * again with a bigger array if truncated).  Returns -1 on failure. */
+int mxtpu_invoke_n(const char *op_name, void **args, int nargs,
+                   const char *kwargs_json, void **outs, int out_capacity);
+
+/* ---- autograd / training (ref: MXAutogradSetIsRecording,
+ *      MXAutogradBackwardEx, MXNDArrayGetGrad) ---------------------------- */
+
+/* Toggle tape recording AND training mode together (the common case,
+ * like `with autograd.record()`).  Returns the previous recording flag,
+ * or -1 on failure. */
+int mxtpu_autograd_set_recording(int on);
+
+/* Allocate a gradient buffer on the array so the tape tracks it. */
+int mxtpu_ndarray_attach_grad(void *handle);
+
+/* Run backward from a (scalar) head, filling attached grads. */
+int mxtpu_backward(void *handle);
+
+/* Owned handle to the array's accumulated gradient (NULL if none /
+ * never attached). */
+void *mxtpu_ndarray_grad(void *handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif  /* MXTPU_CAPI_H_ */

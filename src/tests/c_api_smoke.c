@@ -1,22 +1,14 @@
 /* C smoke client for the mxtpu C ABI (ref: the reference's C API tests —
- * a non-Python caller creates NDArrays, invokes ops, reads results).
- * Built and run by `make -C src test`. */
+ * a non-Python caller creates NDArrays, invokes ops, reads results, and
+ * TRAINS: the reference's bar for its C surface is MXAutogradBackwardEx
+ * driving real updates, so this client fits a 2-layer MLP from C and
+ * asserts the loss drops).  Built and run by `make -C src test`. */
 #include <math.h>
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
 
-extern int mxtpu_init(void);
-extern const char *mxtpu_last_error(void);
-extern void *mxtpu_ndarray_create(const float *data, const long *shape,
-                                  int ndim);
-extern int mxtpu_ndarray_free(void *h);
-extern int mxtpu_ndarray_ndim(void *h);
-extern int mxtpu_ndarray_shape(void *h, long *out);
-extern int mxtpu_ndarray_to_host(void *h, float *out, long capacity);
-extern void *mxtpu_invoke(const char *op, void **args, int nargs,
-                          const char *kwargs_json);
-extern int mxtpu_shutdown(void);
+#include "../mxtpu_capi.h"
 
 #define CHECK(cond, msg)                                            \
   do {                                                              \
@@ -25,6 +17,60 @@ extern int mxtpu_shutdown(void);
       return 1;                                                     \
     }                                                               \
   } while (0)
+
+/* forward: loss = mean((relu(x@w1 + b1) @ w2 + b2 - y)^2); returns the
+ * loss handle (caller frees) or NULL. */
+static void *mlp_forward(void *x, void *w1, void *b1, void *w2, void *b2,
+                         void *y) {
+  void *a1[2] = {x, w1};
+  void *z1 = mxtpu_invoke("dot", a1, 2, NULL);
+  if (!z1) return NULL;
+  void *a2[2] = {z1, b1};
+  void *z1b = mxtpu_invoke("broadcast_add", a2, 2, NULL);
+  mxtpu_ndarray_free(z1);
+  if (!z1b) return NULL;
+  void *a3[1] = {z1b};
+  void *h = mxtpu_invoke("relu", a3, 1, NULL);
+  mxtpu_ndarray_free(z1b);
+  if (!h) return NULL;
+  void *a4[2] = {h, w2};
+  void *z2 = mxtpu_invoke("dot", a4, 2, NULL);
+  mxtpu_ndarray_free(h);
+  if (!z2) return NULL;
+  void *a5[2] = {z2, b2};
+  void *pred = mxtpu_invoke("broadcast_add", a5, 2, NULL);
+  mxtpu_ndarray_free(z2);
+  if (!pred) return NULL;
+  void *a6[2] = {pred, y};
+  void *diff = mxtpu_invoke("broadcast_sub", a6, 2, NULL);
+  mxtpu_ndarray_free(pred);
+  if (!diff) return NULL;
+  void *a7[1] = {diff};
+  void *sq = mxtpu_invoke("square", a7, 1, NULL);
+  mxtpu_ndarray_free(diff);
+  if (!sq) return NULL;
+  void *a8[1] = {sq};
+  void *loss = mxtpu_invoke("mean", a8, 1, NULL);
+  mxtpu_ndarray_free(sq);
+  return loss;
+}
+
+/* one SGD update: param <- sgd_update(param, grad, lr); frees the old
+ * param handle and returns the new one. */
+static void *sgd(void *param, const char *lr_json) {
+  void *g = mxtpu_ndarray_grad(param);
+  if (!g) return NULL;
+  void *a[2] = {param, g};
+  void *updated = mxtpu_invoke("sgd_update", a, 2, lr_json);
+  mxtpu_ndarray_free(g);
+  mxtpu_ndarray_free(param);
+  return updated;
+}
+
+static float frand(unsigned *seed) { /* deterministic LCG in [-0.5, 0.5) */
+  *seed = *seed * 1664525u + 1013904223u;
+  return ((*seed >> 8) & 0xFFFF) / 65536.0f - 0.5f;
+}
 
 int main(void) {
   CHECK(mxtpu_init() == 0, "init");
@@ -40,6 +86,18 @@ int main(void) {
   CHECK(mxtpu_ndarray_shape(a, got_shape) == 2 && got_shape[0] == 2 &&
             got_shape[1] == 3,
         "shape");
+  char dt[16];
+  CHECK(mxtpu_ndarray_dtype(a, dt, sizeof dt) == 0 &&
+            strcmp(dt, "float32") == 0,
+        "dtype query");
+
+  /* copy-in semantics: mutate the caller buffer after create — the
+   * NDArray must NOT see it (the ADVICE r4 aliasing fix). */
+  a_data[0] = 999.0f;
+  float echo[6];
+  CHECK(mxtpu_ndarray_to_host(a, echo, 6) == 6, "to_host");
+  CHECK(fabsf(echo[0] - 1.0f) < 1e-6f, "create copies, not aliases");
+  a_data[0] = 1.0f;
 
   /* elementwise op */
   void *args[2] = {a, b};
@@ -71,17 +129,151 @@ int main(void) {
   CHECK(mxtpu_ndarray_to_host(prod, out3, 4) == 4, "dot to_host");
   CHECK(fabsf(out3[0] - 4.0f) < 1e-5f, "dot values"); /* 1*1+2*0+3*1 */
 
+  /* ---- dtype-generic create/read-back ---------------------------------- */
+  int i32_data[4] = {-2, 0, 7, 123456};
+  long s4[1] = {4};
+  void *i32 = mxtpu_ndarray_create_dtype(i32_data, s4, 1, "int32");
+  CHECK(i32 != NULL, "int32 create");
+  CHECK(mxtpu_ndarray_dtype(i32, dt, sizeof dt) == 0 &&
+            strcmp(dt, "int32") == 0,
+        "int32 dtype");
+  int i32_back[4];
+  CHECK(mxtpu_ndarray_to_host_bytes(i32, i32_back, sizeof i32_back) ==
+            (long)sizeof i32_back,
+        "int32 to_host_bytes");
+  for (int i = 0; i < 4; ++i) CHECK(i32_back[i] == i32_data[i], "int32 rt");
+
+  /* float64 is rejected LOUDLY (the runtime computes in 32-bit; a
+   * silent downcast under an f64 label would corrupt round-trips). */
+  double f64_data[4] = {1.0, -2.5, 3.0, 4.0};
+  CHECK(mxtpu_ndarray_create_dtype(f64_data, s4, 1, "float64") == NULL,
+        "float64 rejected");
+  CHECK(strstr(mxtpu_last_error(), "float64") != NULL,
+        "float64 rejection names the dtype");
+
+  unsigned char u8_data[4] = {0, 1, 128, 255};
+  void *u8 = mxtpu_ndarray_create_dtype(u8_data, s4, 1, "uint8");
+  CHECK(u8 != NULL, "uint8 create");
+  unsigned char u8_back[4];
+  CHECK(mxtpu_ndarray_to_host_bytes(u8, u8_back, 4) == 4, "uint8 rt bytes");
+  for (int i = 0; i < 4; ++i) CHECK(u8_back[i] == u8_data[i], "uint8 rt");
+
+  /* bfloat16 = high 16 bits of the f32 pattern; 1.0, 2.5, -3.0, 0.25 are
+   * exactly representable so truncation is exact. */
+  float bf_vals[4] = {1.0f, 2.5f, -3.0f, 0.25f};
+  unsigned short bf_bits[4];
+  for (int i = 0; i < 4; ++i) {
+    unsigned int u;
+    memcpy(&u, &bf_vals[i], 4);
+    bf_bits[i] = (unsigned short)(u >> 16);
+  }
+  void *bf = mxtpu_ndarray_create_dtype(bf_bits, s4, 1, "bfloat16");
+  CHECK(bf != NULL, "bfloat16 create");
+  CHECK(mxtpu_ndarray_dtype(bf, dt, sizeof dt) == 0 &&
+            strcmp(dt, "bfloat16") == 0,
+        "bfloat16 dtype");
+  float bf_back[4];
+  CHECK(mxtpu_ndarray_to_host(bf, bf_back, 4) == 4, "bf16 to f32 host");
+  for (int i = 0; i < 4; ++i) {
+    CHECK(fabsf(bf_back[i] - bf_vals[i]) < 1e-6f, "bf16 values");
+  }
+  CHECK(mxtpu_ndarray_create_dtype(bf_bits, s4, 1, "complex128") == NULL,
+        "unsupported dtype rejected");
+
+  /* ---- multi-output invoke --------------------------------------------- */
+  void *outs[2] = {NULL, NULL};
+  void *argk[1] = {a};
+  int nout = mxtpu_invoke_n("topk", argk, 1, "{\"k\": 2, \"ret_typ\": \"both\"}",
+                            outs, 2);
+  CHECK(nout == 2 && outs[0] && outs[1], "invoke_n topk gives 2 outputs");
+  float tv[4], ti[4];
+  CHECK(mxtpu_ndarray_to_host(outs[0], tv, 4) == 4, "topk values host");
+  CHECK(mxtpu_ndarray_to_host(outs[1], ti, 4) == 4, "topk indices host");
+  CHECK(fabsf(tv[0] - 3.0f) < 1e-5f && fabsf(ti[0] - 2.0f) < 1e-5f,
+        "topk row0 = (3, idx 2)");
+  mxtpu_ndarray_free(outs[0]);
+  mxtpu_ndarray_free(outs[1]);
+  /* capacity-0 probe: count comes back, nothing written */
+  CHECK(mxtpu_invoke_n("topk", argk, 1, "{\"k\": 2, \"ret_typ\": \"both\"}",
+                       NULL, 0) == 2,
+        "invoke_n capacity probe");
+
   /* unknown op surfaces a clean error, no crash */
   void *bad = mxtpu_invoke("definitely_not_an_op", args, 2, NULL);
   CHECK(bad == NULL, "unknown op returns NULL");
   CHECK(strlen(mxtpu_last_error()) > 0, "unknown op sets error");
 
+  /* ---- train a 2-layer MLP from C (ref: MXAutogradBackwardEx) ---------- */
+  enum { N = 16, DIN = 4, DH = 8 };
+  static float x_data[N * DIN], y_data[N * 1];
+  unsigned seed = 42;
+  for (int i = 0; i < N; ++i) { /* y = sum(x) — learnable by a small MLP */
+    float s = 0;
+    for (int j = 0; j < DIN; ++j) {
+      x_data[i * DIN + j] = frand(&seed);
+      s += x_data[i * DIN + j];
+    }
+    y_data[i] = s;
+  }
+  static float w1_d[DIN * DH], b1_d[DH], w2_d[DH], b2_d[1];
+  for (int i = 0; i < DIN * DH; ++i) w1_d[i] = frand(&seed);
+  for (int i = 0; i < DH; ++i) b1_d[i] = 0.0f;
+  for (int i = 0; i < DH; ++i) w2_d[i] = frand(&seed);
+  b2_d[0] = 0.0f;
+
+  long xs[2] = {N, DIN}, ys[2] = {N, 1}, w1s[2] = {DIN, DH}, b1s[1] = {DH},
+       w2s[2] = {DH, 1}, b2s[1] = {1};
+  void *x = mxtpu_ndarray_create(x_data, xs, 2);
+  void *y = mxtpu_ndarray_create(y_data, ys, 2);
+  void *w1 = mxtpu_ndarray_create(w1_d, w1s, 2);
+  void *b1 = mxtpu_ndarray_create(b1_d, b1s, 1);
+  void *w2 = mxtpu_ndarray_create(w2_d, w2s, 2);
+  void *b2 = mxtpu_ndarray_create(b2_d, b2s, 1);
+  CHECK(x && y && w1 && b1 && w2 && b2, "mlp tensors");
+
+  const char *lr = "{\"lr\": 0.2}";
+  float first_loss = -1, last_loss = -1;
+  for (int step = 0; step < 30; ++step) {
+    CHECK(mxtpu_ndarray_attach_grad(w1) == 0, "attach w1");
+    CHECK(mxtpu_ndarray_attach_grad(b1) == 0, "attach b1");
+    CHECK(mxtpu_ndarray_attach_grad(w2) == 0, "attach w2");
+    CHECK(mxtpu_ndarray_attach_grad(b2) == 0, "attach b2");
+    CHECK(mxtpu_autograd_set_recording(1) >= 0, "record on");
+    void *loss = mlp_forward(x, w1, b1, w2, b2, y);
+    CHECK(mxtpu_autograd_set_recording(0) >= 0, "record off");
+    CHECK(loss != NULL, "mlp forward");
+    float lv;
+    CHECK(mxtpu_ndarray_to_host(loss, &lv, 1) == 1, "loss to host");
+    if (step == 0) first_loss = lv;
+    last_loss = lv;
+    CHECK(mxtpu_backward(loss) == 0, "backward");
+    mxtpu_ndarray_free(loss);
+    w1 = sgd(w1, lr);
+    b1 = sgd(b1, lr);
+    w2 = sgd(w2, lr);
+    b2 = sgd(b2, lr);
+    CHECK(w1 && b1 && w2 && b2, "sgd updates");
+  }
+  printf("c_api mlp train: loss %.5f -> %.5f over 30 steps\n", first_loss,
+         last_loss);
+  CHECK(first_loss > 0.0f, "initial loss positive");
+  CHECK(last_loss < 0.5f * first_loss, "loss halves under C-driven SGD");
+
+  mxtpu_ndarray_free(x);
+  mxtpu_ndarray_free(y);
+  mxtpu_ndarray_free(w1);
+  mxtpu_ndarray_free(b1);
+  mxtpu_ndarray_free(w2);
+  mxtpu_ndarray_free(b2);
   mxtpu_ndarray_free(sum);
   mxtpu_ndarray_free(summed);
   mxtpu_ndarray_free(prod);
   mxtpu_ndarray_free(a);
   mxtpu_ndarray_free(b);
   mxtpu_ndarray_free(bt);
+  mxtpu_ndarray_free(i32);
+  mxtpu_ndarray_free(u8);
+  mxtpu_ndarray_free(bf);
   mxtpu_shutdown();
   printf("c_api smoke: all checks passed\n");
   return 0;

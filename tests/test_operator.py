@@ -1039,3 +1039,10 @@ def test_multi_sgd_update_matches_singles():
     np.testing.assert_allclose(m2.asnumpy(),
                                m32.asnumpy() - 0.1 * g16.astype("float32").asnumpy(),
                                rtol=1e-2, atol=1e-2)
+    # lrs/wds are required (the reference op has no defaults); omitting
+    # them must raise a CLEAR error, and length mismatches are caught
+    with pytest.raises(ValueError, match="requires lrs"):
+        invoke("multi_sgd_update", ws[0], gs[0], num_weights=1)
+    with pytest.raises(ValueError, match="lrs has 2 entries"):
+        invoke("multi_sgd_update", *interleaved, lrs=[0.1, 0.2], wds=0.0,
+               num_weights=3)
