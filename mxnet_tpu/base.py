@@ -107,14 +107,19 @@ def load_native_lib(so_name: str, source_cc: str):
 
     pkg = os.path.dirname(os.path.abspath(__file__))
     path = os.path.join(pkg, "_lib", so_name)
-    if not os.path.exists(path):
-        src = os.path.join(os.path.dirname(pkg), "src")
-        if os.path.exists(os.path.join(src, source_cc)):
-            try:
-                subprocess.run(["make", "-C", src], capture_output=True,
-                               timeout=120, check=False)
-            except Exception:
-                pass
+    src = os.path.join(os.path.dirname(pkg), "src")
+    cc_path = os.path.join(src, source_cc)
+    stale = False
+    if os.path.exists(path) and os.path.exists(cc_path):
+        # rebuild when the source outran the artifact — a stale .so from
+        # before an ABI extension would otherwise fail at symbol lookup
+        stale = os.path.getmtime(cc_path) > os.path.getmtime(path)
+    if (not os.path.exists(path) or stale) and os.path.exists(cc_path):
+        try:
+            subprocess.run(["make", "-C", src], capture_output=True,
+                           timeout=120, check=False)
+        except Exception:
+            pass
     lib = None
     if os.path.exists(path):
         try:

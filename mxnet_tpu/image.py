@@ -199,6 +199,190 @@ class RandomCropAug(Augmenter):
         return random_crop(src, self._size, self._interp, self._rng)[0]
 
 
+class RandomSizedCropAug(Augmenter):
+    """Random-area/aspect crop resized to ``size`` (ref: image.py
+    RandomSizedCropAug; the Inception-style crop).  Single draw + clamp
+    instead of the reference's retry loop, matching the native decoder's
+    deterministic draw count (src/image_decode.cc process_one)."""
+
+    def __init__(self, size, area, ratio, interp=1, rng=None):
+        super().__init__(size=size, area=area, ratio=ratio)
+        self._size = size          # (w, h)
+        self._area = area if isinstance(area, (tuple, list)) else (area, 1.0)
+        self._ratio = ratio
+        self._interp = interp
+        self._rng = rng or np.random
+
+    def __call__(self, src):
+        img = _to_np(src)
+        h, w = img.shape[:2]
+        ua, ur = self._rng.rand(), self._rng.rand()
+        target = (self._area[0] + ua * (self._area[1] - self._area[0])) * h * w
+        lo, hi = np.log(self._ratio[0]), np.log(self._ratio[1])
+        ratio = float(np.exp(lo + ur * (hi - lo)))
+        cw = int(round(np.sqrt(target * ratio)))
+        ch = int(round(np.sqrt(target / ratio)))
+        cw, ch = max(1, min(cw, w)), max(1, min(ch, h))
+        x0 = int(self._rng.randint(0, w - cw + 1))
+        y0 = int(self._rng.randint(0, h - ch + 1))
+        crop = img[y0:y0 + ch, x0:x0 + cw]
+        return imresize(nd.array(crop), self._size[0], self._size[1],
+                        self._interp)
+
+
+# Pure-numpy jitter kernels — the single python implementation of the
+# color math, shared by the Augmenter classes below and the io.py
+# fallback chain (the native twin is src/image_decode.cc color_chain,
+# bit-level-checked by tests/test_image_native_aug.py).
+_GRAY_COEF = np.array([0.299, 0.587, 0.114], np.float32)
+_TYIQ = np.array([[0.299, 0.587, 0.114],
+                  [0.596, -0.274, -0.321],
+                  [0.211, -0.523, 0.311]], np.float32)
+_ITYIQ = np.array([[1.0, 0.956, 0.621],
+                   [1.0, -0.272, -0.647],
+                   [1.0, -1.107, 1.705]], np.float32)
+
+
+def jitter_brightness(x, alpha):
+    """x * alpha (x: HWC float32)."""
+    return x * np.float32(alpha)
+
+
+def jitter_contrast(x, alpha):
+    """Blend with the image's mean gray level."""
+    alpha = np.float32(alpha)
+    per_px = (x * _GRAY_COEF).sum(-1, dtype=np.float32)
+    gray = np.float32(per_px.sum(dtype=np.float64) / per_px.size) \
+        * (np.float32(1) - alpha)
+    return alpha * x + gray
+
+
+def jitter_saturation(x, alpha):
+    """Blend each pixel with its own gray value."""
+    alpha = np.float32(alpha)
+    gray = (x * _GRAY_COEF).sum(-1, keepdims=True, dtype=np.float32) \
+        * (np.float32(1) - alpha)
+    return alpha * x + gray
+
+
+def jitter_hue(x, alpha):
+    """YIQ-rotation hue shift ("Gil's method"; pure RGB matrix math)."""
+    u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+    bt = np.array([[1, 0, 0], [0, u, -w], [0, w, u]], np.float32)
+    t = (_ITYIQ @ bt @ _TYIQ).T.astype(np.float32)
+    return x @ t
+
+
+def pca_lighting(x, alpha3, eigval=None, eigvec=None):
+    """AlexNet-style PCA lighting shift; alpha3: 3 gaussian draws."""
+    ev = IMAGENET_EIGVAL if eigval is None else np.asarray(eigval, np.float32)
+    evec = IMAGENET_EIGVEC if eigvec is None \
+        else np.asarray(eigvec, np.float32)
+    return x + (evec * np.asarray(alpha3, np.float32)) @ ev
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= alpha, alpha ~ U[1-b, 1+b] (ref: image.py
+    BrightnessJitterAug)."""
+
+    def __init__(self, brightness, rng=None):
+        super().__init__(brightness=brightness)
+        self._b = brightness
+        self._rng = rng or np.random
+
+    def __call__(self, src):
+        alpha = 1.0 + (2.0 * self._rng.rand() - 1.0) * self._b
+        return nd.array(jitter_brightness(
+            _to_np(src).astype(np.float32), alpha))
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the mean gray level (ref: image.py ContrastJitterAug)."""
+
+    def __init__(self, contrast, rng=None):
+        super().__init__(contrast=contrast)
+        self._c = contrast
+        self._rng = rng or np.random
+
+    def __call__(self, src):
+        alpha = 1.0 + (2.0 * self._rng.rand() - 1.0) * self._c
+        return nd.array(jitter_contrast(
+            _to_np(src).astype(np.float32), alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend each pixel with its own gray value (ref: image.py
+    SaturationJitterAug)."""
+
+    def __init__(self, saturation, rng=None):
+        super().__init__(saturation=saturation)
+        self._s = saturation
+        self._rng = rng or np.random
+
+    def __call__(self, src):
+        alpha = 1.0 + (2.0 * self._rng.rand() - 1.0) * self._s
+        return nd.array(jitter_saturation(
+            _to_np(src).astype(np.float32), alpha))
+
+
+class HueJitterAug(Augmenter):
+    """YIQ-rotation hue shift, alpha ~ U[-h, h] (ref: image.py
+    HueJitterAug — "Gil's method")."""
+
+    def __init__(self, hue, rng=None):
+        super().__init__(hue=hue)
+        self._h = hue
+        self._rng = rng or np.random
+
+    def __call__(self, src):
+        alpha = (2.0 * self._rng.rand() - 1.0) * self._h
+        return nd.array(jitter_hue(_to_np(src).astype(np.float32), alpha))
+
+
+class ColorJitterAug(Augmenter):
+    """Brightness+contrast+saturation in that fixed order (ref: image.py
+    ColorJitterAug — the reference applies them in a random order; the
+    fixed order here matches the native decoder so seeded runs agree)."""
+
+    def __init__(self, brightness, contrast, saturation, rng=None):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        self._augs = [a for a in (
+            BrightnessJitterAug(brightness, rng) if brightness > 0 else None,
+            ContrastJitterAug(contrast, rng) if contrast > 0 else None,
+            SaturationJitterAug(saturation, rng) if saturation > 0 else None)
+            if a is not None]
+
+    def __call__(self, src):
+        for a in self._augs:
+            src = a(src)
+        return src
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise (ref: image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec, rng=None):
+        super().__init__(alphastd=alphastd)
+        self._std = alphastd
+        self._eigval = np.asarray(eigval, np.float32)
+        self._eigvec = np.asarray(eigvec, np.float32)
+        self._rng = rng or np.random
+
+    def __call__(self, src):
+        alpha = self._rng.normal(0, self._std, size=(3,)).astype(np.float32)
+        return nd.array(pca_lighting(_to_np(src).astype(np.float32), alpha,
+                                     self._eigval, self._eigvec))
+
+
+# ImageNet PCA basis (RGB 0-255) — the standard AlexNet lighting values
+# (kept identical to src/image_decode.cc kEigval/kEigvec).
+IMAGENET_EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+IMAGENET_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]], np.float32)
+
+
 class CenterCropAug(Augmenter):
     def __init__(self, size, interp=1):
         super().__init__(size=size)
@@ -208,20 +392,40 @@ class CenterCropAug(Augmenter):
         return center_crop(src, self._size, self._interp)[0]
 
 
-def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_mirror=False,
-                    mean=None, std=None, **kwargs):
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    min_random_area=0.08, max_random_area=1.0,
+                    min_aspect_ratio=3.0 / 4.0, max_aspect_ratio=4.0 / 3.0,
+                    **kwargs):
     """Standard augmenter list (ref: CreateAugmenter; unsupported reference
-    options are accepted and ignored, matching its permissive kwargs)."""
+    options are accepted and ignored, matching its permissive kwargs).
+    Augmenter order matches the native decoder's fixed chain
+    (src/image_decode.cc): geometry -> mirror -> brightness -> contrast ->
+    saturation -> hue -> pca lighting -> cast -> normalize."""
     auglist = []
-    if resize > 0:
-        auglist.append(ResizeAug(resize))
     crop = (data_shape[2], data_shape[1])
-    if rand_crop:
-        auglist.append(RandomCropAug(crop))
+    if rand_resize:
+        assert rand_crop, "rand_resize requires rand_crop"
+        auglist.append(RandomSizedCropAug(
+            crop, (min_random_area, max_random_area),
+            (min_aspect_ratio, max_aspect_ratio)))
     else:
-        auglist.append(CenterCropAug(crop))
+        if resize > 0:
+            auglist.append(ResizeAug(resize))
+        if rand_crop:
+            auglist.append(RandomCropAug(crop))
+        else:
+            auglist.append(CenterCropAug(crop))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise:
+        auglist.append(LightingAug(pca_noise, IMAGENET_EIGVAL,
+                                   IMAGENET_EIGVEC))
     auglist.append(CastAug())
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53], np.float32)

@@ -217,6 +217,65 @@ class CSVIter(DataIter):
         return self._it.provide_label
 
 
+class AugSpec:
+    """Batch-wide augmentation amplitudes shared by the native decoder
+    (src/image_decode.cc AugParams — keep the float layout in sync) and
+    the python fallback chain (_color_chain_np)."""
+
+    __slots__ = ("rrc", "min_area", "max_area", "min_aspect", "max_aspect",
+                 "brightness", "contrast", "saturation", "hue", "pca_noise")
+
+    def __init__(self, rrc=False, min_area=1.0, max_area=1.0,
+                 min_aspect=1.0, max_aspect=1.0, brightness=0.0,
+                 contrast=0.0, saturation=0.0, hue=0.0, pca_noise=0.0):
+        self.rrc = rrc
+        self.min_area, self.max_area = min_area, max_area
+        self.min_aspect, self.max_aspect = min_aspect, max_aspect
+        self.brightness, self.contrast = brightness, contrast
+        self.saturation, self.hue = saturation, hue
+        self.pca_noise = pca_noise
+
+    @property
+    def any_color(self):
+        return (self.brightness > 0 or self.contrast > 0
+                or self.saturation > 0 or self.hue > 0 or self.pca_noise > 0)
+
+    @property
+    def active(self):
+        return self.rrc or self.any_color
+
+    def to_array(self):
+        return np.array([1.0 if self.rrc else 0.0, self.min_area,
+                         self.max_area, self.min_aspect, self.max_aspect,
+                         self.brightness, self.contrast, self.saturation,
+                         self.hue, self.pca_noise], np.float32)
+
+
+def _color_chain_np(x, aug, rng):
+    """Python twin of src/image_decode.cc color_chain: brightness ->
+    contrast -> saturation -> hue -> pca lighting on HWC float32 0-255.
+    The math lives once, in image.py's jitter_* kernels; this only draws
+    the per-image alphas (from ``rng``, a RandomState, rather than the
+    native per-image xorshift — the bit-level oracle lives in
+    tests/test_image_native_aug.py)."""
+    from . import image as img_mod
+    if aug.brightness > 0:
+        x = img_mod.jitter_brightness(
+            x, 1 + (2 * rng.rand() - 1) * aug.brightness)
+    if aug.contrast > 0:
+        x = img_mod.jitter_contrast(
+            x, 1 + (2 * rng.rand() - 1) * aug.contrast)
+    if aug.saturation > 0:
+        x = img_mod.jitter_saturation(
+            x, 1 + (2 * rng.rand() - 1) * aug.saturation)
+    if aug.hue > 0:
+        x = img_mod.jitter_hue(x, (2 * rng.rand() - 1) * aug.hue)
+    if aug.pca_noise > 0:
+        x = img_mod.pca_lighting(
+            x, rng.normal(0, aug.pca_noise, size=(3,)).astype(np.float32))
+    return x
+
+
 def _native_decoder():
     """Load src/image_decode.cc's batch JPEG pipeline (decode threads of
     the reference's iter_image_recordio_2.cc), auto-building like every
@@ -245,7 +304,12 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, resize=-1, preprocess_threads=0, seed=0,
                  round_batch=True, label_width=1, use_native_decode=None,
-                 num_parts=1, part_index=0, **kwargs):
+                 num_parts=1, part_index=0,
+                 random_resized_crop=False, min_random_area=1.0,
+                 max_random_area=1.0, min_aspect_ratio=None,
+                 max_aspect_ratio=0.0, random_h=0, random_s=0, random_l=0,
+                 max_random_contrast=0.0, max_random_illumination=0.0,
+                 pca_noise=0.0, **kwargs):
         super().__init__(batch_size)
         _IGNORED_OK = {"prefetch_buffer", "data_name", "label_name",
                        "verify_decode",
@@ -284,6 +348,30 @@ class ImageRecordIter(DataIter):
         self._rand_crop = rand_crop
         self._rand_mirror = rand_mirror
         self._resize = resize
+        # Color/geometry augmentation amplitudes (ref: image_aug_default.cc
+        # DefaultImageAugmentParam).  HSL knob mapping onto the RGB-space
+        # jitter chain: random_h (degrees, 0-180) -> hue amplitude h/180;
+        # random_s (0-255) -> saturation s/255; random_l and
+        # max_random_illumination (0-255) -> brightness factor l/255.
+        if random_resized_crop:
+            if not rand_crop:
+                rand_crop = self._rand_crop = True
+            if min_aspect_ratio is None:
+                min_aspect_ratio = (1.0 / max_aspect_ratio
+                                    if max_aspect_ratio > 1.0 else 3.0 / 4.0)
+            if max_aspect_ratio <= 0:
+                max_aspect_ratio = 4.0 / 3.0
+        self._aug = AugSpec(
+            rrc=bool(random_resized_crop),
+            min_area=float(min_random_area), max_area=float(max_random_area),
+            min_aspect=float(min_aspect_ratio or 1.0),
+            max_aspect=float(max_aspect_ratio or 1.0),
+            brightness=max(float(random_l) / 255.0,
+                           float(max_random_illumination) / 255.0),
+            contrast=float(max_random_contrast),
+            saturation=float(random_s) / 255.0,
+            hue=float(random_h) / 180.0,
+            pca_noise=float(pca_noise))
         c = self._shape[0]
         self._mean = np.array([mean_r, mean_g, mean_b][:c] or [mean_r],
                               np.float32)
@@ -329,7 +417,8 @@ class ImageRecordIter(DataIter):
     def _augment(self, img, rng=None):
         return _augment_img(img, self._shape, self._resize, self._rand_crop,
                             self._rand_mirror, self._mean, self._std,
-                            rng if rng is not None else self._rng)
+                            rng if rng is not None else self._rng,
+                            aug=self._aug)
 
     def _native_batch(self, keys, rng):
         """Whole-batch decode through src/image_decode.cc: JPEG records in
@@ -354,7 +443,7 @@ class ImageRecordIter(DataIter):
                 img = recordio.img_from_payload(payload, iscolor=1)
                 out[i] = _crop_aug_u8(img, self._shape, self._resize,
                                       self._rand_crop, self._rand_mirror,
-                                      rng)
+                                      rng, aug=self._aug)
         if jpeg_idx:
             lib = self._native
             m = len(blobs)
@@ -371,8 +460,11 @@ class ImageRecordIter(DataIter):
                 *[int(s) for s in rng.randint(1, 2 ** 31, size=m)])
             dec = np.empty((m, c, h, w), np.uint8)
             ok = np.empty((m,), np.uint8)
-            lib.mxtpu_decode_batch(
+            aug_arr = self._aug.to_array() if self._aug.active else None
+            lib.mxtpu_decode_batch_aug(
                 ptrs, sizes, m, h, w, self._resize, cx, cy, mir, seeds,
+                None if aug_arr is None else
+                aug_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
                 dec.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                 ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
                 self._nthreads)
@@ -385,7 +477,8 @@ class ImageRecordIter(DataIter):
                     img = recordio.img_from_payload(blobs[j], iscolor=1)
                     out[i] = _crop_aug_u8(img, self._shape, self._resize,
                                           self._rand_crop,
-                                          self._rand_mirror, rng)
+                                          self._rand_mirror, rng,
+                                          aug=self._aug)
         return headers, out
 
     def _drain_pending(self):
@@ -462,9 +555,11 @@ class ImageRecordIter(DataIter):
         assembles.  Per-item seeds keep augmentation deterministic."""
         iscolor = 0 if self._shape[0] == 1 else 1
         seeds = self._rng.randint(0, 2 ** 31, size=len(keys))
+        aug_arr = tuple(float(v) for v in self._aug.to_array()) \
+            if self._aug.active else None
         args = [(self._idx_path, self._rec_path, k, iscolor, self._shape,
                  self._resize, self._rand_crop, self._rand_mirror,
-                 int(s)) for k, s in zip(keys, seeds)]
+                 int(s), aug_arr) for k, s in zip(keys, seeds)]
         return self._pool.map_async(_decode_augment_one, args)
 
     def next(self):
@@ -561,44 +656,63 @@ class ImageRecordIter(DataIter):
 _worker_rec = {}
 
 
-def _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror, rng):
-    """resize-short → crop → mirror → CHW **uint8** (ref:
-    image_aug_default.cc DefaultImageAugmenter).  Stays uint8 so the
-    worker→parent IPC ships 4× fewer bytes; the float conversion +
-    mean/std normalisation runs vectorised over the whole batch in the
-    parent (one SIMD pass into the pooled buffer)."""
+def _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror, rng, aug=None):
+    """resize-short → crop (or random-area/aspect crop) → mirror → color
+    jitter chain → CHW **uint8** (ref: image_aug_default.cc
+    DefaultImageAugmenter).  Stays uint8 so the worker→parent IPC ships
+    4× fewer bytes; the float conversion + mean/std normalisation runs
+    vectorised over the whole batch in the parent (one SIMD pass into
+    the pooled buffer)."""
     from PIL import Image
     c, h, w = shape
-    if resize > 0:
-        im = Image.fromarray(img)
-        short = min(im.size)
-        scale = resize / short
-        im = im.resize((max(1, round(im.size[0] * scale)),
-                        max(1, round(im.size[1] * scale))))
-        img = np.asarray(im)
-    ih, iw = img.shape[:2]
-    if ih < h or iw < w:
-        im = Image.fromarray(img).resize((max(w, iw), max(h, ih)))
-        img = np.asarray(im)
+    if aug is not None and aug.rrc:
         ih, iw = img.shape[:2]
-    if rand_crop:
-        y0 = rng.randint(0, ih - h + 1)
-        x0 = rng.randint(0, iw - w + 1)
+        ua, ur = rng.rand(), rng.rand()
+        target = (aug.min_area + ua * (aug.max_area - aug.min_area)) * ih * iw
+        lo, hi = np.log(aug.min_aspect), np.log(aug.max_aspect)
+        ratio = float(np.exp(lo + ur * (hi - lo)))
+        cw = max(1, min(int(round(np.sqrt(target * ratio))), iw))
+        ch = max(1, min(int(round(np.sqrt(target / ratio))), ih))
+        x0 = int(rng.randint(0, iw - cw + 1))
+        y0 = int(rng.randint(0, ih - ch + 1))
+        img = np.asarray(Image.fromarray(img[y0:y0 + ch, x0:x0 + cw])
+                         .resize((w, h)))
     else:
-        y0, x0 = (ih - h) // 2, (iw - w) // 2
-    img = img[y0:y0 + h, x0:x0 + w]
+        if resize > 0:
+            im = Image.fromarray(img)
+            short = min(im.size)
+            scale = resize / short
+            im = im.resize((max(1, round(im.size[0] * scale)),
+                            max(1, round(im.size[1] * scale))))
+            img = np.asarray(im)
+        ih, iw = img.shape[:2]
+        if ih < h or iw < w:
+            im = Image.fromarray(img).resize((max(w, iw), max(h, ih)))
+            img = np.asarray(im)
+            ih, iw = img.shape[:2]
+        if rand_crop:
+            y0 = rng.randint(0, ih - h + 1)
+            x0 = rng.randint(0, iw - w + 1)
+        else:
+            y0, x0 = (ih - h) // 2, (iw - w) // 2
+        img = img[y0:y0 + h, x0:x0 + w]
     if rand_mirror and rng.rand() < 0.5:
         img = img[:, ::-1]
     if img.ndim == 2:
         img = np.stack([img] * c, axis=-1)
+    if aug is not None and aug.any_color and img.ndim == 3 \
+            and img.shape[-1] == 3:
+        x = _color_chain_np(img.astype(np.float32), aug, rng)
+        img = np.clip(x, 0, 255).astype(np.uint8)
     return np.ascontiguousarray(img.transpose(2, 0, 1))  # CHW uint8
 
 
 def _augment_img(img, shape, resize, rand_crop, rand_mirror, mean, std,
-                 rng):
+                 rng, aug=None):
     """Full per-image pipeline incl. normalisation → CHW float32 (the
     single-process path)."""
-    chw = _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror, rng)
+    chw = _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror, rng,
+                       aug=aug)
     mean = np.asarray(mean, np.float32).reshape(-1, 1, 1)
     std = np.asarray(std, np.float32).reshape(-1, 1, 1)
     return (chw.astype(np.float32) - mean) / std
@@ -611,14 +725,18 @@ def _decode_augment_one(args):
     own reader lazily (fds don't survive fork safely for concurrent
     seeks)."""
     (idx_path, rec_path, key, iscolor, shape, resize, rand_crop,
-     rand_mirror, seed) = args
+     rand_mirror, seed, aug_arr) = args
     rec = _worker_rec.get(rec_path)
     if rec is None:
         rec = recordio.MXIndexedRecordIO(idx_path, rec_path, "r")
         _worker_rec[rec_path] = rec
     header, img = recordio.unpack_img(rec.read_idx(key), iscolor=iscolor)
+    aug = None
+    if aug_arr is not None:
+        a = list(aug_arr)
+        aug = AugSpec(bool(a[0]), *a[1:])
     chw = _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror,
-                       np.random.RandomState(seed))
+                       np.random.RandomState(seed), aug=aug)
     return header, chw
 
 

@@ -98,8 +98,7 @@ struct DtypeInfo {
 
 const DtypeInfo kDtypes[] = {
     {"float32", 4, true},   {"float16", 2, true}, {"bfloat16", 2, false},
-    {"int32", 4, true},     {"int64", 8, true},   {"uint8", 1, true},
-    {"int8", 1, true},
+    {"int32", 4, true},     {"uint8", 1, true},   {"int8", 1, true},
 };
 
 const DtypeInfo *lookup_dtype(const char *dtype) {
@@ -133,14 +132,15 @@ void *mxtpu_ndarray_create_dtype(const void *data, const long *shape,
   }
   const DtypeInfo *info = lookup_dtype(dtype != nullptr ? dtype : "float32");
   if (info == nullptr) {
-    // float64 deliberately absent: the runtime computes in 32-bit (the
-    // TPU has no f64 datapath; jax x64 mode is off framework-wide), and
-    // silently storing f32 under an f64 label would corrupt round-trips.
+    // float64/int64 deliberately absent: the runtime computes in 32-bit
+    // (the TPU has no f64 datapath; jax x64 mode is off framework-wide),
+    // and silently storing a 32-bit value under a 64-bit label would
+    // corrupt byte-level round-trips.
     g_last_error = std::string("unsupported dtype: ") +
                    (dtype != nullptr ? dtype : "(null)") +
-                   " (supported: float32 float16 bfloat16 int32 int64 "
-                   "uint8 int8; float64 is not a TPU dtype — convert to "
-                   "float32 host-side)";
+                   " (supported: float32 float16 bfloat16 int32 uint8 "
+                   "int8; 64-bit dtypes are not TPU dtypes — convert to "
+                   "the 32-bit kind host-side)";
     return nullptr;
   }
   Gil gil;

@@ -112,57 +112,271 @@ uint32_t xorshift(uint32_t *s) {
   return *s = x;
 }
 
-// One record: decode -> resize-short -> crop(out_h,out_w at cx,cy;
-// -1 = center, -2 = seeded random) -> mirror (0/1; 2 = seeded coin)
-// -> CHW into out.
+// Uniform in (0, 1): 24-bit mantissa, exactly representable in f32 and
+// never 0 (safe under logf for Box-Muller).
+float u01(uint32_t *s) {
+  return ((xorshift(s) >> 8) + 0.5f) * (1.0f / 16777216.0f);
+}
+
+// Augmentation amplitudes, batch-wide (ref: image_aug_default.cc —
+// DefaultImageAugmentParam; python image.py CreateAugmenter).  All the
+// per-image randomness still comes from the per-image seed, so results
+// are reproducible record-by-record.  Layout matches kAugLen floats
+// handed through the C ABI:
+//   [0] random_resized_crop (0/1)   [1] min_area  [2] max_area
+//   [3] min_aspect  [4] max_aspect
+//   [5] brightness  [6] contrast  [7] saturation  [8] hue
+//   [9] pca_noise stddev
+constexpr int kAugLen = 10;
+
+struct AugParams {
+  bool rrc = false;
+  float min_area = 1.0f, max_area = 1.0f;
+  float min_aspect = 1.0f, max_aspect = 1.0f;
+  float brightness = 0.0f, contrast = 0.0f, saturation = 0.0f, hue = 0.0f;
+  float pca_noise = 0.0f;
+
+  static AugParams from(const float *a) {
+    AugParams p;
+    if (a == nullptr) return p;
+    p.rrc = a[0] != 0.0f;
+    p.min_area = a[1];
+    p.max_area = a[2];
+    p.min_aspect = a[3];
+    p.max_aspect = a[4];
+    p.brightness = a[5];
+    p.contrast = a[6];
+    p.saturation = a[7];
+    p.hue = a[8];
+    p.pca_noise = a[9];
+    return p;
+  }
+
+  bool any_color() const {
+    return brightness > 0 || contrast > 0 || saturation > 0 || hue > 0 ||
+           pca_noise > 0;
+  }
+};
+
+// ImageNet PCA basis (RGB, 0-255 scale) — the standard AlexNet lighting
+// values every framework ships (ref: python image.py LightingAug
+// defaults in example scripts).
+const float kEigval[3] = {55.46f, 4.794f, 1.148f};
+const float kEigvec[3][3] = {{-0.5675f, 0.7192f, 0.4009f},
+                             {-0.5808f, -0.0045f, -0.8140f},
+                             {-0.5836f, -0.6948f, 0.4203f}};
+
+// Color jitter chain on the cropped float RGB image.  Identical math to
+// the python oracle in tests/test_image_native_aug.py — keep in sync.
+// Draw order: brightness, contrast, saturation, hue, pca (each draw
+// SKIPPED when its amplitude is 0 so disabled augs leave the stream
+// untouched).
+void color_chain(float *px, int n_px, const AugParams &p, uint32_t *rng) {
+  if (p.brightness > 0) {
+    float ab = 1.0f + (2.0f * u01(rng) - 1.0f) * p.brightness;
+    for (int i = 0; i < n_px * 3; ++i) px[i] *= ab;
+  }
+  if (p.contrast > 0) {
+    float ac = 1.0f + (2.0f * u01(rng) - 1.0f) * p.contrast;
+    double acc = 0.0;  // f64 accumulator: n_px*255 overflows f32 mantissa
+    for (int i = 0; i < n_px; ++i) {
+      acc += 0.299f * px[i * 3] + 0.587f * px[i * 3 + 1] +
+             0.114f * px[i * 3 + 2];
+    }
+    float gray = static_cast<float>(acc / n_px) * (1.0f - ac);
+    for (int i = 0; i < n_px * 3; ++i) px[i] = ac * px[i] + gray;
+  }
+  if (p.saturation > 0) {
+    float as = 1.0f + (2.0f * u01(rng) - 1.0f) * p.saturation;
+    for (int i = 0; i < n_px; ++i) {
+      float g = (0.299f * px[i * 3] + 0.587f * px[i * 3 + 1] +
+                 0.114f * px[i * 3 + 2]) * (1.0f - as);
+      px[i * 3] = as * px[i * 3] + g;
+      px[i * 3 + 1] = as * px[i * 3 + 1] + g;
+      px[i * 3 + 2] = as * px[i * 3 + 2] + g;
+    }
+  }
+  if (p.hue > 0) {
+    // YIQ-rotation hue shift (ref: python image.py HueJitterAug —
+    // "Gil's method"; pure RGB matrix math, no HSV round-trip)
+    float alpha = (2.0f * u01(rng) - 1.0f) * p.hue;
+    float cu = std::cos(alpha * static_cast<float>(M_PI));
+    float sw = std::sin(alpha * static_cast<float>(M_PI));
+    const float tyiq[3][3] = {{0.299f, 0.587f, 0.114f},
+                              {0.596f, -0.274f, -0.321f},
+                              {0.211f, -0.523f, 0.311f}};
+    const float ityiq[3][3] = {{1.0f, 0.956f, 0.621f},
+                               {1.0f, -0.272f, -0.647f},
+                               {1.0f, -1.107f, 1.705f}};
+    const float bt[3][3] = {{1, 0, 0}, {0, cu, -sw}, {0, sw, cu}};
+    float ib[3][3], t[3][3];
+    for (int r = 0; r < 3; ++r) {
+      for (int c = 0; c < 3; ++c) {
+        ib[r][c] = ityiq[r][0] * bt[0][c] + ityiq[r][1] * bt[1][c] +
+                   ityiq[r][2] * bt[2][c];
+      }
+    }
+    for (int r = 0; r < 3; ++r) {
+      for (int c = 0; c < 3; ++c) {
+        t[r][c] = ib[r][0] * tyiq[0][c] + ib[r][1] * tyiq[1][c] +
+                  ib[r][2] * tyiq[2][c];
+      }
+    }
+    for (int i = 0; i < n_px; ++i) {
+      float r = px[i * 3], g = px[i * 3 + 1], b = px[i * 3 + 2];
+      // src · t^T  (row-vector convention of the python augmenter)
+      px[i * 3] = r * t[0][0] + g * t[0][1] + b * t[0][2];
+      px[i * 3 + 1] = r * t[1][0] + g * t[1][1] + b * t[1][2];
+      px[i * 3 + 2] = r * t[2][0] + g * t[2][1] + b * t[2][2];
+    }
+  }
+  if (p.pca_noise > 0) {
+    // Box-Muller, 4 uniforms -> 3 gaussians (fixed draw count)
+    float su1 = u01(rng), su2 = u01(rng), su3 = u01(rng), su4 = u01(rng);
+    float r1 = std::sqrt(-2.0f * std::log(su1));
+    float z0 = r1 * std::cos(2.0f * static_cast<float>(M_PI) * su2);
+    float z1 = r1 * std::sin(2.0f * static_cast<float>(M_PI) * su2);
+    float z2 = std::sqrt(-2.0f * std::log(su3)) *
+               std::cos(2.0f * static_cast<float>(M_PI) * su4);
+    float alpha[3] = {z0 * p.pca_noise, z1 * p.pca_noise,
+                      z2 * p.pca_noise};
+    float shift[3];
+    for (int c = 0; c < 3; ++c) {
+      shift[c] = kEigvec[c][0] * alpha[0] * kEigval[0] +
+                 kEigvec[c][1] * alpha[1] * kEigval[1] +
+                 kEigvec[c][2] * alpha[2] * kEigval[2];
+    }
+    for (int i = 0; i < n_px; ++i) {
+      px[i * 3] += shift[0];
+      px[i * 3 + 1] += shift[1];
+      px[i * 3 + 2] += shift[2];
+    }
+  }
+}
+
+// One record: decode -> geometry (resize-short+crop, or random-area/
+// aspect crop when aug.rrc) -> mirror -> color jitter chain -> CHW.
+// cx/cy: -1 = center, -2 = seeded random; mirror: 0/1, 2 = seeded coin.
 bool process_one(const uint8_t *blob, long size, int out_h, int out_w,
                  int resize, int cx, int cy, int mirror, uint32_t seed,
-                 uint8_t *out) {
+                 const AugParams &aug, uint8_t *out) {
   uint32_t rng = seed != 0 ? seed : 1u;
   std::vector<uint8_t> rgb;
   int w = 0, h = 0;
-  if (!decode_jpeg(blob, size, resize > 0 ? resize : 0, &rgb, &w, &h)) {
+  // rrc must see the full-resolution image (its crop IS the rescale)
+  int prescale = (!aug.rrc && resize > 0) ? resize : 0;
+  if (!decode_jpeg(blob, size, prescale, &rgb, &w, &h)) {
     return false;
   }
   std::vector<uint8_t> resized;
-  if (resize > 0 && std::min(w, h) != resize) {
-    int nw, nh;
-    if (w < h) {
-      nw = resize;
-      nh = static_cast<int>(static_cast<int64_t>(h) * resize / w);
-    } else {
-      nh = resize;
-      nw = static_cast<int>(static_cast<int64_t>(w) * resize / h);
+  std::vector<uint8_t> cropbuf;
+  int crop_w = out_w, crop_h = out_h;
+  if (aug.rrc) {
+    // Single-draw random-area/aspect crop (ref: image_aug_default.cc
+    // random_resized_crop; one draw + clamp instead of the reference's
+    // retry loop — deterministic draw count keeps seeds replayable)
+    float ua = u01(&rng), ur = u01(&rng);
+    float area = static_cast<float>(w) * static_cast<float>(h);
+    float target = (aug.min_area + ua * (aug.max_area - aug.min_area)) * area;
+    float lr = std::log(aug.min_aspect) +
+               ur * (std::log(aug.max_aspect) - std::log(aug.min_aspect));
+    float ratio = std::exp(lr);
+    crop_w = static_cast<int>(std::lround(std::sqrt(target * ratio)));
+    crop_h = static_cast<int>(std::lround(std::sqrt(target / ratio)));
+    if (crop_w > w) crop_w = w;
+    if (crop_h > h) crop_h = h;
+    if (crop_w < 1) crop_w = 1;
+    if (crop_h < 1) crop_h = 1;
+    cx = static_cast<int>(xorshift(&rng) % (w - crop_w + 1));
+    cy = static_cast<int>(xorshift(&rng) % (h - crop_h + 1));
+  } else {
+    if (resize > 0 && std::min(w, h) != resize) {
+      int nw, nh;
+      if (w < h) {
+        nw = resize;
+        nh = static_cast<int>(static_cast<int64_t>(h) * resize / w);
+      } else {
+        nh = resize;
+        nw = static_cast<int>(static_cast<int64_t>(w) * resize / h);
+      }
+      resized.resize(static_cast<size_t>(nw) * nh * 3);
+      resize_bilinear(rgb.data(), w, h, resized.data(), nw, nh);
+      rgb.swap(resized);
+      w = nw;
+      h = nh;
     }
-    resized.resize(static_cast<size_t>(nw) * nh * 3);
-    resize_bilinear(rgb.data(), w, h, resized.data(), nw, nh);
-    rgb.swap(resized);
-    w = nw;
-    h = nh;
+    if (w < out_w || h < out_h) {  // upscale to cover the crop
+      int nw = std::max(w, out_w), nh = std::max(h, out_h);
+      resized.resize(static_cast<size_t>(nw) * nh * 3);
+      resize_bilinear(rgb.data(), w, h, resized.data(), nw, nh);
+      rgb.swap(resized);
+      w = nw;
+      h = nh;
+    }
+    if (cx == -2) cx = static_cast<int>(xorshift(&rng) % (w - out_w + 1));
+    if (cy == -2) cy = static_cast<int>(xorshift(&rng) % (h - out_h + 1));
+    if (cx < 0) cx = (w - out_w) / 2;
+    if (cy < 0) cy = (h - out_h) / 2;
+    cx = std::min(std::max(cx, 0), w - out_w);
+    cy = std::min(std::max(cy, 0), h - out_h);
   }
-  if (w < out_w || h < out_h) {  // upscale to cover the crop
-    int nw = std::max(w, out_w), nh = std::max(h, out_h);
-    resized.resize(static_cast<size_t>(nw) * nh * 3);
-    resize_bilinear(rgb.data(), w, h, resized.data(), nw, nh);
-    rgb.swap(resized);
-    w = nw;
-    h = nh;
-  }
-  if (cx == -2) cx = static_cast<int>(xorshift(&rng) % (w - out_w + 1));
-  if (cy == -2) cy = static_cast<int>(xorshift(&rng) % (h - out_h + 1));
-  if (cx < 0) cx = (w - out_w) / 2;
-  if (cy < 0) cy = (h - out_h) / 2;
   if (mirror == 2) mirror = static_cast<int>(xorshift(&rng) & 1u);
-  cx = std::min(std::max(cx, 0), w - out_w);
-  cy = std::min(std::max(cy, 0), h - out_h);
+
+  const uint8_t *src = rgb.data();
+  int src_stride = w;
+  if (aug.rrc && (crop_w != out_w || crop_h != out_h)) {
+    // materialise the crop, then bilinear-resize it to the output size
+    cropbuf.resize(static_cast<size_t>(crop_w) * crop_h * 3);
+    for (int y = 0; y < crop_h; ++y) {
+      std::memcpy(cropbuf.data() + static_cast<size_t>(y) * crop_w * 3,
+                  rgb.data() + ((cy + y) * static_cast<size_t>(w) + cx) * 3,
+                  static_cast<size_t>(crop_w) * 3);
+    }
+    resized.resize(static_cast<size_t>(out_w) * out_h * 3);
+    resize_bilinear(cropbuf.data(), crop_w, crop_h, resized.data(), out_w,
+                    out_h);
+    src = resized.data();
+    src_stride = out_w;
+    cx = cy = 0;
+  }
+
   const size_t plane = static_cast<size_t>(out_h) * out_w;
+  if (!aug.any_color()) {  // fast u8 path, bit-identical to round 4
+    for (int y = 0; y < out_h; ++y) {
+      for (int x = 0; x < out_w; ++x) {
+        int sx = mirror ? (cx + out_w - 1 - x) : (cx + x);
+        const uint8_t *px = src + ((cy + y) * static_cast<size_t>(src_stride)
+                                   + sx) * 3;
+        out[0 * plane + y * out_w + x] = px[0];
+        out[1 * plane + y * out_w + x] = px[1];
+        out[2 * plane + y * out_w + x] = px[2];
+      }
+    }
+    return true;
+  }
+  // float RGB staging for the jitter chain
+  std::vector<float> fpx(static_cast<size_t>(out_h) * out_w * 3);
   for (int y = 0; y < out_h; ++y) {
     for (int x = 0; x < out_w; ++x) {
       int sx = mirror ? (cx + out_w - 1 - x) : (cx + x);
-      const uint8_t *px = rgb.data() + ((cy + y) * w + sx) * 3;
-      out[0 * plane + y * out_w + x] = px[0];
-      out[1 * plane + y * out_w + x] = px[1];
-      out[2 * plane + y * out_w + x] = px[2];
+      const uint8_t *px = src + ((cy + y) * static_cast<size_t>(src_stride)
+                                 + sx) * 3;
+      float *d = fpx.data() + (static_cast<size_t>(y) * out_w + x) * 3;
+      d[0] = px[0];
+      d[1] = px[1];
+      d[2] = px[2];
+    }
+  }
+  color_chain(fpx.data(), out_h * out_w, aug, &rng);
+  for (int y = 0; y < out_h; ++y) {
+    for (int x = 0; x < out_w; ++x) {
+      const float *d = fpx.data() + (static_cast<size_t>(y) * out_w + x) * 3;
+      for (int c = 0; c < 3; ++c) {
+        float v = d[c];
+        v = v < 0.0f ? 0.0f : (v > 255.0f ? 255.0f : v);
+        out[c * plane + y * out_w + x] =
+            static_cast<uint8_t>(v + 0.5f);
+      }
     }
   }
   return true;
@@ -178,17 +392,21 @@ int mxtpu_is_jpeg(const uint8_t *blob, long size) {
 }
 
 // Decode+augment a batch of JPEG blobs into out (n, 3, out_h, out_w)
-// uint8 CHW.  crop_x/crop_y: per-image crop origin (-1 = center);
-// mirror: per-image 0/1.  nthreads native worker threads (values < 1
-// clamp to 1).  Returns the number of successfully decoded images;
-// failed slots are zero-filled and flagged in ok[i]=0.
-int mxtpu_decode_batch(const uint8_t **blobs, const long *sizes, int n,
-                       int out_h, int out_w, int resize, const int *crop_x,
-                       const int *crop_y, const uint8_t *mirror,
-                       const uint32_t *seeds, uint8_t *out, uint8_t *ok,
-                       int nthreads) {
+// uint8 CHW.  crop_x/crop_y: per-image crop origin (-1 = center, -2 =
+// seeded random); mirror: per-image 0/1 (2 = seeded coin).  aug: batch-
+// wide amplitudes, kAugLen floats (see AugParams) or NULL for geometry
+// only.  nthreads native worker threads (values < 1 clamp to 1).
+// Returns the number of successfully decoded images; failed slots are
+// zero-filled and flagged in ok[i]=0.
+int mxtpu_decode_batch_aug(const uint8_t **blobs, const long *sizes, int n,
+                           int out_h, int out_w, int resize,
+                           const int *crop_x, const int *crop_y,
+                           const uint8_t *mirror, const uint32_t *seeds,
+                           const float *aug, uint8_t *out, uint8_t *ok,
+                           int nthreads) {
   if (nthreads < 1) nthreads = 1;
   nthreads = std::min(nthreads, n);
+  const AugParams params = AugParams::from(aug);
   const size_t img_bytes = static_cast<size_t>(3) * out_h * out_w;
   std::atomic<int> next(0), good(0);
   auto worker = [&]() {
@@ -197,7 +415,7 @@ int mxtpu_decode_batch(const uint8_t **blobs, const long *sizes, int n,
       if (i >= n) return;
       bool k = process_one(blobs[i], sizes[i], out_h, out_w, resize,
                            crop_x[i], crop_y[i], mirror[i],
-                           seeds != nullptr ? seeds[i] : 0u,
+                           seeds != nullptr ? seeds[i] : 0u, params,
                            out + i * img_bytes);
       if (!k) std::memset(out + i * img_bytes, 0, img_bytes);
       ok[i] = k ? 1 : 0;
@@ -213,6 +431,18 @@ int mxtpu_decode_batch(const uint8_t **blobs, const long *sizes, int n,
     for (auto &th : pool) th.join();
   }
   return good.load();
+}
+
+// Round-4 entry point: geometry-only augmentation (kept as a stable ABI
+// wrapper; results are bit-identical to round 4 for the same seeds).
+int mxtpu_decode_batch(const uint8_t **blobs, const long *sizes, int n,
+                       int out_h, int out_w, int resize, const int *crop_x,
+                       const int *crop_y, const uint8_t *mirror,
+                       const uint32_t *seeds, uint8_t *out, uint8_t *ok,
+                       int nthreads) {
+  return mxtpu_decode_batch_aug(blobs, sizes, n, out_h, out_w, resize,
+                                crop_x, crop_y, mirror, seeds, nullptr, out,
+                                ok, nthreads);
 }
 
 }  // extern "C"

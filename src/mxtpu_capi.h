@@ -27,11 +27,12 @@ const char *mxtpu_last_error(void);
 /* Create an NDArray by COPYING ndim-dimensional host data (ref:
  * MXNDArraySyncCopyFromCPU — same copy-in semantics: the caller's buffer
  * is free to be reused or freed the moment the call returns).
- * dtype: "float32" | "float16" | "bfloat16" | "int32" | "int64" |
- * "uint8" | "int8".  data is raw bytes in that dtype's layout (bfloat16
- * = high 16 bits of the IEEE f32 pattern).  float64 is rejected: the
- * runtime computes in 32-bit (no f64 datapath on TPU) and a silent
- * downcast under an f64 label would corrupt byte-level round-trips. */
+ * dtype: "float32" | "float16" | "bfloat16" | "int32" | "uint8" |
+ * "int8".  data is raw bytes in that dtype's layout (bfloat16 = high 16
+ * bits of the IEEE f32 pattern).  float64 and int64 are rejected: the
+ * runtime computes in 32-bit (no f64 datapath on TPU; jax x64 off) and
+ * a silent downcast under a 64-bit label would corrupt byte-level
+ * round-trips. */
 void *mxtpu_ndarray_create_dtype(const void *data, const long *shape,
                                  int ndim, const char *dtype);
 

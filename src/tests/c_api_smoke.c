@@ -150,6 +150,9 @@ int main(void) {
         "float64 rejected");
   CHECK(strstr(mxtpu_last_error(), "float64") != NULL,
         "float64 rejection names the dtype");
+  long long i64_data[4] = {1, 2, 3, 1LL << 40};
+  CHECK(mxtpu_ndarray_create_dtype(i64_data, s4, 1, "int64") == NULL,
+        "int64 rejected (would truncate to int32 silently)");
 
   unsigned char u8_data[4] = {0, 1, 128, 255};
   void *u8 = mxtpu_ndarray_create_dtype(u8_data, s4, 1, "uint8");

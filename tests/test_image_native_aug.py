@@ -1,0 +1,294 @@
+"""Numeric parity: native decoder augmentation chain vs a python oracle.
+
+The oracle replicates src/image_decode.cc bit-by-bit: the per-image
+xorshift32 stream, the draw order (area, ratio, cx, cy, mirror,
+brightness, contrast, saturation, hue, pca), float32 bilinear resize,
+and the color jitter chain — so any drift in the native implementation
+shows up as a pixel diff here (ref: image_aug_default.cc — the
+reference's augmenter; tests/python/unittest/test_image.py strategy).
+
+PIL decodes through the same libjpeg the native library links, so the
+decode stage is identical and the comparison isolates the augmentation
+math.  Test images are sized so the DCT prescale never engages
+(short/2 < resize keeps scale_denom == 1).
+"""
+import ctypes
+import io as _io
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.io import AugSpec, _native_decoder
+
+pytestmark = pytest.mark.skipif(_native_decoder() is None,
+                                reason="libimagedecode.so not built")
+
+EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                   [-0.5808, -0.0045, -0.8140],
+                   [-0.5836, -0.6948, 0.4203]], np.float32)
+
+
+# ---------------------------------------------------------------- oracle ----
+class XorShift:
+    def __init__(self, seed):
+        self.s = np.uint32(seed if seed != 0 else 1)
+
+    def next(self):
+        x = np.uint32(self.s)
+        x ^= np.uint32((int(x) << 13) & 0xFFFFFFFF)
+        x ^= np.uint32(int(x) >> 17)
+        x ^= np.uint32((int(x) << 5) & 0xFFFFFFFF)
+        self.s = x
+        return int(x)
+
+    def u01(self):
+        return np.float32((self.next() >> 8) + np.float32(0.5)) \
+            * np.float32(1.0 / 16777216.0)
+
+
+def resize_bilinear_f32(src, dw, dh):
+    """float32 mirror of the C++ resize_bilinear (u8 in, u8 out)."""
+    sh, sw = src.shape[:2]
+    xs, ys = np.float32(sw) / np.float32(dw), np.float32(sh) / np.float32(dh)
+    out = np.empty((dh, dw, 3), np.uint8)
+    xf = (np.arange(dw, dtype=np.float32) + np.float32(0.5)) * xs \
+        - np.float32(0.5)
+    yf = (np.arange(dh, dtype=np.float32) + np.float32(0.5)) * ys \
+        - np.float32(0.5)
+    x0 = np.maximum(0, np.floor(xf).astype(np.int32))
+    y0 = np.maximum(0, np.floor(yf).astype(np.int32))
+    x1 = np.minimum(sw - 1, x0 + 1)
+    y1 = np.minimum(sh - 1, y0 + 1)
+    wx = np.maximum(np.float32(0), (xf - x0.astype(np.float32)))
+    wy = np.maximum(np.float32(0), (yf - y0.astype(np.float32)))
+    s = src.astype(np.float32)
+    for j in range(dh):
+        a = s[y0[j], x0] * (np.float32(1) - wx)[:, None] \
+            + s[y0[j], x1] * wx[:, None]
+        b = s[y1[j], x0] * (np.float32(1) - wx)[:, None] \
+            + s[y1[j], x1] * wx[:, None]
+        v = a * (np.float32(1) - wy[j]) + b * wy[j] + np.float32(0.5)
+        out[j] = v.astype(np.uint8)
+    return out
+
+
+def color_chain_oracle(x, aug, rng):
+    """float32 mirror of color_chain (x: HWC float32 0-255)."""
+    coef = np.array([0.299, 0.587, 0.114], np.float32)
+    if aug.brightness > 0:
+        ab = np.float32(1) + (np.float32(2) * rng.u01() - np.float32(1)) \
+            * np.float32(aug.brightness)
+        x = x * ab
+    if aug.contrast > 0:
+        ac = np.float32(1) + (np.float32(2) * rng.u01() - np.float32(1)) \
+            * np.float32(aug.contrast)
+        per_px = (x * coef).sum(-1, dtype=np.float32)
+        gray = np.float32(per_px.sum(dtype=np.float64) / per_px.size) \
+            * (np.float32(1) - ac)
+        x = ac * x + gray
+    if aug.saturation > 0:
+        a_s = np.float32(1) + (np.float32(2) * rng.u01() - np.float32(1)) \
+            * np.float32(aug.saturation)
+        g = (x * coef).sum(-1, keepdims=True, dtype=np.float32) \
+            * (np.float32(1) - a_s)
+        x = a_s * x + g
+    if aug.hue > 0:
+        alpha = (np.float32(2) * rng.u01() - np.float32(1)) \
+            * np.float32(aug.hue)
+        cu = np.float32(np.cos(np.float32(alpha) * np.float32(np.pi)))
+        sw = np.float32(np.sin(np.float32(alpha) * np.float32(np.pi)))
+        tyiq = np.array([[0.299, 0.587, 0.114],
+                         [0.596, -0.274, -0.321],
+                         [0.211, -0.523, 0.311]], np.float32)
+        ityiq = np.array([[1.0, 0.956, 0.621],
+                          [1.0, -0.272, -0.647],
+                          [1.0, -1.107, 1.705]], np.float32)
+        bt = np.array([[1, 0, 0], [0, cu, -sw], [0, sw, cu]], np.float32)
+        t = (ityiq @ bt @ tyiq)
+        x = x @ t.T.astype(np.float32)
+    if aug.pca_noise > 0:
+        u1, u2, u3, u4 = rng.u01(), rng.u01(), rng.u01(), rng.u01()
+        r1 = np.float32(np.sqrt(np.float32(-2) * np.log(u1)))
+        z0 = r1 * np.float32(np.cos(np.float32(2 * np.pi) * u2))
+        z1 = r1 * np.float32(np.sin(np.float32(2 * np.pi) * u2))
+        z2 = np.float32(np.sqrt(np.float32(-2) * np.log(u3))) \
+            * np.float32(np.cos(np.float32(2 * np.pi) * u4))
+        alpha = np.array([z0, z1, z2], np.float32) * np.float32(aug.pca_noise)
+        shift = (EIGVEC * alpha) @ EIGVAL
+        x = x + shift
+    return x
+
+
+def oracle_process(jpeg_blob, out_h, out_w, resize, rand_crop, rand_mirror,
+                   seed, aug):
+    """Python replica of process_one."""
+    from PIL import Image
+    img = np.asarray(Image.open(_io.BytesIO(jpeg_blob)))
+    h, w = img.shape[:2]
+    rng = XorShift(seed)
+    if aug.rrc:
+        ua, ur = rng.u01(), rng.u01()
+        area = np.float32(w) * np.float32(h)
+        target = (np.float32(aug.min_area)
+                  + ua * (np.float32(aug.max_area)
+                          - np.float32(aug.min_area))) * area
+        lo = np.float32(np.log(np.float32(aug.min_aspect)))
+        hi = np.float32(np.log(np.float32(aug.max_aspect)))
+        ratio = np.float32(np.exp(lo + ur * (hi - lo)))
+        cw = int(np.floor(np.float32(np.sqrt(target * ratio))
+                          + np.float32(0.5)))
+        ch = int(np.floor(np.float32(np.sqrt(target / ratio))
+                          + np.float32(0.5)))
+        cw, ch = max(1, min(cw, w)), max(1, min(ch, h))
+        cx = rng.next() % (w - cw + 1)
+        cy = rng.next() % (h - ch + 1)
+        crop = img[cy:cy + ch, cx:cx + cw]
+        if (cw, ch) != (out_w, out_h):
+            crop = resize_bilinear_f32(crop, out_w, out_h)
+    else:
+        assert resize <= 0 or min(h, w) == resize, \
+            "oracle only covers the no-resize / exact-size geometry"
+        if w < out_w or h < out_h:
+            img = resize_bilinear_f32(img, max(w, out_w), max(h, out_h))
+            h, w = img.shape[:2]
+        if rand_crop:
+            cx = rng.next() % (w - out_w + 1)
+            cy = rng.next() % (h - out_h + 1)
+        else:
+            cx, cy = (w - out_w) // 2, (h - out_h) // 2
+        crop = img[cy:cy + out_h, cx:cx + out_w]
+    mirror = 0
+    if rand_mirror:
+        mirror = rng.next() & 1
+    if mirror:
+        crop = crop[:, ::-1]
+    if not aug.any_color:
+        return np.ascontiguousarray(crop.transpose(2, 0, 1))
+    x = color_chain_oracle(crop.astype(np.float32), aug, rng)
+    x = np.clip(x, np.float32(0), np.float32(255)) + np.float32(0.5)
+    return x.astype(np.uint8).transpose(2, 0, 1)
+
+
+# ---------------------------------------------------------------- driver ----
+def native_process(jpeg_blob, out_h, out_w, resize, rand_crop, rand_mirror,
+                   seed, aug):
+    lib = _native_decoder()
+    ptrs = (ctypes.c_char_p * 1)(jpeg_blob)
+    sizes = (ctypes.c_long * 1)(len(jpeg_blob))
+    cx = (ctypes.c_int * 1)(-2 if rand_crop else -1)
+    cy = (ctypes.c_int * 1)(-2 if rand_crop else -1)
+    mir = (ctypes.c_uint8 * 1)(2 if rand_mirror else 0)
+    seeds = (ctypes.c_uint32 * 1)(seed)
+    out = np.empty((3, out_h, out_w), np.uint8)
+    ok = np.empty((1,), np.uint8)
+    arr = aug.to_array()
+    n = lib.mxtpu_decode_batch_aug(
+        ptrs, sizes, 1, out_h, out_w, resize, cx, cy, mir, seeds,
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 1)
+    assert n == 1 and ok[0] == 1
+    return out
+
+
+def _jpeg(w, h, seed):
+    from PIL import Image
+    rng = np.random.RandomState(seed)
+    # smooth gradients + low-freq noise: JPEG-friendly, exercises all hues
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float32)
+    img = np.stack([128 + 100 * np.sin(xx / 17 + seed),
+                    128 + 100 * np.cos(yy / 13),
+                    128 + 90 * np.sin((xx + yy) / 23)], axis=-1)
+    img = np.clip(img + rng.randn(h, w, 3) * 8, 0, 255).astype(np.uint8)
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format="JPEG", quality=92)
+    return buf.getvalue()
+
+
+FULL = dict(rrc=True, min_area=0.3, max_area=1.0, min_aspect=0.75,
+            max_aspect=4.0 / 3.0, brightness=0.4, contrast=0.4,
+            saturation=0.4, hue=0.3, pca_noise=0.1)
+
+
+@pytest.mark.parametrize("seed", [1, 7, 123456, 2 ** 31 - 5])
+def test_full_chain_parity(seed):
+    """rrc geometry + every color aug vs the oracle, multiple seeds."""
+    blob = _jpeg(96, 80, seed % 7)
+    aug = AugSpec(**FULL)
+    nat = native_process(blob, 64, 64, 0, True, True, seed, aug)
+    ora = oracle_process(blob, 64, 64, 0, True, True, seed, aug)
+    diff = np.abs(nat.astype(np.int32) - ora.astype(np.int32))
+    # float math in two compilers: allow +-2 quantization, no structure
+    assert diff.max() <= 2, (diff.max(), (diff > 2).sum())
+    assert (diff > 0).mean() < 0.05
+
+
+@pytest.mark.parametrize("key", ["brightness", "contrast", "saturation",
+                                 "hue", "pca_noise"])
+def test_single_aug_parity(key):
+    """Each color aug alone: draw-order isolation (a missing/extra draw
+    desynchronizes the stream and fails loudly)."""
+    blob = _jpeg(64, 64, 3)
+    aug = AugSpec(**{key: 0.5 if key != "pca_noise" else 0.15})
+    nat = native_process(blob, 64, 64, 0, False, False, 99, aug)
+    ora = oracle_process(blob, 64, 64, 0, False, False, 99, aug)
+    assert np.abs(nat.astype(np.int32) - ora.astype(np.int32)).max() <= 2
+
+
+def test_geometry_only_matches_round4_path():
+    """aug all-zero == the stable round-4 entry point, bit for bit."""
+    lib = _native_decoder()
+    blob = _jpeg(90, 70, 5)
+    ptrs = (ctypes.c_char_p * 1)(blob)
+    sizes = (ctypes.c_long * 1)(len(blob))
+    cx = (ctypes.c_int * 1)(-2)
+    cy = (ctypes.c_int * 1)(-2)
+    mir = (ctypes.c_uint8 * 1)(2)
+    seeds = (ctypes.c_uint32 * 1)(424242)
+    a = np.empty((3, 48, 48), np.uint8)
+    b = np.empty((3, 48, 48), np.uint8)
+    ok = np.empty((1,), np.uint8)
+    lib.mxtpu_decode_batch(
+        ptrs, sizes, 1, 48, 48, 0, cx, cy, mir, seeds,
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 1)
+    assert ok[0] == 1
+    lib.mxtpu_decode_batch_aug(
+        ptrs, sizes, 1, 48, 48, 0, cx, cy, mir, seeds, None,
+        b.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        ok.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), 1)
+    assert ok[0] == 1
+    np.testing.assert_array_equal(a, b)
+
+
+def test_record_iter_color_args_native(tmp_path):
+    """ImageRecordIter with the reference's color/rrc options stays on
+    the native path, is seed-deterministic, and actually augments."""
+    from mxnet_tpu import io as mio, recordio
+    rec = str(tmp_path / "t.rec")
+    idx = str(tmp_path / "t.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    from PIL import Image
+    for i in range(8):
+        blob = _jpeg(80, 72, i)
+        hdr = recordio.IRHeader(0, float(i % 3), i, 0)
+        w.write_idx(i, recordio.pack(hdr, blob))
+    w.close()
+
+    kw = dict(data_shape=(3, 48, 48), batch_size=4,
+              rand_crop=True, rand_mirror=True, random_resized_crop=True,
+              min_random_area=0.3, random_h=36, random_s=64, random_l=50,
+              max_random_contrast=0.3, pca_noise=0.05, seed=11,
+              use_native_decode=True)
+    it1 = mio.ImageRecordIter(rec, path_imgidx=idx, **kw)
+    b1 = [it1.next().data[0].asnumpy() for _ in range(2)]
+    it2 = mio.ImageRecordIter(rec, path_imgidx=idx, **kw)
+    b2 = [it2.next().data[0].asnumpy() for _ in range(2)]
+    for x, y in zip(b1, b2):
+        np.testing.assert_array_equal(x, y)  # seed-deterministic
+    it3 = mio.ImageRecordIter(rec, path_imgidx=idx, data_shape=(3, 48, 48),
+                              batch_size=4, rand_crop=True, rand_mirror=True,
+                              seed=11, use_native_decode=True)
+    b3 = it3.next().data[0].asnumpy()
+    assert np.abs(b1[0] - b3).max() > 1  # the color chain did something
