@@ -23,7 +23,10 @@ __all__ = ["imdecode", "imread", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize", "Augmenter",
            "ResizeAug", "ForceResizeAug", "HorizontalFlipAug", "CastAug",
            "ColorNormalizeAug", "RandomCropAug", "CenterCropAug",
-           "CreateAugmenter", "ImageIter"]
+           "RandomSizedCropAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "CreateAugmenter", "ImageIter",
+           "IMAGENET_EIGVAL", "IMAGENET_EIGVEC"]
 
 
 def _pil():
@@ -199,11 +202,26 @@ class RandomCropAug(Augmenter):
         return random_crop(src, self._size, self._interp, self._rng)[0]
 
 
+def draw_rrc_box(h, w, area, ratio, rng):
+    """Draw a random-area/aspect crop box: (y0, x0, ch, cw).  Single draw
+    + clamp instead of the reference's retry loop, matching the native
+    decoder's deterministic draw count (src/image_decode.cc process_one).
+    The ONE python implementation of this geometry — RandomSizedCropAug
+    and io.py's fallback both call it."""
+    ua, ur = rng.rand(), rng.rand()
+    target = (area[0] + ua * (area[1] - area[0])) * h * w
+    lo, hi = np.log(ratio[0]), np.log(ratio[1])
+    r = float(np.exp(lo + ur * (hi - lo)))
+    cw = max(1, min(int(round(np.sqrt(target * r))), w))
+    ch = max(1, min(int(round(np.sqrt(target / r))), h))
+    x0 = int(rng.randint(0, w - cw + 1))
+    y0 = int(rng.randint(0, h - ch + 1))
+    return y0, x0, ch, cw
+
+
 class RandomSizedCropAug(Augmenter):
     """Random-area/aspect crop resized to ``size`` (ref: image.py
-    RandomSizedCropAug; the Inception-style crop).  Single draw + clamp
-    instead of the reference's retry loop, matching the native decoder's
-    deterministic draw count (src/image_decode.cc process_one)."""
+    RandomSizedCropAug; the Inception-style crop)."""
 
     def __init__(self, size, area, ratio, interp=1, rng=None):
         super().__init__(size=size, area=area, ratio=ratio)
@@ -216,15 +234,8 @@ class RandomSizedCropAug(Augmenter):
     def __call__(self, src):
         img = _to_np(src)
         h, w = img.shape[:2]
-        ua, ur = self._rng.rand(), self._rng.rand()
-        target = (self._area[0] + ua * (self._area[1] - self._area[0])) * h * w
-        lo, hi = np.log(self._ratio[0]), np.log(self._ratio[1])
-        ratio = float(np.exp(lo + ur * (hi - lo)))
-        cw = int(round(np.sqrt(target * ratio)))
-        ch = int(round(np.sqrt(target / ratio)))
-        cw, ch = max(1, min(cw, w)), max(1, min(ch, h))
-        x0 = int(self._rng.randint(0, w - cw + 1))
-        y0 = int(self._rng.randint(0, h - ch + 1))
+        y0, x0, ch, cw = draw_rrc_box(h, w, self._area, self._ratio,
+                                      self._rng)
         crop = img[y0:y0 + ch, x0:x0 + cw]
         return imresize(nd.array(crop), self._size[0], self._size[1],
                         self._interp)
@@ -407,6 +418,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     crop = (data_shape[2], data_shape[1])
     if rand_resize:
         assert rand_crop, "rand_resize requires rand_crop"
+        if resize > 0:  # reference order: resize-short, THEN area crop —
+            auglist.append(ResizeAug(resize))  # area is drawn post-resize
         auglist.append(RandomSizedCropAug(
             crop, (min_random_area, max_random_area),
             (min_aspect_ratio, max_aspect_ratio)))

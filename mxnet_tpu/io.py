@@ -279,9 +279,15 @@ def _color_chain_np(x, aug, rng):
 def _native_decoder():
     """Load src/image_decode.cc's batch JPEG pipeline (decode threads of
     the reference's iter_image_recordio_2.cc), auto-building like every
-    other native core.  None when unbuildable."""
+    other native core.  None when unbuildable — including a stale
+    pre-augmentation .so that load_native_lib couldn't rebuild (no src/
+    tree or no compiler): missing the current entry point means the
+    python fallback, not an AttributeError mid-epoch."""
     from .base import load_native_lib
-    return load_native_lib("libimagedecode.so", "image_decode.cc")
+    lib = load_native_lib("libimagedecode.so", "image_decode.cc")
+    if lib is not None and not hasattr(lib, "mxtpu_decode_batch_aug"):
+        return None
+    return lib
 
 
 class ImageRecordIter(DataIter):
@@ -666,15 +672,10 @@ def _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror, rng, aug=None):
     from PIL import Image
     c, h, w = shape
     if aug is not None and aug.rrc:
+        from .image import draw_rrc_box
         ih, iw = img.shape[:2]
-        ua, ur = rng.rand(), rng.rand()
-        target = (aug.min_area + ua * (aug.max_area - aug.min_area)) * ih * iw
-        lo, hi = np.log(aug.min_aspect), np.log(aug.max_aspect)
-        ratio = float(np.exp(lo + ur * (hi - lo)))
-        cw = max(1, min(int(round(np.sqrt(target * ratio))), iw))
-        ch = max(1, min(int(round(np.sqrt(target / ratio))), ih))
-        x0 = int(rng.randint(0, iw - cw + 1))
-        y0 = int(rng.randint(0, ih - ch + 1))
+        y0, x0, ch, cw = draw_rrc_box(ih, iw, (aug.min_area, aug.max_area),
+                                      (aug.min_aspect, aug.max_aspect), rng)
         img = np.asarray(Image.fromarray(img[y0:y0 + ch, x0:x0 + cw])
                          .resize((w, h)))
     else:
