@@ -71,15 +71,30 @@ def _prologue(x, scale, shift, res, relu):
 def _taps(Xp, h, w_dim, ci, k, stride):
     """Yield (ky, kx, patch) with patch = the (Ho, Wo, Ci) strided window
     of the padded input under tap (ky, kx) — the 9 shifted views whose
-    matmuls sum to the convolution."""
+    matmuls sum to the convolution.
+
+    Mosaic rejects strided vector slices (`vector.extract_strided_slice`
+    requires unit strides — see TPU_FUSED_COMPILE_r05.md), so for
+    stride > 1 the decimation is a contiguous slice + reshape + static
+    index, all of which lower to unit-stride ops.  Callers must pad Xp
+    with `stride - 1` extra rows/cols (see ``_pad_guard``) so the
+    contiguous slice extent ``stride * ho`` stays in bounds."""
     ho, wo = _out_dim(h, stride), _out_dim(w_dim, stride)
     for ky in range(k):
         for kx in range(k):
-            patch = lax.slice(Xp, (ky, kx, 0),
-                              (ky + stride * (ho - 1) + 1,
-                               kx + stride * (wo - 1) + 1, ci),
-                              (stride, stride, 1))
+            if stride == 1:
+                patch = lax.slice(Xp, (ky, kx, 0), (ky + ho, kx + wo, ci))
+            else:
+                full = lax.slice(Xp, (ky, kx, 0),
+                                 (ky + stride * ho, kx + stride * wo, ci))
+                patch = full.reshape(ho, stride, wo, stride,
+                                     ci)[:, 0, :, 0, :]
             yield ky, kx, patch
+
+
+def _pad_guard(stride):
+    """Extra high-side padding so stride>1 taps can slice contiguously."""
+    return stride - 1
 
 
 def _fwd_kernel(x_ref, scale_ref, shift_ref, w_ref, *rest, k, stride, relu,
@@ -98,7 +113,8 @@ def _fwd_kernel(x_ref, scale_ref, shift_ref, w_ref, *rest, k, stride, relu,
     else:
         py, _py2 = _same_pads(h, k, stride)
         px, _px2 = _same_pads(w_dim, k, stride)
-        Xp = jnp.pad(X, ((py, _py2), (px, _px2), (0, 0)))
+        g = _pad_guard(stride)
+        Xp = jnp.pad(X, ((py, _py2 + g), (px, _px2 + g), (0, 0)))
         acc = None
         for ky, kx, patch in _taps(Xp, h, w_dim, ci, k, stride):
             term = patch.reshape(ho * wo, ci) @ \
@@ -170,10 +186,17 @@ def _dx_kernel(x_ref, scale_ref, shift_ref, w_ref, do_ref, *rest, k, stride,
             dod = do
         else:
             # transposed conv: dilate dO by the stride (zeros between
-            # output positions), then full-correlate with flipped taps
-            dod = jnp.zeros((stride * (ho - 1) + 1,
-                             stride * (wo - 1) + 1, co), jnp.float32)
-            dod = dod.at[::stride, ::stride].set(do)
+            # output positions), then full-correlate with flipped taps.
+            # Strided scatter (`.at[::s, ::s]`) doesn't lower on Mosaic;
+            # interleave zeros via pad + reshape (unit-stride ops), then
+            # trim the trailing `stride - 1` zeros to the dilated extent.
+            dod = jnp.pad(do.reshape(ho, 1, wo, 1, co),
+                          ((0, 0), (0, stride - 1),
+                           (0, 0), (0, stride - 1), (0, 0)))
+            dod = dod.reshape(stride * ho, stride * wo, co)
+            dod = lax.slice(dod, (0, 0, 0),
+                            (stride * (ho - 1) + 1,
+                             stride * (wo - 1) + 1, co))
         py, _ = _same_pads(h, k, stride)
         px, _ = _same_pads(wd, k, stride)
         ply = k - 1 - py
@@ -202,8 +225,11 @@ def _dx_kernel(x_ref, scale_ref, shift_ref, w_ref, do_ref, *rest, k, stride,
     dx_ref[0] = (Gm * scale).astype(dx_ref.dtype)
     if has_res:
         dres_ref[0] = Gm.astype(dres_ref.dtype)
-    dsc_ref[0] = jnp.sum(Gm * x, axis=(0, 1))
-    dsh_ref[0] = jnp.sum(Gm, axis=(0, 1))
+    # rank-3 (N, 1, Ci) partials: a (1, Ci) block over an (N, Ci) array
+    # violates Mosaic's last-two-dims rule (1 ∤ 8 and 1 != N); the extra
+    # unit axis makes the block's trailing dims equal the array's.
+    dsc_ref[0, 0] = jnp.sum(Gm * x, axis=(0, 1))
+    dsh_ref[0, 0] = jnp.sum(Gm, axis=(0, 1))
 
 
 def _dx(x, scale, shift, w, res, do, relu, stride, interpret):
@@ -229,10 +255,10 @@ def _dx(x, scale, shift, w, res, do, relu, stride, interpret):
         out_specs.append(
             pl.BlockSpec((1, h, wd, ci), lambda nb: (nb, 0, 0, 0)))
         out_shape.append(jax.ShapeDtypeStruct(x.shape, res.dtype))
-    out_specs += [pl.BlockSpec((1, ci), lambda nb: (nb, 0)),
-                  pl.BlockSpec((1, ci), lambda nb: (nb, 0))]
-    out_shape += [jax.ShapeDtypeStruct((n, ci), jnp.float32),
-                  jax.ShapeDtypeStruct((n, ci), jnp.float32)]
+    out_specs += [pl.BlockSpec((1, 1, ci), lambda nb: (nb, 0, 0)),
+                  pl.BlockSpec((1, 1, ci), lambda nb: (nb, 0, 0))]
+    out_shape += [jax.ShapeDtypeStruct((n, 1, ci), jnp.float32),
+                  jax.ShapeDtypeStruct((n, 1, ci), jnp.float32)]
     outs = pl.pallas_call(
         functools.partial(_dx_kernel, k=k, stride=stride, relu=relu,
                           has_res=has_res),
@@ -247,8 +273,8 @@ def _dx(x, scale, shift, w, res, do, relu, stride, interpret):
     else:
         dx, dsc, dsh = outs
         dres = None
-    # per-sample partials -> channel totals (tiny (N, Ci) reduce in XLA)
-    return dx, dres, dsc.sum(axis=0), dsh.sum(axis=0)
+    # per-sample partials -> channel totals (tiny (N, 1, Ci) reduce in XLA)
+    return dx, dres, dsc.sum(axis=(0, 1)), dsh.sum(axis=(0, 1))
 
 
 # ---------------------------------------------------------- backward dW -----
@@ -277,7 +303,8 @@ def _dw_kernel(x_ref, scale_ref, shift_ref, do_ref, *rest, k, stride,
     else:
         py, py2 = _same_pads(h, k, stride)
         px, px2 = _same_pads(wd, k, stride)
-        Xp = jnp.pad(X, ((py, py2), (px, px2), (0, 0)))
+        g = _pad_guard(stride)
+        Xp = jnp.pad(X, ((py, py2 + g), (px, px2 + g), (0, 0)))
         for ky, kx, patch in _taps(Xp, h, wd, ci, k, stride):
             acc_ref[ky, kx] += patch.reshape(ho * wo, ci).T @ do
 
