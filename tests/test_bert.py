@@ -1,6 +1,7 @@
 """BERT model family tests (config 4 path, ref: GluonNLP model/bert.py
 contract — see mxnet_tpu/gluon/model_zoo/bert.py docstrings)."""
 import numpy as np
+import pytest
 import jax.numpy as jnp
 
 import mxnet_tpu as mx
@@ -118,6 +119,7 @@ def test_bert_named_configs():
     assert net.encoder.layers[0].ffn1._units == 3072
 
 
+@pytest.mark.slow
 def test_bert_mlm_accuracy_gate():
     """Quality gate with teeth (BASELINE config 4): after memorizing a fixed
     masked batch, masked-LM top-1 accuracy must beat chance (1/vocab = 2%)

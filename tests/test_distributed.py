@@ -11,7 +11,8 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-@pytest.mark.parametrize("n", [2, 4])
+@pytest.mark.parametrize(
+    "n", [2, pytest.param(4, marks=pytest.mark.slow)])
 def test_launch_local_dist_workers(n):
     env = dict(os.environ)
     env.pop("PALLAS_AXON_POOL_IPS", None)

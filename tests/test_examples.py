@@ -34,6 +34,7 @@ def test_resnet_fused_example():
     assert "img/s" in out
 
 
+@pytest.mark.slow
 def test_word_lm_example():
     out = _run("word_language_model.py", "--epochs", "1", "--batch-size",
                "8", "--embed-size", "32", "--hidden-size", "32",
@@ -41,6 +42,7 @@ def test_word_lm_example():
     assert "ppl=" in out
 
 
+@pytest.mark.slow
 def test_bert_pretrain_example():
     out = _run("bert_pretrain.py", "--layers", "1", "--units", "64",
                "--heads", "4", "--batch-size", "2", "--seq-len", "32",

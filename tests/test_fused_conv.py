@@ -7,6 +7,11 @@ compiles natively on TPU (tests_tpu re-run)."""
 import numpy as np
 import pytest
 
+# Interpreter-mode Pallas sweeps dominate the suite's runtime (~3 min on
+# one core); the on-chip re-run (tests_tpu/test_fused_conv_tpu.py) always
+# includes them, the default CPU tier does not.
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 
@@ -253,3 +258,4 @@ def test_layer_stride2():
         (out * out).sum().backward()
     assert out.shape == (2, 4, 4, 8)
     assert np.isfinite(x.grad.asnumpy()).all()
+

@@ -324,6 +324,7 @@ def test_summary_on_warm_hybridized_net(capsys):
     assert "(3, 8)" in out and "(3, 2)" in out  # child shapes present
 
 
+@pytest.mark.slow
 def test_int8_quantized_zoo_model_accuracy_gate():
     """THE int8 workflow gate (VERDICT r3 missing #4): train a model-zoo
     network to real accuracy on separable data, quantize it with

@@ -3,6 +3,7 @@ smoke-convergence gate on synthetic boxes (ref: example/ssd train flow +
 GluonCV ssd_512_resnet50_v1; tests mirror tests/python/train/ convergence
 style — loss must genuinely decrease)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon
@@ -63,6 +64,7 @@ def test_ssd_targets_and_detect_roundtrip():
     assert det.shape == (2, a, 6)
 
 
+@pytest.mark.slow
 def test_ssd_smoke_convergence():
     """Fixed batch of synthetic boxes: the full train path (targets + loss +
     backward + update) must drive the loss down substantially."""
@@ -140,6 +142,7 @@ def test_voc_map_metric_correctness():
     assert abs(m07.get_map() - 1.0) < 1e-6
 
 
+@pytest.mark.slow
 def test_ssd_train_reaches_ap_gate():
     """THE detection quality gate (BASELINE config 5 proxy): train the tiny
     SSD on a fixed synthetic batch until detections reach AP >= 0.5 against
