@@ -172,6 +172,29 @@ class CSRNDArray(BaseSparseNDArray):
     def asnumpy(self):
         return np.asarray(self.tostype("default")._data)
 
+    def __getitem__(self, key):
+        """Row slicing stays csr (ref: ndarray/sparse.py —
+        CSRNDArray.__getitem__ / SliceCsrImpl): `csr[a:b]` and `csr[i]`
+        re-base indptr and take the covered nnz range."""
+        if isinstance(key, int):
+            if key < 0:
+                key += self.shape[0]
+            if not 0 <= key < self.shape[0]:
+                raise IndexError(f"row {key} out of range {self.shape[0]}")
+            key = slice(key, key + 1)
+        if not isinstance(key, slice):
+            raise TypeError("csr supports int/slice row indexing only")
+        if key.step not in (None, 1):
+            raise ValueError("csr row slicing requires step 1")
+        a, b, _ = key.indices(self.shape[0])
+        b = max(a, b)
+        _check_concrete(self._data)
+        ip = np.asarray(self._indptr)
+        lo, hi = int(ip[a]), int(ip[b])
+        return CSRNDArray(self._data[lo:hi], self._indices[lo:hi],
+                          self._indptr[a:b + 1] - lo,
+                          (b - a, self.shape[1]), self._ctx)
+
 
 # ------------------------------------------------------------ construction --
 def cast_storage(arr, stype):
@@ -259,8 +282,43 @@ def retain(rsp, indices):
                             rsp._ctx)
 
 
-def dot(lhs, rhs, transpose_a=False):
-    """ref: sparse dot — csr×dense (fwd) and csrᵀ×dense (the grad path)."""
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """ref: sparse dot (src/operator/tensor/dot-inl.h dispatch table):
+    csr×dense / csrᵀ×dense (fwd + grad paths), dense×csr / dense×csrᵀ
+    (the mirrored branches), dense×rsp, and rspᵀ×dense (embedding grad).
+    Dense-lhs × sparse-rhs always returns dense, like the reference."""
+    if transpose_a and transpose_b:
+        raise ValueError("sparse dot supports at most one transposed side")
+    if isinstance(lhs, NDArray) and isinstance(rhs, (CSRNDArray,
+                                                     RowSparseNDArray)):
+        if transpose_a:
+            raise NotImplementedError("dense-lhs sparse dot with "
+                                      "transpose_a is not in the reference "
+                                      "dispatch table either")
+        dense = lhs._data
+        if isinstance(rhs, CSRNDArray):
+            # dot(d, csr) = dot(csrᵀ, dᵀ)ᵀ; dot(d, csrᵀ) = dot(csr, dᵀ)ᵀ —
+            # reuse the csr-lhs segment-sum kernels on the transposed dense
+            out = dot(rhs, NDArray(dense.T, ctx=lhs._ctx),
+                      transpose_a=not transpose_b)
+            return NDArray(out._data.T, ctx=lhs._ctx)
+        # rsp rhs: only stored rows contribute columns of the contraction
+        if rhs._data.ndim != 2:
+            raise NotImplementedError("dense×rsp dot supports 2-D values")
+        if dense.shape[-1] != rhs.shape[1 if transpose_b else 0]:
+            raise ValueError(f"dot shape mismatch: dense {dense.shape} × "
+                             f"rsp{'ᵀ' if transpose_b else ''} {rhs.shape}")
+        if transpose_b:
+            # out[i, j] = Σ_k d[i, k] rsp[j, k] — dense result over all rows
+            out = jnp.zeros((dense.shape[0], rhs.shape[0]),
+                            rhs._data.dtype)
+            out = out.at[:, rhs._indices].set(dense @ rhs._data.T)
+            return NDArray(out.astype(dense.dtype), ctx=lhs._ctx)
+        out = dense[:, rhs._indices] @ rhs._data
+        return NDArray(out.astype(dense.dtype), ctx=lhs._ctx)
+    if transpose_b:
+        raise NotImplementedError("transpose_b requires a dense lhs with a "
+                                  "sparse rhs (reference dispatch table)")
     if isinstance(lhs, CSRNDArray):
         dense = rhs._data if isinstance(rhs, NDArray) else jnp.asarray(rhs)
         vec = dense.ndim == 1

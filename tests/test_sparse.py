@@ -430,3 +430,53 @@ def test_sparse_grad_local_update_dense_only_optimizer():
         ((emb(x) ** 2).sum()).backward()
     tr.step(1)
     assert not np.allclose(emb.weight.data().asnumpy(), w0)
+
+
+def test_csr_row_slicing():
+    """csr[a:b] / csr[i] stay csr with re-based indptr (ref: SliceCsrImpl)."""
+    from mxnet_tpu import sparse
+    d = np.array([[0, 1, 0, 2],
+                  [0, 0, 0, 0],
+                  [3, 0, 4, 0],
+                  [0, 0, 0, 5]], np.float32)
+    csr = sparse.cast_storage(mx.nd.array(d), "csr")
+    s = csr[1:3]
+    assert s.stype == "csr" and s.shape == (2, 4)
+    np.testing.assert_allclose(s.asnumpy(), d[1:3])
+    np.testing.assert_array_equal(np.asarray(s._indptr), [0, 0, 2])
+    one = csr[2]
+    assert one.shape == (1, 4)
+    np.testing.assert_allclose(one.asnumpy(), d[2:3])
+    np.testing.assert_allclose(csr[-1].asnumpy(), d[3:4])
+    np.testing.assert_allclose(csr[0:0].asnumpy().shape, (0, 4))
+    with pytest.raises(ValueError):
+        csr[0:4:2]
+    with pytest.raises(IndexError):
+        csr[7]
+
+
+def test_dot_dense_lhs_branches():
+    """dense×csr, dense×csrᵀ, dense×rsp, dense×rspᵀ vs numpy oracles
+    (ref: dot-inl.h dispatch table rows with dense lhs)."""
+    from mxnet_tpu import sparse
+    rng = np.random.RandomState(0)
+    dn = rng.randn(3, 4).astype(np.float32)
+    sp = np.array([[0, 1, 0, 2],
+                   [0, 0, 0, 0],
+                   [3, 0, 4, 0],
+                   [0, 0, 0, 5]], np.float32)
+    csr = sparse.cast_storage(mx.nd.array(sp), "csr")
+    out = sparse.dot(mx.nd.array(dn), csr)
+    np.testing.assert_allclose(out.asnumpy(), dn @ sp, rtol=1e-5)
+    dn2 = rng.randn(3, 4).astype(np.float32)
+    out = sparse.dot(mx.nd.array(dn2), csr, transpose_b=True)
+    np.testing.assert_allclose(out.asnumpy(), dn2 @ sp.T, rtol=1e-5)
+    rsp = sparse.cast_storage(mx.nd.array(sp), "row_sparse")
+    out = sparse.dot(mx.nd.array(dn), rsp)
+    np.testing.assert_allclose(out.asnumpy(), dn @ sp, rtol=1e-5)
+    out = sparse.dot(mx.nd.array(dn2), rsp, transpose_b=True)
+    np.testing.assert_allclose(out.asnumpy(), dn2 @ sp.T, rtol=1e-5)
+    with pytest.raises(ValueError):
+        sparse.dot(csr, mx.nd.array(dn), transpose_a=True, transpose_b=True)
+    with pytest.raises(NotImplementedError):
+        sparse.dot(csr, mx.nd.array(dn.T), transpose_b=True)
