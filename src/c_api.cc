@@ -510,6 +510,144 @@ void *mxtpu_ndarray_grad(void *handle) {
   return g;
 }
 
+// ---- kvstore surface (ref: MXKVStoreCreate, MXKVStoreInit,
+//      MXKVStorePushEx, MXKVStorePullEx, MXKVStorePushPullEx,
+//      MXKVStoreSetOptimizer) ----------------------------------------------
+
+// Create a KVStore ("local", "device", ...).  Returns an owned handle.
+void *mxtpu_kvstore_create(const char *type) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return nullptr;
+  }
+  Gil gil;
+  PyObject *kvmod = PyImport_ImportModule("mxnet_tpu.kvstore");
+  if (kvmod == nullptr) {
+    capture_py_error("import mxnet_tpu.kvstore failed");
+    return nullptr;
+  }
+  PyObject *kv = PyObject_CallMethod(kvmod, "create", "s",
+                                     type != nullptr ? type : "local");
+  Py_DECREF(kvmod);
+  if (kv == nullptr) capture_py_error("kvstore create failed");
+  return kv;
+}
+
+int mxtpu_kvstore_free(void *kv) {
+  Gil gil;
+  Py_XDECREF(reinterpret_cast<PyObject *>(kv));
+  return 0;
+}
+
+namespace {
+
+// Shared no-result method call on the kvstore handle.
+int kv_call(void *kv, const char *method, const char *key, void *value) {
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(reinterpret_cast<PyObject *>(kv),
+                                    method, "sO", key,
+                                    reinterpret_cast<PyObject *>(value));
+  if (r == nullptr) {
+    capture_py_error(method);
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+}  // namespace
+
+// Register the key with its initial value (ref: MXKVStoreInit).
+int mxtpu_kvstore_init(void *kv, const char *key, void *value) {
+  return kv_call(kv, "init", key, value);
+}
+
+// Push a value (gradient) for aggregation / server-side update
+// (ref: MXKVStorePushEx).
+int mxtpu_kvstore_push(void *kv, const char *key, void *value) {
+  return kv_call(kv, "push", key, value);
+}
+
+// Pull the stored value.  Returns an owned NDArray handle or NULL
+// (ref: MXKVStorePullEx).  The handle is a COPY: KVStore.pull hands back
+// the live stored array, which later pushes mutate in place — a C client
+// snapshot must not change under it.
+void *mxtpu_kvstore_pull(void *kv, const char *key) {
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(reinterpret_cast<PyObject *>(kv),
+                                    "pull", "s", key);
+  if (r == nullptr) {
+    capture_py_error("kvstore pull failed");
+    return nullptr;
+  }
+  PyObject *snap = PyObject_CallMethod(r, "copy", nullptr);
+  Py_DECREF(r);
+  if (snap == nullptr) capture_py_error("kvstore pull copy failed");
+  return snap;
+}
+
+// Fused push+pull (ref: MXKVStorePushPullEx): pushes `value`, then
+// returns the freshly aggregated/updated stored value as an owned handle.
+void *mxtpu_kvstore_pushpull(void *kv, const char *key, void *value) {
+  if (mxtpu_kvstore_push(kv, key, value) != 0) return nullptr;
+  return mxtpu_kvstore_pull(kv, key);
+}
+
+// Install a server-side optimizer so push applies an update instead of
+// overwrite/accumulate (ref: MXKVStoreSetOptimizer).  kwargs_json: JSON
+// object of optimizer args ({"learning_rate": 0.1}), "" or NULL for none.
+int mxtpu_kvstore_set_optimizer(void *kv, const char *name,
+                                const char *kwargs_json) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *optmod = PyImport_ImportModule("mxnet_tpu.optimizer");
+  if (optmod == nullptr) {
+    capture_py_error("import mxnet_tpu.optimizer failed");
+    return -1;
+  }
+  PyObject *create = PyObject_GetAttrString(optmod, "create");
+  Py_DECREF(optmod);
+  if (create == nullptr) {
+    capture_py_error("optimizer.create missing");
+    return -1;
+  }
+  PyObject *kw = nullptr;
+  if (kwargs_json != nullptr && kwargs_json[0] != '\0') {
+    PyObject *json = PyImport_ImportModule("json");
+    kw = json != nullptr
+             ? PyObject_CallMethod(json, "loads", "s", kwargs_json)
+             : nullptr;
+    Py_XDECREF(json);
+    if (kw == nullptr || !PyDict_Check(kw)) {
+      capture_py_error("kwargs_json is not a JSON object");
+      Py_XDECREF(kw);
+      Py_DECREF(create);
+      return -1;
+    }
+  }
+  PyObject *pos = Py_BuildValue("(s)", name);
+  PyObject *opt = PyObject_Call(create, pos, kw);
+  Py_DECREF(pos);
+  Py_XDECREF(kw);
+  Py_DECREF(create);
+  if (opt == nullptr) {
+    capture_py_error("optimizer create failed");
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod(reinterpret_cast<PyObject *>(kv),
+                                    "set_optimizer", "O", opt);
+  Py_DECREF(opt);
+  if (r == nullptr) {
+    capture_py_error("set_optimizer failed");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
 int mxtpu_shutdown() {
   if (g_nd_module != nullptr) {
     Gil gil;

@@ -90,6 +90,33 @@ int mxtpu_backward(void *handle);
  * never attached). */
 void *mxtpu_ndarray_grad(void *handle);
 
+/* ---- kvstore (ref: MXKVStoreCreate, MXKVStoreInit, MXKVStorePushEx,
+ *      MXKVStorePullEx, MXKVStorePushPullEx, MXKVStoreSetOptimizer) ------- */
+
+/* Create a KVStore handle; type: "local" | "device" (the dist types need
+ * a jax.distributed gang and are Python-launcher territory). */
+void *mxtpu_kvstore_create(const char *type);
+int mxtpu_kvstore_free(void *kv);
+
+/* Register `key` with its initial value. */
+int mxtpu_kvstore_init(void *kv, const char *key, void *value);
+
+/* Push a value (gradient); with an optimizer installed the server
+ * applies the update, otherwise pushes accumulate reference-style. */
+int mxtpu_kvstore_push(void *kv, const char *key, void *value);
+
+/* Pull the stored value as a new owned NDArray handle (NULL on error). */
+void *mxtpu_kvstore_pull(void *kv, const char *key);
+
+/* Fused push+pull: returns the post-push stored value (owned handle). */
+void *mxtpu_kvstore_pushpull(void *kv, const char *key, void *value);
+
+/* Install a server-side optimizer by registry name ("sgd", "adam", ...)
+ * with JSON kwargs ({"learning_rate": 0.1}; NULL or "" for defaults), so
+ * subsequent pushes of gradients update the stored weights in place. */
+int mxtpu_kvstore_set_optimizer(void *kv, const char *name,
+                                const char *kwargs_json);
+
 #ifdef __cplusplus
 }
 #endif

@@ -262,6 +262,34 @@ int main(void) {
   CHECK(first_loss > 0.0f, "initial loss positive");
   CHECK(last_loss < 0.5f * first_loss, "loss halves under C-driven SGD");
 
+  /* ---- kvstore from C (ref: MXKVStorePushPullEx) ----------------------- */
+  void *kv = mxtpu_kvstore_create("local");
+  CHECK(kv != NULL, "kvstore create");
+  float wv[4] = {1.f, 2.f, 3.f, 4.f};
+  float gv[4] = {0.5f, 0.5f, 0.5f, 0.5f};
+  long kvs[1] = {4};
+  void *w0 = mxtpu_ndarray_create(wv, kvs, 1);
+  void *g0 = mxtpu_ndarray_create(gv, kvs, 1);
+  CHECK(w0 && g0, "kvstore tensors");
+  CHECK(mxtpu_kvstore_init(kv, "w", w0) == 0, "kvstore init");
+  CHECK(mxtpu_kvstore_set_optimizer(kv, "sgd",
+                                    "{\"learning_rate\": 0.1}") == 0,
+        "kvstore set_optimizer");
+  void *pulled = mxtpu_kvstore_pushpull(kv, "w", g0);
+  CHECK(pulled != NULL, "kvstore pushpull");
+  float pv[4];
+  CHECK(mxtpu_ndarray_to_host(pulled, pv, 4) == 4, "pull to host");
+  /* server-side sgd: w <- w - 0.1 * 0.5 */
+  CHECK(fabsf(pv[0] - 0.95f) < 1e-5f && fabsf(pv[3] - 3.95f) < 1e-5f,
+        "server-side sgd applied on push");
+  mxtpu_ndarray_free(pulled);
+  /* unknown key surfaces a clean error */
+  CHECK(mxtpu_kvstore_pull(kv, "nope") == NULL, "pull unknown key NULL");
+  CHECK(strlen(mxtpu_last_error()) > 0, "pull unknown key sets error");
+  mxtpu_ndarray_free(w0);
+  mxtpu_ndarray_free(g0);
+  mxtpu_kvstore_free(kv);
+
   mxtpu_ndarray_free(x);
   mxtpu_ndarray_free(y);
   mxtpu_ndarray_free(w1);
