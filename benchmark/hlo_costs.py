@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Compiled-HLO cost accounting for the headline ResNet train step.
+
+The axon tunnel breaks `jax.profiler` device traces (PERF.md), so the
+measurable substitute is XLA's own `cost_analysis()` on the compiled
+train step: FLOPs and HBM bytes accessed per step.  This is the tool
+behind PERF.md's 51.4 -> 44.2 GB traffic accounting and the fused-conv
+A/B (VERDICT r4 task #2: fused target <= 38 GB/step from 44.2).
+
+  python benchmark/hlo_costs.py            # unfused NHWC resnet50
+  MXTPU_BENCH_FUSED=1 python benchmark/hlo_costs.py
+
+Prints one JSON line: {"fused": bool, "flops_T": .., "bytes_GB": ..,
+"batch": N}.  Needs a live backend (compilation happens server-side);
+runs on CPU too but CPU byte counts are not comparable to TPU's.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_default_matmul_precision", "bfloat16")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    fused = bool(int(os.environ.get("MXTPU_BENCH_FUSED") or "0"))
+    batch = int(os.environ.get("MXTPU_COST_BATCH") or "256")
+    net = resnet50_v1(layout="NHWC", fused=fused)
+    net.initialize()
+    net.cast("bfloat16")
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              wd=1e-4)
+    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              opt, mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch, 224, 224, 3)
+                    .astype(np.float32)).astype("bfloat16")
+    y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.int32))
+    step(x, y).asnumpy()  # build + compile the fused train program
+
+    costs = step.cost_analysis()
+    print(json.dumps({
+        "fused": fused,
+        "batch": batch,
+        "flops_T": round(costs.get("flops", float("nan")) / 1e12, 3),
+        "bytes_GB": round(costs.get("bytes accessed", float("nan")) / 1e9,
+                          2),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -230,17 +230,41 @@ class TrainStep:
             self._n_label = len(label_leaves)
             self._jit = self._compile(data_tree, label_tree, len(data_leaves))
             self._sig = sig
+            self._last_avals = None  # refresh lazily on the next step
+            self._cost_cache = None
         key = _random.next_key()
         lr = jnp.float32(self._base_lr())
         dat_sh = NamedSharding(self.mesh, self._data_pspec)
         data_leaves = [_put_batch(l, dat_sh) for l in data_leaves]
         label_leaves = [_put_batch(l, dat_sh) for l in label_leaves]
+        args = (self._train_arrays, self._aux_arrays, self._states,
+                self._t, key, lr, *data_leaves, *label_leaves)
+        if getattr(self, "_last_avals", None) is None:
+            # once per signature: the aval snapshot cost_analysis() lowers
+            # with (shapes are fixed until sig changes)
+            self._last_avals = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
         (self._train_arrays, self._aux_arrays, self._states, self._t,
-         loss) = self._jit(self._train_arrays, self._aux_arrays, self._states,
-                           self._t, key, lr, *data_leaves, *label_leaves)
+         loss) = self._jit(*args)
         self._num_update += 1
         self.optimizer.num_update = self._num_update
         return NDArray(loss)
+
+    # ------------------------------------------------------------- costing --
+    def cost_analysis(self):
+        """XLA's cost model of the compiled step program: {'flops': ...,
+        'bytes accessed': ...} — the profiler substitute that works through
+        the axon tunnel (PERF.md methodology; device traces do not).  Run
+        at least one step first so the program and arg shapes exist.
+        Cached per jit signature: the AOT lower+compile is a second full
+        XLA compile, not worth repeating through a flaky tunnel."""
+        if getattr(self, "_last_avals", None) is None or self._jit is None:
+            raise RuntimeError("cost_analysis() needs one completed step")
+        if getattr(self, "_cost_cache", None) is None:
+            costs = (self._jit.lower(*self._last_avals).compile()
+                     .cost_analysis())
+            self._cost_cache = costs[0] if isinstance(costs, list) else costs
+        return self._cost_cache
 
     # ---------------------------------------------------------------- sync --
     def sync_params_to_net(self):

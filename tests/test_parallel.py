@@ -255,3 +255,22 @@ def test_kvstore_pull_mismatch_raises():
     kv.init([0, 1, 2], [mx.nd.ones((2,))] * 3)
     with pytest.raises(ValueError):
         kv.pull([0, 1, 2], out=[mx.nd.zeros((2,)), mx.nd.zeros((2,))])
+
+
+def test_trainstep_cost_analysis():
+    """TrainStep.cost_analysis(): XLA's cost model of the compiled step
+    (the profiler substitute that works through the axon tunnel; used by
+    benchmark/hlo_costs.py for the fused-conv HBM A/B)."""
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    step = parallel.TrainStep(net, gluon.loss.L2Loss(),
+                              mx.optimizer.create("sgd", learning_rate=0.1),
+                              mesh=parallel.make_mesh(dp=-1))
+    with pytest.raises(RuntimeError):
+        step.cost_analysis()
+    x = mx.nd.array(np.random.randn(8, 8).astype(np.float32))
+    y = mx.nd.array(np.random.randn(8, 4).astype(np.float32))
+    step(x, y).asnumpy()
+    costs = step.cost_analysis()
+    assert costs.get("flops", 0) > 0
+    assert costs.get("bytes accessed", 0) > 0

@@ -38,27 +38,36 @@ LOG_PATH = os.path.join(REPO, "tools", "tpu_watch.log")
 PROBE_INTERVAL = 600       # seconds between probes while wedged
 PROBE_TIMEOUT = 120        # a healthy tunnel answers in ~5-20 s
 
-# (name, argv, artifact paths, timeout_s).  Ordered cheapest-first so a brief
-# tunnel window still yields the highest-value evidence: the compile-only
-# fused-conv smoke distinguishes "Mosaic rejects the kernel" from "numerics
-# drift" (VERDICT r4 weak #2) before the expensive full suite runs.
+# (name, argv, artifact paths, timeout_s, extra_env).  Ordered cheapest-first
+# so a brief tunnel window still yields the highest-value evidence: the
+# compile-only fused-conv smoke distinguishes "Mosaic rejects the kernel"
+# from "numerics drift" (VERDICT r4 weak #2) before the expensive full suite.
 QUEUE = [
     ("fused_conv_compile_smoke",
      [sys.executable, "-m", "pytest", "tests_tpu/test_fused_conv_tpu.py",
       "-q", "-k", "compile_only", "--no-header"],
-     ["TPU_FUSED_COMPILE_r05.md"], 1800),
+     ["TPU_FUSED_COMPILE_r05.md"], 1800, {}),
     ("bench_default",
      [sys.executable, "bench.py"],
-     ["BENCH_builder_r05.json"], 2400),
+     ["BENCH_builder_r05.json"], 2400, {}),
     ("bench_fused_ab",
      [sys.executable, "bench.py"],
-     ["BENCH_builder_r05_fused.json"], 2400),
+     ["BENCH_builder_r05_fused.json"], 2400, {"MXTPU_BENCH_FUSED": "1"}),
+    ("hlo_costs_default",
+     [sys.executable, "benchmark/hlo_costs.py"],
+     ["HLO_COSTS_r05.md"], 2400, {}),
+    ("hlo_costs_fused",
+     [sys.executable, "benchmark/hlo_costs.py"],
+     ["HLO_COSTS_r05_fused.md"], 2400, {"MXTPU_BENCH_FUSED": "1"}),
+    ("bench_ssd",
+     [sys.executable, "bench.py", "ssd"],
+     ["BENCH_builder_r05_ssd.json"], 2400, {}),
     ("bench_all",
      [sys.executable, "bench.py", "all"],
-     ["BENCH_builder_r05_all.json"], 4800),
+     ["BENCH_builder_r05_all.json"], 4800, {}),
     ("tests_tpu",
      [sys.executable, "-m", "pytest", "tests_tpu/", "-q"],
-     ["TPU_TESTS_r05.md"], 7200),
+     ["TPU_TESTS_r05.md"], 10800, {}),
 ]
 
 
@@ -69,12 +78,17 @@ def log(msg):
         f.write(line + "\n")
 
 
+MAX_ATTEMPTS = 3           # per-step cap so one red step can't starve the rest
+
+
 def load_state():
     try:
         with open(STATE_PATH) as f:
-            return json.load(f)
+            st = json.load(f)
+            st.setdefault("attempts", {})
+            return st
     except (OSError, ValueError):
-        return {"done": [], "probes": 0, "alive_at": None}
+        return {"done": [], "probes": 0, "alive_at": None, "attempts": {}}
 
 
 def save_state(state):
@@ -94,10 +108,9 @@ def probe():
         return False
 
 
-def run_step(name, argv, artifacts, timeout_s):
+def run_step(name, argv, artifacts, timeout_s, extra_env=None):
     env = dict(os.environ)
-    if name == "bench_fused_ab":
-        env["MXTPU_BENCH_FUSED"] = "1"
+    env.update(extra_env or {})
     log("step %s: starting (timeout %ds)" % (name, timeout_s))
     t0 = time.time()
     try:
@@ -112,6 +125,11 @@ def run_step(name, argv, artifacts, timeout_s):
         with open(os.path.join(REPO, artifacts[0]), "w") as f:
             f.write("# step %s TIMED OUT after %ds at %s\n%s" %
                     (name, timeout_s, time.strftime("%F %T"), partial[-20000:]))
+        # a timed-out log is still on-chip evidence: commit it like the rest
+        subprocess.run(["git", "add", "--"] + artifacts, cwd=REPO)
+        subprocess.run(["git", "commit", "-q", "-m",
+                        "on-chip artifact: %s (timeout, tpu_watch)" % name,
+                        "--"] + artifacts, cwd=REPO)
         return False
     dt = time.time() - t0
     body = ("# on-chip artifact: %s  (builder-measured via tpu_watch, "
@@ -153,13 +171,31 @@ def main():
         log("probe #%d: TUNNEL ALIVE — firing queue (%d pending)"
             % (state["probes"], len(pending)))
         save_state(state)
-        for name, argv, artifacts, timeout_s in pending:
-            if run_step(name, argv, artifacts, timeout_s):
+        for name, argv, artifacts, timeout_s, extra_env in pending:
+            if state["attempts"].get(name, 0) >= MAX_ATTEMPTS:
+                continue  # persistently red: its artifact is committed; move on
+            state["attempts"][name] = state["attempts"].get(name, 0) + 1
+            if run_step(name, argv, artifacts, timeout_s, extra_env):
                 state["done"].append(name)
                 save_state(state)
             else:
-                # failed or wedged mid-step: re-probe before burning more time
-                break
+                # Failed: distinguish "step is red" (tunnel alive — keep
+                # draining the rest of the queue; round-4 bug: a red first
+                # step starved every later step) from "tunnel re-wedged
+                # mid-step" (refund the attempt — the step never saw a
+                # healthy tunnel — and back off until the next alive probe).
+                if not probe():
+                    state["attempts"][name] -= 1
+                    save_state(state)
+                    log("tunnel re-wedged mid-queue; backing off")
+                    break
+                save_state(state)
+        still_pending = [s for s in QUEUE if s[0] not in state["done"]]
+        if still_pending and all(state["attempts"].get(s[0], 0) >= MAX_ATTEMPTS
+                                 for s in still_pending):
+            log("every pending step exhausted %d attempts; exiting "
+                "(red artifacts are committed)" % MAX_ATTEMPTS)
+            return 1
         time.sleep(60)
 
 
