@@ -23,3 +23,4 @@ from . import image  # noqa: F401
 from . import multibox  # noqa: F401
 from . import quantization  # noqa: F401
 from . import control_flow  # noqa: F401
+from . import random_ops  # noqa: F401

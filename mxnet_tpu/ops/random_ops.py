@@ -1,0 +1,175 @@
+"""Random sampling operators, registered in the op registry.
+
+ref: src/operator/random/sample_op.cc — the reference registers its sampler
+family (`_random_uniform`, `_random_normal`, ...) as first-class NNVM ops so
+every frontend (Python, C API via MXImperativeInvokeEx, Scala, ...) draws
+through one dispatch path.  Here the same names are registry ops over
+`jax.random`: the registry's `needs_rng` machinery threads a fresh traced
+PRNG key into the jitted closure (see ops/registry.py::compiled), so samples
+are reproducible under `mx.random.seed` and never constant-folded by XLA.
+
+`mx.nd.random.uniform` (module-style API) and `mx.nd.uniform` (generated op
+wrapper, matching the reference's `mx.nd.uniform`) both exist; this module
+provides the latter and the C ABI's `mxtpu_invoke("_random_uniform", ...)`.
+
+The `_sample_*` variants (ref: src/operator/random/multisample_op.cc) draw
+per-row: parameter arrays of shape (B,) produce output (B, *shape).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op
+from .. import random as _random
+from ..base import dtype_np
+
+
+def _norm_shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+@register_op("_random_uniform", aliases=("uniform", "random_uniform"),
+             needs_rng=True)
+def _random_uniform(low=0.0, high=1.0, shape=(1,), dtype="float32", ctx=None):
+    """ref: sample_op.cc — _random_uniform (SampleUniform)."""
+    return jax.random.uniform(_random.next_key(), _norm_shape(shape),
+                              dtype_np(dtype), minval=low, maxval=high)
+
+
+@register_op("_random_normal", aliases=("normal", "random_normal"),
+             needs_rng=True)
+def _random_normal(loc=0.0, scale=1.0, shape=(1,), dtype="float32", ctx=None):
+    """ref: sample_op.cc — _random_normal (SampleNormal)."""
+    dt = dtype_np(dtype)
+    return loc + scale * jax.random.normal(_random.next_key(),
+                                           _norm_shape(shape), dt)
+
+
+@register_op("_random_gamma", aliases=("random_gamma",), needs_rng=True)
+def _random_gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None):
+    """ref: sample_op.cc — _random_gamma; beta is the SCALE parameter
+    (matching the reference's alpha/beta = shape/scale convention)."""
+    dt = dtype_np(dtype)
+    return beta * jax.random.gamma(_random.next_key(), alpha,
+                                   _norm_shape(shape), dt)
+
+
+@register_op("_random_exponential", aliases=("random_exponential",),
+             needs_rng=True)
+def _random_exponential(lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    """ref: sample_op.cc — _random_exponential (rate parameter lam)."""
+    dt = dtype_np(dtype)
+    return jax.random.exponential(_random.next_key(),
+                                  _norm_shape(shape), dt) / lam
+
+
+@register_op("_random_poisson", aliases=("random_poisson",), needs_rng=True)
+def _random_poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None):
+    """ref: sample_op.cc — _random_poisson.  Counts are produced in the
+    requested dtype (the reference defaults to float32 too)."""
+    out = jax.random.poisson(_random.next_key(), lam, _norm_shape(shape))
+    return out.astype(dtype_np(dtype))
+
+
+@register_op("_random_negative_binomial",
+             aliases=("random_negative_binomial",), needs_rng=True)
+def _random_negative_binomial(k=1, p=0.5, shape=(1,), dtype="float32",
+                              ctx=None):
+    """ref: sample_op.cc — _random_negative_binomial: failures before the
+    k-th success at success probability p.  Drawn as the standard
+    gamma-Poisson mixture: lam ~ Gamma(k, (1-p)/p), out ~ Poisson(lam)."""
+    kg, kp = jax.random.split(_random.next_key())
+    shp = _norm_shape(shape)
+    lam = jax.random.gamma(kg, float(k), shp) * ((1.0 - p) / p)
+    return jax.random.poisson(kp, lam, shp).astype(dtype_np(dtype))
+
+
+@register_op("_random_generalized_negative_binomial",
+             aliases=("random_generalized_negative_binomial",),
+             needs_rng=True)
+def _random_generalized_negative_binomial(mu=1.0, alpha=1.0, shape=(1,),
+                                          dtype="float32", ctx=None):
+    """ref: sample_op.cc — the (mu, alpha) mean/dispersion parameterisation:
+    Gamma(1/alpha, mu*alpha) mixed through Poisson."""
+    kg, kp = jax.random.split(_random.next_key())
+    shp = _norm_shape(shape)
+    lam = jax.random.gamma(kg, 1.0 / alpha, shp) * (mu * alpha)
+    return jax.random.poisson(kp, lam, shp).astype(dtype_np(dtype))
+
+
+@register_op("_random_randint", aliases=("random_randint", "randint"),
+             needs_rng=True)
+def _random_randint(low=0, high=2, shape=(1,), dtype="int32", ctx=None):
+    """ref: sample_op.cc — _random_randint over [low, high)."""
+    return jax.random.randint(_random.next_key(), _norm_shape(shape),
+                              int(low), int(high), dtype_np(dtype))
+
+
+# ---- per-row parameterised sampling (ref: multisample_op.cc) --------------
+
+def _rows(param, shape):
+    """Broadcast a (B,)-shaped parameter against per-row draw shape."""
+    extra = _norm_shape(shape)
+    return param.reshape(param.shape + (1,) * len(extra)), extra
+
+
+@register_op("_sample_uniform", aliases=("sample_uniform",), needs_rng=True)
+def _sample_uniform(low, high, shape=(), dtype="float32"):
+    """ref: multisample_op.cc — _sample_uniform: low/high of shape (B,)
+    produce (B, *shape) draws, row i from [low[i], high[i])."""
+    lo, extra = _rows(low, shape)
+    hi, _ = _rows(high, shape)
+    u = jax.random.uniform(_random.next_key(), low.shape + extra,
+                           dtype_np(dtype))
+    return lo + u * (hi - lo)
+
+
+@register_op("_sample_normal", aliases=("sample_normal",), needs_rng=True)
+def _sample_normal(mu, sigma, shape=(), dtype="float32"):
+    """ref: multisample_op.cc — _sample_normal."""
+    m, extra = _rows(mu, shape)
+    s, _ = _rows(sigma, shape)
+    z = jax.random.normal(_random.next_key(), mu.shape + extra,
+                          dtype_np(dtype))
+    return m + s * z
+
+
+@register_op("_sample_gamma", aliases=("sample_gamma",), needs_rng=True)
+def _sample_gamma(alpha, beta, shape=(), dtype="float32"):
+    """ref: multisample_op.cc — _sample_gamma (alpha shape, beta scale)."""
+    a, extra = _rows(alpha, shape)
+    b, _ = _rows(beta, shape)
+    g = jax.random.gamma(_random.next_key(), a.astype(dtype_np(dtype)),
+                         alpha.shape + extra)
+    return b * g
+
+
+@register_op("_sample_exponential", aliases=("sample_exponential",),
+             needs_rng=True)
+def _sample_exponential(lam, shape=(), dtype="float32"):
+    """ref: multisample_op.cc — _sample_exponential."""
+    l, extra = _rows(lam, shape)
+    e = jax.random.exponential(_random.next_key(), lam.shape + extra,
+                               dtype_np(dtype))
+    return e / l
+
+
+@register_op("_sample_poisson", aliases=("sample_poisson",), needs_rng=True)
+def _sample_poisson(lam, shape=(), dtype="float32"):
+    """ref: multisample_op.cc — _sample_poisson."""
+    l, extra = _rows(lam, shape)
+    out = jax.random.poisson(_random.next_key(),
+                             jnp.broadcast_to(l, lam.shape + extra),
+                             lam.shape + extra)
+    return out.astype(dtype_np(dtype))
+
+
+@register_op("_shuffle", aliases=("shuffle",), needs_rng=True)
+def _shuffle(data):
+    """ref: src/operator/random/shuffle_op.cc — permute along axis 0."""
+    return jax.random.permutation(_random.next_key(), data, axis=0)

@@ -22,6 +22,7 @@
 
 #include <Python.h>
 
+#include <cstdio>
 #include <cstring>
 #include <string>
 
@@ -646,6 +647,295 @@ int mxtpu_kvstore_set_optimizer(void *kv, const char *name,
   }
   Py_DECREF(r);
   return 0;
+}
+
+// ---- runtime introspection / utilities (ref: MXGetVersion,
+//      MXListAllOpNames, MXSymbolGetAtomicSymbolInfo, MXRandomSeed,
+//      MXNDArrayWaitAll, MXGetGPUCount) ------------------------------------
+
+namespace {
+
+// Copy `s` into out (capacity bytes incl. NUL).  Returns the byte length
+// the full string needs INCLUDING the NUL, so callers can size-and-retry;
+// writes a truncated NUL-terminated prefix when capacity is short.
+long copy_out_string(const std::string &s, char *out, long capacity) {
+  long need = static_cast<long>(s.size()) + 1;
+  if (out != nullptr && capacity > 0) {
+    long n = need <= capacity ? need - 1 : capacity - 1;
+    std::memcpy(out, s.data(), n);
+    out[n] = '\0';
+  }
+  return need;
+}
+
+}  // namespace
+
+// Framework version as major*10000 + minor*100 + patch
+// (ref: MXGetVersion's MXNET_VERSION encoding).  -1 on failure.
+int mxtpu_version() {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *mod = PyImport_ImportModule("mxnet_tpu");
+  PyObject *v = mod != nullptr ? PyObject_GetAttrString(mod, "__version__")
+                               : nullptr;
+  Py_XDECREF(mod);
+  if (v == nullptr) {
+    capture_py_error("__version__ missing");
+    return -1;
+  }
+  const char *c = PyUnicode_AsUTF8(v);
+  if (c == nullptr) {
+    capture_py_error("__version__ not a string");
+    Py_DECREF(v);
+    return -1;
+  }
+  int maj = 0, min = 0, pat = 0;
+  std::sscanf(c, "%d.%d.%d", &maj, &min, &pat);
+  Py_DECREF(v);
+  return maj * 10000 + min * 100 + pat;
+}
+
+// Device count of the default jax backend (ref: MXGetGPUCount).
+int mxtpu_num_devices() {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *jax = PyImport_ImportModule("jax");
+  PyObject *ds = jax != nullptr
+                     ? PyObject_CallMethod(jax, "device_count", nullptr)
+                     : nullptr;
+  Py_XDECREF(jax);
+  if (ds == nullptr) {
+    capture_py_error("jax.device_count failed");
+    return -1;
+  }
+  int n = static_cast<int>(PyLong_AsLong(ds));
+  Py_DECREF(ds);
+  return n;
+}
+
+// Default backend platform name ("tpu" | "cpu" | ...) into out.
+// Returns needed byte length incl. NUL, or -1.
+long mxtpu_device_platform(char *out, long capacity) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *jax = PyImport_ImportModule("jax");
+  PyObject *p = jax != nullptr
+                    ? PyObject_CallMethod(jax, "default_backend", nullptr)
+                    : nullptr;
+  Py_XDECREF(jax);
+  if (p == nullptr) {
+    capture_py_error("jax.default_backend failed");
+    return -1;
+  }
+  const char *c = PyUnicode_AsUTF8(p);
+  if (c == nullptr) {
+    capture_py_error("platform name not a string");
+    Py_DECREF(p);
+    return -1;
+  }
+  long need = copy_out_string(c, out, capacity);
+  Py_DECREF(p);
+  return need;
+}
+
+// Seed the framework RNG stream (ref: MXRandomSeed).
+int mxtpu_random_seed(int seed) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *rnd = PyImport_ImportModule("mxnet_tpu.random");
+  PyObject *r = rnd != nullptr ? PyObject_CallMethod(rnd, "seed", "i", seed)
+                               : nullptr;
+  Py_XDECREF(rnd);
+  if (r == nullptr) {
+    capture_py_error("random.seed failed");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+// Block until every queued device computation has finished
+// (ref: MXNDArrayWaitAll over the dependency engine).
+int mxtpu_wait_all() {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *eng = PyImport_ImportModule("mxnet_tpu.engine");
+  PyObject *r = eng != nullptr ? PyObject_CallMethod(eng, "waitall", nullptr)
+                               : nullptr;
+  Py_XDECREF(eng);
+  if (r == nullptr) {
+    capture_py_error("engine.waitall failed");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+// Newline-joined sorted registry op names into out (ref: MXListAllOpNames).
+// Returns the byte length the full listing needs incl. NUL (call with
+// capacity 0 to size a buffer), or -1.
+long mxtpu_list_ops(char *out, long capacity) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *reg = PyImport_ImportModule("mxnet_tpu.ops.registry");
+  PyObject *ops = reg != nullptr ? PyObject_GetAttrString(reg, "OPS")
+                                 : nullptr;
+  Py_XDECREF(reg);
+  if (ops == nullptr) {
+    capture_py_error("ops.registry.OPS missing");
+    return -1;
+  }
+  PyObject *keys = PyDict_Keys(ops);
+  Py_DECREF(ops);
+  if (keys == nullptr || PyList_Sort(keys) != 0) {
+    capture_py_error("op name listing failed");
+    Py_XDECREF(keys);
+    return -1;
+  }
+  std::string joined;
+  Py_ssize_t n = PyList_Size(keys);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    const char *c = PyUnicode_AsUTF8(PyList_GET_ITEM(keys, i));
+    if (c == nullptr) continue;
+    if (!joined.empty()) joined += '\n';
+    joined += c;
+  }
+  Py_DECREF(keys);
+  return copy_out_string(joined, out, capacity);
+}
+
+// Docstring of a registered op into out (ref: MXSymbolGetAtomicSymbolInfo's
+// description field).  Returns needed byte length incl. NUL, or -1.
+long mxtpu_op_doc(const char *op_name, char *out, long capacity) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *reg = PyImport_ImportModule("mxnet_tpu.ops.registry");
+  PyObject *fn = reg != nullptr
+                     ? PyObject_CallMethod(reg, "get_op", "s", op_name)
+                     : nullptr;
+  Py_XDECREF(reg);
+  if (fn == nullptr) {
+    capture_py_error("unknown op");
+    return -1;
+  }
+  PyObject *doc = PyObject_GetAttrString(fn, "__doc__");
+  Py_DECREF(fn);
+  std::string text;
+  if (doc != nullptr && doc != Py_None) {
+    const char *c = PyUnicode_AsUTF8(doc);
+    if (c != nullptr) text = c;
+  }
+  Py_XDECREF(doc);
+  if (doc == nullptr) PyErr_Clear();
+  return copy_out_string(text, out, capacity);
+}
+
+// ---- NDArray file I/O (ref: MXNDArraySave / MXNDArrayLoad) ----------------
+
+// Save n arrays to fname.  keys==NULL saves positionally (loads back as a
+// list); otherwise keys[i] names handles[i] (loads back as a dict).
+int mxtpu_ndarray_save(const char *fname, const char **keys, void **handles,
+                       int n) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *payload;
+  if (keys != nullptr) {
+    payload = PyDict_New();
+    for (int i = 0; i < n; ++i) {
+      PyDict_SetItemString(payload, keys[i],
+                           reinterpret_cast<PyObject *>(handles[i]));
+    }
+  } else {
+    payload = PyList_New(n);
+    for (int i = 0; i < n; ++i) {
+      PyObject *h = reinterpret_cast<PyObject *>(handles[i]);
+      Py_INCREF(h);
+      PyList_SET_ITEM(payload, i, h);
+    }
+  }
+  PyObject *r = PyObject_CallMethod(g_nd_module, "save", "sO", fname,
+                                    payload);
+  Py_DECREF(payload);
+  if (r == nullptr) {
+    capture_py_error("nd.save failed");
+    return -1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+// Load arrays from fname.  Fills outs[0..min(count, out_capacity)) with
+// owned handles; for dict-saved files also writes the newline-joined key
+// order into names (names_capacity bytes; "" for list saves).  Returns
+// the total array count (callers detect truncation), or -1.
+int mxtpu_ndarray_load(const char *fname, void **outs, int out_capacity,
+                       char *names, long names_capacity) {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return -1;
+  }
+  Gil gil;
+  PyObject *r = PyObject_CallMethod(g_nd_module, "load", "s", fname);
+  if (r == nullptr) {
+    capture_py_error("nd.load failed");
+    return -1;
+  }
+  std::string joined;
+  int n = 0;
+  if (PyDict_Check(r)) {
+    PyObject *key = nullptr, *val = nullptr;
+    Py_ssize_t pos = 0;
+    while (PyDict_Next(r, &pos, &key, &val)) {
+      if (n < out_capacity) {
+        Py_INCREF(val);
+        outs[n] = val;
+      }
+      const char *c = PyUnicode_AsUTF8(key);
+      if (c != nullptr) {
+        if (!joined.empty()) joined += '\n';
+        joined += c;
+      }
+      ++n;
+    }
+  } else if (PyList_Check(r)) {
+    n = static_cast<int>(PyList_Size(r));
+    for (int i = 0; i < n && i < out_capacity; ++i) {
+      PyObject *o = PyList_GET_ITEM(r, i);
+      Py_INCREF(o);
+      outs[i] = o;
+    }
+  } else {
+    Py_DECREF(r);
+    g_last_error = "nd.load returned neither list nor dict";
+    return -1;
+  }
+  Py_DECREF(r);
+  if (names != nullptr) copy_out_string(joined, names, names_capacity);
+  return n;
 }
 
 int mxtpu_shutdown() {

@@ -117,6 +117,49 @@ void *mxtpu_kvstore_pushpull(void *kv, const char *key, void *value);
 int mxtpu_kvstore_set_optimizer(void *kv, const char *name,
                                 const char *kwargs_json);
 
+/* ---- runtime introspection / utilities (ref: MXGetVersion,
+ *      MXListAllOpNames, MXSymbolGetAtomicSymbolInfo, MXRandomSeed,
+ *      MXNDArrayWaitAll, MXGetGPUCount) --------------------------------- */
+
+/* Framework version, major*10000 + minor*100 + patch (ref: MXGetVersion). */
+int mxtpu_version(void);
+
+/* Device count of the default jax backend (ref: MXGetGPUCount analog). */
+int mxtpu_num_devices(void);
+
+/* Default backend platform name ("tpu" | "cpu" | ...).  Returns the byte
+ * length the name needs INCLUDING the NUL (size-and-retry contract shared
+ * by every string-returning call below), or -1. */
+long mxtpu_device_platform(char *out, long capacity);
+
+/* Seed the framework RNG stream (ref: MXRandomSeed). */
+int mxtpu_random_seed(int seed);
+
+/* Block until all queued device computations finish (ref: MXNDArrayWaitAll). */
+int mxtpu_wait_all(void);
+
+/* Newline-joined sorted op names (ref: MXListAllOpNames).  Call with
+ * capacity 0 to size the buffer; returns needed bytes incl. NUL, or -1. */
+long mxtpu_list_ops(char *out, long capacity);
+
+/* Docstring of one registered op (ref: MXSymbolGetAtomicSymbolInfo
+ * description).  Same size-and-retry contract; -1 on unknown op. */
+long mxtpu_op_doc(const char *op_name, char *out, long capacity);
+
+/* ---- NDArray file I/O (ref: MXNDArraySave / MXNDArrayLoad) ------------- */
+
+/* Save n arrays.  keys==NULL: positional (loads back as a list);
+ * else keys[i] names handles[i] (loads back as a dict). */
+int mxtpu_ndarray_save(const char *fname, const char **keys, void **handles,
+                       int n);
+
+/* Load arrays; fills outs[0..min(count, out_capacity)) with owned handles.
+ * For dict-saved files writes newline-joined keys into names ("" for list
+ * saves).  Returns total count (n > out_capacity signals truncation), -1
+ * on error. */
+int mxtpu_ndarray_load(const char *fname, void **outs, int out_capacity,
+                       char *names, long names_capacity);
+
 #ifdef __cplusplus
 }
 #endif

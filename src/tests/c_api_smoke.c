@@ -290,6 +290,85 @@ int main(void) {
   mxtpu_ndarray_free(g0);
   mxtpu_kvstore_free(kv);
 
+  /* ---- introspection / utilities (ref: MXGetVersion, MXListAllOpNames,
+   *      MXRandomSeed, MXNDArrayWaitAll) -------------------------------- */
+  CHECK(mxtpu_version() >= 100, "version encodes major.minor.patch");
+  CHECK(mxtpu_num_devices() >= 1, "at least one device");
+  char plat[16];
+  CHECK(mxtpu_device_platform(plat, sizeof plat) > 1, "platform name");
+  CHECK(strlen(plat) > 0, "platform non-empty");
+  CHECK(mxtpu_wait_all() == 0, "wait_all");
+
+  long ops_need = mxtpu_list_ops(NULL, 0);
+  CHECK(ops_need > 1000, "op listing is substantial"); /* 290+ names */
+  char *ops_buf = (char *)malloc(ops_need);
+  CHECK(mxtpu_list_ops(ops_buf, ops_need) == ops_need, "op listing fills");
+  CHECK(strstr(ops_buf, "broadcast_add") != NULL &&
+            strstr(ops_buf, "Convolution") != NULL &&
+            strstr(ops_buf, "sgd_update") != NULL,
+        "op listing has core names");
+  free(ops_buf);
+  char doc[4096];
+  CHECK(mxtpu_op_doc("dot", doc, sizeof doc) > 1, "op doc");
+  CHECK(strstr(doc, "ref:") != NULL, "op doc carries the ref citation");
+  CHECK(mxtpu_op_doc("definitely_not_an_op", doc, sizeof doc) == -1,
+        "op doc unknown op errors");
+
+  /* random seed determinism: same seed -> same uniform sample */
+  CHECK(mxtpu_random_seed(7) == 0, "seed");
+  void *r1 = mxtpu_invoke("uniform", NULL, 0,
+                          "{\"shape\": [4], \"low\": 0.0, \"high\": 1.0}");
+  CHECK(mxtpu_random_seed(7) == 0, "re-seed");
+  void *r2 = mxtpu_invoke("uniform", NULL, 0,
+                          "{\"shape\": [4], \"low\": 0.0, \"high\": 1.0}");
+  CHECK(r1 && r2, "uniform samples");
+  float rv1[4], rv2[4];
+  CHECK(mxtpu_ndarray_to_host(r1, rv1, 4) == 4 &&
+            mxtpu_ndarray_to_host(r2, rv2, 4) == 4,
+        "uniform to host");
+  for (int i = 0; i < 4; ++i) {
+    CHECK(fabsf(rv1[i] - rv2[i]) < 1e-7f, "seeded streams reproduce");
+    CHECK(rv1[i] >= 0.0f && rv1[i] < 1.0f, "uniform in range");
+  }
+  mxtpu_ndarray_free(r1);
+  mxtpu_ndarray_free(r2);
+
+  /* ---- NDArray save/load round-trip (ref: MXNDArraySave/Load) --------- */
+  const char *save_keys[2] = {"alpha", "beta"};
+  void *save_vals[2] = {a, b};
+  CHECK(mxtpu_ndarray_save("/tmp/mxtpu_smoke.npz", save_keys, save_vals,
+                           2) == 0,
+        "ndarray_save dict");
+  void *loaded[2] = {NULL, NULL};
+  char names[64];
+  int nloaded = mxtpu_ndarray_load("/tmp/mxtpu_smoke.npz", loaded, 2, names,
+                                   sizeof names);
+  CHECK(nloaded == 2 && loaded[0] && loaded[1], "ndarray_load dict");
+  CHECK(strstr(names, "alpha") != NULL && strstr(names, "beta") != NULL,
+        "loaded names round-trip");
+  /* find which handle is "alpha" (dict order) and check its payload */
+  void *alpha = strncmp(names, "alpha", 5) == 0 ? loaded[0] : loaded[1];
+  float alpha_back[6];
+  CHECK(mxtpu_ndarray_to_host(alpha, alpha_back, 6) == 6, "alpha host");
+  for (int i = 0; i < 6; ++i) {
+    CHECK(fabsf(alpha_back[i] - a_data[i]) < 1e-6f, "alpha values survive");
+  }
+  mxtpu_ndarray_free(loaded[0]);
+  mxtpu_ndarray_free(loaded[1]);
+  /* positional save loads back as a list (names empty) */
+  CHECK(mxtpu_ndarray_save("/tmp/mxtpu_smoke_list.npz", NULL, save_vals,
+                           2) == 0,
+        "ndarray_save list");
+  void *loaded2[2] = {NULL, NULL};
+  CHECK(mxtpu_ndarray_load("/tmp/mxtpu_smoke_list.npz", loaded2, 2, names,
+                           sizeof names) == 2,
+        "ndarray_load list");
+  CHECK(names[0] == '\0', "list load has no names");
+  mxtpu_ndarray_free(loaded2[0]);
+  mxtpu_ndarray_free(loaded2[1]);
+  remove("/tmp/mxtpu_smoke.npz");
+  remove("/tmp/mxtpu_smoke_list.npz");
+
   mxtpu_ndarray_free(x);
   mxtpu_ndarray_free(y);
   mxtpu_ndarray_free(w1);
