@@ -236,14 +236,10 @@ random.bernoulli = _rand_wrap(
 
 
 def _multinomial(data, shape=1, get_prob=False, dtype="int32"):
-    key = _random.next_key()
-    n = shape if isinstance(shape, int) else int(_np.prod(shape))
-    logits = jnp.log(jnp.maximum(data._data, 1e-30))
-    idx = jax.random.categorical(key, logits, axis=-1, shape=(n,) + logits.shape[:-1])
-    idx = jnp.moveaxis(idx, 0, -1)
-    if isinstance(shape, int) and shape == 1:
-        idx = idx[..., 0]
-    return NDArray(idx.astype(dtype_np(dtype)), ctx=data._ctx)
+    # one implementation: the registry op (ref: sample_multinomial_op.cc),
+    # which also serves nd.invoke / the C ABI and supports get_prob
+    return invoke("_sample_multinomial", data, shape=shape,
+                  get_prob=get_prob, dtype=dtype)
 
 
 random.multinomial = _multinomial

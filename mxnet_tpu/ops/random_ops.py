@@ -169,6 +169,39 @@ def _sample_poisson(lam, shape=(), dtype="float32"):
     return out.astype(dtype_np(dtype))
 
 
+@register_op("_sample_multinomial", aliases=("sample_multinomial",),
+             needs_rng=True)
+def _sample_multinomial(data, shape=1, get_prob=False, dtype="int32"):
+    """ref: src/operator/random/sample_multinomial_op.cc — categorical draws
+    from probability rows (..., K).  Output is batch_shape + shape (the
+    reference's per-distribution draw shape); the default single draw is
+    squeezed to batch_shape, like the reference's shape=_Null.  get_prob=True
+    additionally returns the log-prob of each draw (the REINFORCE helper,
+    matching the reference's two-output form).
+
+    `mx.nd.random.multinomial` is this op (one implementation; the module
+    wrapper delegates here)."""
+    if shape is None or shape == () or (isinstance(shape, int) and shape == 1):
+        extra = ()
+    elif isinstance(shape, int):
+        extra = (shape,)
+    else:
+        extra = tuple(int(s) for s in shape)
+    n = 1
+    for s in extra:
+        n *= s
+    batch = data.shape[:-1]
+    logp = jnp.log(jnp.maximum(data, 1e-30))
+    idx = jax.random.categorical(_random.next_key(), logp, axis=-1,
+                                 shape=(n,) + batch)
+    idx = jnp.moveaxis(idx, 0, -1)              # batch + (n,)
+    out = idx.reshape(batch + extra).astype(dtype_np(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(logp, idx, axis=-1)
+        return out, lp.reshape(batch + extra).astype(jnp.float32)
+    return out
+
+
 @register_op("_shuffle", aliases=("shuffle",), needs_rng=True)
 def _shuffle(data):
     """ref: src/operator/random/shuffle_op.cc — permute along axis 0."""

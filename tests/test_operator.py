@@ -935,6 +935,22 @@ COVERED_ELSEWHERE = {
     "flash_attention": "tests/test_flash_attention.py",
     "quantized_conv": "tests/test_misc_subsystems.py",
     "FusedNormReluConv": "tests/test_fused_conv.py",
+    # the whole sampler family (every alias resolves to the same fns)
+    "_random_uniform": "tests/test_random_ops.py",
+    "_random_normal": "tests/test_random_ops.py",
+    "_random_gamma": "tests/test_random_ops.py",
+    "_random_exponential": "tests/test_random_ops.py",
+    "_random_poisson": "tests/test_random_ops.py",
+    "_random_negative_binomial": "tests/test_random_ops.py",
+    "_random_generalized_negative_binomial": "tests/test_random_ops.py",
+    "_random_randint": "tests/test_random_ops.py",
+    "_sample_uniform": "tests/test_random_ops.py",
+    "_sample_normal": "tests/test_random_ops.py",
+    "_sample_gamma": "tests/test_random_ops.py",
+    "_sample_exponential": "tests/test_random_ops.py",
+    "_sample_poisson": "tests/test_random_ops.py",
+    "_sample_multinomial": "tests/test_random_ops.py",
+    "_shuffle": "tests/test_random_ops.py",
 }
 
 

@@ -119,6 +119,36 @@ def test_sample_variants_per_row():
     assert abs(p[0].mean() - 0.5) < 0.1 and abs(p[1].mean() - 4.0) < 0.2
 
 
+def test_sample_multinomial():
+    mx.random.seed(0)
+    probs = nd.array(np.array([[0.0, 0.1, 0.9], [1.0, 0.0, 0.0]], np.float32))
+    draws = nd.invoke("_sample_multinomial", probs, shape=2000).asnumpy()
+    assert draws.shape == (2, 2000)
+    assert draws[0].min() >= 1                       # class 0 has prob 0
+    assert abs((draws[0] == 2).mean() - 0.9) < 0.03  # matches pvals
+    assert set(np.unique(draws[1])) == {0}           # degenerate row
+    # single draw squeezes the trailing axis, like the reference
+    one = nd.invoke("_sample_multinomial", probs).asnumpy()
+    assert one.shape == (2,)
+    # tuple shape: output is batch + shape (all prod(shape) draws kept)
+    t = nd.invoke("_sample_multinomial", probs, shape=(3, 5)).asnumpy()
+    assert t.shape == (2, 3, 5)
+    assert set(np.unique(t[1])) == {0}
+    # get_prob returns the log-prob of each draw
+    d, lp = nd.invoke("_sample_multinomial", probs, shape=4, get_prob=True)
+    dv, lpv = d.asnumpy(), lp.asnumpy()
+    assert dv.shape == (2, 4) and lpv.shape == (2, 4)
+    np.testing.assert_allclose(
+        lpv, np.log(np.maximum(probs.asnumpy(), 1e-30))[
+            np.arange(2)[:, None], dv.astype(int)], rtol=1e-5)
+    # the module-style wrapper is the same implementation
+    mx.random.seed(11)
+    m1 = nd.random.multinomial(probs, shape=6).asnumpy()
+    mx.random.seed(11)
+    m2 = nd.invoke("_sample_multinomial", probs, shape=6).asnumpy()
+    np.testing.assert_array_equal(m1, m2)
+
+
 def test_shuffle_permutes_rows():
     mx.random.seed(3)
     x = nd.array(np.arange(40, dtype=np.float32).reshape(10, 4))
