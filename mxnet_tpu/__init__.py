@@ -63,6 +63,12 @@ from . import profiler
 from . import onnx
 from . import parallel
 from . import gluon
+from . import symbol
+from . import symbol as sym          # mx.sym — symbolic graph frontend
+from . import executor
+from . import module
+from . import module as mod          # mx.mod — Module API
+from . import model                  # mx.model — checkpoint helpers
 
 config._apply_startup()
 

@@ -1,0 +1,370 @@
+"""Symbolic executor (ref: src/executor/graph_executor.cc — GraphExecutor).
+
+The reference's executor plans memory, schedules kernels through the
+dependency engine, and hand-wires every op's FGradient into a backward
+graph.  TPU-native substitution: the Symbol DAG traces into ONE pure jax
+function; `jax.jit` is the memory planner + scheduler (XLA buffer
+assignment and fusion), and `jax.grad` over the traced function IS the
+backward graph.  MXNet's output-op semantics (SoftmaxOutput & friends carry
+their loss gradient implicitly) live in `_HEAD_LOSSES`, so
+`executor.backward()` reproduces the reference's training contract without
+a per-op FGradient registry.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import random as _random
+from .context import current_context, Context
+from .ndarray import NDArray
+from .ops.registry import OP_META, get_op
+from .symbol import LAYERS, Symbol, _AUX_STATE_OPS, infer_arg_shapes
+
+
+# ---------------------------------------------------------------------------
+# tracing the DAG into a pure function
+# ---------------------------------------------------------------------------
+
+def _trace(sym: Symbol, arg_vals: Dict, aux_vals: Dict, training: bool):
+    """Evaluate the DAG on jax values.  Returns (outputs, aux_updates)."""
+    memo: Dict[int, object] = {}
+    aux_updates: Dict[str, object] = {}
+
+    def value(s: Symbol):
+        node = s._node
+        key = id(node)
+        if key not in memo:
+            if node.op is None:
+                store = aux_vals if node.is_aux else arg_vals
+                if node.name not in store:
+                    kind = "auxiliary state" if node.is_aux else "argument"
+                    raise ValueError(f"executor: unbound {kind} {node.name!r}")
+                memo[key] = store[node.name]
+            else:
+                fn = get_op(node.op)
+                ins = [value(i) for i in node.inputs]
+                kwargs = {k: v for k, v in node.attrs.items()
+                          if not k.startswith("__")}
+                if OP_META.get(node.op, {}).get("has_training"):
+                    kwargs.setdefault("training", training)
+                res = fn(*ins, **kwargs)
+                if node.op in _AUX_STATE_OPS:
+                    # functional aux form: (out, *new_aux) threads back into
+                    # the aux variables (ref: graph executor aux_states)
+                    out = res[0]
+                    new_aux = res[1:]
+                    aux_syms = [i for i in node.inputs if i._node.is_aux]
+                    for s_aux, v_new in zip(aux_syms, new_aux):
+                        aux_updates[s_aux._node.name] = v_new
+                    res = out
+                memo[key] = res
+        res = memo[key]
+        if isinstance(res, tuple):
+            node.n_out = len(res)
+            return res[s._index]
+        return res
+
+    outs = []
+    for s in sym._outputs_list():
+        first = value(s)
+        res = memo[id(s._node)]
+        if s._whole and isinstance(res, tuple):
+            # an undissected multi-output head yields EVERY output, like the
+            # reference's executor (SliceChannel, topk ret_typ='both', ...)
+            outs.extend(res)
+        else:
+            outs.append(first)
+    return outs, aux_updates
+
+
+def _fwd_fn(sym: Symbol, training: bool):
+    def fwd(arg_vals, aux_vals, key):
+        with _random.RandomScope(key):
+            return _trace(sym, dict(arg_vals), dict(aux_vals), training)
+
+    return fwd
+
+
+# ---------------------------------------------------------------------------
+# implicit losses of the reference's output ops
+# (ref: src/operator/softmax_output-inl.h Backward, regression_output-inl.h)
+# ---------------------------------------------------------------------------
+
+def _softmax_output_loss(out, label, attrs):
+    axis = 1 if attrs.get("multi_output", False) else -1
+    scale = float(attrs.get("grad_scale", 1.0))
+    logp = jnp.log(jnp.maximum(out, 1e-37))
+    lab = label.astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(lab, axis), axis)
+    picked = jnp.squeeze(picked, axis)
+    valid = jnp.ones_like(picked, bool)
+    if attrs.get("use_ignore", False):
+        valid = lab != int(attrs.get("ignore_label", -1))
+        picked = jnp.where(valid, picked, 0.0)
+    norm = attrs.get("normalization", "null")
+    total = -jnp.sum(picked)
+    if norm == "batch":
+        total = total / out.shape[0]
+    elif norm == "valid":
+        total = total / jnp.maximum(jnp.sum(valid), 1)
+    return total * scale
+
+
+def _linear_regression_loss(out, label, attrs):
+    scale = float(attrs.get("grad_scale", 1.0))
+    return 0.5 * jnp.sum((out - label) ** 2) * scale
+
+
+def _mae_regression_loss(out, label, attrs):
+    scale = float(attrs.get("grad_scale", 1.0))
+    return jnp.sum(jnp.abs(out - label)) * scale
+
+
+def _logistic_regression_loss(out, label, attrs):
+    # BCE on the sigmoid OUTPUT: d/dz = sigmoid(z) - label, the reference's
+    # gradient (regression_output-inl.h LogisticRegressionOutput)
+    scale = float(attrs.get("grad_scale", 1.0))
+    p = jnp.clip(out, 1e-7, 1.0 - 1e-7)
+    return -jnp.sum(label * jnp.log(p) + (1 - label) * jnp.log(1 - p)) * scale
+
+
+def _make_loss_loss(out, label, attrs):
+    return jnp.sum(out) * float(attrs.get("grad_scale", 1.0))
+
+
+_HEAD_LOSSES = {
+    "SoftmaxOutput": _softmax_output_loss,
+    "LinearRegressionOutput": _linear_regression_loss,
+    "MAERegressionOutput": _mae_regression_loss,
+    "LogisticRegressionOutput": _logistic_regression_loss,
+    "make_loss": _make_loss_loss,
+    "MakeLoss": _make_loss_loss,
+}
+
+
+def _head_label_name(node) -> Optional[str]:
+    for s in node.inputs:
+        if s._node.op is None and s._node.name.endswith("_label"):
+            return s._node.name
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Executor
+# ---------------------------------------------------------------------------
+
+def _as_nd(v, ctx):
+    if isinstance(v, NDArray):
+        return v
+    return NDArray(jnp.asarray(v), ctx=ctx)
+
+
+class Executor:
+    """ref: mx.executor.Executor — forward/backward over bound arrays."""
+
+    def __init__(self, symbol: Symbol, ctx=None, args=None, args_grad=None,
+                 grad_req="write", aux_states=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else current_context()
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+
+        def normalize(vals, names, what):
+            if vals is None:
+                return {}
+            if isinstance(vals, dict):
+                return {k: _as_nd(v, self._ctx) for k, v in vals.items()}
+            vals = list(vals)
+            if len(vals) != len(names):
+                raise ValueError(f"{what}: expected {len(names)} entries "
+                                 f"({names}), got {len(vals)}")
+            return {n: _as_nd(v, self._ctx) for n, v in zip(names, vals)}
+
+        self.arg_dict: Dict[str, NDArray] = normalize(args, self._arg_names,
+                                                      "args")
+        self.aux_dict: Dict[str, NDArray] = normalize(aux_states,
+                                                      self._aux_names, "aux")
+        self.grad_dict: Dict[str, NDArray] = normalize(args_grad,
+                                                       self._arg_names,
+                                                       "args_grad")
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._arg_names}
+        else:
+            self._grad_req = {n: grad_req.get(n, "null")
+                              for n in self._arg_names}
+        self.outputs: List[NDArray] = []
+        self._jit_cache = {}
+        self._last_train = False
+
+    # ---- array-list views (reference API) ----
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    # ---- forward ----
+    def _vals(self, d):
+        return {k: v._data for k, v in d.items()}
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            self.arg_dict[k] = _as_nd(v, self._ctx)
+        key = ("fwd", bool(is_train))
+        if key not in self._jit_cache:
+            self._jit_cache[key] = jax.jit(_fwd_fn(self._symbol,
+                                                   bool(is_train)))
+        outs, aux_updates = self._jit_cache[key](
+            self._vals(self.arg_dict), self._vals(self.aux_dict),
+            _random.next_key())
+        self.outputs = [NDArray(o, ctx=self._ctx) for o in outs]
+        if is_train:
+            for k, v in aux_updates.items():
+                self.aux_dict[k]._data = v
+        self._last_train = bool(is_train)
+        return self.outputs
+
+    # ---- backward ----
+    def _loss_fn(self):
+        sym = self._symbol
+        heads = sym._outputs_list()
+
+        def loss(diff_vals, fixed_vals, aux_vals, key, out_grads):
+            arg_vals = dict(fixed_vals)
+            arg_vals.update(diff_vals)
+            with _random.RandomScope(key):
+                outs, _ = _trace(sym, arg_vals, dict(aux_vals), True)
+            total = jnp.zeros((), jnp.float32)
+            pos = 0
+            for h in heads:
+                # whole multi-output heads were expanded by _trace (n_out
+                # was discovered during this very trace); keep indices
+                # aligned with the user-visible outputs list
+                n = h._node.n_out if (h._whole and h._node.n_out > 1) else 1
+                for _ in range(n):
+                    out, i = outs[pos], pos
+                    pos += 1
+                    op = h._node.op
+                    if op in _HEAD_LOSSES and out_grads.get(i) is None:
+                        lname = _head_label_name(h._node)
+                        lab = arg_vals.get(lname) if lname else None
+                        total = total + _HEAD_LOSSES[op](
+                            out, lab, h._node.attrs).astype(jnp.float32)
+                    elif out_grads.get(i) is not None:
+                        total = total + jnp.sum(
+                            out.astype(jnp.float32) *
+                            out_grads[i].astype(jnp.float32))
+                    # heads with neither implicit loss nor a cotangent
+                    # contribute nothing (detached outputs)
+            return total
+
+        return loss
+
+    def backward(self, out_grads=None):
+        """Fill grad arrays (ref: Executor::Backward).  For loss-op heads the
+        implicit gradient is used; other heads need `out_grads` entries."""
+        if out_grads is not None and not isinstance(out_grads, (list, tuple)):
+            out_grads = [out_grads]
+        og = {}
+        heads = self._symbol._outputs_list()
+        if out_grads is not None:
+            for i, g in enumerate(out_grads):
+                if g is not None:
+                    og[i] = g._data if isinstance(g, NDArray) else jnp.asarray(g)
+        else:
+            missing = [h._node.op for h in heads
+                       if h._node.op not in _HEAD_LOSSES]
+            if missing:
+                raise ValueError(
+                    f"backward(): heads {missing} carry no implicit loss; "
+                    f"pass out_grads")
+        diff_names = tuple(sorted(n for n, r in self._grad_req.items()
+                                  if r != "null"))
+        key = ("bwd", diff_names, tuple(sorted(og)))
+        if key not in self._jit_cache:
+            loss = self._loss_fn()
+            self._jit_cache[key] = jax.jit(jax.grad(loss, argnums=0))
+        diff_vals = {n: self.arg_dict[n]._data for n in diff_names}
+        fixed_vals = {n: v._data for n, v in self.arg_dict.items()
+                      if n not in diff_vals}
+        grads = self._jit_cache[key](diff_vals, fixed_vals,
+                                     self._vals(self.aux_dict),
+                                     _random.next_key(), og)
+        for n, g in grads.items():
+            req = self._grad_req[n]
+            if n in self.grad_dict:
+                if req == "add":
+                    self.grad_dict[n]._data = self.grad_dict[n]._data + g
+                else:
+                    self.grad_dict[n]._data = g
+            else:
+                self.grad_dict[n] = NDArray(g, ctx=self._ctx)
+        return self.grad_arrays
+
+
+# ---------------------------------------------------------------------------
+# binding helpers
+# ---------------------------------------------------------------------------
+
+def simple_bind(sym: Symbol, ctx, grad_req, shapes):
+    """ref: Symbol.simple_bind — infer every shape, allocate args/grads/aux."""
+    ctx = ctx if isinstance(ctx, Context) else current_context()
+    arg_shapes = infer_arg_shapes(sym, shapes)
+    args, grads, aux = {}, {}, {}
+    for n in sym.list_arguments():
+        args[n] = NDArray(jnp.zeros(arg_shapes[n], jnp.float32), ctx=ctx)
+        req = grad_req.get(n, "null") if isinstance(grad_req, dict) \
+            else grad_req
+        if req != "null":
+            grads[n] = NDArray(jnp.zeros(arg_shapes[n], jnp.float32), ctx=ctx)
+    for n in sym.list_auxiliary_states():
+        aux[n] = NDArray(jnp.zeros(arg_shapes[n], jnp.float32), ctx=ctx)
+    return Executor(sym, ctx, args, grads, grad_req, aux)
+
+
+def eval_symbol(sym: Symbol, ctx, bindings):
+    """Symbol.eval — one-shot forward with everything bound by name."""
+    ex = Executor(sym, ctx, bindings, None, "null",
+                  {n: bindings[n] for n in sym.list_auxiliary_states()
+                   if n in bindings})
+    return ex.forward(is_train=False)
+
+
+def abstract_eval(sym: Symbol, arg_shapes: Dict[str, tuple]):
+    """Output + aux shapes via jax.eval_shape (the NNVM InferShape pass)."""
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    argv = {n: jax.ShapeDtypeStruct(tuple(arg_shapes[n]), jnp.float32)
+            for n in arg_names}
+    auxv = {n: jax.ShapeDtypeStruct(tuple(arg_shapes[n]), jnp.float32)
+            for n in aux_names}
+
+    outs, aux_updates = jax.eval_shape(_fwd_fn(sym, False), argv, auxv,
+                                       jax.random.key(0))
+    aux_shapes = {n: tuple(arg_shapes[n]) for n in aux_names}
+    return outs, aux_shapes
+
+
+def abstract_eval_prefix(s: Symbol, shapes: Dict[str, tuple]):
+    """Shape of one intermediate symbol given variable shapes, or None when
+    some variable below it has no known shape yet (infer_shape walks layers
+    in topo order, so earlier layers' params are already inferred)."""
+    for n in s._topo_nodes():
+        if n.op is None and n.name not in shapes:
+            return None
+    argv = {n.name: jax.ShapeDtypeStruct(tuple(shapes[n.name]), jnp.float32)
+            for n in s._topo_nodes() if n.op is None and not n.is_aux}
+    auxv = {n.name: jax.ShapeDtypeStruct(tuple(shapes[n.name]), jnp.float32)
+            for n in s._topo_nodes() if n.op is None and n.is_aux}
+    outs, _ = jax.eval_shape(_fwd_fn(s, False), argv, auxv,
+                             jax.random.key(0))
+    return tuple(outs[0].shape)

@@ -1,0 +1,308 @@
+"""Module API (`mx.mod.Module`) — the classic symbolic training driver.
+
+ref: python/mxnet/module/module.py — bind → init_params → init_optimizer →
+fit/forward/backward/update, plus checkpointing.  The reference Module
+owns a GraphExecutor per device and a kvstore; here the executor is the
+jit-traced Symbol (executor.py) and single-process multi-device data
+parallelism belongs to `parallel.TrainStep` — Module keeps the 1.x user
+contract for ported scripts (Gluon is the primary modern API).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import initializer as _init
+from . import metric as _metric
+from . import optimizer as _opt
+from .context import Context, current_context
+from .io import DataBatch, DataDesc
+from .ndarray import NDArray
+from . import ndarray as nd
+from .symbol import Symbol, load as _sym_load
+
+
+class Module:
+    """ref: mx.mod.Module (single-executor form)."""
+
+    def __init__(self, symbol: Symbol, data_names=("data",),
+                 label_names=("softmax_label",), context=None, logger=None):
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._ctx = context if isinstance(context, Context) \
+            else current_context()
+        self._logger = logger or logging.getLogger(__name__)
+        self._exec = None
+        self._optimizer = None
+        self._opt_states: Dict[str, object] = {}
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self.for_training = False
+
+    # ------------------------------------------------------------- binding --
+    @property
+    def symbol(self):
+        return self._symbol
+
+    def _param_names(self):
+        skip = set(self._data_names) | set(self._label_names)
+        return [n for n in self._symbol.list_arguments() if n not in skip]
+
+    @staticmethod
+    def _desc_shapes(descs):
+        out = {}
+        for d in descs or []:
+            if isinstance(d, DataDesc):
+                out[d.name] = tuple(d.shape)
+            else:  # (name, shape) tuple
+                out[d[0]] = tuple(d[1])
+        return out
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, grad_req="write"):
+        """ref: Module.bind — allocates the executor via simple_bind."""
+        if self.binded and not force_rebind:
+            return
+        shapes = self._desc_shapes(data_shapes)
+        shapes.update(self._desc_shapes(label_shapes))
+        req = grad_req if for_training else "null"
+        if isinstance(req, str) and req != "null" and not inputs_need_grad:
+            req = {n: ("null" if n in self._data_names or
+                       n in self._label_names else req)
+                   for n in self._symbol.list_arguments()}
+        self._exec = self._symbol.simple_bind(self._ctx, grad_req=req,
+                                              **shapes)
+        self.binded = True
+        self.for_training = for_training
+
+    def _check_bound(self):
+        if not self.binded:
+            raise RuntimeError("Module: call bind() first")
+
+    # -------------------------------------------------------------- params --
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False):
+        """ref: Module.init_params."""
+        self._check_bound()
+        if self.params_initialized and not force_init:
+            return
+        if arg_params is None and aux_params is None and \
+                getattr(self, "_preloaded", None):
+            # Module.load(...) → bind → init_params restores the checkpoint
+            # (the reference's load flow; random re-init here would silently
+            # discard the loaded weights)
+            arg_params, aux_params = self._preloaded
+        initializer = initializer or _init.Uniform(0.01)
+        if isinstance(initializer, str):
+            initializer = _init.create(initializer)
+        for n in self._param_names():
+            arr = self._exec.arg_dict[n]
+            if arg_params and n in arg_params:
+                arr._data = arg_params[n]._data if isinstance(
+                    arg_params[n], NDArray) else np.asarray(arg_params[n])
+            elif arg_params and not allow_missing:
+                raise ValueError(f"init_params: missing {n} "
+                                 f"(allow_missing=False)")
+            else:
+                arr._data = initializer(n, arr.shape, "float32")
+        for n in self._symbol.list_auxiliary_states():
+            arr = self._exec.aux_dict[n]
+            if aux_params and n in aux_params:
+                arr._data = aux_params[n]._data if isinstance(
+                    aux_params[n], NDArray) else np.asarray(aux_params[n])
+            else:
+                arr._data = initializer(n, arr.shape, "float32")
+        self.params_initialized = True
+
+    def get_params(self):
+        """ref: Module.get_params — (arg_params, aux_params) snapshots."""
+        self._check_bound()
+        args = {n: self._exec.arg_dict[n].copy() for n in self._param_names()}
+        aux = {n: a.copy() for n, a in self._exec.aux_dict.items()}
+        return args, aux
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+
+    # ----------------------------------------------------------- optimizer --
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        """ref: Module.init_optimizer.  kvstore accepted for API compat —
+        single-process Module updates locally; multi-device data
+        parallelism is parallel.TrainStep territory."""
+        self._check_bound()
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            self._optimizer = _opt.create(optimizer,
+                                          **dict(optimizer_params or ()))
+        else:
+            self._optimizer = optimizer
+        names = self._param_names()
+        self._optimizer.idx2name = dict(enumerate(names))
+        self._opt_states = {
+            n: self._optimizer.create_state_multi_precision(
+                i, self._exec.arg_dict[n])
+            for i, n in enumerate(names)}
+        self.optimizer_initialized = True
+
+    # ---------------------------------------------------- forward/backward --
+    def forward(self, data_batch: DataBatch, is_train=None):
+        """ref: Module.forward."""
+        self._check_bound()
+        if is_train is None:
+            is_train = self.for_training
+        feeds = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feeds[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feeds[name] = arr
+        self._exec.forward(is_train=is_train, **feeds)
+
+    def backward(self, out_grads=None):
+        self._check_bound()
+        self._exec.backward(out_grads)
+
+    def update(self):
+        """ref: Module.update — one optimizer step on every parameter."""
+        self._check_bound()
+        if not self.optimizer_initialized:
+            raise RuntimeError("Module: call init_optimizer() first")
+        for i, n in enumerate(self._param_names()):
+            g = self._exec.grad_dict.get(n)
+            if g is None:
+                continue
+            self._optimizer.update_multi_precision(
+                i, self._exec.arg_dict[n], g, self._opt_states[n])
+
+    def get_outputs(self):
+        self._check_bound()
+        return list(self._exec.outputs)
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(list(labels), self.get_outputs())
+
+    # ------------------------------------------------------------ training --
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, num_epoch=1, batch_end_callback=None,
+            epoch_end_callback=None, force_rebind=False, force_init=False):
+        """ref: BaseModule.fit — the classic epoch loop."""
+        self.bind([(d.name, d.shape) for d in train_data.provide_data],
+                  [(d.name, d.shape) for d in train_data.provide_label],
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(optimizer=optimizer,
+                            optimizer_params=optimizer_params,
+                            force_init=force_init)
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        for epoch in range(num_epoch):
+            t0 = time.time()
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback:
+                    batch_end_callback(
+                        type("BatchEndParam", (), {
+                            "epoch": epoch, "nbatch": nbatch,
+                            "eval_metric": eval_metric})())
+            name, val = eval_metric.get()
+            self._logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
+                              epoch, name, val, time.time() - t0)
+            if eval_data is not None:
+                for name, val in self.score(eval_data, eval_metric):
+                    self._logger.info("Epoch[%d] Validation-%s=%f",
+                                      epoch, name, val)
+            if epoch_end_callback:
+                arg, aux = self.get_params()
+                epoch_end_callback(epoch, self._symbol, arg, aux)
+
+    def score(self, eval_data, eval_metric, num_batch=None):
+        """ref: BaseModule.score."""
+        self._check_bound()
+        if isinstance(eval_metric, str):
+            eval_metric = _metric.create(eval_metric)
+        eval_metric.reset()
+        eval_data.reset()
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return [eval_metric.get()]
+
+    def predict(self, eval_data, num_batch=None):
+        """ref: BaseModule.predict — concatenated first-output batches."""
+        self._check_bound()
+        eval_data.reset()
+        chunks = []
+        for i, batch in enumerate(eval_data):
+            if num_batch is not None and i >= num_batch:
+                break
+            self.forward(batch, is_train=False)
+            chunks.append(self.get_outputs()[0].asnumpy())
+        return nd.array(np.concatenate(chunks, axis=0))
+
+    # ---------------------------------------------------------- checkpoint --
+    def save_checkpoint(self, prefix, epoch):
+        """ref: Module.save_checkpoint → prefix-symbol.json +
+        prefix-NNNN.params."""
+        arg, aux = self.get_params()
+        save_checkpoint(prefix, epoch, self._symbol, arg, aux)
+
+    @classmethod
+    def load(cls, prefix, epoch, data_names=("data",),
+             label_names=("softmax_label",), context=None):
+        symb, arg, aux = load_checkpoint(prefix, epoch)
+        m = cls(symb, data_names=data_names, label_names=label_names,
+                context=context)
+        m._preloaded = (arg, aux)
+        return m
+
+    def bind_and_restore(self, data_shapes, label_shapes=None,
+                         for_training=False):
+        """Convenience for load(): bind then restore the checkpointed
+        params (the reference does this inside Module.load + bind)."""
+        self.bind(data_shapes, label_shapes, for_training=for_training)
+        arg, aux = getattr(self, "_preloaded", (None, None))
+        self.set_params(arg or {}, aux or {})
+
+
+# ---------------------------------------------------------------------------
+# mx.model checkpoint helpers (ref: python/mxnet/model.py)
+# ---------------------------------------------------------------------------
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """ref: mx.model.save_checkpoint — symbol json + 'arg:'/'aux:'-prefixed
+    param file, the 1.x artifact layout."""
+    symbol.save(f"{prefix}-symbol.json")
+    payload = {f"arg:{k}": v for k, v in arg_params.items()}
+    payload.update({f"aux:{k}": v for k, v in aux_params.items()})
+    nd.save(f"{prefix}-{epoch:04d}.params", payload)
+
+
+def load_checkpoint(prefix, epoch):
+    """ref: mx.model.load_checkpoint → (symbol, arg_params, aux_params)."""
+    symb = _sym_load(f"{prefix}-symbol.json")
+    payload = nd.load(f"{prefix}-{epoch:04d}.params")
+    arg = {k[4:]: v for k, v in payload.items() if k.startswith("arg:")}
+    aux = {k[4:]: v for k, v in payload.items() if k.startswith("aux:")}
+    return symb, arg, aux

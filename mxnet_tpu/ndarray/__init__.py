@@ -235,9 +235,11 @@ random.bernoulli = _rand_wrap(
     lambda key, shape, dt, p=0.5: jax.random.bernoulli(key, p, shape).astype(dt))
 
 
-def _multinomial(data, shape=1, get_prob=False, dtype="int32"):
+def _multinomial(data, shape=None, get_prob=False, dtype="int32"):
     # one implementation: the registry op (ref: sample_multinomial_op.cc),
-    # which also serves nd.invoke / the C ABI and supports get_prob
+    # which also serves nd.invoke / the C ABI and supports get_prob;
+    # shape=None (the reference's _Null) squeezes, explicit shape=1 keeps
+    # the trailing draw axis
     return invoke("_sample_multinomial", data, shape=shape,
                   get_prob=get_prob, dtype=dtype)
 

@@ -16,6 +16,29 @@ def _softmax_cross_entropy(data, label):
     return -jnp.sum(picked)
 
 
+@register_op("LinearRegressionOutput", aliases=("linear_regression_output",))
+def _linear_regression_output(data, label=None, grad_scale=1.0):
+    """ref: src/operator/regression_output-inl.h — forward is identity; the
+    L2 gradient (data - label) * grad_scale is the op's IMPLICIT loss,
+    applied by the symbolic executor's backward (executor.py _HEAD_LOSSES;
+    under autograd, use gluon.loss.L2Loss instead)."""
+    return data
+
+
+@register_op("MAERegressionOutput", aliases=("mae_regression_output",))
+def _mae_regression_output(data, label=None, grad_scale=1.0):
+    """ref: regression_output-inl.h — identity forward, L1 implicit loss."""
+    return data
+
+
+@register_op("LogisticRegressionOutput",
+             aliases=("logistic_regression_output",))
+def _logistic_regression_output(data, label=None, grad_scale=1.0):
+    """ref: regression_output-inl.h — sigmoid forward; the executor's
+    implicit BCE loss yields the reference's (sigmoid - label) gradient."""
+    return jax.nn.sigmoid(data)
+
+
 @register_op("CTCLoss", aliases=("ctc_loss",))
 def _ctc_loss(data, label, data_lengths=None, label_lengths=None,
               use_data_lengths=False, use_label_lengths=False, blank_label="first"):

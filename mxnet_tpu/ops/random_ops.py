@@ -171,17 +171,18 @@ def _sample_poisson(lam, shape=(), dtype="float32"):
 
 @register_op("_sample_multinomial", aliases=("sample_multinomial",),
              needs_rng=True)
-def _sample_multinomial(data, shape=1, get_prob=False, dtype="int32"):
+def _sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
     """ref: src/operator/random/sample_multinomial_op.cc — categorical draws
     from probability rows (..., K).  Output is batch_shape + shape (the
-    reference's per-distribution draw shape); the default single draw is
-    squeezed to batch_shape, like the reference's shape=_Null.  get_prob=True
-    additionally returns the log-prob of each draw (the REINFORCE helper,
-    matching the reference's two-output form).
+    reference's per-distribution draw shape); the UNSPECIFIED default is a
+    single draw squeezed to batch_shape (the reference's shape=_Null), while
+    an explicit shape=1 keeps the trailing axis: batch_shape + (1,).
+    get_prob=True additionally returns the log-prob of each draw (the
+    REINFORCE helper, matching the reference's two-output form).
 
     `mx.nd.random.multinomial` is this op (one implementation; the module
     wrapper delegates here)."""
-    if shape is None or shape == () or (isinstance(shape, int) and shape == 1):
+    if shape is None or shape == ():
         extra = ()
     elif isinstance(shape, int):
         extra = (shape,)

@@ -1,0 +1,552 @@
+"""Symbolic graph frontend (`mx.sym`).
+
+ref: include/mxnet/symbolic.h + python/mxnet/symbol/symbol.py — the
+reference's Symbol is an NNVM graph handle; composition builds a C++ graph,
+`bind` produces a GraphExecutor that plans memory and schedules kernels.
+
+TPU-native redesign: a Symbol here is a lightweight Python DAG node.  The
+"graph compiler" is XLA — `bind` does no planning of its own; it traces the
+DAG into ONE jax function (`executor.py`) and jits it, which is the same
+machinery `HybridBlock.hybridize()` uses.  Shape inference is
+`jax.eval_shape` over the same trace (the reference re-implements shape/type
+inference as NNVM passes; XLA's abstract evaluation subsumes both), plus the
+classic parameter-shape rules (weight/bias from num_hidden etc.) so
+`simple_bind`/`infer_shape` work from data shapes alone, like the reference.
+
+Supported surface: `Variable/var`, generated op builders for every registry
+op (auto-creating weight/bias/gamma/... inputs with MXNet's naming scheme),
+arithmetic sugar, `Group`, multi-output indexing, `list_arguments /
+list_outputs / list_auxiliary_states`, `infer_shape`, `eval`, `bind`,
+`simple_bind`, `tojson/save/load` (MXNet-1.x-style node-list json).
+"""
+from __future__ import annotations
+
+import ast
+import json
+import re
+import sys
+from typing import Dict, List, Optional
+
+from .ops.registry import OPS, register_op, get_op
+
+# ---------------------------------------------------------------------------
+# layer-parameter table: which op inputs are learnable params / aux states,
+# and how their shapes follow from the data shape + attrs (ref: each op's
+# InferShape in src/operator/nn/*-inl.h).  Ops not listed take ONLY explicit
+# Symbol inputs (positional or by keyword).
+# ---------------------------------------------------------------------------
+
+
+def _prod(xs):
+    p = 1
+    for x in xs:
+        p *= int(x)
+    return p
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(x) for x in v)
+
+
+def _fc_shapes(d, a):
+    k = _prod(d[1:]) if a.get("flatten", True) else int(d[-1])
+    n = int(a["num_hidden"])
+    return {"weight": (n, k), "bias": (n,)}
+
+
+def _conv_shapes(d, a):
+    nd = len(d) - 2
+    kernel = _tup(a.get("kernel"), nd)
+    nf, ng = int(a["num_filter"]), int(a.get("num_group", 1))
+    if (a.get("layout") or "NCHW").endswith("C"):  # NHWC / NDHWC
+        w = (nf,) + kernel + (int(d[-1]) // ng,)
+    else:
+        w = (nf, int(d[1]) // ng) + kernel
+    return {"weight": w, "bias": (nf,)}
+
+
+def _deconv_shapes(d, a):
+    nd = len(d) - 2
+    kernel = _tup(a.get("kernel"), nd)
+    nf, ng = int(a["num_filter"]), int(a.get("num_group", 1))
+    return {"weight": (int(d[1]), nf // ng) + kernel, "bias": (nf,)}
+
+
+def _bn_shapes(d, a):
+    c = int(d[int(a.get("axis", 1)) % len(d)])
+    return {k: (c,) for k in ("gamma", "beta", "moving_mean", "moving_var")}
+
+
+def _embed_shapes(d, a):
+    return {"weight": (int(a["input_dim"]), int(a["output_dim"]))}
+
+
+class _LayerSpec:
+    def __init__(self, data, params=(), aux=(), labels=(), shapes=None,
+                 skip=None):
+        self.data = tuple(data)        # ordinary symbol inputs, in op order
+        self.params = tuple(params)    # auto-created learnable inputs
+        self.aux = tuple(aux)          # auto-created non-learnable state
+        self.labels = tuple(labels)    # auto-created label inputs
+        self.shapes = shapes           # fn(data_shape, attrs) -> {param: shape}
+        self.skip = skip or {}         # param -> fn(attrs) -> bool (omit)
+
+    def inputs(self, attrs):
+        out = list(self.data)
+        for p in self.params:
+            if not (p in self.skip and self.skip[p](attrs)):
+                out.append(p)
+        out.extend(self.aux)
+        out.extend(self.labels)
+        return out
+
+
+_no_bias = {"bias": lambda a: bool(a.get("no_bias", False))}
+
+LAYERS: Dict[str, _LayerSpec] = {
+    "FullyConnected": _LayerSpec(["data"], ["weight", "bias"],
+                                 shapes=_fc_shapes, skip=_no_bias),
+    "Convolution": _LayerSpec(["data"], ["weight", "bias"],
+                              shapes=_conv_shapes, skip=_no_bias),
+    "Deconvolution": _LayerSpec(["data"], ["weight", "bias"],
+                                shapes=_deconv_shapes, skip=_no_bias),
+    "BatchNorm": _LayerSpec(["data"], ["gamma", "beta"],
+                            aux=["moving_mean", "moving_var"],
+                            shapes=_bn_shapes),
+    "Embedding": _LayerSpec(["data"], ["weight"], shapes=_embed_shapes),
+    "SoftmaxOutput": _LayerSpec(["data"], labels=["label"]),
+    "LinearRegressionOutput": _LayerSpec(["data"], labels=["label"]),
+    "MAERegressionOutput": _LayerSpec(["data"], labels=["label"]),
+    "LogisticRegressionOutput": _LayerSpec(["data"], labels=["label"]),
+    "make_loss": _LayerSpec(["data"]),
+}
+LAYERS["MakeLoss"] = LAYERS["make_loss"]
+
+# ops whose registry function returns (out, new_moving_mean, new_moving_var)
+# — the functional aux-state form (see ops/nn.py _batch_norm docstring)
+_AUX_STATE_OPS = {"BatchNorm": ("moving_mean", "moving_var")}
+
+
+# ---------------------------------------------------------------------------
+# the Symbol DAG
+# ---------------------------------------------------------------------------
+
+_AUTO_COUNT: Dict[str, int] = {}
+
+
+def _auto_name(op: str) -> str:
+    base = re.sub(r"[^0-9a-zA-Z]", "", op).lower()
+    i = _AUTO_COUNT.get(base, 0)
+    _AUTO_COUNT[base] = i + 1
+    return f"{base}{i}"
+
+
+def reset_auto_names():
+    """Test helper: deterministic auto-naming per test."""
+    _AUTO_COUNT.clear()
+
+
+class _Node:
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "n_out")
+
+    def __init__(self, op: Optional[str], name: str, attrs=None, inputs=(),
+                 is_aux=False):
+        self.op = op               # None => variable ('null' in json)
+        self.name = name
+        self.attrs = dict(attrs or {})
+        self.inputs = list(inputs)  # list[Symbol]
+        self.is_aux = is_aux
+        self.n_out = 1
+
+
+class Symbol:
+    """One output of a graph node (ref: python/mxnet/symbol/symbol.py).
+
+    ``whole=True`` marks the undissected result of a builder call: for
+    multi-output ops (SliceChannel, topk both, ...) a whole symbol stands
+    for EVERY output (bind/forward returns them all, like the reference),
+    while ``sym[i]`` selects one."""
+
+    def __init__(self, node: _Node, index: int = 0, group=None, whole=False):
+        self._node = node
+        self._index = index
+        self._whole = whole
+        self._group: Optional[List[Symbol]] = group  # Group() members
+
+    # ---- identity ----
+    @property
+    def name(self):
+        return "_group" if self._group is not None else self._node.name
+
+    def attr(self, key):
+        if self._group is not None:
+            return None
+        meta = self._node.attrs.get("__meta__") or {}
+        if key in meta:
+            return meta[key]
+        return self._node.attrs.get(key)
+
+    def list_attr(self):
+        if self._group is not None:
+            return {}
+        out = {k: v for k, v in self._node.attrs.items()
+               if not k.startswith("__")}
+        out.update(self._node.attrs.get("__meta__") or {})
+        return out
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    # ---- graph walks ----
+    def _outputs_list(self) -> List["Symbol"]:
+        return list(self._group) if self._group is not None else [self]
+
+    def _topo_nodes(self) -> List[_Node]:
+        seen, order = set(), []
+
+        def walk(node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for s in node.inputs:
+                walk(s._node)
+            order.append(node)
+
+        for s in self._outputs_list():
+            walk(s._node)
+        return order
+
+    def list_arguments(self):
+        return [n.name for n in self._topo_nodes()
+                if n.op is None and not n.is_aux]
+
+    def list_auxiliary_states(self):
+        return [n.name for n in self._topo_nodes() if n.op is None and n.is_aux]
+
+    def list_outputs(self):
+        outs = []
+        for s in self._outputs_list():
+            n = s._node
+            if n.n_out > 1 and s._whole:
+                outs.extend(f"{n.name}_output{i}" for i in range(n.n_out))
+            elif n.n_out > 1:
+                outs.append(f"{n.name}_output{s._index}")
+            else:
+                outs.append(f"{n.name}_output")
+        return outs
+
+    def get_internals(self):
+        return Group([Symbol(n) for n in self._topo_nodes() if n.op is not None]
+                     or [self])
+
+    def __getitem__(self, i):
+        if self._group is not None:
+            return self._group[i]
+        return Symbol(self._node, i, whole=False)
+
+    # ---- composition sugar ----
+    def _binop(self, other, op, swap=False):
+        if not isinstance(other, Symbol):
+            other = _scalar_const(other)
+        a, b = (other, self) if swap else (self, other)
+        return _invoke_sym(op, [a, b], {}, None)
+
+    def __add__(self, o):
+        return self._binop(o, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, "broadcast_sub")
+
+    def __rsub__(self, o):
+        return self._binop(o, "broadcast_sub", swap=True)
+
+    def __mul__(self, o):
+        return self._binop(o, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, "broadcast_div")
+
+    def __rtruediv__(self, o):
+        return self._binop(o, "broadcast_div", swap=True)
+
+    def __neg__(self):
+        return _invoke_sym("negative", [self], {}, None)
+
+    def __pow__(self, o):
+        return self._binop(o, "broadcast_power")
+
+    # ---- evaluation / binding (executor.py implements the machinery) ----
+    def eval(self, ctx=None, **bindings):
+        from .executor import eval_symbol
+
+        return eval_symbol(self, ctx, bindings)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None):
+        from .executor import Executor
+
+        return Executor(self, ctx, args, args_grad, grad_req, aux_states)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from .executor import simple_bind
+
+        return simple_bind(self, ctx, grad_req, shapes)
+
+    # ---- shape inference ----
+    def infer_shape(self, **kwargs):
+        """ref: MXSymbolInferShape.  kwargs: data/label shapes.  Parameter
+        shapes come from the LAYERS rules; output/aux shapes from
+        jax.eval_shape over the traced graph."""
+        arg_shapes = infer_arg_shapes(self, kwargs)
+        from .executor import abstract_eval
+
+        outs, aux = abstract_eval(self, arg_shapes)
+        return ([tuple(arg_shapes[a]) for a in self.list_arguments()],
+                [tuple(o.shape) for o in outs],
+                [tuple(aux[a]) for a in self.list_auxiliary_states()])
+
+    # ---- serialization (MXNet-1.x style node-list json) ----
+    def tojson(self):
+        nodes_list = self._topo_nodes()
+        idx = {id(n): i for i, n in enumerate(nodes_list)}
+        nodes = []
+        for n in nodes_list:
+            nodes.append({
+                "op": "null" if n.op is None else n.op,
+                "name": n.name,
+                "attrs": {k: str(v) for k, v in n.attrs.items()} | (
+                    {"__is_aux__": "1"} if n.is_aux else {}),
+                "inputs": [[idx[id(s._node)], s._index, 0]
+                           for s in n.inputs],
+            })
+        heads = [[idx[id(s._node)], s._index, 0]
+                 for s in self._outputs_list()]
+        return json.dumps({"nodes": nodes,
+                           "arg_nodes": [i for i, n in enumerate(nodes_list)
+                                         if n.op is None],
+                           "heads": heads,
+                           "attrs": {"mxnet_tpu": "1"}}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+def _scalar_const(v):
+    """Scalar constant as a variable-free graph node (full op)."""
+    return _invoke_sym("_scalar", [], {"value": float(v)}, None)
+
+
+if "_scalar" not in OPS:
+    import jax.numpy as _jnp
+
+    @register_op("_scalar")
+    def _scalar(value=0.0):
+        """Symbol-frontend scalar literal (sugar for `sym + 2`)."""
+        return _jnp.asarray(value, _jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# builders
+# ---------------------------------------------------------------------------
+
+def Variable(name, attr=None, shape=None, dtype=None, init=None,
+             __is_aux__=False, **kwargs):
+    """ref: mx.sym.Variable."""
+    attrs = dict(attr or {})
+    if shape is not None:
+        attrs["__shape__"] = tuple(shape)
+    if dtype is not None:
+        attrs["__dtype__"] = str(dtype)
+    if init is not None:
+        attrs["__init__"] = str(init)
+    attrs.update(kwargs)
+    return Symbol(_Node(None, name, attrs, (), is_aux=__is_aux__))
+
+
+var = Variable
+
+
+def Group(symbols):
+    """ref: mx.sym.Group — multi-head symbol."""
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs_list())
+    return Symbol(outs[0]._node, outs[0]._index, group=outs)
+
+
+def _invoke_sym(op_name, sym_inputs, attrs, name):
+    node = _Node(op_name, name or _auto_name(op_name), attrs, sym_inputs)
+    return Symbol(node, whole=True)
+
+
+def _signature_order(op_name):
+    import inspect
+
+    try:
+        return [p for p in inspect.signature(get_op(op_name)).parameters]
+    except (TypeError, ValueError):
+        return []
+
+
+def _make_builder(op_name):
+    def builder(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        attr = kwargs.pop("attr", None)
+        sym_args = list(args)
+        # keyword Symbol inputs -> ordered by the op signature
+        sym_kwargs = {k: v for k, v in kwargs.items()
+                      if isinstance(v, Symbol)}
+        attrs = {k: v for k, v in kwargs.items()
+                 if not isinstance(v, Symbol)}
+        if attr:
+            # 1.x attribute METADATA (lr_mult, ctx_group, ...) — kept on the
+            # node for Symbol.attr()/list_attr(), never forwarded to the op
+            attrs["__meta__"] = dict(attr)
+        spec = LAYERS.get(op_name)
+        if spec is not None:
+            wanted = spec.inputs(attrs)
+            inputs = []
+            it = iter(sym_args)
+            nm = name or _auto_name(op_name)
+            for slot in wanted:
+                if slot in sym_kwargs:
+                    inputs.append(sym_kwargs.pop(slot))
+                    continue
+                nxt = next(it, None)
+                if nxt is not None:
+                    inputs.append(nxt)
+                    continue
+                # auto-create with MXNet's naming convention
+                is_aux = slot in spec.aux
+                inputs.append(Variable(f"{nm}_{slot}", __is_aux__=is_aux))
+            if sym_kwargs:
+                raise TypeError(f"{op_name}: unexpected symbol kwargs "
+                                f"{sorted(sym_kwargs)}")
+            node = _Node(op_name, nm, attrs, inputs)
+            return Symbol(node, whole=True)
+        # generic op: positional symbols + keyword symbols in signature order
+        if sym_kwargs:
+            order = _signature_order(op_name)
+            for pname in order:
+                if pname in sym_kwargs:
+                    sym_args.append(sym_kwargs.pop(pname))
+            if sym_kwargs:
+                raise TypeError(f"{op_name}: unknown symbol kwargs "
+                                f"{sorted(sym_kwargs)}")
+        return _invoke_sym(op_name, sym_args, attrs, name)
+
+    builder.__name__ = op_name
+    builder.__qualname__ = f"sym.{op_name}"
+    builder.__doc__ = (get_op(op_name).__doc__ or "") + \
+        "\n(symbolic builder)"
+    return builder
+
+
+# ---------------------------------------------------------------------------
+# parameter shape inference (LAYERS rules + __shape__ hints)
+# ---------------------------------------------------------------------------
+
+def infer_arg_shapes(sym: Symbol, known: Dict[str, tuple]) -> Dict[str, tuple]:
+    """Shapes for every argument+aux variable: caller-provided data/label
+    shapes, variable __shape__ hints, and the per-layer weight rules, walked
+    in topo order so chained layers see their input's inferred shape."""
+    from .executor import abstract_eval_prefix
+
+    shapes: Dict[str, tuple] = {}
+    for n in sym._topo_nodes():
+        if n.op is None:
+            if n.name in known:
+                shapes[n.name] = tuple(known[n.name])
+            elif "__shape__" in n.attrs:
+                shapes[n.name] = tuple(n.attrs["__shape__"])
+    # walk layer nodes: infer params from their data input's shape
+    for n in sym._topo_nodes():
+        spec = LAYERS.get(n.op or "")
+        if not (spec and spec.shapes):
+            continue
+        data_sym = n.inputs[0]
+        dshape = abstract_eval_prefix(data_sym, shapes)
+        if dshape is None:
+            raise ValueError(
+                f"infer_shape: cannot determine input shape of layer "
+                f"{n.name!r}; provide the shape of its data variable")
+        rules = spec.shapes(tuple(dshape), n.attrs)
+        for s in n.inputs:
+            nn = s._node
+            if nn.op is None and nn.name not in shapes:
+                # auto-created params are f"{layer}_{slot}"; strip the layer
+                # prefix to get the slot (handles multi-word slots like
+                # moving_mean); explicitly-passed params fall back to the
+                # trailing component
+                if nn.name.startswith(n.name + "_"):
+                    suffix = nn.name[len(n.name) + 1:]
+                else:
+                    suffix = nn.name.rsplit("_", 1)[-1]
+                if suffix in rules:
+                    shapes[nn.name] = tuple(rules[suffix])
+    missing = [n.name for n in sym._topo_nodes()
+               if n.op is None and n.name not in shapes]
+    # label variables default to the leading dims of their head's data input
+    for n in sym._topo_nodes():
+        spec = LAYERS.get(n.op or "")
+        if spec and spec.labels:
+            dshape = abstract_eval_prefix(n.inputs[0], shapes)
+            for s in n.inputs:
+                if s._node.op is None and s._node.name in missing \
+                        and s._node.name.endswith("_label") and dshape:
+                    if n.op == "SoftmaxOutput":
+                        shapes[s._node.name] = (int(dshape[0]),)
+                    else:
+                        shapes[s._node.name] = tuple(dshape)
+                    missing.remove(s._node.name)
+    if missing:
+        raise ValueError(f"infer_shape: missing shapes for {missing}; "
+                         f"pass them as infer_shape(name=shape, ...)")
+    return shapes
+
+
+# ---------------------------------------------------------------------------
+# load (json) + module namespace generation
+# ---------------------------------------------------------------------------
+
+def _parse_attr(v: str):
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
+def fromjson(text: str) -> Symbol:
+    d = json.loads(text)
+    built: List[Symbol] = []
+    for nd_ in d["nodes"]:
+        attrs = {k: _parse_attr(v) for k, v in (nd_.get("attrs") or {}).items()}
+        is_aux = bool(attrs.pop("__is_aux__", 0))
+        if nd_["op"] == "null":
+            built.append(Variable(nd_["name"], __is_aux__=is_aux, **attrs))
+        else:
+            ins = [built[i][oi] for i, oi, _ in nd_["inputs"]]
+            node = _Node(nd_["op"], nd_["name"], attrs, ins)
+            built.append(Symbol(node))
+    heads = [built[i][oi] for i, oi, _ in d["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return fromjson(f.read())
+
+
+_this = sys.modules[__name__]
+for _n in list(OPS):
+    if not hasattr(_this, _n):
+        setattr(_this, _n, _make_builder(_n))

@@ -1,0 +1,141 @@
+"""Module API (ref: tests/python/unittest/test_module.py — bind/init/fit,
+checkpointing, score/predict)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    sym.reset_auto_names()
+    yield
+
+
+def _cls_problem(n=512, d=10, k=3, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, d).astype(np.float32)
+    W = rng.randn(d, k).astype(np.float32)
+    y = (X @ W).argmax(axis=1).astype(np.float32)
+    return X, y
+
+
+def _mlp_sym():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=32)
+    net = sym.Activation(net, name="relu1", act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=3)
+    return sym.SoftmaxOutput(net, name="softmax", normalization="batch")
+
+
+def test_fit_converges_and_scores():
+    X, y = _cls_problem()
+    train = mx.io.NDArrayIter(X, y, batch_size=32, shuffle=True)
+    val = mx.io.NDArrayIter(X[:128], y[:128], batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.02),),
+            eval_metric="acc", num_epoch=20)
+    name, acc = mod.score(val, "acc")[0]
+    assert name == "accuracy" and acc > 0.95, (name, acc)
+    preds = mod.predict(val).asnumpy()
+    assert preds.shape == (128, 3)
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_forward_backward_update_manual_loop():
+    X, y = _cls_problem(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([(d.name, d.shape) for d in it.provide_data],
+             [(d.name, d.shape) for d in it.provide_label])
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+    losses = []
+    metric = mx.metric.create("ce")
+    for _ in range(15):
+        it.reset()
+        metric.reset()
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+        losses.append(metric.get()[1])
+    assert losses[-1] < 0.5 * losses[0], losses
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    X, y = _cls_problem(n=128)
+    it = mx.io.NDArrayIter(X, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, optimizer="adam", optimizer_params=(("learning_rate", 0.02),),
+            num_epoch=3)
+    prefix = str(tmp_path / "m")
+    mod.save_checkpoint(prefix, 3)
+
+    m2 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    m2.bind_and_restore([("data", (32, 10))], [("softmax_label", (32,))])
+    np.testing.assert_allclose(m2.predict(it).asnumpy(),
+                               mod.predict(it).asnumpy(), rtol=1e-5)
+
+    # the params file is the 1.x layout: arg:/aux:-prefixed nd.save
+    symb, arg, aux = mx.model.load_checkpoint(prefix, 3)
+    assert set(arg) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    assert symb.list_arguments() == mod.symbol.list_arguments()
+
+    # the reference's load -> bind -> init_params flow restores the
+    # checkpoint, never random re-init (review r5)
+    m3 = mx.mod.Module.load(prefix, 3, context=mx.cpu())
+    m3.bind([("data", (32, 10))], [("softmax_label", (32,))],
+            for_training=False)
+    m3.init_params()
+    got, _ = m3.get_params()
+    np.testing.assert_allclose(got["fc1_weight"].asnumpy(),
+                               arg["fc1_weight"].asnumpy())
+
+
+def test_get_set_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([("data", (4, 10))], [("softmax_label", (4,))])
+    mod.init_params()
+    arg, aux = mod.get_params()
+    arg2 = {k: v * 0 + 7.0 for k, v in arg.items()}
+    mod.set_params(arg2, aux)
+    got, _ = mod.get_params()
+    np.testing.assert_allclose(got["fc1_weight"].asnumpy(), 7.0)
+    # snapshots are copies, not views of live state
+    arg3, _ = mod.get_params()
+    mod.set_params({k: v * 0 for k, v in arg3.items()}, aux)
+    np.testing.assert_allclose(arg3["fc1_weight"].asnumpy(), 7.0)
+
+
+def test_regression_module():
+    rng = np.random.RandomState(0)
+    X = rng.randn(256, 6).astype(np.float32)
+    w = rng.randn(6).astype(np.float32)
+    y = (X @ w).astype(np.float32).reshape(-1, 1)
+    it = mx.io.NDArrayIter(X, y, batch_size=32, label_name="lro_label")
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc", num_hidden=1)
+    net = sym.LinearRegressionOutput(net, name="lro", grad_scale=1.0 / 32)
+    mod = mx.mod.Module(net, label_names=("lro_label",), context=mx.cpu())
+    mod.fit(it, optimizer="adam", optimizer_params=(("learning_rate", 0.05),),
+            eval_metric="mse", num_epoch=25)
+    name, mse = mod.score(it, "mse")[0]
+    assert mse < 0.05, mse
+
+
+def test_bind_without_labels_for_inference():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc", num_hidden=4)
+    mod = mx.mod.Module(net, label_names=(), context=mx.cpu())
+    mod.bind([("data", (2, 3))], for_training=False)
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.array(np.ones((2, 3), np.float32))],
+                            label=None)
+    mod.forward(batch)
+    assert mod.get_outputs()[0].shape == (2, 4)

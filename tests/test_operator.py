@@ -935,6 +935,11 @@ COVERED_ELSEWHERE = {
     "flash_attention": "tests/test_flash_attention.py",
     "quantized_conv": "tests/test_misc_subsystems.py",
     "FusedNormReluConv": "tests/test_fused_conv.py",
+    # the symbolic frontend's ops (tests/test_symbol.py, test_module.py)
+    "_scalar": "tests/test_symbol.py",
+    "LinearRegressionOutput": "tests/test_symbol.py",
+    "MAERegressionOutput": "tests/test_symbol.py",
+    "LogisticRegressionOutput": "tests/test_symbol.py",
     # the whole sampler family (every alias resolves to the same fns)
     "_random_uniform": "tests/test_random_ops.py",
     "_random_normal": "tests/test_random_ops.py",

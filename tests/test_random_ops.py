@@ -127,9 +127,11 @@ def test_sample_multinomial():
     assert draws[0].min() >= 1                       # class 0 has prob 0
     assert abs((draws[0] == 2).mean() - 0.9) < 0.03  # matches pvals
     assert set(np.unique(draws[1])) == {0}           # degenerate row
-    # single draw squeezes the trailing axis, like the reference
+    # unspecified shape squeezes (reference _Null); explicit 1 keeps axis
     one = nd.invoke("_sample_multinomial", probs).asnumpy()
     assert one.shape == (2,)
+    kept = nd.invoke("_sample_multinomial", probs, shape=1).asnumpy()
+    assert kept.shape == (2, 1)
     # tuple shape: output is batch + shape (all prod(shape) draws kept)
     t = nd.invoke("_sample_multinomial", probs, shape=(3, 5)).asnumpy()
     assert t.shape == (2, 3, 5)

@@ -1,0 +1,298 @@
+"""Symbol frontend + executor (ref: tests/python/unittest/test_symbol.py,
+test_executor.py — composition, shape inference, bind/forward/backward,
+serialization)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import symbol as sym
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    sym.reset_auto_names()
+    yield
+
+
+def _mlp():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=8)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=3)
+    return sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def test_composition_and_listing():
+    out = _mlp()
+    assert out.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias",
+                                    "softmax_label"]
+    assert out.list_outputs() == ["softmax_output"]
+    assert out.list_auxiliary_states() == []
+    assert out.name == "softmax"
+
+
+def test_no_bias_skips_variable():
+    d = sym.Variable("data")
+    fc = sym.FullyConnected(d, name="fc", num_hidden=4, no_bias=True)
+    assert fc.list_arguments() == ["data", "fc_weight"]
+
+
+def test_auto_naming():
+    d = sym.Variable("data")
+    a = sym.FullyConnected(d, num_hidden=2)
+    b = sym.FullyConnected(d, num_hidden=2)
+    assert a.name == "fullyconnected0" and b.name == "fullyconnected1"
+
+
+def test_infer_shape_mlp():
+    out = _mlp()
+    arg, outs, aux = out.infer_shape(data=(4, 5))
+    assert dict(zip(out.list_arguments(), arg)) == {
+        "data": (4, 5), "fc1_weight": (8, 5), "fc1_bias": (8,),
+        "fc2_weight": (3, 8), "fc2_bias": (3,), "softmax_label": (4,)}
+    assert outs == [(4, 3)]
+    assert aux == []
+
+
+def test_infer_shape_conv_bn_chain():
+    d = sym.Variable("data")
+    c = sym.Convolution(d, name="conv1", kernel=(3, 3), num_filter=4,
+                        pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn1")
+    p = sym.Pooling(b, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = sym.Flatten(p)
+    o = sym.SoftmaxOutput(sym.FullyConnected(f, name="fc", num_hidden=2),
+                          name="softmax")
+    arg, outs, aux = o.infer_shape(data=(2, 3, 8, 8))
+    shapes = dict(zip(o.list_arguments(), arg))
+    assert shapes["conv1_weight"] == (4, 3, 3, 3)
+    assert shapes["fc_weight"] == (2, 64)
+    assert outs == [(2, 2)]
+    assert o.list_auxiliary_states() == ["bn1_moving_mean", "bn1_moving_var"]
+    assert aux == [(4,), (4,)]
+
+
+def test_arithmetic_sugar_eval():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    expr = (a + b) * 2 - b / 2 + 1
+    av = nd.array(np.float32([1.0, 2.0]))
+    bv = nd.array(np.float32([4.0, 6.0]))
+    (out,) = expr.eval(a=av, b=bv)
+    np.testing.assert_allclose(out.asnumpy(), [9.0, 14.0])
+
+
+def test_executor_grad_matches_autograd():
+    """bind/backward must agree with the tape on the same computation."""
+    out = _mlp()
+    rng = np.random.RandomState(0)
+    vals = {"data": rng.randn(4, 5).astype(np.float32),
+            "fc1_weight": rng.randn(8, 5).astype(np.float32) * 0.3,
+            "fc1_bias": np.zeros(8, np.float32),
+            "fc2_weight": rng.randn(3, 8).astype(np.float32) * 0.3,
+            "fc2_bias": np.zeros(3, np.float32),
+            "softmax_label": np.float32([0, 1, 2, 1])}
+    ex = out.bind(args={k: nd.array(v) for k, v in vals.items()},
+                  args_grad={k: nd.zeros(v.shape) for k, v in vals.items()
+                             if k not in ("data", "softmax_label")},
+                  grad_req={k: "write" for k in vals
+                            if k not in ("data", "softmax_label")})
+    probs = ex.forward(is_train=True)[0]
+    ex.backward()
+
+    # same loss on the tape
+    arrs = {k: nd.array(v) for k, v in vals.items()}
+    for k in ("fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"):
+        arrs[k].attach_grad()
+    with autograd.record():
+        h = nd.relu(nd.FullyConnected(arrs["data"], arrs["fc1_weight"],
+                                      arrs["fc1_bias"], num_hidden=8))
+        z = nd.FullyConnected(h, arrs["fc2_weight"], arrs["fc2_bias"],
+                              num_hidden=3)
+        p = nd.softmax(z, axis=-1)
+        picked = nd.pick(p, arrs["softmax_label"], axis=-1)
+        loss = -(nd.log(picked)).sum()
+    loss.backward()
+    np.testing.assert_allclose(probs.asnumpy(), p.asnumpy(), rtol=1e-5)
+    for k in ("fc1_weight", "fc2_weight", "fc1_bias", "fc2_bias"):
+        np.testing.assert_allclose(ex.grad_dict[k].asnumpy(),
+                                   arrs[k].grad.asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_grad_req_add_and_null():
+    d = sym.Variable("x")
+    o = sym.make_loss(d * d)
+    x = nd.array(np.float32([3.0]))
+    ex = o.bind(args={"x": x}, args_grad={"x": nd.zeros((1,))},
+                grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward()
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [12.0])  # 2*6
+    ex2 = o.bind(args={"x": x}, grad_req="null")
+    ex2.forward(is_train=True)
+    ex2.backward()
+    assert ex2.grad_dict.get("x") is None
+
+
+def test_regression_output_grads():
+    """LinearRegressionOutput: grad = (pred - label) * grad_scale
+    (ref: regression_output-inl.h)."""
+    d = sym.Variable("x")
+    o = sym.LinearRegressionOutput(d, name="lro", grad_scale=2.0)
+    x = nd.array(np.float32([1.0, 4.0]))
+    lab = nd.array(np.float32([0.0, 1.0]))
+    ex = o.bind(args={"x": x, "lro_label": lab},
+                args_grad={"x": nd.zeros((2,))},
+                grad_req={"x": "write"})
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 4.0])  # identity fwd
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["x"].asnumpy(), [2.0, 6.0])
+
+    o2 = sym.LogisticRegressionOutput(d, name="sig")
+    ex2 = o2.bind(args={"x": x, "sig_label": nd.array(np.float32([0., 1.]))},
+                  args_grad={"x": nd.zeros((2,))}, grad_req={"x": "write"})
+    p = ex2.forward(is_train=True)[0].asnumpy()
+    np.testing.assert_allclose(p, 1 / (1 + np.exp(-x.asnumpy())), rtol=1e-5)
+    ex2.backward()
+    np.testing.assert_allclose(ex2.grad_dict["x"].asnumpy(),
+                               p - np.float32([0., 1.]), rtol=1e-4,
+                               atol=1e-6)
+
+
+def test_softmax_output_ignore_and_normalization():
+    d = sym.Variable("x")
+    o = sym.SoftmaxOutput(d, name="softmax", use_ignore=True,
+                          ignore_label=-1, normalization="valid")
+    x = nd.array(np.float32([[2.0, 0.0], [0.0, 2.0], [1.0, 1.0]]))
+    lab = nd.array(np.float32([0, 1, -1]))
+    ex = o.bind(args={"x": x, "softmax_label": lab},
+                args_grad={"x": nd.zeros((3, 2))}, grad_req={"x": "write"})
+    ex.forward(is_train=True)
+    ex.backward()
+    g = ex.grad_dict["x"].asnumpy()
+    # ignored row contributes zero gradient
+    np.testing.assert_allclose(g[2], [0.0, 0.0], atol=1e-7)
+    assert abs(g[0]).sum() > 0
+
+
+def test_multi_output_and_group():
+    d = sym.Variable("data")
+    k = sym.topk(d, k=2, ret_typ="both")
+    grp = sym.Group([k[0], k[1]])
+    vals = grp.eval(data=nd.array(np.float32([[3, 1, 2]])))
+    np.testing.assert_allclose(vals[0].asnumpy(), [[3, 2]])
+    np.testing.assert_allclose(vals[1].asnumpy(), [[0, 2]])
+    # output count is known once traced
+    assert k[0].list_outputs()[0].endswith("_output0")
+
+
+def test_multi_output_head_binds_all_outputs():
+    """A whole multi-output head yields every output, like the reference's
+    executor (review r5: output 1+ used to be silently dropped)."""
+    d = sym.Variable("data")
+    s = sym.SliceChannel(d, num_outputs=2, name="sc")
+    ex = s.bind(args={"data": nd.array(np.float32([[1, 2, 3, 4]]))},
+                grad_req="null")
+    outs = ex.forward()
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0].asnumpy(), [[1, 2]])
+    np.testing.assert_allclose(outs[1].asnumpy(), [[3, 4]])
+    assert s.list_outputs() == ["sc_output0", "sc_output1"]
+    # an indexed output still binds alone
+    ex1 = s[1].bind(args={"data": nd.array(np.float32([[1, 2, 3, 4]]))},
+                    grad_req="null")
+    np.testing.assert_allclose(ex1.forward()[0].asnumpy(), [[3, 4]])
+
+
+def test_attr_metadata_not_forwarded_to_op():
+    """1.x attribute metadata (lr_mult etc.) must not reach the op kwargs
+    (review r5: it used to crash bind)."""
+    d = sym.Variable("data")
+    fc = sym.FullyConnected(d, num_hidden=4, name="fc",
+                            attr={"lr_mult": "0.5", "ctx_group": "dev1"})
+    assert fc.attr("lr_mult") == "0.5"
+    assert fc.list_attr()["ctx_group"] == "dev1"
+    ex = fc.simple_bind(data=(2, 3))
+    assert ex.forward()[0].shape == (2, 4)
+
+
+def test_simple_bind_dict_grad_req_skips_null():
+    out = _mlp()
+    req = {n: "null" if n in ("data", "softmax_label") else "write"
+           for n in out.list_arguments()}
+    ex = out.simple_bind(grad_req=req, data=(4, 5))
+    assert "data" not in ex.grad_dict and "fc1_weight" in ex.grad_dict
+
+
+def test_json_roundtrip_with_aux_and_attrs():
+    d = sym.Variable("data")
+    c = sym.Convolution(d, name="conv1", kernel=(3, 3), num_filter=4,
+                        pad=(1, 1))
+    b = sym.BatchNorm(c, name="bn1", momentum=0.8)
+    o = sym.SoftmaxOutput(sym.FullyConnected(sym.Flatten(b), name="fc",
+                                             num_hidden=2), name="softmax")
+    o2 = sym.fromjson(o.tojson())
+    assert o2.list_arguments() == o.list_arguments()
+    assert o2.list_auxiliary_states() == o.list_auxiliary_states()
+    # attrs survive with python types usable by the ops
+    rng = np.random.RandomState(0)
+    shapes = {"data": (2, 3, 4, 4)}
+    a1 = o.infer_shape(**shapes)[0]
+    a2 = o2.infer_shape(**shapes)[0]
+    assert a1 == a2
+    # numerics identical through a bound executor
+    args = {n: nd.array(rng.randn(*s).astype(np.float32) * 0.1)
+            for n, s in zip(o.list_arguments(), a1)}
+    aux = {n: nd.array(np.zeros(sh, np.float32) if "mean" in n
+                       else np.ones(sh, np.float32))
+           for n, sh in zip(o.list_auxiliary_states(),
+                            o.infer_shape(**shapes)[2])}
+    ex1 = o.bind(args=dict(args), aux_states=dict(aux), grad_req="null")
+    ex2 = o2.bind(args=dict(args), aux_states=dict(aux), grad_req="null")
+    np.testing.assert_allclose(ex1.forward()[0].asnumpy(),
+                               ex2.forward()[0].asnumpy(), rtol=1e-6)
+
+
+def test_save_load_file(tmp_path):
+    o = _mlp()
+    f = str(tmp_path / "m-symbol.json")
+    o.save(f)
+    o2 = sym.load(f)
+    assert o2.list_arguments() == o.list_arguments()
+
+
+def test_dropout_respects_mode():
+    d = sym.Variable("data")
+    o = sym.Dropout(d, p=0.5, name="drop")
+    x = nd.array(np.ones((64, 64), np.float32))
+    ex = o.bind(args={"data": x}, grad_req="null")
+    # predict mode: identity
+    out = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, 1.0)
+    # train mode: stochastic, inverted scaling, fresh mask per call
+    mx.random.seed(0)
+    t1 = ex.forward(is_train=True)[0].asnumpy()
+    t2 = ex.forward(is_train=True)[0].asnumpy()
+    assert set(np.unique(t1.round(4))) == {0.0, 2.0}
+    assert not np.array_equal(t1, t2)
+
+
+def test_get_internals():
+    o = _mlp()
+    internals = o.get_internals()
+    names = [s.name for s in internals._outputs_list()]
+    assert "fc1" in names and "relu1" in names
+
+
+def test_unbound_argument_errors():
+    d = sym.Variable("data")
+    o = sym.make_loss(d * 2)
+    ex = o.bind(args={}, grad_req="null")
+    with pytest.raises(ValueError, match="unbound argument 'data'"):
+        ex.forward()
