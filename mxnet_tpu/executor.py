@@ -28,37 +28,33 @@ from .symbol import LAYERS, Symbol, _AUX_STATE_OPS, infer_arg_shapes
 # tracing the DAG into a pure function
 # ---------------------------------------------------------------------------
 
-def _trace(sym: Symbol, arg_vals: Dict, aux_vals: Dict, training: bool):
-    """Evaluate the DAG on jax values.  Returns (outputs, aux_updates)."""
+def walk_graph(sym: Symbol, leaf, apply_op, aux_update):
+    """THE DAG-evaluation algorithm, shared by the executor (jax values,
+    registry fns) and gluon.SymbolBlock (NDArrays, nd.invoke).
+
+    ``leaf(node) -> value`` resolves a variable; ``apply_op(node, ins,
+    attrs) -> value|tuple`` applies one op; ``aux_update(name, value)``
+    receives the functional aux-state outputs (BatchNorm moving stats)
+    threading back into their variables.  A whole multi-output head yields
+    EVERY output, like the reference's executor."""
     memo: Dict[int, object] = {}
-    aux_updates: Dict[str, object] = {}
 
     def value(s: Symbol):
         node = s._node
         key = id(node)
         if key not in memo:
             if node.op is None:
-                store = aux_vals if node.is_aux else arg_vals
-                if node.name not in store:
-                    kind = "auxiliary state" if node.is_aux else "argument"
-                    raise ValueError(f"executor: unbound {kind} {node.name!r}")
-                memo[key] = store[node.name]
+                memo[key] = leaf(node)
             else:
-                fn = get_op(node.op)
                 ins = [value(i) for i in node.inputs]
-                kwargs = {k: v for k, v in node.attrs.items()
-                          if not k.startswith("__")}
-                if OP_META.get(node.op, {}).get("has_training"):
-                    kwargs.setdefault("training", training)
-                res = fn(*ins, **kwargs)
-                if node.op in _AUX_STATE_OPS:
-                    # functional aux form: (out, *new_aux) threads back into
-                    # the aux variables (ref: graph executor aux_states)
-                    out = res[0]
-                    new_aux = res[1:]
+                attrs = {k: v for k, v in node.attrs.items()
+                         if not k.startswith("__")}
+                res = apply_op(node, ins, attrs)
+                if node.op in _AUX_STATE_OPS and isinstance(res, tuple):
+                    out, new_aux = res[0], res[1:]
                     aux_syms = [i for i in node.inputs if i._node.is_aux]
                     for s_aux, v_new in zip(aux_syms, new_aux):
-                        aux_updates[s_aux._node.name] = v_new
+                        aux_update(s_aux._node.name, v_new)
                     res = out
                 memo[key] = res
         res = memo[key]
@@ -72,11 +68,29 @@ def _trace(sym: Symbol, arg_vals: Dict, aux_vals: Dict, training: bool):
         first = value(s)
         res = memo[id(s._node)]
         if s._whole and isinstance(res, tuple):
-            # an undissected multi-output head yields EVERY output, like the
-            # reference's executor (SliceChannel, topk ret_typ='both', ...)
             outs.extend(res)
         else:
             outs.append(first)
+    return outs
+
+
+def _trace(sym: Symbol, arg_vals: Dict, aux_vals: Dict, training: bool):
+    """Evaluate the DAG on jax values.  Returns (outputs, aux_updates)."""
+    aux_updates: Dict[str, object] = {}
+
+    def leaf(node):
+        store = aux_vals if node.is_aux else arg_vals
+        if node.name not in store:
+            kind = "auxiliary state" if node.is_aux else "argument"
+            raise ValueError(f"executor: unbound {kind} {node.name!r}")
+        return store[node.name]
+
+    def apply_op(node, ins, kwargs):
+        if OP_META.get(node.op, {}).get("has_training"):
+            kwargs.setdefault("training", training)
+        return get_op(node.op)(*ins, **kwargs)
+
+    outs = walk_graph(sym, leaf, apply_op, aux_updates.__setitem__)
     return outs, aux_updates
 
 

@@ -607,23 +607,127 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Wrap an arbitrary callable + params as a block (ref: class SymbolBlock
-    — construct a Block from symbol outputs). TPU-native: wraps a jax-traceable
-    python callable instead of a deserialized symbol graph."""
+    """Construct a Block from symbol outputs (ref: class SymbolBlock).
+
+    Three accepted forms of ``outputs``:
+      * an ``mx.sym.Symbol`` graph + ``inputs`` (Symbols or names) — the
+        reference's original contract: remaining graph arguments become
+        Parameters (aux states with grad_req null), and forward evaluates
+        the DAG through ``nd.invoke`` so autograd/hybridize work normally;
+      * any jax-traceable python callable + params (TPU-native form);
+      * ``SymbolBlock.imports`` — class-free serving from
+        ``HybridBlock.export``'s StableHLO artifact.
+    """
 
     def __init__(self, outputs, inputs=None, params=None, prefix=None):
         super().__init__(prefix=prefix)
+        from .. import symbol as _symbol
+
+        self._sym = None
+        if isinstance(outputs, _symbol.Symbol):
+            self._init_from_symbol(outputs, inputs, params)
+            return
         if not callable(outputs):
-            raise TypeError("SymbolBlock(outputs): outputs must be a callable "
-                            "built from framework ops")
+            raise TypeError("SymbolBlock(outputs): outputs must be a Symbol "
+                            "or a callable built from framework ops")
         self._fn = outputs
         if params:
             for name, p in (params.items() if hasattr(params, "items") else
                             ((p.name, p) for p in params)):
                 self._params._params[name] = p
 
+    def _init_from_symbol(self, outputs, inputs, params):
+        from .. import symbol as _symbol
+
+        self._sym = outputs
+        if inputs is None:
+            inputs = ["data"]
+        if isinstance(inputs, (str, _symbol.Symbol)):
+            inputs = [inputs]
+        for s in inputs:
+            if isinstance(s, _symbol.Symbol) and s._node.op is not None:
+                raise ValueError(
+                    f"SymbolBlock: input {s.name!r} is an op output, not a "
+                    f"variable; graph cutting is not supported — rebuild the "
+                    f"subgraph from a Variable (or bind the full symbol)")
+        self._sym_inputs = [s.name if isinstance(s, _symbol.Symbol) else s
+                            for s in inputs]
+        arg_names = self._sym.list_arguments()
+        aux_names = self._sym.list_auxiliary_states()
+        unknown = [n for n in self._sym_inputs
+                   if n not in arg_names and n not in aux_names]
+        if unknown:
+            raise ValueError(
+                f"SymbolBlock: inputs {unknown} are not variables of the "
+                f"symbol (its variables: {arg_names})")
+        given = {}
+        if params:
+            items = params.items() if hasattr(params, "items") else \
+                ((p.name, p) for p in params)
+            for name, p in items:
+                # accept mx.model arg_params-style 'arg:'/'aux:' prefixes
+                key = name.split(":", 1)[1] if name[:4] in ("arg:", "aux:") \
+                    else name
+                given[key] = p
+        for n in arg_names + aux_names:
+            if n in self._sym_inputs:
+                continue
+            p = given.pop(n, None)
+            if isinstance(p, Parameter):
+                self._params._params[n] = p
+                continue
+            param = Parameter(n, shape=None, allow_deferred_init=True,
+                              grad_req="null" if n in aux_names else "write")
+            if p is not None:  # an NDArray/array from load_checkpoint
+                param.set_data(p if isinstance(p, NDArray)
+                               else NDArray(np.asarray(p)))
+            self._params._params[n] = param
+        if given:
+            # a key mismatch must not silently yield a random-init model
+            # (ref: SymbolBlock raises for params not found in the symbol)
+            raise ValueError(
+                f"SymbolBlock: params {sorted(given)} match no argument of "
+                f"the symbol (its arguments: {arg_names + aux_names})")
+
     def forward(self, *args):
-        return self._fn(*args)
+        if self._sym is None:
+            return self._fn(*args)
+        from ..executor import walk_graph
+        from ..ndarray import invoke as _invoke
+
+        if len(args) != len(self._sym_inputs):
+            raise ValueError(f"SymbolBlock: expected {len(self._sym_inputs)} "
+                             f"inputs {self._sym_inputs}, got {len(args)}")
+        feed = dict(zip(self._sym_inputs, args))
+        pending = [p for p in self._params._params.values()
+                   if p._data is None and p._deferred_init is not None]
+        if pending:
+            # first forward with known input shapes finishes deferred init
+            # (ref: SymbolBlock parameter shape inference at first call)
+            from ..symbol import infer_arg_shapes
+            shapes = infer_arg_shapes(
+                self._sym, {n: tuple(feed[n].shape)
+                            for n in self._sym_inputs})
+            for p in pending:
+                p._finish_deferred_init(shapes.get(p.name))
+
+        def leaf(node):
+            if node.name in feed:
+                return feed[node.name]
+            return self._params._params[node.name].data()
+
+        def apply_op(node, ins, attrs):
+            # nd.invoke injects the training flag and tapes under autograd
+            return _invoke(node.op, *ins, **attrs)
+
+        def aux_update(name, v_new):
+            if _autograd.is_training():
+                # in place (set_data) so external aliases of the aux
+                # NDArray see fresh stats, like the reference's mutation
+                self._params._params[name].set_data(v_new)
+
+        outs = walk_graph(self._sym, leaf, apply_op, aux_update)
+        return outs[0] if len(outs) == 1 else tuple(outs)
 
     @staticmethod
     def imports(symbol_file, input_names=None, param_file=None, ctx=None):

@@ -283,6 +283,75 @@ def test_dropout_respects_mode():
     assert not np.array_equal(t1, t2)
 
 
+def test_symbolblock_from_symbol():
+    """gluon.SymbolBlock's original contract: wrap an mx.sym graph + params
+    as a trainable Block (ref: gluon/block.py SymbolBlock(outputs, inputs))."""
+    from mxnet_tpu import gluon
+
+    data = sym.Variable("data")
+    s = sym.FullyConnected(data, name="fc1", num_hidden=8)
+    s = sym.Activation(s, act_type="relu", name="r")
+    s = sym.FullyConnected(s, name="fc2", num_hidden=2)
+    rng = np.random.RandomState(0)
+    arg = {"fc1_weight": nd.array(rng.randn(8, 6).astype(np.float32) * 0.3),
+           "fc1_bias": nd.zeros((8,)),
+           "fc2_weight": nd.array(rng.randn(2, 8).astype(np.float32) * 0.3),
+           "fc2_bias": nd.zeros((2,))}
+    blk = gluon.SymbolBlock(s, [data], params=arg)
+    x = nd.array(rng.randn(4, 6).astype(np.float32))
+    out = blk(x)
+    ex = s.bind(args={**arg, "data": x}, grad_req="null")
+    np.testing.assert_allclose(out.asnumpy(), ex.forward()[0].asnumpy(),
+                               rtol=1e-5)
+    # trains under gluon.Trainer (autograd tapes through nd.invoke)
+    tr = gluon.Trainer(blk.collect_params(), "sgd", {"learning_rate": 0.3})
+    l2 = gluon.loss.L2Loss()
+    y = nd.array(rng.randn(4, 2).astype(np.float32))
+    losses = []
+    for _ in range(40):
+        with autograd.record():
+            loss = l2(blk(x), y).mean()
+        loss.backward()
+        tr.step(4)
+        losses.append(float(loss.asnumpy()))
+    assert losses[-1] < 0.2 * losses[0], losses[::10]
+    # deferred init (shapes inferred at first forward) + hybridize
+    blk2 = gluon.SymbolBlock(s, [data])
+    blk2.initialize()
+    assert blk2(x).shape == (4, 2)
+    eager = blk(x).asnumpy()          # baseline BEFORE hybridize
+    blk.hybridize()
+    np.testing.assert_allclose(blk(x).asnumpy(), eager, rtol=1e-5)
+
+    # bad wiring fails loudly, never silently random-inits
+    with pytest.raises(ValueError, match="not variables of the symbol"):
+        gluon.SymbolBlock(s, ["dtaa"])
+    with pytest.raises(ValueError, match="match no argument"):
+        gluon.SymbolBlock(s, [data], params={"dense0_weight": arg["fc1_weight"]})
+    with pytest.raises(ValueError, match="graph cutting"):
+        gluon.SymbolBlock(s, [sym.FullyConnected(data, num_hidden=2)])
+
+
+def test_symbolblock_batchnorm_aux():
+    from mxnet_tpu import gluon
+
+    d = sym.Variable("data")
+    g = sym.BatchNorm(sym.FullyConnected(d, name="fc", num_hidden=4),
+                      name="bn")
+    blk = gluon.SymbolBlock(g, [d])
+    blk.initialize()
+    x = nd.array(np.random.RandomState(0).randn(4, 6).astype(np.float32))
+    with autograd.record():
+        blk(x)
+    mm = blk.collect_params()["bn_moving_mean"].data().asnumpy()
+    assert not np.allclose(mm, 0.0)   # running stats threaded back
+    # predict mode leaves aux untouched
+    before = mm.copy()
+    blk(x)
+    np.testing.assert_allclose(
+        blk.collect_params()["bn_moving_mean"].data().asnumpy(), before)
+
+
 def test_get_internals():
     o = _mlp()
     internals = o.get_internals()
