@@ -1,0 +1,99 @@
+"""Classic symbolic MXNet 1.x workflow: mx.sym + mx.mod.Module.
+
+ref: example/image-classification/train_mnist.py — the canonical 1.x
+script: compose a Symbol, hand it to Module.fit with an NDArrayIter, then
+checkpoint and re-serve.  This file is intentionally near-verbatim 1.x
+user code; under the hood the executor is the Symbol DAG traced into one
+jax function (see mxnet_tpu/executor.py).  The tail shows the bridge into
+the modern API: the trained checkpoint served through gluon.SymbolBlock.
+
+    python examples/module_symbolic_mnist.py [--epochs 5]
+"""
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def get_data(batch_size):
+    """MNIST via the gluon dataset (synthetic stand-in when offline),
+    re-packed into the classic NDArrayIter."""
+    def to_arrays(train):
+        ds = gluon.data.vision.MNIST(train=train)
+        n = min(len(ds), 4096 if train else 1024)
+        xs = np.stack([np.asarray(ds[i][0], np.float32).reshape(-1) / 255.0
+                       for i in range(n)])
+        ys = np.array([float(ds[i][1]) for i in range(n)], np.float32)
+        return xs, ys
+
+    Xtr, ytr = to_arrays(True)
+    Xva, yva = to_arrays(False)
+    return (mx.io.NDArrayIter(Xtr, ytr, batch_size, shuffle=True),
+            mx.io.NDArrayIter(Xva, yva, batch_size))
+
+
+def build_symbol():
+    data = mx.sym.Variable("data")
+    net = mx.sym.FullyConnected(data, name="fc1", num_hidden=128)
+    net = mx.sym.Activation(net, name="relu1", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc2", num_hidden=64)
+    net = mx.sym.Activation(net, name="relu2", act_type="relu")
+    net = mx.sym.FullyConnected(net, name="fc3", num_hidden=10)
+    return mx.sym.SoftmaxOutput(net, name="softmax", normalization="batch")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=5)
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--lr", type=float, default=0.02)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    train_iter, val_iter = get_data(args.batch_size)
+    softmax = build_symbol()
+    mx.viz.print_summary(softmax, shape=(args.batch_size, 784))
+
+    mod = mx.mod.Module(softmax, context=mx.cpu()
+                        if os.environ.get("JAX_PLATFORMS") == "cpu"
+                        else None)
+    mod.fit(train_iter, eval_data=val_iter, optimizer="adam",
+            optimizer_params=(("learning_rate", args.lr),),
+            eval_metric="acc", num_epoch=args.epochs)
+    name, acc = mod.score(val_iter, "acc")[0]
+    print(f"validation {name}: {acc:.4f}")
+
+    # classic 1.x checkpoint artifacts ...
+    prefix = os.path.join(tempfile.mkdtemp(), "mnist-mlp")
+    mod.save_checkpoint(prefix, args.epochs)
+    print("saved", f"{prefix}-symbol.json", f"{prefix}-{args.epochs:04d}.params")
+
+    # ... restored the classic way ...
+    m2 = mx.mod.Module.load(prefix, args.epochs)
+    m2.bind([("data", (args.batch_size, 784))],
+            [("softmax_label", (args.batch_size,))], for_training=False)
+    m2.init_params()
+    print("Module reload score:", m2.score(val_iter, "acc")[0])
+
+    # ... or served through the modern API: Symbol -> gluon.SymbolBlock
+    symb, arg_params, aux_params = mx.model.load_checkpoint(prefix,
+                                                            args.epochs)
+    # pass BOTH dicts: aux params (BatchNorm running stats) restore too
+    served = gluon.SymbolBlock(symb, ["data"],
+                               params={**arg_params, **aux_params})
+    val_iter.reset()
+    batch = next(iter(val_iter))
+    probs = served(batch.data[0])
+    print("SymbolBlock serve:", probs.shape)
+
+
+if __name__ == "__main__":
+    main()

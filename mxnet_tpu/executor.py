@@ -21,7 +21,7 @@ from . import random as _random
 from .context import current_context, Context
 from .ndarray import NDArray
 from .ops.registry import OP_META, get_op
-from .symbol import LAYERS, Symbol, _AUX_STATE_OPS, infer_arg_shapes
+from .symbol import LAYERS, Symbol, infer_arg_shapes, node_threads_aux
 
 
 # ---------------------------------------------------------------------------
@@ -50,7 +50,7 @@ def walk_graph(sym: Symbol, leaf, apply_op, aux_update):
                 attrs = {k: v for k, v in node.attrs.items()
                          if not k.startswith("__")}
                 res = apply_op(node, ins, attrs)
-                if node.op in _AUX_STATE_OPS and isinstance(res, tuple):
+                if node_threads_aux(node) and isinstance(res, tuple):
                     out, new_aux = res[0], res[1:]
                     aux_syms = [i for i in node.inputs if i._node.is_aux]
                     for s_aux, v_new in zip(aux_syms, new_aux):
@@ -160,9 +160,13 @@ _HEAD_LOSSES = {
 
 
 def _head_label_name(node) -> Optional[str]:
-    for s in node.inputs:
-        if s._node.op is None and s._node.name.endswith("_label"):
-            return s._node.name
+    """Slot-based (any variable name), like symbol.label_variables."""
+    spec = LAYERS.get(node.op or "")
+    if spec and spec.labels:
+        slots = spec.inputs(node.attrs)
+        for slot, s in zip(slots, node.inputs):
+            if slot in spec.labels and s._node.op is None:
+                return s._node.name
     return None
 
 

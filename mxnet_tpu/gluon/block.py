@@ -660,6 +660,11 @@ class SymbolBlock(HybridBlock):
             raise ValueError(
                 f"SymbolBlock: inputs {unknown} are not variables of the "
                 f"symbol (its variables: {arg_names})")
+        # loss-head label variables are inputs, never weights: zeros are
+        # fed at forward unless the caller wires them as inputs
+        self._label_vars = _symbol.label_variables(self._sym) \
+            - set(self._sym_inputs)
+        self._label_shape_cache = {}
         given = {}
         if params:
             items = params.items() if hasattr(params, "items") else \
@@ -670,7 +675,7 @@ class SymbolBlock(HybridBlock):
                     else name
                 given[key] = p
         for n in arg_names + aux_names:
-            if n in self._sym_inputs:
+            if n in self._sym_inputs or n in self._label_vars:
                 continue
             p = given.pop(n, None)
             if isinstance(p, Parameter):
@@ -699,6 +704,17 @@ class SymbolBlock(HybridBlock):
             raise ValueError(f"SymbolBlock: expected {len(self._sym_inputs)} "
                              f"inputs {self._sym_inputs}, got {len(args)}")
         feed = dict(zip(self._sym_inputs, args))
+        missing_labels = [n for n in self._label_vars if n not in feed]
+        if missing_labels:
+            ckey = tuple(tuple(feed[n].shape) for n in self._sym_inputs)
+            if ckey not in self._label_shape_cache:
+                from ..symbol import infer_arg_shapes
+                self._label_shape_cache[ckey] = infer_arg_shapes(
+                    self._sym, {n: tuple(feed[n].shape)
+                                for n in self._sym_inputs})
+            shp = self._label_shape_cache[ckey]
+            for n in missing_labels:
+                feed[n] = NDArray(jax.numpy.zeros(shp[n], jax.numpy.float32))
         pending = [p for p in self._params._params.values()
                    if p._data is None and p._deferred_init is not None]
         if pending:

@@ -131,6 +131,31 @@ LAYERS["MakeLoss"] = LAYERS["make_loss"]
 _AUX_STATE_OPS = {"BatchNorm": ("moving_mean", "moving_var")}
 
 
+def node_threads_aux(node) -> bool:
+    """True when this node's trailing outputs are aux-state updates to
+    thread back (NOT when BatchNorm's output_mean_var=True turns them into
+    user-visible (out, mean, inv_std) heads — ops/nn.py _batch_norm)."""
+    return node.op in _AUX_STATE_OPS and \
+        not node.attrs.get("output_mean_var", False)
+
+
+def data_variables(sym: "Symbol"):
+    """The variables a USER must feed, in graph order: everything that is
+    neither an auto-creatable layer param/aux nor a loss-head label."""
+    labeled = label_variables(sym)
+    param_slots = set()
+    for n in sym._topo_nodes():
+        spec = LAYERS.get(n.op or "")
+        if spec:
+            slots = spec.inputs(n.attrs)
+            for slot, s in zip(slots, n.inputs):
+                if slot not in spec.data and s._node.op is None:
+                    param_slots.add(s._node.name)
+    return [n.name for n in sym._topo_nodes()
+            if n.op is None and n.name not in labeled
+            and n.name not in param_slots]
+
+
 # ---------------------------------------------------------------------------
 # the Symbol DAG
 # ---------------------------------------------------------------------------
@@ -495,14 +520,16 @@ def infer_arg_shapes(sym: Symbol, known: Dict[str, tuple]) -> Dict[str, tuple]:
                     shapes[nn.name] = tuple(rules[suffix])
     missing = [n.name for n in sym._topo_nodes()
                if n.op is None and n.name not in shapes]
-    # label variables default to the leading dims of their head's data input
+    # label variables (slot-based, any name) default to the shape implied
+    # by their head's data input
     for n in sym._topo_nodes():
         spec = LAYERS.get(n.op or "")
         if spec and spec.labels:
             dshape = abstract_eval_prefix(n.inputs[0], shapes)
-            for s in n.inputs:
-                if s._node.op is None and s._node.name in missing \
-                        and s._node.name.endswith("_label") and dshape:
+            slots = spec.inputs(n.attrs)
+            for slot, s in zip(slots, n.inputs):
+                if slot in spec.labels and s._node.op is None \
+                        and s._node.name in missing and dshape:
                     if n.op == "SoftmaxOutput":
                         shapes[s._node.name] = (int(dshape[0]),)
                     else:
@@ -517,6 +544,21 @@ def infer_arg_shapes(sym: Symbol, known: Dict[str, tuple]) -> Dict[str, tuple]:
 # ---------------------------------------------------------------------------
 # load (json) + module namespace generation
 # ---------------------------------------------------------------------------
+
+def label_variables(sym: Symbol):
+    """Names of variables bound to loss-head LABEL slots (SoftmaxOutput
+    etc.) — graph inputs, not weights; SymbolBlock feeds zeros for them at
+    inference (the reference's output ops ignore labels in forward)."""
+    out = set()
+    for n in sym._topo_nodes():
+        spec = LAYERS.get(n.op or "")
+        if spec and spec.labels:
+            slots = spec.inputs(n.attrs)
+            for slot, s in zip(slots, n.inputs):
+                if slot in spec.labels and s._node.op is None:
+                    out.add(s._node.name)
+    return out
+
 
 def _parse_attr(v: str):
     try:

@@ -12,24 +12,83 @@ __all__ = ["print_summary", "plot_network"]
 
 
 def print_summary(block, shape=None, **kwargs):
-    """Print a layer-by-layer summary of a Gluon block.
+    """Print a layer-by-layer summary of a Gluon block OR an mx.sym Symbol.
 
-    ``shape``: optional input shape (or list of shapes) INCLUDING batch
-    dim, e.g. ``(1, 3, 224, 224)`` — mirrors the reference's shape dict.
-    With a shape, ``Block.summary`` runs one hooked forward and the table
-    includes per-layer output shapes; without, it prints param counts
-    only.
+    ``shape``: optional input shape (or list/dict of shapes) INCLUDING the
+    batch dim, e.g. ``(1, 3, 224, 224)`` — mirrors the reference's shape
+    dict.  For a Block, ``Block.summary`` runs one hooked forward; for a
+    Symbol the table walks the graph nodes with shapes from
+    ``infer_shape`` (ref: visualization.print_summary over symbols).
     """
     import numpy as np
 
     from . import ndarray as nd
+    from . import symbol as _symbol
 
+    if isinstance(block, _symbol.Symbol):
+        return _print_symbol_summary(block, shape)
     if shape is None:
         return block.summary()
     shapes = shape if isinstance(shape, (list, tuple)) and shape and \
         isinstance(shape[0], (list, tuple)) else [shape]
     inputs = [nd.array(np.zeros(s, np.float32)) for s in shapes]
     return block.summary(*inputs)
+
+
+def _print_symbol_summary(sym, shape=None):
+    """Node table for a Symbol: name, op, output shape, param count.
+
+    Shapes come from ONE jax.eval_shape over the whole graph (every
+    node's first output via get_internals), not per-node prefix traces.
+    ``shape``: a tuple, a list of tuples (zipped with the graph's data
+    variables in order), or a {var: shape} dict."""
+    from .symbol import (Group, data_variables, infer_arg_shapes,
+                         label_variables)
+    from .executor import abstract_eval
+
+    known = {}
+    if isinstance(shape, dict):
+        known = {k: tuple(v) for k, v in shape.items()}
+    elif shape is not None:
+        shapes = shape if isinstance(shape, (list, tuple)) and shape and \
+            isinstance(shape[0], (list, tuple)) else [shape]
+        known = dict(zip(data_variables(sym), (tuple(s) for s in shapes)))
+    arg_shapes, node_shape = {}, {}
+    try:
+        arg_shapes = infer_arg_shapes(sym, known)
+        internals = sym.get_internals()._outputs_list()
+        outs, _ = abstract_eval(Group(internals), arg_shapes)
+        node_shape = {id(s._node): tuple(o.shape)
+                      for s, o in zip(internals, outs)}
+    except Exception:
+        arg_shapes, node_shape = {}, {}  # unknown: the table prints '?'
+    labels = label_variables(sym)
+    args = set(sym.list_arguments()) | set(sym.list_auxiliary_states())
+    total = 0
+    rows = [("Layer (op)", "Output shape", "Params")]
+    for node in sym._topo_nodes():
+        if node.op is None:
+            continue
+        out_shape = str(node_shape.get(id(node), "?"))
+        n_params = 0
+        for s in node.inputs:
+            nn = s._node
+            if nn.op is None and nn.name in args and \
+                    nn.name not in labels and \
+                    nn.name in arg_shapes and nn.name not in known:
+                p = 1
+                for d in arg_shapes[nn.name]:
+                    p *= int(d)
+                n_params += p
+        total += n_params
+        rows.append((f"{node.name} ({node.op})", out_shape, str(n_params)))
+    widths = [max(len(r[i]) for r in rows) for i in range(3)]
+    for i, r in enumerate(rows):
+        print("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+        if i == 0:
+            print("-" * (sum(widths) + 4))
+    print(f"Total params: {total}")
+    return total
 
 
 def plot_network(*args, **kwargs):

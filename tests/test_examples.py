@@ -28,6 +28,12 @@ def test_mnist_mlp_example():
     assert "val_acc=" in out
 
 
+def test_module_symbolic_example():
+    out = _run("module_symbolic_mnist.py", "--epochs", "1")
+    assert "validation accuracy" in out
+    assert "SymbolBlock serve" in out
+
+
 def test_resnet_fused_example():
     out = _run("train_resnet_fused.py", "--model", "resnet18_v1",
                "--batch-size", "4", "--iters", "2", "--classes", "10")

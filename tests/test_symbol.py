@@ -352,6 +352,61 @@ def test_symbolblock_batchnorm_aux():
         blk.collect_params()["bn_moving_mean"].data().asnumpy(), before)
 
 
+def test_batchnorm_output_mean_var_heads():
+    """output_mean_var=True turns the extra outputs into user-visible heads
+    — NOT aux updates (review r5: moving_var used to absorb inv_std)."""
+    d = sym.Variable("data")
+    b = sym.BatchNorm(sym.FullyConnected(d, name="fc", num_hidden=4),
+                      name="bn", output_mean_var=True)
+    rng = np.random.RandomState(0)
+    ex = b.simple_bind(grad_req="null", data=(8, 3))
+    ex.arg_dict["data"]._data = np.float32(rng.randn(8, 3))
+    ex.arg_dict["fc_weight"]._data = np.float32(rng.randn(4, 3))
+    ex.aux_dict["bn_moving_var"]._data = np.float32(np.ones(4))
+    mv0 = ex.aux_dict["bn_moving_var"].asnumpy().copy()
+    outs = ex.forward(is_train=True)
+    assert len(outs) == 3                      # (out, mean, inv_std)
+    np.testing.assert_allclose(ex.aux_dict["bn_moving_var"].asnumpy(), mv0)
+
+
+def test_custom_label_variable_name():
+    """Loss-head labels are found by SLOT, not by a '_label' suffix."""
+    from mxnet_tpu import gluon
+
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    o = sym.SoftmaxOutput(sym.FullyConnected(x, name="fc", num_hidden=3),
+                          label=y, name="softmax")
+    assert "y" in sym.label_variables(o)
+    # executor backward uses y's value for the implicit CE gradient
+    rng = np.random.RandomState(0)
+    ex = o.simple_bind(grad_req={"fc_weight": "write", "fc_bias": "write"},
+                       x=(4, 5), y=(4,))
+    ex.arg_dict["x"]._data = np.float32(rng.randn(4, 5))
+    ex.arg_dict["fc_weight"]._data = np.float32(rng.randn(3, 5) * 0.3)
+    ex.arg_dict["y"]._data = np.float32([0, 1, 2, 1])
+    ex.forward(is_train=True)
+    ex.backward()
+    assert abs(ex.grad_dict["fc_weight"].asnumpy()).sum() > 0
+    # SymbolBlock serves it: y is an input-by-default zeros feed, no param
+    blk = gluon.SymbolBlock(o, ["x"])
+    blk.initialize()
+    assert "y" not in blk.collect_params()
+    assert blk(nd.array(np.float32(rng.randn(4, 5)))).shape == (4, 3)
+
+
+def test_print_summary_symbol_forms():
+    from mxnet_tpu import visualization as viz
+
+    out = _mlp()
+    total = viz.print_summary(out, shape=(2, 5))
+    expect = 8 * 5 + 8 + 3 * 8 + 3
+    assert total == expect
+    assert viz.print_summary(out, shape=[(2, 5)]) == expect       # list form
+    assert viz.print_summary(out, shape={"data": (2, 5)}) == expect
+    assert viz.print_summary(out) == 0                            # no shapes
+
+
 def test_get_internals():
     o = _mlp()
     internals = o.get_internals()
