@@ -64,7 +64,10 @@ def bench_resnet():
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
 
     on_accel = jax.devices()[0].platform != "cpu"
-    batch = 256 if on_accel else 8
+    # MXTPU_BENCH_BATCH: A/B knob for batch-size sweeps (tpu_watch runs a
+    # 512 variant; throughput is reported per-image so runs are comparable)
+    batch = int(os.environ.get("MXTPU_BENCH_BATCH") or
+                (256 if on_accel else 8))
     iters = 20 if on_accel else 2
 
     # channel-last: the TPU-native layout (features on lanes; see PERF.md).

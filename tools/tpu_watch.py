@@ -71,6 +71,13 @@ QUEUE = [
      # 2700s inner budget, outer sized for bench.py's probe + single retry.
      [sys.executable, "bench.py", "ssd"],
      ["BENCH_builder_r05_ssd.json"], 6000, {"MXTPU_BENCH_TIMEOUT": "2700"}),
+    ("bench_batch512",
+     # batch-size A/B: larger per-chip batch amortises dispatch + norm
+     # overheads; per-image rate printed, so directly comparable to the
+     # batch-256 default
+     [sys.executable, "bench.py"],
+     ["BENCH_builder_r05_b512.json"], 5400,
+     {"MXTPU_BENCH_BATCH": "512", "MXTPU_BENCH_TIMEOUT": "2400"}),
     ("bench_all",
      [sys.executable, "bench.py", "all"],
      ["BENCH_builder_r05_all.json"], 4800, {}),
