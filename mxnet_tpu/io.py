@@ -29,16 +29,17 @@ class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
 
 
 class DataBatch:
-    """ref: io.DataBatch."""
+    """ref: io.DataBatch (bucket_key routes BucketingModule batches)."""
 
     def __init__(self, data, label=None, pad=0, index=None,
-                 provide_data=None, provide_label=None):
+                 provide_data=None, provide_label=None, bucket_key=None):
         self.data = data
         self.label = label
         self.pad = pad
         self.index = index
         self.provide_data = provide_data
         self.provide_label = provide_label
+        self.bucket_key = bucket_key
 
 
 class DataIter:

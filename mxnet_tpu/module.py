@@ -64,8 +64,14 @@ class Module:
         return out
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
-             inputs_need_grad=False, force_rebind=False, grad_req="write"):
-        """ref: Module.bind — allocates the executor via simple_bind."""
+             inputs_need_grad=False, force_rebind=False, grad_req="write",
+             shared_module=None):
+        """ref: Module.bind — allocates the executor via simple_bind.
+
+        ``shared_module``: an already-bound Module whose parameter, grad,
+        and aux NDArrays this executor ALIASES (the reference's
+        shared-executor memory sharing, used by BucketingModule so every
+        bucket trains the same weights and one optimizer serves all)."""
         if self.binded and not force_rebind:
             return
         shapes = self._desc_shapes(data_shapes)
@@ -77,6 +83,23 @@ class Module:
                    for n in self._symbol.list_arguments()}
         self._exec = self._symbol.simple_bind(self._ctx, grad_req=req,
                                               **shapes)
+        if shared_module is not None:
+            src = shared_module._exec
+            missing = [n for n in self._param_names()
+                       if n not in src.arg_dict]
+            if missing:
+                raise ValueError(
+                    f"bind(shared_module=...): parameters {missing} do not "
+                    f"exist in the shared module — they would silently "
+                    f"stay at zeros and never train")
+            for n in self._param_names():
+                self._exec.arg_dict[n] = src.arg_dict[n]
+                if n in src.grad_dict and n in self._exec.grad_dict:
+                    self._exec.grad_dict[n] = src.grad_dict[n]
+            for n in self._symbol.list_auxiliary_states():
+                if n in src.aux_dict:
+                    self._exec.aux_dict[n] = src.aux_dict[n]
+            self.params_initialized = shared_module.params_initialized
         self.binded = True
         self.for_training = for_training
 
@@ -208,58 +231,19 @@ class Module:
         self.init_optimizer(optimizer=optimizer,
                             optimizer_params=optimizer_params,
                             force_init=force_init)
-        if isinstance(eval_metric, str):
-            eval_metric = _metric.create(eval_metric)
-        for epoch in range(num_epoch):
-            t0 = time.time()
-            eval_metric.reset()
-            train_data.reset()
-            for nbatch, batch in enumerate(train_data):
-                self.forward(batch, is_train=True)
-                self.backward()
-                self.update()
-                self.update_metric(eval_metric, batch.label)
-                if batch_end_callback:
-                    batch_end_callback(
-                        type("BatchEndParam", (), {
-                            "epoch": epoch, "nbatch": nbatch,
-                            "eval_metric": eval_metric})())
-            name, val = eval_metric.get()
-            self._logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
-                              epoch, name, val, time.time() - t0)
-            if eval_data is not None:
-                for name, val in self.score(eval_data, eval_metric):
-                    self._logger.info("Epoch[%d] Validation-%s=%f",
-                                      epoch, name, val)
-            if epoch_end_callback:
-                arg, aux = self.get_params()
-                epoch_end_callback(epoch, self._symbol, arg, aux)
+        _fit_loop(self, self._symbol, self._logger, train_data, eval_data,
+                  eval_metric, num_epoch, batch_end_callback,
+                  epoch_end_callback)
 
     def score(self, eval_data, eval_metric, num_batch=None):
         """ref: BaseModule.score."""
         self._check_bound()
-        if isinstance(eval_metric, str):
-            eval_metric = _metric.create(eval_metric)
-        eval_metric.reset()
-        eval_data.reset()
-        for i, batch in enumerate(eval_data):
-            if num_batch is not None and i >= num_batch:
-                break
-            self.forward(batch, is_train=False)
-            self.update_metric(eval_metric, batch.label)
-        return [eval_metric.get()]
+        return _score_loop(self, eval_data, eval_metric, num_batch)
 
     def predict(self, eval_data, num_batch=None):
         """ref: BaseModule.predict — concatenated first-output batches."""
         self._check_bound()
-        eval_data.reset()
-        chunks = []
-        for i, batch in enumerate(eval_data):
-            if num_batch is not None and i >= num_batch:
-                break
-            self.forward(batch, is_train=False)
-            chunks.append(self.get_outputs()[0].asnumpy())
-        return nd.array(np.concatenate(chunks, axis=0))
+        return _predict_loop(self, eval_data, num_batch)
 
     # ---------------------------------------------------------- checkpoint --
     def save_checkpoint(self, prefix, epoch):
@@ -284,6 +268,233 @@ class Module:
         self.bind(data_shapes, label_shapes, for_training=for_training)
         arg, aux = getattr(self, "_preloaded", (None, None))
         self.set_params(arg or {}, aux or {})
+
+
+# ---------------------------------------------------------------------------
+# the epoch / score / predict loops, shared by Module and BucketingModule
+# (ref: BaseModule.fit/score/predict — both module kinds route through one
+# driver; `mod` needs forward/backward/update/update_metric/get_outputs)
+# ---------------------------------------------------------------------------
+
+def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
+              num_epoch, batch_end_callback, epoch_end_callback):
+    if isinstance(eval_metric, str):
+        eval_metric = _metric.create(eval_metric)
+    for epoch in range(num_epoch):
+        t0 = time.time()
+        eval_metric.reset()
+        train_data.reset()
+        for nbatch, batch in enumerate(train_data):
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(eval_metric, batch.label)
+            if batch_end_callback:
+                batch_end_callback(
+                    type("BatchEndParam", (), {
+                        "epoch": epoch, "nbatch": nbatch,
+                        "eval_metric": eval_metric})())
+        name, val = eval_metric.get()
+        logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
+                    epoch, name, val, time.time() - t0)
+        if eval_data is not None:
+            for name, val in mod.score(eval_data, eval_metric):
+                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+        if epoch_end_callback:
+            arg, aux = mod.get_params()
+            epoch_end_callback(epoch, symbol, arg, aux)
+
+
+def _score_loop(mod, eval_data, eval_metric, num_batch=None):
+    if isinstance(eval_metric, str):
+        eval_metric = _metric.create(eval_metric)
+    eval_metric.reset()
+    eval_data.reset()
+    for i, batch in enumerate(eval_data):
+        if num_batch is not None and i >= num_batch:
+            break
+        mod.forward(batch, is_train=False)
+        mod.update_metric(eval_metric, batch.label)
+    return [eval_metric.get()]
+
+
+def _predict_loop(mod, eval_data, num_batch=None):
+    eval_data.reset()
+    chunks = []
+    for i, batch in enumerate(eval_data):
+        if num_batch is not None and i >= num_batch:
+            break
+        mod.forward(batch, is_train=False)
+        chunks.append(mod.get_outputs()[0].asnumpy())
+    return nd.array(np.concatenate(chunks, axis=0))
+
+
+class BucketingModule:
+    """ref: mx.mod.BucketingModule — one Module per bucket (sequence
+    length), every bucket ALIASING the default bucket's parameter/grad/aux
+    arrays via ``Module.bind(shared_module=...)``, so a single optimizer
+    trains them all.  ``sym_gen(bucket_key) -> (symbol, data_names,
+    label_names)``; batches route by ``DataBatch.bucket_key``."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, context=None,
+                 logger=None):
+        if default_bucket_key is None:
+            raise ValueError("BucketingModule needs default_bucket_key")
+        self._sym_gen = sym_gen
+        self._default_key = default_bucket_key
+        self._ctx = context
+        self._logger = logger or logging.getLogger(__name__)
+        self._buckets: Dict[object, Module] = {}
+        self._curr: Optional[Module] = None
+        self.binded = False
+        self.for_training = False
+
+    def _module_for(self, key):
+        if key not in self._buckets:
+            symb, dnames, lnames = self._sym_gen(key)
+            self._buckets[key] = Module(symb, data_names=dnames,
+                                        label_names=lnames,
+                                        context=self._ctx,
+                                        logger=self._logger)
+        return self._buckets[key]
+
+    @property
+    def _default_module(self):
+        return self._buckets[self._default_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             force_rebind=False, grad_req="write"):
+        """Bind the DEFAULT bucket (it owns the shared arrays)."""
+        if self.binded and not force_rebind:
+            return
+        m = self._module_for(self._default_key)
+        m.bind(data_shapes, label_shapes, for_training=for_training,
+               force_rebind=force_rebind, grad_req=grad_req)
+        self._grad_req = grad_req     # every bucket binds with the same req
+        self._curr = m
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        """ref: BucketingModule.switch_bucket — bind (sharing arrays with
+        the default bucket) and make current."""
+        m = self._module_for(bucket_key)
+        if not m.binded:
+            extra = [n for n in m._param_names()
+                     if n not in self._default_module._exec.arg_dict]
+            if extra:
+                # the reference asserts bucket args are a subset of the
+                # default bucket's — a bucket-unique param would silently
+                # stay at zeros and never train
+                raise ValueError(
+                    f"bucket {bucket_key!r} introduces parameters {extra} "
+                    f"absent from the default bucket "
+                    f"{self._default_key!r}; the default bucket must "
+                    f"cover every parameter")
+            m.bind(data_shapes, label_shapes,
+                   for_training=self.for_training,
+                   grad_req=self._grad_req,
+                   shared_module=self._default_module)
+        self._curr = m
+        return m
+
+    # ---- delegation to the current bucket ----
+    def init_params(self, *a, **kw):
+        self._default_module.init_params(*a, **kw)
+        for m in self._buckets.values():
+            m.params_initialized = True
+
+    def init_optimizer(self, *a, **kw):
+        self._default_module.init_optimizer(*a, **kw)
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", None)
+        key = self._default_key if key is None else key
+        shapes = [(n, tuple(d.shape)) for n, d in
+                  zip(self._module_for(key)._data_names, data_batch.data)]
+        lshapes = None
+        if data_batch.label is not None:
+            lshapes = [(n, tuple(d.shape)) for n, d in
+                       zip(self._module_for(key)._label_names,
+                           data_batch.label)]
+        self.switch_bucket(key, shapes, lshapes)
+        self._curr.forward(data_batch, is_train=is_train)
+
+    def backward(self, out_grads=None):
+        self._curr.backward(out_grads)
+
+    def update(self):
+        # shared arrays: the default bucket's optimizer sees the grads the
+        # current bucket just wrote
+        self._default_module.update()
+
+    def get_outputs(self):
+        return self._curr.get_outputs()
+
+    def update_metric(self, eval_metric, labels):
+        self._curr.update_metric(eval_metric, labels)
+
+    def get_params(self):
+        return self._default_module.get_params()
+
+    def set_params(self, arg_params, aux_params, **kw):
+        self._default_module.set_params(arg_params, aux_params, **kw)
+
+    def score(self, eval_data, eval_metric, num_batch=None):
+        return _score_loop(self, eval_data, eval_metric, num_batch)
+
+    def predict(self, eval_data, num_batch=None):
+        return _predict_loop(self, eval_data, num_batch)
+
+    def _bind_from_iter(self, train_data, force_rebind):
+        """Default-bucket shapes: provide_data when the iterator describes
+        them (they describe the DEFAULT bucket, per the 1.x contract);
+        otherwise the first batch, which must then BE the default bucket —
+        binding the shared arrays from another bucket's shapes would
+        allocate wrong-shaped weights for shape-dependent nets."""
+        if getattr(train_data, "provide_data", None):
+            self.bind([(d.name, tuple(d.shape))
+                       for d in train_data.provide_data],
+                      [(d.name, tuple(d.shape))
+                       for d in train_data.provide_label]
+                      if getattr(train_data, "provide_label", None) else None,
+                      force_rebind=force_rebind)
+            return
+        first = next(iter(train_data))
+        train_data.reset()
+        key = getattr(first, "bucket_key", None)
+        if key is not None and key != self._default_key:
+            raise ValueError(
+                f"BucketingModule.fit: the iterator has no provide_data and "
+                f"its first batch is bucket {key!r}, not the default "
+                f"{self._default_key!r}; give the iterator provide_data "
+                f"describing the default bucket (or lead with a "
+                f"default-bucket batch)")
+        dm = self._module_for(self._default_key)
+        self.bind([(n, tuple(d.shape)) for n, d in
+                   zip(dm._data_names, first.data)],
+                  [(n, tuple(d.shape)) for n, d in
+                   zip(dm._label_names, first.label)]
+                  if first.label is not None else None,
+                  force_rebind=force_rebind)
+
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, num_epoch=1, batch_end_callback=None,
+            epoch_end_callback=None, force_rebind=False, force_init=False):
+        """ref: BaseModule.fit routed through switch_bucket — same
+        signature as Module.fit."""
+        self._bind_from_iter(train_data, force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(optimizer=optimizer,
+                            optimizer_params=optimizer_params,
+                            force_init=force_init)
+        _fit_loop(self, self._default_module.symbol, self._logger,
+                  train_data, eval_data, eval_metric, num_epoch,
+                  batch_end_callback, epoch_end_callback)
 
 
 # ---------------------------------------------------------------------------

@@ -129,6 +129,80 @@ def test_regression_module():
     assert mse < 0.05, mse
 
 
+def test_bucketing_module():
+    """ref: test_module.py test_bucket_module — per-bucket executors share
+    ONE weight set (Module.bind(shared_module=...) array aliasing)."""
+    VOCAB, DIM = 20, 16
+
+    def sym_gen(seq_len):
+        data = sym.Variable("data")
+        emb = sym.Embedding(data, name="emb", input_dim=VOCAB,
+                            output_dim=DIM)
+        fc = sym.FullyConnected(sym.mean(emb, axis=1), name="fc",
+                                num_hidden=2)
+        out = sym.SoftmaxOutput(fc, name="softmax", normalization="batch")
+        return out, ("data",), ("softmax_label",)
+
+    rng = np.random.RandomState(0)
+    batches = []
+    for _ in range(30):
+        L = int(rng.choice([4, 8, 12]))
+        x = rng.randint(0, VOCAB, (16, L)).astype(np.float32)
+        yv = (x.mean(axis=1) > (VOCAB - 1) / 2).astype(np.float32)
+        batches.append(mx.io.DataBatch(data=[nd.array(x)],
+                                       label=[nd.array(yv)], bucket_key=L))
+
+    class ListIter:
+        """Bucketed iterator: provide_data/label describe the DEFAULT
+        bucket (the 1.x contract BucketingModule.bind relies on)."""
+
+        def __init__(self, bs, default_len):
+            self.batches = bs
+            self.provide_data = [mx.io.DataDesc("data", (16, default_len),
+                                                np.float32)]
+            self.provide_label = [mx.io.DataDesc("softmax_label", (16,),
+                                                 np.float32)]
+
+        def __iter__(self):
+            return iter(self.batches)
+
+        def reset(self):
+            pass
+
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=12,
+                                context=mx.cpu())
+    bm.fit(ListIter(batches, 12), optimizer="adam",
+           optimizer_params=(("learning_rate", 0.05),), num_epoch=15)
+    name, acc = bm.score(ListIter(batches, 12), "acc")[0]
+    assert acc > 0.9, (name, acc)
+    # every bucket aliases the default bucket's arrays (not copies)
+    w_def = bm._buckets[12]._exec.arg_dict["fc_weight"]
+    assert len(bm._buckets) == 3
+    for k, m in bm._buckets.items():
+        assert m._exec.arg_dict["fc_weight"] is w_def, k
+    # get_params is a coherent single weight set
+    arg, _ = bm.get_params()
+    assert set(arg) == {"emb_weight", "fc_weight", "fc_bias"}
+
+    # a bucket whose symbol introduces a new parameter fails LOUDLY
+    def bad_gen(seq_len):
+        data = sym.Variable("data")
+        emb = sym.Embedding(data, name="emb", input_dim=VOCAB,
+                            output_dim=DIM)
+        h = sym.FullyConnected(sym.mean(emb, axis=1),
+                               name=f"extra{seq_len}", num_hidden=4)
+        out = sym.SoftmaxOutput(sym.FullyConnected(h, name="fc",
+                                                   num_hidden=2),
+                                name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    bm2 = mx.mod.BucketingModule(bad_gen, default_bucket_key=12,
+                                 context=mx.cpu())
+    bm2.bind([("data", (16, 12))], [("softmax_label", (16,))])
+    with pytest.raises(ValueError, match="absent from the default bucket"):
+        bm2.switch_bucket(8, [("data", (16, 8))], [("softmax_label", (16,))])
+
+
 def test_bind_without_labels_for_inference():
     data = sym.Variable("data")
     net = sym.FullyConnected(data, name="fc", num_hidden=4)
