@@ -64,6 +64,14 @@ def do_checkpoint(prefix, period=1):
     def _callback(epoch, net, *rest):
         if (epoch + 1) % period != 0:
             return
+        if len(rest) == 2 and isinstance(rest[0], dict):
+            # Module.fit's (epoch, symbol, arg_params, aux_params) form —
+            # write the classic 1.x artifact pair
+            from .module import save_checkpoint
+            save_checkpoint(prefix, epoch + 1, net, rest[0], rest[1])
+            logging.info("Saved checkpoint to \"%s-%04d.params\"",
+                         prefix, epoch + 1)
+            return
         fname = f"{prefix}-{epoch + 1:04d}.params"
         if hasattr(net, "save_parameters"):
             net.save_parameters(fname)

@@ -15,6 +15,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from . import callback as _callback
 from . import initializer as _init
 from . import metric as _metric
 from . import optimizer as _opt
@@ -172,6 +173,9 @@ class Module:
             self._optimizer = optimizer
         names = self._param_names()
         self._optimizer.idx2name = dict(enumerate(names))
+        # stable name→index map so a shared optimizer (BucketingModule)
+        # sees consistent indices from every bucket's update()
+        self._opt_index = {n: i for i, n in enumerate(names)}
         self._opt_states = {
             n: self._optimizer.create_state_multi_precision(
                 i, self._exec.arg_dict[n])
@@ -206,7 +210,8 @@ class Module:
             if g is None:
                 continue
             self._optimizer.update_multi_precision(
-                i, self._exec.arg_dict[n], g, self._opt_states[n])
+                self._opt_index.get(n, i), self._exec.arg_dict[n], g,
+                self._opt_states[n])
 
     def get_outputs(self):
         self._check_bound()
@@ -290,10 +295,8 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
             mod.update()
             mod.update_metric(eval_metric, batch.label)
             if batch_end_callback:
-                batch_end_callback(
-                    type("BatchEndParam", (), {
-                        "epoch": epoch, "nbatch": nbatch,
-                        "eval_metric": eval_metric})())
+                batch_end_callback(_callback.BatchEndParam(
+                    epoch=epoch, nbatch=nbatch, eval_metric=eval_metric))
         name, val = eval_metric.get()
         logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
                     epoch, name, val, time.time() - t0)
@@ -375,9 +378,14 @@ class BucketingModule:
         self.binded = True
         self.for_training = for_training
 
+    def _check_bound(self):
+        if not self.binded:
+            raise RuntimeError("BucketingModule: call bind() first")
+
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         """ref: BucketingModule.switch_bucket — bind (sharing arrays with
         the default bucket) and make current."""
+        self._check_bound()
         m = self._module_for(bucket_key)
         if not m.binded:
             extra = [n for n in m._param_names()
@@ -395,8 +403,21 @@ class BucketingModule:
                    for_training=self.for_training,
                    grad_req=self._grad_req,
                    shared_module=self._default_module)
+        self._share_optimizer(m)
         self._curr = m
         return m
+
+    def _share_optimizer(self, m):
+        """Every bucket updates through ONE optimizer + state set, with
+        name-stable indices, so update() steps exactly the params whose
+        grads the CURRENT bucket just wrote (review r5: stepping all
+        default params re-applied stale grads for subset buckets)."""
+        d = self._default_module
+        if d.optimizer_initialized and not m.optimizer_initialized:
+            m._optimizer = d._optimizer
+            m._opt_states = d._opt_states
+            m._opt_index = d._opt_index
+            m.optimizer_initialized = True
 
     # ---- delegation to the current bucket ----
     def init_params(self, *a, **kw):
@@ -408,6 +429,7 @@ class BucketingModule:
         self._default_module.init_optimizer(*a, **kw)
 
     def forward(self, data_batch, is_train=None):
+        self._check_bound()
         key = getattr(data_batch, "bucket_key", None)
         key = self._default_key if key is None else key
         shapes = [(n, tuple(d.shape)) for n, d in
@@ -421,20 +443,24 @@ class BucketingModule:
         self._curr.forward(data_batch, is_train=is_train)
 
     def backward(self, out_grads=None):
+        self._check_bound()
         self._curr.backward(out_grads)
 
     def update(self):
-        # shared arrays: the default bucket's optimizer sees the grads the
-        # current bucket just wrote
-        self._default_module.update()
+        # through the CURRENT bucket: the shared optimizer/state set steps
+        # exactly the params whose grads this bucket's backward wrote
+        self._check_bound()
+        self._curr.update()
 
     def get_outputs(self):
+        self._check_bound()
         return self._curr.get_outputs()
 
     def update_metric(self, eval_metric, labels):
         self._curr.update_metric(eval_metric, labels)
 
     def get_params(self):
+        self._check_bound()
         return self._default_module.get_params()
 
     def set_params(self, arg_params, aux_params, **kw):

@@ -203,6 +203,70 @@ def test_bucketing_module():
         bm2.switch_bucket(8, [("data", (16, 8))], [("softmax_label", (16,))])
 
 
+def test_bucketing_subset_bucket_update_isolation():
+    """A bucket that omits a default-bucket layer must not re-apply that
+    layer's stale gradient on update (review r5)."""
+    VOCAB, DIM = 12, 8
+
+    def sym_gen(L):
+        d = sym.Variable("data")
+        e = sym.Embedding(d, name="emb", input_dim=VOCAB, output_dim=DIM)
+        h = sym.mean(e, axis=1)
+        if L >= 10:
+            h = sym.Activation(sym.FullyConnected(h, name="proj",
+                                                  num_hidden=DIM),
+                               act_type="relu")
+        f = sym.FullyConnected(h, name="fc", num_hidden=2)
+        out = sym.SoftmaxOutput(f, name="softmax", normalization="batch")
+        return out, ("data",), ("softmax_label",)
+
+    rng = np.random.RandomState(0)
+    bm = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                context=mx.cpu())
+    bm.bind([("data", (8, 10))], [("softmax_label", (8,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", 0.5),
+                                        ("momentum", 0.9)))
+
+    def batch(L):
+        x = rng.randint(0, VOCAB, (8, L)).astype(np.float32)
+        yv = (x.mean(1) > 5.5).astype(np.float32)
+        return mx.io.DataBatch([nd.array(x)], [nd.array(yv)], bucket_key=L)
+
+    bm.forward(batch(10), is_train=True)
+    bm.backward()
+    bm.update()   # leaves a nonzero grad in proj_weight
+    frozen = bm.get_params()[0]["proj_weight"].asnumpy().copy()
+    for _ in range(5):
+        bm.forward(batch(6), is_train=True)
+        bm.backward()
+        bm.update()
+    np.testing.assert_array_equal(
+        bm.get_params()[0]["proj_weight"].asnumpy(), frozen)
+    # unbound use raises the clear guard, not a cryptic AttributeError
+    bm2 = mx.mod.BucketingModule(sym_gen, default_bucket_key=10)
+    with pytest.raises(RuntimeError, match="bind"):
+        bm2.forward(batch(10))
+
+
+def test_fit_with_classic_callbacks(tmp_path):
+    """Speedometer + do_checkpoint wire into Module.fit like the 1.x
+    scripts expect (ref: callback.py + model.BatchEndParam)."""
+    X, y = _cls_problem(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    prefix = str(tmp_path / "cb")
+    mod.fit(it, optimizer="sgd", num_epoch=2,
+            batch_end_callback=mx.callback.Speedometer(16, frequent=2),
+            epoch_end_callback=mx.callback.do_checkpoint(prefix))
+    # do_checkpoint wrote the classic artifact pair each epoch
+    for epoch in (1, 2):
+        symb, arg, aux = mx.model.load_checkpoint(prefix, epoch)
+        assert set(arg) == {"fc1_weight", "fc1_bias", "fc2_weight",
+                            "fc2_bias"}
+
+
 def test_bind_without_labels_for_inference():
     data = sym.Variable("data")
     net = sym.FullyConnected(data, name="fc", num_hidden=4)
