@@ -69,6 +69,7 @@ from . import executor
 from . import module
 from . import module as mod          # mx.mod — Module API
 from . import model                  # mx.model — checkpoint helpers
+from . import rnn                    # mx.rnn — legacy symbolic RNN cells
 
 config._apply_startup()
 

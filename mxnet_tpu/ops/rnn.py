@@ -124,19 +124,27 @@ def _run_direction(x, h0, c0, cell_params, mode, reverse):
 
 
 @register_op("RNN", needs_rng=True)
-def _rnn(data, parameters, state, state_cell=None, state_size=None, num_layers=1,
+def _rnn(data, parameters, state=None, state_cell=None, state_size=None,
+         num_layers=1,
          bidirectional=False, mode="lstm", p=0.0, state_outputs=False,
          projection_size=None, lstm_state_clip_min=None, lstm_state_clip_max=None,
          lstm_state_clip_nan=False, use_sequence_length=False, training=None):
     """Fused multi-layer RNN (ref: src/operator/rnn.cc — the PTB-LSTM hot path).
 
     data (T, N, C); state (L*dirs, N, H); lstm also takes state_cell.
-    Returns out, state_h [, state_c] — always the tuple; callers select.
+    state/state_cell may be omitted (None) for zero initial states — the
+    common `mx.rnn.FusedRNNCell.unroll` start.  Returns out, state_h
+    [, state_c] — always the tuple; callers select.
     """
     if training is None:
         training = _autograd.is_training()
     dirs = 2 if bidirectional else 1
     h = state_size
+    if state is None:
+        state = jnp.zeros((num_layers * dirs, data.shape[1], h), data.dtype)
+    if state_cell is None and mode == "lstm":
+        state_cell = jnp.zeros((num_layers * dirs, data.shape[1], h),
+                               data.dtype)
     cells = _unpack(parameters, mode, data.shape[-1], h, num_layers, dirs)
     x = data
     h_states, c_states = [], []

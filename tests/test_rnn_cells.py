@@ -1,0 +1,161 @@
+"""mx.rnn legacy symbolic cells (ref: tests/python/unittest/test_rnn.py —
+cell composition, unroll shapes, fused/cell parity via packed weights)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import symbol as sym
+
+
+@pytest.fixture(autouse=True)
+def _fresh_names():
+    sym.reset_auto_names()
+    yield
+
+
+T, N, C, H = 5, 4, 6, 8
+
+
+def _x():
+    return np.random.RandomState(0).randn(N, T, C).astype(np.float32)
+
+
+def test_lstm_cell_unroll_shapes_and_training():
+    data = sym.Variable("data")
+    cell = mx.rnn.LSTMCell(num_hidden=H, prefix="lstm_")
+    outs, states = cell.unroll(T, data, layout="NTC", merge_outputs=True)
+    assert len(states) == 2          # (h, c)
+    head = sym.SoftmaxOutput(
+        sym.FullyConnected(sym.Flatten(
+            sym.slice_axis(outs, axis=1, begin=T - 1, end=T)),
+            name="fc", num_hidden=2),
+        name="softmax", normalization="batch")
+    assert "lstm_i2h_weight" in head.list_arguments()
+    a, o, _ = head.infer_shape(data=(N, T, C))
+    shapes = dict(zip(head.list_arguments(), a))
+    assert shapes["lstm_i2h_weight"] == (4 * H, C)
+    assert shapes["lstm_h2h_weight"] == (4 * H, H)
+    assert o == [(N, 2)]
+
+    x = _x()
+    y = (x.mean(axis=(1, 2)) > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=N)
+    mod = mx.mod.Module(head, context=mx.cpu())
+    mod.fit(it, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.05),), num_epoch=20)
+    assert mod.score(it, "acc")[0][1] == 1.0
+
+
+def test_fused_vs_cell_parity():
+    """FusedRNNCell (the lax.scan RNN op) and the explicit LSTMCell unroll
+    compute the same sequence given the cuDNN-packed weight layout."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(N, T, C).astype(np.float32)
+    i2h_w = rng.randn(4 * H, C).astype(np.float32) * 0.3
+    h2h_w = rng.randn(4 * H, H).astype(np.float32) * 0.3
+    i2h_b = rng.randn(4 * H).astype(np.float32) * 0.1
+    h2h_b = rng.randn(4 * H).astype(np.float32) * 0.1
+    packed = np.concatenate([i2h_w.ravel(), h2h_w.ravel(),
+                             i2h_b.ravel(), h2h_b.ravel()])
+
+    cell = mx.rnn.LSTMCell(num_hidden=H, prefix="lstm_")
+    outs, _ = cell.unroll(T, sym.Variable("data"), layout="NTC",
+                          merge_outputs=True)
+    ex_cell = outs.bind(args={"data": nd.array(x),
+                              "lstm_i2h_weight": nd.array(i2h_w),
+                              "lstm_i2h_bias": nd.array(i2h_b),
+                              "lstm_h2h_weight": nd.array(h2h_w),
+                              "lstm_h2h_bias": nd.array(h2h_b)},
+                        grad_req="null")
+    cell_out = ex_cell.forward()[0].asnumpy()
+
+    fused = mx.rnn.FusedRNNCell(num_hidden=H, mode="lstm", prefix="f_")
+    assert fused.param_size(C) == packed.size
+    fo, _ = fused.unroll(T, sym.Variable("data"), layout="NTC")
+    ex_f = fo.bind(args={"data": nd.array(x),
+                         "f_parameters": nd.array(packed)}, grad_req="null")
+    np.testing.assert_allclose(ex_f.forward()[0].asnumpy(), cell_out,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gru_and_vanilla_cells():
+    x = _x()
+    for cell, nstates in [(mx.rnn.GRUCell(H, prefix="g_"), 1),
+                          (mx.rnn.RNNCell(H, prefix="r_"), 1)]:
+        outs, states = cell.unroll(T, sym.Variable("data"), layout="NTC",
+                                   merge_outputs=True)
+        assert len(states) == nstates
+        a, o, _ = outs.infer_shape(data=(N, T, C))
+        assert o == [(N, T, H)]
+        # executes with random params
+        ex = outs.simple_bind(grad_req="null", data=(N, T, C))
+        for n, arr in ex.arg_dict.items():
+            if n != "data":
+                arr._data = np.random.RandomState(1).randn(
+                    *arr.shape).astype(np.float32) * 0.2
+        ex.arg_dict["data"]._data = x
+        out = ex.forward()[0].asnumpy()
+        assert out.shape == (N, T, H)
+        assert np.isfinite(out).all()
+
+
+def test_sequential_stack_with_dropout():
+    stack = mx.rnn.SequentialRNNCell([mx.rnn.GRUCell(H, prefix="g0_"),
+                                      mx.rnn.DropoutCell(0.5),
+                                      mx.rnn.GRUCell(H, prefix="g1_")])
+    outs, states = stack.unroll(T, sym.Variable("data"), layout="NTC",
+                                merge_outputs=True)
+    a, o, _ = outs.infer_shape(data=(N, T, C))
+    assert o == [(N, T, H)]
+    args = outs.list_arguments()
+    assert "g0_i2h_weight" in args and "g1_i2h_weight" in args
+    # dropout is identity at inference
+    ex = outs.simple_bind(grad_req="null", data=(N, T, C))
+    for n, arr in ex.arg_dict.items():
+        if n != "data":
+            arr._data = np.random.RandomState(1).randn(
+                *arr.shape).astype(np.float32) * 0.2
+    ex.arg_dict["data"]._data = _x()
+    o1 = ex.forward(is_train=False)[0].asnumpy()
+    o2 = ex.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_array_equal(o1, o2)
+
+
+def test_flat_state_list_through_stack():
+    """The 1.x state-carry contract: a FLAT state list threads through a
+    stack, sliced by each cell's num_states (review r5)."""
+    stack = mx.rnn.SequentialRNNCell([mx.rnn.LSTMCell(H, prefix="l0_"),
+                                      mx.rnn.DropoutCell(0.0),
+                                      mx.rnn.LSTMCell(H, prefix="l1_")])
+    assert stack.num_states == 4     # (h0, c0) + () + (h1, c1)
+    begin = [sym.Variable(f"s{i}") for i in range(4)]
+    outs, states = stack.unroll(T, sym.Variable("data"), begin_state=begin,
+                                layout="NTC", merge_outputs=True)
+    assert len(states) == 4          # flat, not nested
+    shapes = {"data": (N, T, C)}
+    shapes.update({f"s{i}": (N, H) for i in range(4)})
+    a, o, _ = outs.infer_shape(**shapes)
+    assert o == [(N, T, H)]
+    # wrong-length flat list fails loudly
+    with pytest.raises(ValueError, match="flat state list"):
+        stack.unroll(T, sym.Variable("data"), begin_state=begin[:3])
+    # DropoutCell honours merge_outputs on a merged input
+    dc = mx.rnn.DropoutCell(0.5)
+    steps, _ = dc.unroll(T, sym.Variable("x"), layout="NTC",
+                         merge_outputs=False)
+    assert isinstance(steps, list) and len(steps) == T
+
+
+def test_tnc_layout_and_step_lists():
+    cell = mx.rnn.RNNCell(H, prefix="r_")
+    outs, _ = cell.unroll(T, sym.Variable("data"), layout="TNC",
+                          merge_outputs=True)
+    a, o, _ = outs.infer_shape(data=(T, N, C))
+    assert o == [(T, N, H)]
+    cell.reset()
+    step_list, _ = cell.unroll(T, sym.Variable("data"), layout="NTC",
+                               merge_outputs=False)
+    assert len(step_list) == T
+    a, o, _ = step_list[0].infer_shape(data=(N, T, C))
+    assert o == [(N, H)]

@@ -22,3 +22,4 @@ if jax.default_backend() == "cpu":
 
 from test_operator import *          # noqa: F401,F403,E402
 from test_autograd import *          # noqa: F401,F403,E402
+from test_random_ops import *        # noqa: F401,F403,E402

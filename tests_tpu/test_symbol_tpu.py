@@ -12,3 +12,4 @@ if jax.default_backend() == "cpu":
 
 from test_symbol import *            # noqa: F401,F403,E402
 from test_module import *            # noqa: F401,F403,E402
+from test_rnn_cells import *         # noqa: F401,F403,E402
