@@ -185,6 +185,9 @@ class Executor:
 
     def __init__(self, symbol: Symbol, ctx=None, args=None, args_grad=None,
                  grad_req="write", aux_states=None):
+        from .symbol import check_unique_variables
+
+        check_unique_variables(symbol)
         self._symbol = symbol
         self._ctx = ctx if isinstance(ctx, Context) else current_context()
         self._arg_names = symbol.list_arguments()

@@ -114,11 +114,23 @@ class _GatedCell(BaseRNNCell):
             sym.slice_axis(i2h_out, axis=1, begin=0, end=self._h))
 
 
+def _cell_prefix(prefix, base):
+    """Default prefixes auto-number (ref: NameManager — 'lstm0_',
+    'lstm1_', ...) so stacking two default-prefix cells never collides;
+    explicit duplicate prefixes fail loudly at bind (symbol.py
+    check_unique_variables)."""
+    if prefix is not None:
+        return prefix
+    from .symbol import _auto_name
+
+    return f"{_auto_name(base)}_"
+
+
 class RNNCell(_GatedCell):
     """ref: rnn_cell.RNNCell — h' = act(i2h(x) + h2h(h))."""
 
-    def __init__(self, num_hidden, activation="tanh", prefix="rnn_"):
-        super().__init__(num_hidden, prefix, n_gates=1)
+    def __init__(self, num_hidden, activation="tanh", prefix=None):
+        super().__init__(num_hidden, _cell_prefix(prefix, "rnn"), n_gates=1)
         self._act = activation
 
     def __call__(self, x, states):
@@ -138,8 +150,9 @@ class LSTMCell(_GatedCell):
 
     num_states = 2
 
-    def __init__(self, num_hidden, prefix="lstm_"):
-        super().__init__(num_hidden, prefix, n_gates=4)
+    def __init__(self, num_hidden, prefix=None):
+        super().__init__(num_hidden, _cell_prefix(prefix, "lstm"),
+                         n_gates=4)
 
     def __call__(self, x, states):
         t = self._counter
@@ -164,8 +177,8 @@ class LSTMCell(_GatedCell):
 class GRUCell(_GatedCell):
     """ref: rnn_cell.GRUCell — gates [r, z, n], two bias sets."""
 
-    def __init__(self, num_hidden, prefix="gru_"):
-        super().__init__(num_hidden, prefix, n_gates=3)
+    def __init__(self, num_hidden, prefix=None):
+        super().__init__(num_hidden, _cell_prefix(prefix, "gru"), n_gates=3)
 
     def __call__(self, x, states):
         t = self._counter

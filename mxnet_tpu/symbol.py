@@ -480,11 +480,29 @@ def _make_builder(op_name):
 # parameter shape inference (LAYERS rules + __shape__ hints)
 # ---------------------------------------------------------------------------
 
+def check_unique_variables(sym: Symbol):
+    """Two DISTINCT variable nodes sharing one name would silently collapse
+    into a single bound array (dict-keyed binding) — the reference raises a
+    duplicate-argument error at bind; so do we (e.g. two same-prefix
+    LSTMCells both creating 'lstm_i2h_weight')."""
+    seen: Dict[str, object] = {}
+    for n in sym._topo_nodes():
+        if n.op is None:
+            if n.name in seen and seen[n.name] is not n:
+                raise ValueError(
+                    f"duplicate variable name {n.name!r}: two distinct "
+                    f"graph variables share it (same-prefix cells/layers?) "
+                    f"— give them unique names/prefixes")
+            seen[n.name] = n
+
+
 def infer_arg_shapes(sym: Symbol, known: Dict[str, tuple]) -> Dict[str, tuple]:
     """Shapes for every argument+aux variable: caller-provided data/label
     shapes, variable __shape__ hints, and the per-layer weight rules, walked
     in topo order so chained layers see their input's inferred shape."""
     from .executor import abstract_eval_prefix
+
+    check_unique_variables(sym)
 
     shapes: Dict[str, tuple] = {}
     for n in sym._topo_nodes():

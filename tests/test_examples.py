@@ -34,6 +34,11 @@ def test_module_symbolic_example():
     assert "SymbolBlock serve" in out
 
 
+def test_bucketing_lstm_example():
+    out = _run("bucketing_lstm.py", "--epochs", "2", "--batch-size", "16")
+    assert "over buckets [4, 8, 12]" in out
+
+
 def test_resnet_fused_example():
     out = _run("train_resnet_fused.py", "--model", "resnet18_v1",
                "--batch-size", "4", "--iters", "2", "--classes", "10")

@@ -147,6 +147,29 @@ def test_flat_state_list_through_stack():
     assert isinstance(steps, list) and len(steps) == T
 
 
+def test_default_prefixes_never_collide():
+    """Default cell prefixes auto-number (NameManager behaviour); explicit
+    duplicate prefixes fail loudly at bind (review r5)."""
+    stack = mx.rnn.SequentialRNNCell([mx.rnn.LSTMCell(H), mx.rnn.LSTMCell(H)])
+    outs, _ = stack.unroll(3, sym.Variable("data"), layout="NTC",
+                           merge_outputs=True)
+    args = outs.list_arguments()
+    i2h = [a for a in args if a.endswith("i2h_weight")]
+    assert len(i2h) == 2 and len(set(i2h)) == 2, i2h
+    a, o, _ = outs.infer_shape(data=(N, 3, C))
+    shapes = dict(zip(args, a))
+    assert shapes[i2h[0]] == (4 * H, C)
+    assert shapes[i2h[1]] == (4 * H, H)   # layer 1 takes layer 0's H
+
+    # explicit duplicate prefixes raise instead of silently tying weights
+    dup = mx.rnn.SequentialRNNCell([mx.rnn.LSTMCell(H, prefix="same_"),
+                                    mx.rnn.LSTMCell(H, prefix="same_")])
+    douts, _ = dup.unroll(3, sym.Variable("data"), layout="NTC",
+                          merge_outputs=True)
+    with pytest.raises(ValueError, match="duplicate variable name"):
+        douts.infer_shape(data=(N, 3, C))
+
+
 def test_tnc_layout_and_step_lists():
     cell = mx.rnn.RNNCell(H, prefix="r_")
     outs, _ = cell.unroll(T, sym.Variable("data"), layout="TNC",
