@@ -70,6 +70,7 @@ from . import module
 from . import module as mod          # mx.mod — Module API
 from . import model                  # mx.model — checkpoint helpers
 from . import rnn                    # mx.rnn — legacy symbolic RNN cells
+from . import name                   # mx.name — NameManager/Prefix scopes
 
 config._apply_startup()
 

@@ -28,6 +28,7 @@ __all__ = [
     "mark_variables",
     "backward",
     "grad",
+    "Function",
     "set_recording",
     "set_training",
 ]
@@ -249,3 +250,74 @@ def _fresh_zero(v):
     from .ndarray import NDArray
 
     return NDArray(jnp.zeros(v.shape, v._data.dtype), ctx=v.context)
+
+
+class Function:
+    """User-defined differentiable function (ref: mxnet.autograd.Function —
+    class Function with forward/backward and save_for_backward).
+
+    Subclass, implement ``forward(*inputs)`` and ``backward(*out_grads)``
+    (one gradient per NDArray input, in order), then CALL the instance.
+    ``forward`` runs outside recording (like the reference's pause), and
+    the instance is spliced into the tape as one node whose VJP is your
+    ``backward``::
+
+        class sigmoid(autograd.Function):
+            def forward(self, x):
+                y = nd.sigmoid(x)
+                self.save_for_backward(y)
+                return y
+            def backward(self, dy):
+                (y,) = self.saved_tensors
+                return dy * y * (1 - y)
+    """
+
+    def __init__(self):
+        self._saved = ()
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with pause():
+            outs = self.forward(*inputs)
+        outs_t = outs if isinstance(outs, tuple) else (outs,)
+        if is_recording():
+            in_list = [a for a in inputs if isinstance(a, NDArray)]
+            n_in = len(in_list)
+            # snapshot the residuals NOW: reusing one instance for several
+            # recorded calls must not make earlier nodes read the LAST
+            # call's save_for_backward state
+            saved_snapshot = self._saved
+
+            def _pull(cts):
+                prev = self._saved
+                self._saved = saved_snapshot
+                try:
+                    with pause():
+                        grads = self.backward(*[NDArray(c) for c in cts])
+                finally:
+                    self._saved = prev
+                grads_t = grads if isinstance(grads, tuple) else (grads,)
+                if len(grads_t) != n_in:
+                    raise ValueError(
+                        f"{type(self).__name__}.backward returned "
+                        f"{len(grads_t)} gradients for {n_in} array inputs")
+                return [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                        for g in grads_t]
+
+            append_node(TapeNode(in_list, list(outs_t), _pull,
+                                 name=f"Function:{type(self).__name__}"))
+        return outs

@@ -398,7 +398,10 @@ class BucketingModule:
                     f"bucket {bucket_key!r} introduces parameters {extra} "
                     f"absent from the default bucket "
                     f"{self._default_key!r}; the default bucket must "
-                    f"cover every parameter")
+                    f"cover every parameter.  If these are auto-numbered "
+                    f"names (lstm2_...), your sym_gen constructs NEW "
+                    f"default-prefix cells per call — construct cells once "
+                    f"outside sym_gen, or give them explicit prefixes")
             m.bind(data_shapes, label_shapes,
                    for_training=self.for_training,
                    grad_req=self._grad_req,

@@ -1,0 +1,58 @@
+"""`mx.name` — naming scopes for symbol composition.
+
+ref: python/mxnet/name.py — NameManager assigns `op0`, `op1`, ... to
+anonymous symbols; `Prefix` prepends a scope prefix ("with
+mx.name.Prefix('resnet_'):" in classic model definitions).  The active
+manager is consulted by `symbol._auto_name`.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = [NameManager()]
+    return _tls.stack
+
+
+def current() -> "NameManager":
+    return _stack()[-1]
+
+
+class NameManager:
+    """Counts per-op-type anonymous names (ref: class NameManager)."""
+
+    def __init__(self):
+        self._counts = {}
+
+    def get(self, name, hint):
+        """Explicit ``name`` wins; otherwise `hint` + running counter."""
+        if name is not None:
+            return name
+        i = self._counts.get(hint, 0)
+        self._counts[hint] = i + 1
+        return f"{hint}{i}"
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()
+
+
+class Prefix(NameManager):
+    """Prepends ``prefix`` to every auto-generated name
+    (ref: class Prefix)."""
+
+    def __init__(self, prefix: str):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        return self._prefix + super().get(name, hint)

@@ -118,7 +118,12 @@ def _cell_prefix(prefix, base):
     """Default prefixes auto-number (ref: NameManager — 'lstm0_',
     'lstm1_', ...) so stacking two default-prefix cells never collides;
     explicit duplicate prefixes fail loudly at bind (symbol.py
-    check_unique_variables)."""
+    check_unique_variables).
+
+    Auto-numbering is per construction: with BucketingModule, construct
+    cells ONCE outside sym_gen (the reference's bucketing examples close
+    over one stack) or pass explicit prefixes, so every bucket names the
+    same parameters."""
     if prefix is not None:
         return prefix
     from .symbol import _auto_name

@@ -160,19 +160,26 @@ def data_variables(sym: "Symbol"):
 # the Symbol DAG
 # ---------------------------------------------------------------------------
 
-_AUTO_COUNT: Dict[str, int] = {}
+def _scoped_name(name, op: str) -> str:
+    """Node name via the active mx.name scope (ref: NameManager.get —
+    `with mx.name.Prefix('net_'):` prefixes BOTH auto-generated and
+    explicit op names, so two towers built under different prefixes never
+    collide)."""
+    from . import name as _name
+
+    base = re.sub(r"[^0-9a-zA-Z]", "", op).lower()
+    return _name.current().get(name, base)
 
 
 def _auto_name(op: str) -> str:
-    base = re.sub(r"[^0-9a-zA-Z]", "", op).lower()
-    i = _AUTO_COUNT.get(base, 0)
-    _AUTO_COUNT[base] = i + 1
-    return f"{base}{i}"
+    return _scoped_name(None, op)
 
 
 def reset_auto_names():
     """Test helper: deterministic auto-naming per test."""
-    _AUTO_COUNT.clear()
+    from . import name as _name
+
+    _name.current()._counts.clear()
 
 
 class _Node:
@@ -409,7 +416,7 @@ def Group(symbols):
 
 
 def _invoke_sym(op_name, sym_inputs, attrs, name):
-    node = _Node(op_name, name or _auto_name(op_name), attrs, sym_inputs)
+    node = _Node(op_name, _scoped_name(name, op_name), attrs, sym_inputs)
     return Symbol(node, whole=True)
 
 
@@ -441,7 +448,7 @@ def _make_builder(op_name):
             wanted = spec.inputs(attrs)
             inputs = []
             it = iter(sym_args)
-            nm = name or _auto_name(op_name)
+            nm = _scoped_name(name, op_name)
             for slot in wanted:
                 if slot in sym_kwargs:
                     inputs.append(sym_kwargs.pop(slot))

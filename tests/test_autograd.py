@@ -166,3 +166,76 @@ def test_retain_graph():
     np.testing.assert_allclose(x.grad.asnumpy(), [4])
     y.backward()
     np.testing.assert_allclose(x.grad.asnumpy(), [4])
+
+
+def test_autograd_function():
+    """ref: autograd.Function — user forward/backward spliced as one tape
+    node, with save_for_backward residuals."""
+    class sigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.float32([-1.0, 0.0, 2.0]))
+    x.attach_grad()
+    w = nd.array(np.float32([1.0, 2.0, 3.0]))
+    with autograd.record():
+        loss = (sigmoid()(x) * w).sum()
+    loss.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(), w.asnumpy() * s * (1 - s),
+                               rtol=1e-5)
+
+    class mul(autograd.Function):
+        def forward(self, a, b):
+            self.save_for_backward(a, b)
+            return a * b
+
+        def backward(self, dy):
+            a, b = self.saved_tensors
+            return dy * b, dy * a
+
+    a = nd.array(np.float32([2.0, 3.0]))
+    b = nd.array(np.float32([5.0, 7.0]))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = mul()(a, b).sum()
+    out.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), [5.0, 7.0])
+    np.testing.assert_allclose(b.grad.asnumpy(), [2.0, 3.0])
+
+    # one instance reused across recorded calls: each node keeps ITS OWN
+    # residuals (review r5: the last call used to clobber all of them)
+    f = sigmoid()
+    x1 = nd.array(np.float32([0.5]))
+    x2 = nd.array(np.float32([-2.0]))
+    x1.attach_grad()
+    x2.attach_grad()
+    with autograd.record():
+        total = f(x1).sum() + f(x2).sum()
+    total.backward()
+    for xi in (x1, x2):
+        si = 1 / (1 + np.exp(-xi.asnumpy()))
+        np.testing.assert_allclose(xi.grad.asnumpy(), si * (1 - si),
+                                   rtol=1e-5)
+
+    # wrong gradient arity fails loudly
+    class bad(autograd.Function):
+        def forward(self, a, b):
+            return a + b
+
+        def backward(self, dy):
+            return dy  # one grad for two inputs
+
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        o = bad()(a, b).sum()
+    with pytest.raises(ValueError, match="returned 1 gradients"):
+        o.backward()

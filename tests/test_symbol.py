@@ -46,6 +46,33 @@ def test_auto_naming():
     assert a.name == "fullyconnected0" and b.name == "fullyconnected1"
 
 
+def test_name_scopes():
+    """mx.name.Prefix / NameManager scope auto-generated AND explicit op
+    names (ref: python/mxnet/name.py)."""
+    d = sym.Variable("data")
+    with mx.name.Prefix("net_"):
+        a = sym.FullyConnected(d, num_hidden=2)
+        assert a.name == "net_fullyconnected0"
+        assert a.list_arguments()[1] == "net_fullyconnected0_weight"
+    # scope exits: back to the outer manager's counter
+    b = sym.FullyConnected(d, num_hidden=2)
+    assert not b.name.startswith("net_")
+    # a fresh nested NameManager restarts its own counts
+    with mx.name.NameManager():
+        c = sym.FullyConnected(d, num_hidden=2)
+        assert c.name == "fullyconnected0"
+    # two towers with the SAME explicit layer name but different prefixes
+    # get distinct parameters (the reference's two-tower pattern)
+    with mx.name.Prefix("a_"):
+        ta = sym.FullyConnected(d, name="fc", num_hidden=2)
+    with mx.name.Prefix("b_"):
+        tb = sym.FullyConnected(d, name="fc", num_hidden=2)
+    assert ta.name == "a_fc" and tb.name == "b_fc"
+    both = sym.Group([ta, tb])
+    assert "a_fc_weight" in both.list_arguments()
+    assert "b_fc_weight" in both.list_arguments()
+
+
 def test_infer_shape_mlp():
     out = _mlp()
     arg, outs, aux = out.infer_shape(data=(4, 5))
