@@ -71,6 +71,8 @@ from . import module as mod          # mx.mod — Module API
 from . import model                  # mx.model — checkpoint helpers
 from . import rnn                    # mx.rnn — legacy symbolic RNN cells
 from . import name                   # mx.name — NameManager/Prefix scopes
+from . import monitor                # mx.monitor — layer-stat debugging
+from . import monitor as mon
 
 config._apply_startup()
 

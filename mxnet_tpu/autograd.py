@@ -291,7 +291,9 @@ class Function:
     def __call__(self, *inputs):
         from .ndarray import NDArray
 
-        with pause():
+        # pause recording but PRESERVE train mode: a forward using Dropout
+        # or is_training() branches must see the enclosing mode
+        with pause(train_mode=is_training()):
             outs = self.forward(*inputs)
         outs_t = outs if isinstance(outs, tuple) else (outs,)
         if is_recording():
@@ -310,7 +312,8 @@ class Function:
                         grads = self.backward(*[NDArray(c) for c in cts])
                 finally:
                     self._saved = prev
-                grads_t = grads if isinstance(grads, tuple) else (grads,)
+                grads_t = tuple(grads) if isinstance(grads, (tuple, list)) \
+                    else (grads,)
                 if len(grads_t) != n_in:
                     raise ValueError(
                         f"{type(self).__name__}.backward returned "

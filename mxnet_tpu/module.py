@@ -75,6 +75,12 @@ class Module:
         bucket trains the same weights and one optimizer serves all)."""
         if self.binded and not force_rebind:
             return
+        # a re-bind must not silently reset trained weights to zeros while
+        # params_initialized stays True (the reference preserves params
+        # across bind calls)
+        preserved = None
+        if self._exec is not None and self.params_initialized:
+            preserved = self.get_params()
         shapes = self._desc_shapes(data_shapes)
         shapes.update(self._desc_shapes(label_shapes))
         req = grad_req if for_training else "null"
@@ -84,6 +90,15 @@ class Module:
                    for n in self._symbol.list_arguments()}
         self._exec = self._symbol.simple_bind(self._ctx, grad_req=req,
                                               **shapes)
+        if preserved is not None:
+            arg, aux = preserved
+            for src, dst in ((arg, self._exec.arg_dict),
+                             (aux, self._exec.aux_dict)):
+                for n, v in src.items():
+                    if n in dst and dst[n].shape == v.shape:
+                        dst[n]._data = v._data
+        if getattr(self, "_monitor", None) is not None:
+            self._monitor.install(self._exec)
         if shared_module is not None:
             src = shared_module._exec
             missing = [n for n in self._param_names()
@@ -157,6 +172,33 @@ class Module:
                          force_init=force_init)
 
     # ----------------------------------------------------------- optimizer --
+    @staticmethod
+    def _attr_mults(symbol):
+        """Per-parameter lr/wd multipliers from symbol attributes (ref:
+        Module._create_optimizer reads __lr_mult__/__wd_mult__ from
+        sym.attr_dict()).  A multiplier on a Variable applies to it; one in
+        a layer's attr metadata applies to the layer's auto-created params
+        (f'{layer}_...'), never to its data inputs."""
+        lr, wd = {}, {}
+        for n in symbol._topo_nodes():
+            meta = dict(n.attrs.get("__meta__") or {})
+            if n.op is None:
+                for k in ("lr_mult", "wd_mult"):
+                    if k in n.attrs:
+                        meta.setdefault(k, n.attrs[k])
+                targets = [n.name]
+            else:
+                targets = [s._node.name for s in n.inputs
+                           if s._node.op is None
+                           and s._node.name.startswith(n.name + "_")]
+            if "lr_mult" in meta:
+                for t in targets:
+                    lr[t] = float(meta["lr_mult"])
+            if "wd_mult" in meta:
+                for t in targets:
+                    wd[t] = float(meta["wd_mult"])
+        return lr, wd
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
@@ -166,13 +208,21 @@ class Module:
         self._check_bound()
         if self.optimizer_initialized and not force_init:
             return
-        if isinstance(optimizer, str):
+        from_str = isinstance(optimizer, str)
+        if from_str:
             self._optimizer = _opt.create(optimizer,
                                           **dict(optimizer_params or ()))
         else:
             self._optimizer = optimizer
         names = self._param_names()
         self._optimizer.idx2name = dict(enumerate(names))
+        if from_str:
+            # symbol-attr multipliers apply only to optimizers WE create;
+            # a user-supplied instance keeps its own set_lr_mult choices
+            # (ref: Module._create_optimizer)
+            lrm, wdm = self._attr_mults(self._symbol)
+            self._optimizer.lr_mult.update(lrm)
+            self._optimizer.wd_mult.update(wdm)
         # stable name→index map so a shared optimizer (BucketingModule)
         # sees consistent indices from every bucket's update()
         self._opt_index = {n: i for i, n in enumerate(names)}
@@ -216,6 +266,14 @@ class Module:
     def get_outputs(self):
         self._check_bound()
         return list(self._exec.outputs)
+
+    def install_monitor(self, mon):
+        """ref: Module.install_monitor — attach a mx.monitor.Monitor.
+        Remembered across re-binds (a force_rebind would otherwise leave
+        the monitor pointed at the dead executor)."""
+        self._check_bound()
+        self._monitor = mon
+        mon.install(self._exec)
 
     def update_metric(self, eval_metric, labels):
         eval_metric.update(list(labels), self.get_outputs())
@@ -408,6 +466,9 @@ class BucketingModule:
                    shared_module=self._default_module)
         self._share_optimizer(m)
         self._curr = m
+        mon = getattr(self, "_monitor", None)
+        if mon is not None and mon._exec is not m._exec:
+            mon.install(m._exec)
         return m
 
     def _share_optimizer(self, m):
@@ -465,6 +526,16 @@ class BucketingModule:
     def get_params(self):
         self._check_bound()
         return self._default_module.get_params()
+
+    def install_monitor(self, mon):
+        """ref: BucketingModule.install_monitor — the monitor follows the
+        current bucket's executor at every switch."""
+        self._check_bound()
+        self._monitor = mon
+        for m in self._buckets.values():
+            if m.binded:
+                m._monitor = mon
+        mon.install(self._curr._exec)
 
     def set_params(self, arg_params, aux_params, **kw):
         self._default_module.set_params(arg_params, aux_params, **kw)

@@ -267,6 +267,85 @@ def test_fit_with_classic_callbacks(tmp_path):
                             "fc2_bias"}
 
 
+def test_force_rebind_preserves_params_and_monitor():
+    """Re-binding (new batch size) keeps trained weights and the installed
+    monitor follows the new executor (review r5)."""
+    X, y = _cls_problem(n=32)
+    data = sym.Variable("data")
+    out = sym.SoftmaxOutput(sym.FullyConnected(data, name="fc",
+                                               num_hidden=2), name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind([("data", (16, 10))], [("softmax_label", (16,))])
+    mod.init_params(initializer=mx.init.Xavier())
+    w0 = mod.get_params()[0]["fc_weight"].asnumpy().copy()
+    mon = mx.mon.Monitor(1, pattern="fc.*")
+    mod.install_monitor(mon)
+    mod.bind([("data", (8, 10))], [("softmax_label", (8,))],
+             force_rebind=True)
+    np.testing.assert_array_equal(mod.get_params()[0]["fc_weight"].asnumpy(),
+                                  w0)
+    b = mx.io.DataBatch([nd.array(X[:8])], [nd.array(y[:8])])
+    mon.tic()
+    mod.forward(b, is_train=False)
+    stats = {n: float(v) for _, n, v in mon.toc()}
+    assert stats["fc_weight"] > 0 and np.isfinite(stats["fc_output"])
+
+
+def test_monitor():
+    """ref: monitor.py Monitor — per-layer stats at the set interval."""
+    X, y = _cls_problem(n=32)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind([(d.name, d.shape) for d in it.provide_data],
+             [(d.name, d.shape) for d in it.provide_label])
+    mod.init_params()
+    mon = mx.mon.Monitor(interval=2, pattern="fc.*")
+    mod.install_monitor(mon)
+    collected = []
+    for batch in it:
+        mon.tic()
+        mod.forward(batch, is_train=False)
+        collected.append(mon.toc())
+    assert collected[0] and collected[1] == []   # interval=2
+    names = {n for _, n, _ in collected[0]}
+    assert {"fc1_output", "fc2_output", "fc1_weight"} <= names
+    assert "data" not in names                   # pattern filtered
+    assert all(np.isfinite(v) for _, _, v in collected[0])
+
+
+def test_lr_mult_from_symbol_attrs():
+    """Layer attr lr_mult freezes/scales its params through the optimizer
+    (ref: Module reads __lr_mult__ from symbol attrs)."""
+    data = sym.Variable("data")
+    f1 = sym.FullyConnected(data, name="fc1", num_hidden=8,
+                            attr={"lr_mult": "0.0"})
+    a1 = sym.Activation(f1, name="r", act_type="relu")
+    out = sym.SoftmaxOutput(sym.FullyConnected(a1, name="fc2", num_hidden=2),
+                            name="softmax", normalization="batch")
+    X, y = _cls_problem(n=64)
+    it = mx.io.NDArrayIter(X, y, batch_size=16)
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind([(d.name, d.shape) for d in it.provide_data],
+             [(d.name, d.shape) for d in it.provide_label])
+    mod.init_params()
+    before = mod.get_params()[0]
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.5),))
+    for batch in it:
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+    after = mod.get_params()[0]
+    # fc1 frozen by lr_mult=0, fc2 trained
+    np.testing.assert_array_equal(after["fc1_weight"].asnumpy(),
+                                  before["fc1_weight"].asnumpy())
+    assert not np.array_equal(after["fc2_weight"].asnumpy(),
+                              before["fc2_weight"].asnumpy())
+    # the attr targets the layer's own params, never the data input
+    lrm, _ = mx.mod.Module._attr_mults(out)
+    assert lrm == {"fc1_weight": 0.0, "fc1_bias": 0.0}
+
+
 def test_bind_without_labels_for_inference():
     data = sym.Variable("data")
     net = sym.FullyConnected(data, name="fc", num_hidden=4)
