@@ -175,3 +175,49 @@ def _zeros_like(x):
 @register_op("ones_like")
 def _ones_like(x):
     return jnp.ones_like(x)
+
+
+# ---- creation ops (ref: src/operator/tensor/init_op.cc — _zeros/_ones/
+# _arange/_full are registry ops so the SYMBOL frontend can create
+# constants; mx.nd keeps its richer module-level creation functions) ----
+
+def _shape_tuple(shape):
+    return (shape,) if isinstance(shape, int) else tuple(shape)
+
+
+@register_op("_zeros", aliases=("zeros",))
+def _zeros_op(shape=(1,), dtype="float32", ctx=None):
+    from ..base import dtype_np
+
+    return jnp.zeros(_shape_tuple(shape), dtype_np(dtype))
+
+
+@register_op("_ones", aliases=("ones",))
+def _ones_op(shape=(1,), dtype="float32", ctx=None):
+    from ..base import dtype_np
+
+    return jnp.ones(_shape_tuple(shape), dtype_np(dtype))
+
+
+@register_op("_full", aliases=("full",))
+def _full_op(shape=(1,), value=0.0, dtype="float32", ctx=None, val=None):
+    """`value` is the reference op's name; `val` (mx.nd.full's spelling)
+    is accepted as an alias so sym/nd calls stay interchangeable."""
+    from ..base import dtype_np
+
+    if val is not None:
+        value = val
+    return jnp.full(_shape_tuple(shape), value, dtype_np(dtype))
+
+
+@register_op("_arange", aliases=("arange",))
+def _arange_op(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+               dtype="float32", ctx=None):
+    from ..base import dtype_np
+
+    if stop is None:
+        start, stop = 0.0, start
+    out = jnp.arange(start, stop, step, dtype_np(dtype))
+    if repeat != 1:
+        out = jnp.repeat(out, repeat)
+    return out

@@ -465,7 +465,20 @@ def _make_builder(op_name):
                                 f"{sorted(sym_kwargs)}")
             node = _Node(op_name, nm, attrs, inputs)
             return Symbol(node, whole=True)
-        # generic op: positional symbols + keyword symbols in signature order
+        # generic op: non-Symbol positionals map onto the op signature as
+        # attrs (sym.zeros((2,3)), sym.arange(2, 8) — the 1.x calling
+        # convention for creation/scalar-leading ops); Symbol positionals
+        # stay graph inputs, in order
+        if any(not isinstance(a, Symbol) for a in sym_args):
+            order = _signature_order(op_name)
+            mapped = []
+            for pname, a in zip(order, sym_args):
+                if isinstance(a, Symbol):
+                    mapped.append(a)
+                else:
+                    attrs.setdefault(pname, a)
+            sym_args = mapped
+        # keyword symbols append in signature order
         if sym_kwargs:
             order = _signature_order(op_name)
             for pname in order:

@@ -937,6 +937,10 @@ COVERED_ELSEWHERE = {
     "FusedNormReluConv": "tests/test_fused_conv.py",
     # the symbolic frontend's ops (tests/test_symbol.py, test_module.py)
     "_scalar": "tests/test_symbol.py",
+    "_zeros": "tests/test_symbol.py",
+    "_ones": "tests/test_symbol.py",
+    "_full": "tests/test_symbol.py",
+    "_arange": "tests/test_symbol.py",
     "LinearRegressionOutput": "tests/test_symbol.py",
     "MAERegressionOutput": "tests/test_symbol.py",
     "LogisticRegressionOutput": "tests/test_symbol.py",

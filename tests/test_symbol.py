@@ -434,6 +434,36 @@ def test_print_summary_symbol_forms():
     assert viz.print_summary(out) == 0                            # no shapes
 
 
+def test_creation_ops():
+    """sym.zeros/ones/full/arange (ref: init_op.cc registry creation ops)."""
+    z = sym.zeros(shape=(2, 3))
+    o = sym.ones(shape=(2, 3))
+    fl = sym.full(shape=(2,), value=7.5)
+    ar = sym.arange(start=2, stop=8, step=2)
+    vals = sym.Group([z, o, fl, ar]).eval()
+    np.testing.assert_allclose(vals[0].asnumpy(), np.zeros((2, 3)))
+    np.testing.assert_allclose(vals[1].asnumpy(), np.ones((2, 3)))
+    np.testing.assert_allclose(vals[2].asnumpy(), [7.5, 7.5])
+    np.testing.assert_allclose(vals[3].asnumpy(), [2.0, 4.0, 6.0])
+    # composes with variables (constant folded into the jitted program)
+    x = sym.Variable("x")
+    e = (x + sym.ones(shape=(3,))).eval(x=nd.array(np.float32([1, 2, 3])))
+    np.testing.assert_allclose(e[0].asnumpy(), [2, 3, 4])
+    # arange single-arg form and repeat
+    r = sym.arange(start=3, repeat=2).eval()[0]
+    np.testing.assert_allclose(r.asnumpy(), [0, 0, 1, 1, 2, 2])
+    # POSITIONAL 1.x spellings: scalars/tuples map onto the op signature
+    np.testing.assert_allclose(sym.zeros((2, 3)).eval()[0].asnumpy(),
+                               np.zeros((2, 3)))
+    np.testing.assert_allclose(sym.arange(2, 8, 2).eval()[0].asnumpy(),
+                               [2.0, 4.0, 6.0])
+    np.testing.assert_allclose(sym.full((2,), 7.5).eval()[0].asnumpy(),
+                               [7.5, 7.5])
+    # nd.full's `val` keyword also works through the op
+    np.testing.assert_allclose(
+        nd.invoke("_full", shape=(2,), val=3.0).asnumpy(), [3.0, 3.0])
+
+
 def test_get_internals():
     o = _mlp()
     internals = o.get_internals()
