@@ -429,6 +429,17 @@ def _signature_order(op_name):
         return []
 
 
+def _signature_has_varargs(op_name):
+    import inspect
+
+    try:
+        return any(p.kind is inspect.Parameter.VAR_POSITIONAL
+                   for p in
+                   inspect.signature(get_op(op_name)).parameters.values())
+    except (TypeError, ValueError):
+        return False
+
+
 def _make_builder(op_name):
     def builder(*args, **kwargs):
         name = kwargs.pop("name", None)
@@ -470,13 +481,25 @@ def _make_builder(op_name):
         # convention for creation/scalar-leading ops); Symbol positionals
         # stay graph inputs, in order
         if any(not isinstance(a, Symbol) for a in sym_args):
+            if _signature_has_varargs(op_name):
+                raise TypeError(
+                    f"{op_name}: takes a variable number of symbol inputs; "
+                    f"pass scalar parameters as keywords")
             order = _signature_order(op_name)
+            if len(sym_args) > len(order):
+                raise TypeError(
+                    f"{op_name}: takes at most {len(order)} positional "
+                    f"arguments ({len(sym_args)} given)")
             mapped = []
             for pname, a in zip(order, sym_args):
                 if isinstance(a, Symbol):
                     mapped.append(a)
+                elif pname in attrs:
+                    raise TypeError(
+                        f"{op_name}: got multiple values for argument "
+                        f"{pname!r}")
                 else:
-                    attrs.setdefault(pname, a)
+                    attrs[pname] = a
             sym_args = mapped
         # keyword symbols append in signature order
         if sym_kwargs:

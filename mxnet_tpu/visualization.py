@@ -91,8 +91,93 @@ def _print_symbol_summary(sym, shape=None):
     return total
 
 
-def plot_network(*args, **kwargs):
-    raise NotImplementedError(
-        "plot_network renders via graphviz, which this image does not "
-        "ship; use print_summary (layer table) or mx.onnx.export_model "
-        "and an external viewer (ref: visualization.py plot_network)")
+class _Digraph:
+    """Minimal graphviz.Digraph stand-in: holds DOT source; ``render``
+    writes the .dot file (rendering to png/pdf needs the graphviz binary,
+    which this image does not ship — view the .dot anywhere)."""
+
+    def __init__(self, source: str, name: str = "plot"):
+        self.source = source
+        self.name = name
+
+    def render(self, filename=None, format=None, **kwargs):  # noqa: A002
+        path = f"{filename or self.name}.dot"
+        with open(path, "w") as f:
+            f.write(self.source)
+        return path
+
+    def _repr_mimebundle_(self, *a, **k):  # notebook-friendly
+        return {"text/plain": self.source}
+
+
+_NODE_STYLE = {
+    None: ("ellipse", "#8dd3c7"),          # variables
+    "Convolution": ("box", "#fb8072"),
+    "FullyConnected": ("box", "#fb8072"),
+    "BatchNorm": ("box", "#bebada"),
+    "Activation": ("box", "#ffffb3"),
+    "Pooling": ("box", "#80b1d3"),
+    "SoftmaxOutput": ("box", "#fccde5"),
+}
+
+
+def _dot_escape(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def plot_network(symbol, title="plot", shape=None, hide_weights=True,
+                 **kwargs):
+    """DOT graph of a Symbol (ref: visualization.plot_network).
+
+    Returns a Digraph-like object whose ``.source`` is DOT text and whose
+    ``.render(filename)`` writes ``filename.dot``; the graphviz BINARY is
+    not shipped in this image, so rasterising is left to the viewer.
+    ``shape`` (same forms as print_summary) annotates each node with its
+    output shape, like the reference's shape-labelled edges."""
+    from .symbol import Symbol, Group, infer_arg_shapes, data_variables
+    from .executor import abstract_eval
+
+    if not isinstance(symbol, Symbol):
+        raise TypeError("plot_network expects an mx.sym Symbol; for Gluon "
+                        "blocks use print_summary")
+    node_shape = {}
+    if shape is not None:
+        if isinstance(shape, dict):
+            known = {k: tuple(v) for k, v in shape.items()}
+        else:
+            shapes = shape if isinstance(shape, (list, tuple)) and shape \
+                and isinstance(shape[0], (list, tuple)) else [shape]
+            known = dict(zip(data_variables(symbol),
+                             (tuple(s) for s in shapes)))
+        arg_shapes = infer_arg_shapes(symbol, known)   # raises on mismatch
+        internals = symbol.get_internals()._outputs_list()
+        outs, _ = abstract_eval(Group(internals), arg_shapes)
+        node_shape = {id(s._node): tuple(o.shape)
+                      for s, o in zip(internals, outs)}
+        node_shape.update({id(n): arg_shapes.get(n.name)
+                           for n in symbol._topo_nodes() if n.op is None})
+    lines = [f'digraph "{_dot_escape(title)}" {{', "  rankdir=BT;"]
+    nodes = symbol._topo_nodes()
+    idx = {id(n): i for i, n in enumerate(nodes)}
+    hidden = set()
+    for n in nodes:
+        if n.op is None and hide_weights and n.inputs == [] and \
+                any(n.name.endswith(s) for s in
+                    ("_weight", "_bias", "_gamma", "_beta", "_moving_mean",
+                     "_moving_var", "parameters")):
+            hidden.add(id(n))
+            continue
+        shape_, color = _NODE_STYLE.get(n.op, ("box", "#d9d9d9"))
+        label = n.name if n.op is None else f"{n.name}\n{n.op}"
+        if node_shape.get(id(n)):
+            label += f"\n{node_shape[id(n)]}"
+        lines.append(f'  n{idx[id(n)]} '
+                     f'[label="{_dot_escape(label)}" shape={shape_} '
+                     f'style=filled fillcolor="{color}"];')
+    for n in nodes:
+        for s in n.inputs:
+            if id(s._node) in hidden:
+                continue
+            lines.append(f"  n{idx[id(s._node)]} -> n{idx[id(n)]};")
+    lines.append("}")
+    return _Digraph("\n".join(lines), name=title)

@@ -305,7 +305,8 @@ def test_visualization_print_summary(capsys):
     mx.viz.print_summary(net)  # shape-less form: param table only
     out2 = capsys.readouterr().out
     assert "Total params" in out2
-    with pytest.raises(NotImplementedError, match="graphviz"):
+    # plot_network works on SYMBOLS (emits DOT); a Block points at summary
+    with pytest.raises(TypeError, match="Symbol"):
         mx.viz.plot_network(net)
 
 

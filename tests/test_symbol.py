@@ -422,6 +422,35 @@ def test_custom_label_variable_name():
     assert blk(nd.array(np.float32(rng.randn(4, 5)))).shape == (4, 3)
 
 
+def test_plot_network_dot():
+    """plot_network emits DOT text + writes .dot (graphviz binary not
+    required; ref: visualization.plot_network)."""
+    from mxnet_tpu import visualization as viz
+
+    out = _mlp()
+    g = viz.plot_network(out, title="mlp")
+    assert 'digraph "mlp"' in g.source
+    assert "fc1\\nFullyConnected" in g.source
+    assert "fc1_weight" not in g.source          # hide_weights default
+    assert "fc1_weight" in viz.plot_network(out, hide_weights=False).source
+    import tempfile, os
+    path = g.render(os.path.join(tempfile.mkdtemp(), "m"))
+    assert path.endswith(".dot") and 'digraph "mlp"' in open(path).read()
+    # shape annotation + quote escaping stay valid DOT
+    gs = viz.plot_network(out, shape=(2, 5))
+    assert "(2, 3)" in gs.source                  # fc2 output annotated
+    q = sym.Variable('we"ird')
+    src = viz.plot_network(sym.make_loss(q * 2)).source
+    assert 'we\\"ird' in src and '"we"' not in src
+    # positional/keyword conflicts + varargs scalars raise like python
+    with pytest.raises(TypeError, match="multiple values"):
+        sym.full((2,), 7.5, value=3.0)
+    with pytest.raises(TypeError, match="keywords"):
+        sym.Concat(sym.Variable("a"), sym.Variable("b"), 1)
+    with pytest.raises(TypeError, match="at most"):
+        sym.arange(1, 2, 3, 4, 5, 6, 7, 8)
+
+
 def test_print_summary_symbol_forms():
     from mxnet_tpu import visualization as viz
 
