@@ -73,6 +73,8 @@ from . import rnn                    # mx.rnn — legacy symbolic RNN cells
 from . import name                   # mx.name — NameManager/Prefix scopes
 from . import monitor                # mx.monitor — layer-stat debugging
 from . import monitor as mon
+from . import attribute              # mx.attribute — AttrScope
+from .attribute import AttrScope
 
 config._apply_startup()
 

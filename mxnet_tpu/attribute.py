@@ -1,0 +1,51 @@
+"""`mx.attribute` / `mx.AttrScope` — attribute scopes for symbol composition.
+
+ref: python/mxnet/attribute.py — class AttrScope: `with
+mx.AttrScope(lr_mult='0.1', ctx_group='dev1'):` attaches attribute
+metadata to every symbol created inside the scope.  The metadata lands in
+each node's `__meta__` (never forwarded to op kwargs) where
+`Symbol.attr`, `Module._attr_mults` (lr/wd multipliers), and the
+group2ctx shim read it.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["AttrScope", "current_attrs"]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def current_attrs() -> dict:
+    """Merged attributes of every active scope (inner wins)."""
+    out: dict = {}
+    for scope in _stack():
+        out.update(scope._attrs)
+    return out
+
+
+class AttrScope:
+    """ref: attribute.AttrScope — values must be strings, like the
+    reference (they serialize into the symbol json)."""
+
+    def __init__(self, **attrs):
+        for k, v in attrs.items():
+            if not isinstance(v, str):
+                raise ValueError(
+                    f"AttrScope only accepts string values; got "
+                    f"{k}={v!r} (stringify it — the reference stores "
+                    f"attributes as strings in the graph json)")
+        self._attrs = dict(attrs)
+
+    def __enter__(self):
+        _stack().append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _stack().pop()

@@ -22,6 +22,7 @@ list_outputs / list_auxiliary_states`, `infer_shape`, `eval`, `bind`,
 from __future__ import annotations
 
 import ast
+import functools
 import json
 import re
 import sys
@@ -392,8 +393,12 @@ if "_scalar" not in OPS:
 
 def Variable(name, attr=None, shape=None, dtype=None, init=None,
              __is_aux__=False, **kwargs):
-    """ref: mx.sym.Variable."""
-    attrs = dict(attr or {})
+    """ref: mx.sym.Variable — the active AttrScope applies to variables
+    too (explicit attr=/kwargs win over the scope)."""
+    from .attribute import current_attrs
+
+    attrs = current_attrs()
+    attrs.update(attr or {})
     if shape is not None:
         attrs["__shape__"] = tuple(shape)
     if dtype is not None:
@@ -420,24 +425,26 @@ def _invoke_sym(op_name, sym_inputs, attrs, name):
     return Symbol(node, whole=True)
 
 
-def _signature_order(op_name):
+@functools.lru_cache(maxsize=2048)
+def _signature_info(op_name):
+    """(parameter names, has *args) for an op — one cached inspection."""
     import inspect
 
     try:
-        return [p for p in inspect.signature(get_op(op_name)).parameters]
+        params = inspect.signature(get_op(op_name)).parameters
     except (TypeError, ValueError):
-        return []
+        return (), False
+    return (tuple(params),
+            any(p.kind is inspect.Parameter.VAR_POSITIONAL
+                for p in params.values()))
+
+
+def _signature_order(op_name):
+    return list(_signature_info(op_name)[0])
 
 
 def _signature_has_varargs(op_name):
-    import inspect
-
-    try:
-        return any(p.kind is inspect.Parameter.VAR_POSITIONAL
-                   for p in
-                   inspect.signature(get_op(op_name)).parameters.values())
-    except (TypeError, ValueError):
-        return False
+    return _signature_info(op_name)[1]
 
 
 def _make_builder(op_name):
@@ -450,10 +457,17 @@ def _make_builder(op_name):
                       if isinstance(v, Symbol)}
         attrs = {k: v for k, v in kwargs.items()
                  if not isinstance(v, Symbol)}
+        # 1.x attribute METADATA (lr_mult, ctx_group, ...) — the active
+        # AttrScope stack first, then the per-call attr dict (inner wins);
+        # kept on the node for Symbol.attr()/list_attr(), never forwarded
+        # to the op
+        from .attribute import current_attrs
+
+        meta = current_attrs()
         if attr:
-            # 1.x attribute METADATA (lr_mult, ctx_group, ...) — kept on the
-            # node for Symbol.attr()/list_attr(), never forwarded to the op
-            attrs["__meta__"] = dict(attr)
+            meta.update(attr)
+        if meta:
+            attrs["__meta__"] = meta
         spec = LAYERS.get(op_name)
         if spec is not None:
             wanted = spec.inputs(attrs)

@@ -134,6 +134,11 @@ def plot_network(symbol, title="plot", shape=None, hide_weights=True,
     not shipped in this image, so rasterising is left to the viewer.
     ``shape`` (same forms as print_summary) annotates each node with its
     output shape, like the reference's shape-labelled edges."""
+    known_noop = {"node_attrs", "save_format", "dtype"}  # reference args
+    unknown = set(kwargs) - known_noop
+    if unknown:
+        raise TypeError(f"plot_network: unknown arguments {sorted(unknown)} "
+                        f"(did you mean hide_weights/shape/title?)")
     from .symbol import Symbol, Group, infer_arg_shapes, data_variables
     from .executor import abstract_eval
 

@@ -237,6 +237,38 @@ def test_multi_output_head_binds_all_outputs():
     np.testing.assert_allclose(ex1.forward()[0].asnumpy(), [[3, 4]])
 
 
+def test_attr_scope():
+    """mx.AttrScope attaches metadata to symbols composed in scope
+    (ref: python/mxnet/attribute.py)."""
+    d = sym.Variable("data")
+    with mx.AttrScope(lr_mult="0.1", ctx_group="dev1"):
+        fc = sym.FullyConnected(d, name="fc", num_hidden=4)
+        with mx.AttrScope(lr_mult="0.5"):       # inner scope wins
+            fc2 = sym.FullyConnected(fc, name="fc2", num_hidden=4)
+    assert fc.attr("lr_mult") == "0.1" and fc.attr("ctx_group") == "dev1"
+    assert fc2.attr("lr_mult") == "0.5" and fc2.attr("ctx_group") == "dev1"
+    # outside: no metadata
+    fc3 = sym.FullyConnected(d, name="fc3", num_hidden=4)
+    assert fc3.attr("lr_mult") is None
+    # per-call attr= overrides the scope
+    with mx.AttrScope(lr_mult="0.1"):
+        fc4 = sym.FullyConnected(d, name="fc4", num_hidden=4,
+                                 attr={"lr_mult": "2.0"})
+    assert fc4.attr("lr_mult") == "2.0"
+    # feeds the optimizer multipliers like explicit attr= does
+    lrm, _ = mx.mod.Module._attr_mults(fc2)
+    assert lrm["fc_weight"] == 0.1 and lrm["fc2_weight"] == 0.5
+    # non-string values rejected loudly, like the reference
+    with pytest.raises(ValueError, match="string"):
+        mx.AttrScope(lr_mult=0.1)
+    # AttrScope applies to Variables too (review r5)
+    with mx.AttrScope(lr_mult="0.25"):
+        w = sym.Variable("embed_weight")
+    assert w.attr("lr_mult") == "0.25"
+    lrm, _ = mx.mod.Module._attr_mults(sym.make_loss(w * 2))
+    assert lrm["embed_weight"] == 0.25
+
+
 def test_attr_metadata_not_forwarded_to_op():
     """1.x attribute metadata (lr_mult etc.) must not reach the op kwargs
     (review r5: it used to crash bind)."""
