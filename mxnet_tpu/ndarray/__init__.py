@@ -166,14 +166,13 @@ ElementWiseSum = add_n
 
 
 # ------------------------------------------------------------ namespaces ----
+from ..ops.registry import CONTRIB_SHORT_NAMES
+
 contrib = types.ModuleType("mxnet_tpu.ndarray.contrib")
 for _name in list(OPS):
     if _name.startswith("_contrib_"):
         setattr(contrib, _name[len("_contrib_"):], _make_wrapper(_name))
-for _short in ("interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
-               "box_nms", "box_iou", "MultiBoxPrior", "MultiBoxTarget",
-               "MultiBoxDetection", "div_sqrt_dim", "multi_head_attention",
-               "quantize_v2", "dequantize"):
+for _short in CONTRIB_SHORT_NAMES:
     if _short in OPS:
         setattr(contrib, _short, _make_wrapper(_short))
 sys.modules["mxnet_tpu.ndarray.contrib"] = contrib

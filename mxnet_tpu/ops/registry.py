@@ -21,6 +21,20 @@ OPS: Dict[str, Callable] = {}
 # numbers — a fresh key must be a traced argument, never constant-folded).
 OP_META: Dict[str, dict] = {}
 
+# Bumped on every (re-)registration so signature caches (symbol builders)
+# never serve a stale inspection after an op is replaced.
+REGISTRATION_EPOCH = 0
+
+# The contrib ops that ALSO get short names in the nd/sym `contrib`
+# namespaces (one list, two frontends — see ndarray/__init__.py and
+# symbol.py namespace generation).
+CONTRIB_SHORT_NAMES = (
+    "interleaved_matmul_selfatt_qk", "interleaved_matmul_selfatt_valatt",
+    "box_nms", "box_iou", "MultiBoxPrior", "MultiBoxTarget",
+    "MultiBoxDetection", "div_sqrt_dim", "multi_head_attention",
+    "quantize_v2", "dequantize",
+)
+
 
 def register_op(name, fn: Callable = None, aliases=(), needs_rng: bool = False,
                 mesh_aware: bool = False):
@@ -31,6 +45,8 @@ def register_op(name, fn: Callable = None, aliases=(), needs_rng: bool = False,
     would pin inputs to one device and fight the mesh)."""
 
     def _do(f):
+        global REGISTRATION_EPOCH
+        REGISTRATION_EPOCH += 1
         try:
             has_training = "training" in inspect.signature(f).parameters
         except (TypeError, ValueError):
@@ -54,6 +70,8 @@ def register_op(name, fn: Callable = None, aliases=(), needs_rng: bool = False,
 
 
 def alias_op(new_name: str, existing: str):
+    global REGISTRATION_EPOCH
+    REGISTRATION_EPOCH += 1
     OPS[new_name] = OPS[existing]
     OP_META[new_name] = OP_META[existing]
 

@@ -425,9 +425,10 @@ def _invoke_sym(op_name, sym_inputs, attrs, name):
     return Symbol(node, whole=True)
 
 
-@functools.lru_cache(maxsize=2048)
-def _signature_info(op_name):
-    """(parameter names, has *args) for an op — one cached inspection."""
+@functools.lru_cache(maxsize=4096)
+def _signature_info_cached(op_name, epoch):
+    """(parameter names, has *args) — keyed on the registry's registration
+    epoch so re-registering an op never serves a stale signature."""
     import inspect
 
     try:
@@ -437,6 +438,12 @@ def _signature_info(op_name):
     return (tuple(params),
             any(p.kind is inspect.Parameter.VAR_POSITIONAL
                 for p in params.values()))
+
+
+def _signature_info(op_name):
+    from .ops import registry as _reg
+
+    return _signature_info_cached(op_name, _reg.REGISTRATION_EPOCH)
 
 
 def _signature_order(op_name):
@@ -482,9 +489,12 @@ def _make_builder(op_name):
                 if nxt is not None:
                     inputs.append(nxt)
                     continue
-                # auto-create with MXNet's naming convention
+                # auto-create with MXNet's naming convention; the MERGED
+                # metadata (scope overridden by the call's attr=) goes on
+                # the param variable so its attr() agrees with the layer's
                 is_aux = slot in spec.aux
-                inputs.append(Variable(f"{nm}_{slot}", __is_aux__=is_aux))
+                inputs.append(Variable(f"{nm}_{slot}", __is_aux__=is_aux,
+                                       attr=attrs.get("__meta__")))
             if sym_kwargs:
                 raise TypeError(f"{op_name}: unexpected symbol kwargs "
                                 f"{sorted(sym_kwargs)}")
@@ -667,3 +677,38 @@ _this = sys.modules[__name__]
 for _n in list(OPS):
     if not hasattr(_this, _n):
         setattr(_this, _n, _make_builder(_n))
+
+# sub-namespaces mirroring mx.nd's layout (ref: mx.sym.contrib / mx.sym.linalg
+# / mx.sym.random in python/mxnet/symbol/) — same builders, shorter names
+import types as _types  # noqa: E402
+
+def _builder_for(op_name):
+    """Reuse the builder already set on this module when the op name is a
+    public attribute; otherwise build one (internal _contrib_/_random_
+    names are not module attributes)."""
+    existing = getattr(_this, op_name, None)
+    return existing if callable(existing) else _make_builder(op_name)
+
+
+from .ops.registry import CONTRIB_SHORT_NAMES  # noqa: E402
+
+contrib = _types.ModuleType("mxnet_tpu.symbol.contrib")
+for _n in list(OPS):
+    if _n.startswith("_contrib_"):
+        setattr(contrib, _n[len("_contrib_"):], _builder_for(_n))
+for _short in CONTRIB_SHORT_NAMES:
+    if _short in OPS:
+        setattr(contrib, _short, _builder_for(_short))
+sys.modules["mxnet_tpu.symbol.contrib"] = contrib
+
+linalg = _types.ModuleType("mxnet_tpu.symbol.linalg")
+for _n in list(OPS):
+    if _n.startswith("linalg_"):
+        setattr(linalg, _n[len("linalg_"):], _builder_for(_n))
+sys.modules["mxnet_tpu.symbol.linalg"] = linalg
+
+random = _types.ModuleType("mxnet_tpu.symbol.random")
+for _n in list(OPS):
+    if _n.startswith("_random_"):
+        setattr(random, _n[len("_random_"):], _builder_for(_n))
+sys.modules["mxnet_tpu.symbol.random"] = random

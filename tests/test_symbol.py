@@ -267,6 +267,16 @@ def test_attr_scope():
     assert w.attr("lr_mult") == "0.25"
     lrm, _ = mx.mod.Module._attr_mults(sym.make_loss(w * 2))
     assert lrm["embed_weight"] == 0.25
+    # auto-created params carry the MERGED meta (call attr= beats scope),
+    # so variable-level and layer-level attrs agree (review r5)
+    with mx.AttrScope(lr_mult="0.1"):
+        fc5 = sym.FullyConnected(d, name="fc5", num_hidden=4,
+                                 attr={"lr_mult": "2.0"})
+    wvar = [s for s in fc5._node.inputs
+            if s._node.name == "fc5_weight"][0]
+    assert wvar.attr("lr_mult") == "2.0"
+    lrm, _ = mx.mod.Module._attr_mults(fc5)
+    assert lrm["fc5_weight"] == 2.0
 
 
 def test_attr_metadata_not_forwarded_to_op():
@@ -493,6 +503,20 @@ def test_print_summary_symbol_forms():
     assert viz.print_summary(out, shape=[(2, 5)]) == expect       # list form
     assert viz.print_summary(out, shape={"data": (2, 5)}) == expect
     assert viz.print_summary(out) == 0                            # no shapes
+
+
+def test_symbol_sub_namespaces():
+    """sym.contrib / sym.linalg / sym.random mirror mx.nd's layout."""
+    d = sym.Variable("x")
+    iou = sym.contrib.box_iou(d, sym.Variable("y"))
+    assert iou._node.op in ("_contrib_box_iou", "box_iou")
+    g = sym.linalg.gemm2(sym.Variable("a"), sym.Variable("b"))
+    a = nd.array(np.float32([[1, 2], [3, 4]]))
+    b = nd.array(np.float32([[1, 0], [0, 1]]))
+    np.testing.assert_allclose(g.eval(a=a, b=b)[0].asnumpy(), a.asnumpy())
+    mx.random.seed(0)
+    u = sym.random.uniform(low=0.0, high=1.0, shape=(64,)).eval()[0]
+    assert u.shape == (64,) and 0 <= float(u.asnumpy().min())
 
 
 def test_creation_ops():
