@@ -75,6 +75,7 @@ from . import monitor                # mx.monitor — layer-stat debugging
 from . import monitor as mon
 from . import attribute              # mx.attribute — AttrScope
 from .attribute import AttrScope
+from . import log                    # mx.log — logging helpers
 
 config._apply_startup()
 

@@ -683,9 +683,10 @@ for _n in list(OPS):
 import types as _types  # noqa: E402
 
 def _builder_for(op_name):
-    """Reuse the builder already set on this module when the op name is a
-    public attribute; otherwise build one (internal _contrib_/_random_
-    names are not module attributes)."""
+    """The generation loop above set a builder for EVERY registry name
+    (including internal _contrib_/_random_ ones), so namespace population
+    reuses those; the fallback only guards against a future module-level
+    attribute shadowing an op name with a non-callable."""
     existing = getattr(_this, op_name, None)
     return existing if callable(existing) else _make_builder(op_name)
 

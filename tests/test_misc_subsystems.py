@@ -395,3 +395,16 @@ def test_int8_quantized_zoo_model_accuracy_gate():
     assert "QuantizedConv2D" in names and "QuantizedDense" in names
     int8_acc = accuracy(net)
     assert int8_acc >= float_acc - 0.05, (float_acc, int8_acc)
+
+
+def test_log_module(tmp_path):
+    """ref: python/mxnet/log.py — get_logger is idempotent and writes
+    through the chosen handler."""
+    f = str(tmp_path / "t.log")
+    lg = mx.log.get_logger("mxtpu_test_logger", filename=f,
+                           level=mx.log.INFO)
+    lg2 = mx.log.get_logger("mxtpu_test_logger")
+    assert lg is lg2 and len(lg.handlers) == 1   # no duplicate handlers
+    lg.info("hello-from-test")
+    lg.handlers[0].flush()
+    assert "hello-from-test" in open(f).read()
