@@ -176,3 +176,18 @@ def test_export_bfloat16_roundtrip(tmp_path):
     fn = mx.onnx.import_to_function(path)
     got = np.asarray(fn(xb)[0]).astype(np.float32)
     np.testing.assert_allclose(got, ref, atol=3e-2, rtol=3e-2)
+
+
+def test_symbol_to_onnx_roundtrip(tmp_path):
+    """The symbolic stack plugs into interchange: Symbol -> SymbolBlock ->
+    ONNX export -> import, numerically identical (round-5 bridge)."""
+    sym = mx.sym
+    sym.reset_auto_names()
+    d = sym.Variable("data")
+    s = sym.FullyConnected(sym.Activation(
+        sym.FullyConnected(d, name="fc1", num_hidden=8), act_type="relu"),
+        name="fc2", num_hidden=3)
+    blk = gluon.SymbolBlock(s, [d])
+    blk.initialize()
+    x_np = np.random.RandomState(0).randn(2, 5).astype(np.float32)
+    _roundtrip(blk, x_np, tmp_path)
