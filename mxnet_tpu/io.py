@@ -18,7 +18,7 @@ from . import recordio
 from .ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "ImageRecordIter", "ResizeIter"]
+           "MNISTIter", "ImageRecordIter", "ResizeIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -111,11 +111,12 @@ class NDArrayIter(DataIter):
 
     def __init__(self, data, label=None, batch_size=1, shuffle=False,
                  last_batch_handle="pad", data_name="data",
-                 label_name="softmax_label"):
+                 label_name="softmax_label", seed=None):
         super().__init__(batch_size)
         self._data = self._init_arrays(data, data_name)
         self._label = self._init_arrays(label, label_name)
         self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed) if seed is not None else None
         self._last = last_batch_handle
         self._n = self._data[0][1].shape[0] if self._data else 0
         for _, a in self._data + self._label:
@@ -143,7 +144,7 @@ class NDArrayIter(DataIter):
     def reset(self):
         self._order = self._base_order.copy()
         if self._shuffle:
-            np.random.shuffle(self._order)
+            (self._rng or np.random).shuffle(self._order)
         if self._last == "roll_over" and self._leftover is not None:
             # remainder from the previous pass leads this epoch (ref:
             # NDArrayIter roll_over semantics)
@@ -187,7 +188,28 @@ class NDArrayIter(DataIter):
                          provide_label=self.provide_label)
 
 
-class CSVIter(DataIter):
+class _WrappedIter(DataIter):
+    """Common NDArrayIter-delegation base for file-backed iterators
+    (CSVIter, MNISTIter)."""
+
+    _it: NDArrayIter
+
+    def reset(self):
+        self._it.reset()
+
+    def next(self):
+        return self._it.next()
+
+    @property
+    def provide_data(self):
+        return self._it.provide_data
+
+    @property
+    def provide_label(self):
+        return self._it.provide_label
+
+
+class CSVIter(_WrappedIter):
     """ref: io.CSVIter — numeric csv rows → batches."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
@@ -203,19 +225,56 @@ class CSVIter(DataIter):
         self._it = NDArrayIter(self._inner_data, label, batch_size,
                                last_batch_handle="discard")
 
-    def reset(self):
-        self._it.reset()
 
-    def next(self):
-        return self._it.next()
+def _read_idx(path):
+    """Parse one IDX file (ref: the MNIST ubyte format the reference's
+    MNISTIter reads), .gz or raw."""
+    import gzip
 
-    @property
-    def provide_data(self):
-        return self._it.provide_data
+    opener = gzip.open if str(path).endswith(".gz") else open
+    with opener(path, "rb") as f:
+        raw = f.read()
+    ndim = raw[3]
+    dims = [int.from_bytes(raw[4 + 4 * i:8 + 4 * i], "big")
+            for i in range(ndim)]
+    return np.frombuffer(raw, np.uint8,
+                         offset=4 + 4 * ndim).reshape(dims)
 
-    @property
-    def provide_label(self):
-        return self._it.provide_label
+
+class MNISTIter(_WrappedIter):
+    """ref: io.MNISTIter — the classic MNIST iterator.
+
+    With explicit ``image``/``label`` IDX paths (the reference's calling
+    convention) the files are parsed directly — missing paths raise, never
+    silently substitute.  Without paths, the gluon MNIST dataset backs the
+    iterator (real files when present, the in-tree synthetic stand-in in
+    zero-egress environments)."""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
+                 flat=False, seed=0, **kwargs):
+        super().__init__(batch_size)
+        import os
+
+        if image or label:
+            for p in (image, label):
+                if not p or not os.path.exists(p):
+                    raise ValueError(
+                        f"MNISTIter: IDX file {p!r} not found; pass both "
+                        f"image= and label= paths, or neither (gluon "
+                        f"MNIST dataset fallback)")
+            xs = _read_idx(image).astype(np.float32) / 255.0
+            ys = _read_idx(label).astype(np.float32)
+        else:
+            from .gluon.data.vision import MNIST
+
+            ds = MNIST(train=True)
+            xs = np.asarray(ds._data, np.float32).reshape(
+                len(ds), 28, 28) / 255.0
+            ys = np.asarray(ds._label, np.float32)
+        n = xs.shape[0]
+        xs = xs.reshape(n, -1) if flat else xs.reshape(n, 1, 28, 28)  # NCHW
+        self._it = NDArrayIter(xs, ys, batch_size, shuffle=shuffle,
+                               seed=seed)
 
 
 class AugSpec:

@@ -408,3 +408,54 @@ def test_log_module(tmp_path):
     lg.info("hello-from-test")
     lg.handlers[0].flush()
     assert "hello-from-test" in open(f).read()
+
+
+def test_mnist_iter(tmp_path):
+    """ref: io.MNISTIter — classic iterator: IDX parsing, seed-stable
+    shuffle, NCHW default + flat form."""
+    # explicit IDX paths parse directly (gz and raw), never silently fall back
+    import gzip
+    import struct
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 256, (10, 28, 28)).astype(np.uint8)
+    labs = rng.randint(0, 10, (10,)).astype(np.uint8)
+    img_p = str(tmp_path / "train-images-idx3-ubyte.gz")
+    lab_p = str(tmp_path / "train-labels-idx1-ubyte")
+    with gzip.open(img_p, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3) + struct.pack(">III", 10, 28, 28)
+                + imgs.tobytes())
+    with open(lab_p, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 1) + struct.pack(">I", 10)
+                + labs.tobytes())
+    itx = mx.io.MNISTIter(image=img_p, label=lab_p, batch_size=5,
+                          shuffle=False)
+    b0 = next(iter(itx))
+    np.testing.assert_allclose(b0.data[0].asnumpy()[0, 0],
+                               imgs[0].astype(np.float32) / 255.0)
+    np.testing.assert_allclose(b0.label[0].asnumpy(),
+                               labs[:5].astype(np.float32))
+    with pytest.raises(ValueError, match="not found"):
+        mx.io.MNISTIter(image=str(tmp_path / "nope"), label=lab_p)
+    # seed makes the shuffle order reproducible
+    def order(seed):
+        it = mx.io.MNISTIter(image=img_p, label=lab_p, batch_size=10,
+                             shuffle=True, seed=seed)
+        return next(iter(it)).label[0].asnumpy()
+    np.testing.assert_array_equal(order(3), order(3))
+
+    it = mx.io.MNISTIter(batch_size=64, shuffle=True)
+    b = next(iter(it))
+    assert b.data[0].shape == (64, 1, 28, 28)
+    assert b.label[0].shape == (64,)
+    x = b.data[0].asnumpy()
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    flat = mx.io.MNISTIter(batch_size=32, flat=True, shuffle=False)
+    assert next(iter(flat)).data[0].shape == (32, 784)
+    # a classic Module script trains from it end to end
+    net = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+        mx.sym.Variable("data"), name="fc", num_hidden=10), name="softmax",
+        normalization="batch")
+    mod = mx.mod.Module(net, context=mx.cpu())
+    mod.fit(flat, optimizer="adam",
+            optimizer_params=(("learning_rate", 0.05),), num_epoch=2)
+    assert mod.score(flat, "acc")[0][1] > 0.5
