@@ -69,6 +69,11 @@ def bench_resnet():
     batch = int(os.environ.get("MXTPU_BENCH_BATCH") or
                 (256 if on_accel else 8))
     iters = 20 if on_accel else 2
+    # MXTPU_BENCH_FEED=prefetch: feed fresh HOST batches through
+    # parallel.DevicePrefetcher (async H2D + donated inputs) instead of the
+    # default device-resident tensors — measures the full input pipeline,
+    # not just the step.
+    feed = os.environ.get("MXTPU_BENCH_FEED", "device")
 
     # channel-last: the TPU-native layout (features on lanes; see PERF.md).
     # MXTPU_BENCH_FUSED=1 swaps in the Pallas fused norm-relu-conv blocks
@@ -80,22 +85,45 @@ def bench_resnet():
     mesh = parallel.make_mesh(dp=len(jax.devices()))
     opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4)
     step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), opt,
-                              mesh=mesh)
+                              mesh=mesh, donate_batch=(feed == "prefetch"))
 
     rng = np.random.RandomState(0)
-    x = mx.nd.array(rng.randn(batch, 224, 224, 3)
-                    .astype(np.float32)).astype("bfloat16")
-    y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.int32))
+    xh = rng.randn(batch, 224, 224, 3).astype(np.float32)
+    yh = rng.randint(0, 1000, (batch,)).astype(np.int32)
 
-    # compile + warmup
-    step(x, y).asnumpy()
-    step(x, y).asnumpy()
+    if feed == "prefetch":
+        import ml_dtypes
+        # keep the batch a HOST numpy array (bf16 via ml_dtypes): every
+        # yield then pays the real H2D transfer the pipeline must overlap
+        xh16 = xh.astype(ml_dtypes.bfloat16)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(x, y)
-    loss.asnumpy()  # block
-    dt = time.perf_counter() - t0
+        def host_batches(n):
+            for _ in range(n):
+                yield (xh16, yh)
+
+        # compile + warmup through the same placed path
+        for d, l in parallel.DevicePrefetcher(host_batches(2), step=step):
+            step(d, l).asnumpy()
+        t0 = time.perf_counter()
+        with parallel.DevicePrefetcher(host_batches(iters), step=step,
+                                       depth=2) as src:
+            for d, l in src:
+                loss = step(d, l)
+        loss.asnumpy()  # block
+        dt = time.perf_counter() - t0
+    else:
+        x = mx.nd.array(xh).astype("bfloat16")
+        y = mx.nd.array(yh)
+
+        # compile + warmup
+        step(x, y).asnumpy()
+        step(x, y).asnumpy()
+
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = step(x, y)
+        loss.asnumpy()  # block
+        dt = time.perf_counter() - t0
 
     # global batch is data-parallel over every device: report PER-CHIP rate
     img_s = batch * iters / dt / len(jax.devices())

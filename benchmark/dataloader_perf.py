@@ -20,6 +20,15 @@ thousands of img/s on a single core.
 
     python benchmark/dataloader_perf.py [--n 2048] [--hw 224]
         [--threads 0,4,8] [--batch-size 256] [--paths native,pil,raw]
+
+``--overlap`` instead measures the async-feed pipeline itself: a producer
+throttled to ``--overlap-ms`` per batch feeds a fake step throttled to the
+same, serial vs through mx.io.PrefetchingIter.  A perfect pipeline takes
+~max(producer, step) per batch instead of their sum; the printed
+``overlap_efficiency`` is the fraction of that ideal saving achieved.
+
+    python benchmark/dataloader_perf.py --overlap [--overlap-ms 10]
+        [--overlap-batches 30]
 """
 from __future__ import annotations
 
@@ -89,6 +98,68 @@ def bench_record_iter(rec, idx, hw, batch_size, threads, native, epochs=1):
     return n / dt
 
 
+class ThrottledIter(mio.DataIter):
+    """Synthetic DataIter that takes ``delay_s`` of wall-clock per batch —
+    stands in for decode/augment cost in the overlap benchmark."""
+
+    def __init__(self, n_batches, delay_s, batch_size=2, feature_dim=4):
+        super().__init__(batch_size)
+        self._n = n_batches
+        self._delay = delay_s
+        self._shape = (batch_size, feature_dim)
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    @property
+    def provide_data(self):
+        return [mio.DataDesc("data", self._shape)]
+
+    @property
+    def provide_label(self):
+        return [mio.DataDesc("softmax_label", (self.batch_size,))]
+
+    def next(self):
+        if self._i >= self._n:
+            raise StopIteration
+        self._i += 1
+        time.sleep(self._delay)
+        data = np.full(self._shape, self._i, np.float32)
+        label = np.full((self.batch_size,), self._i, np.float32)
+        return mio.DataBatch([mio._to_nd(data)], [mio._to_nd(label)],
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+
+
+def overlap_bench(producer_s=0.010, step_s=0.010, n_batches=30, capacity=2):
+    """Serial vs PrefetchingIter pipeline with a throttled producer and a
+    throttled fake step.  Returns timings, speedup, overlap efficiency, and
+    the prefetcher's wait-split stats."""
+    def consume(it):
+        count = 0
+        t0 = time.perf_counter()
+        for _ in it:
+            time.sleep(step_s)  # the "training step"
+            count += 1
+        return time.perf_counter() - t0, count
+
+    serial_s, n1 = consume(ThrottledIter(n_batches, producer_s))
+    pf = mio.PrefetchingIter(ThrottledIter(n_batches, producer_s),
+                             capacity=capacity)
+    pipelined_s, n2 = consume(pf)
+    stats = dict(pf.stats)
+    pf.close()
+    assert n1 == n2 == n_batches
+    ideal_s = n_batches * max(producer_s, step_s)  # perfect overlap
+    eff = (serial_s - pipelined_s) / max(serial_s - ideal_s, 1e-9)
+    return {"serial_s": serial_s, "pipelined_s": pipelined_s,
+            "ideal_s": ideal_s, "speedup": serial_s / pipelined_s,
+            "overlap_efficiency": min(max(eff, 0.0), 1.0),
+            "producer_wait_s": stats["producer_wait_s"],
+            "consumer_wait_s": stats["consumer_wait_s"]}
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--n", type=int, default=1024)
@@ -98,7 +169,32 @@ def main():
     ap.add_argument("--paths", default="native,pil,raw")
     ap.add_argument("--noise", action="store_true")
     ap.add_argument("--json", action="store_true")
+    ap.add_argument("--overlap", action="store_true",
+                    help="measure producer/step overlap through "
+                         "PrefetchingIter instead of decode throughput")
+    ap.add_argument("--overlap-ms", type=float, default=10.0)
+    ap.add_argument("--overlap-batches", type=int, default=30)
     args = ap.parse_args()
+
+    if args.overlap:
+        t = args.overlap_ms / 1e3
+        r = overlap_bench(t, t, args.overlap_batches)
+        row = {"metric": "input_pipeline_overlap",
+               "producer_ms": args.overlap_ms, "step_ms": args.overlap_ms,
+               "batches": args.overlap_batches,
+               "serial_s": round(r["serial_s"], 4),
+               "pipelined_s": round(r["pipelined_s"], 4),
+               "speedup": round(r["speedup"], 3),
+               "overlap_efficiency": round(r["overlap_efficiency"], 3),
+               "producer_wait_s": round(r["producer_wait_s"], 4),
+               "consumer_wait_s": round(r["consumer_wait_s"], 4)}
+        print(json.dumps(row) if args.json else
+              f"overlap: serial {r['serial_s']:.3f}s -> pipelined "
+              f"{r['pipelined_s']:.3f}s  speedup {r['speedup']:.2f}x  "
+              f"efficiency {r['overlap_efficiency']:.0%}  "
+              f"(producer-wait {r['producer_wait_s']:.3f}s, "
+              f"consumer-wait {r['consumer_wait_s']:.3f}s)")
+        return
 
     paths = args.paths.split(",")
     with tempfile.TemporaryDirectory() as d:

@@ -4,8 +4,13 @@ ref: python/mxnet/gluon/data/dataloader.py — class DataLoader,
 _MultiWorkerIter (multiprocessing workers + batchify + pin_memory).
 
 TPU-native: workers produce numpy batches (host); `device_put` to HBM happens
-once per batch on read.  This class matches the reference's flexible python
-path; the packed-record high-throughput path is ``mxnet_tpu.io``.
+once per batch on read.  ``pin_memory=True`` is the async-put path: a
+``parallel.DevicePrefetcher`` issues the host→device transfer for batch N+1
+on a background thread while the consumer computes on batch N (the moral
+equivalent of the reference's pinned staging buffer — transfer overlaps
+compute instead of serializing with it).  This class matches the reference's
+flexible python path; the packed-record high-throughput path is
+``mxnet_tpu.io``.
 """
 from __future__ import annotations
 
@@ -104,6 +109,7 @@ class DataLoader:
                              else 2 * self._num_workers)
         self._thread_pool = thread_pool
         self._pool = None
+        self._closed = False
         if self._num_workers > 0:
             if thread_pool:
                 from multiprocessing.dummy import Pool
@@ -113,14 +119,31 @@ class DataLoader:
                 self._pool = ctx.Pool(self._num_workers)
 
     def __iter__(self):
+        if self._closed:
+            raise RuntimeError("DataLoader is closed")
+        if not self._pin_memory:
+            for batch in self._host_batches():
+                yield _to_device_batch(batch)
+            return
+        # pin_memory: async-put — device placement of batch N+1 runs on a
+        # background thread while the consumer computes on batch N.  The
+        # device-side queue holds WHOLE batches in HBM, so its depth is
+        # capped independently of the (host-side) worker prefetch count:
+        # beyond 2-3 only buys jitter absorption (docs/api.md)
+        from ...parallel.prefetch import DevicePrefetcher
+        with DevicePrefetcher(self._host_batches(),
+                              depth=min(max(1, self._prefetch or 1),
+                                        3)) as feed:
+            yield from feed
+
+    def _host_batches(self):
+        """Yield batchified HOST (numpy) batches, multi-worker when a pool
+        exists (ref: _MultiWorkerIter — async map with bounded prefetch)."""
         if self._pool is None:
             for samples in self._batch_sampler:
-                yield _to_device_batch(self._batchify_fn(
-                    [_as_numpy_sample(self._dataset[i]) for i in samples]))
+                yield self._batchify_fn(
+                    [_as_numpy_sample(self._dataset[i]) for i in samples])
             return
-        # multi-worker: async map with bounded prefetch (ref: _MultiWorkerIter)
-        results = {}
-        order = iter(range(10 ** 12))
         issued = {}
         batches = list(self._batch_sampler)
         next_issue = 0
@@ -137,15 +160,38 @@ class DataLoader:
         for _ in range(self._prefetch or 1):
             _issue()
         while next_yield < len(batches):
-            key, batch = issued[next_yield].get(self._timeout)
+            try:
+                key, batch = issued[next_yield].get(self._timeout)
+            except mp.TimeoutError:
+                raise TimeoutError(
+                    f"DataLoader worker batch {next_yield} not ready within "
+                    f"timeout={self._timeout}s") from None
             del issued[next_yield]
             _issue()
             next_yield += 1
-            yield _to_device_batch(batch)
+            yield batch
 
     def __len__(self):
         return len(self._batch_sampler)
 
+    def close(self):
+        """Shut the worker pool down deterministically (``__del__`` on
+        interpreter teardown is racy — ref: satellite of the async-feed
+        work).  Idempotent; the loader cannot be iterated afterwards."""
+        self._closed = True
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.terminate()
+            pool.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
     def __del__(self):
-        if self._pool is not None:
-            self._pool.terminate()
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -10,6 +10,9 @@ still gluon's DataLoader — these iterators are the Module-era API surface.
 from __future__ import annotations
 
 import os
+import queue as _queue
+import threading
+import time
 from collections import namedtuple
 
 import numpy as np
@@ -18,7 +21,7 @@ from . import recordio
 from .ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
-           "MNISTIter", "ImageRecordIter", "ResizeIter"]
+           "MNISTIter", "ImageRecordIter", "ResizeIter", "PrefetchingIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape", "dtype", "layout"])):
@@ -799,6 +802,228 @@ def _decode_augment_one(args):
     chw = _crop_aug_u8(img, shape, resize, rand_crop, rand_mirror,
                        np.random.RandomState(seed), aug=aug)
     return header, chw
+
+
+class PrefetchingIter(DataIter):
+    """ref: io.PrefetchingIter — asynchronous double-buffering over one or
+    more DataIters.
+
+    Each wrapped iterator gets a producer thread and a bounded queue of
+    ``capacity`` batches, so ``next()`` on this iterator overlaps decode /
+    host work for batch N+1..N+capacity with whatever the consumer does with
+    batch N (the training step).  ``reset()`` is clean across epoch
+    boundaries: producer threads are stopped and joined, prefetched-but-
+    unconsumed batches are dropped, the wrapped iterators reset, and fresh
+    producers start — no thread ever leaks across epochs or iterator
+    teardown (``close()`` / ``with`` joins them deterministically).
+
+    Observability: ``stats`` holds ``produced``/``consumed`` batch counts,
+    the current ``queue_depth``, and the cumulative wait-time split —
+    ``producer_wait_s`` (producers blocked on a full queue: the pipeline is
+    step-bound) vs ``consumer_wait_s`` (``next()`` blocked on an empty
+    queue: the pipeline is input-bound).  The same numbers are emitted as
+    profiler counters/spans when the profiler is running.
+
+    With multiple iterators the reference semantics apply: one batch is
+    taken from each per ``next()`` and the data/label lists concatenate;
+    ``rename_data``/``rename_label`` (list of dicts, one per iterator, or a
+    single dict) remap the DataDesc names.
+    """
+
+    _STOP = object()   # producer→consumer sentinel: wrapped iter exhausted
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 capacity=2):
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        if not iters:
+            raise ValueError("PrefetchingIter needs at least one iterator")
+        super().__init__(getattr(iters[0], "batch_size", 0))
+        self._iters = list(iters)
+        self._rename_data = self._norm_rename(rename_data, len(iters))
+        self._rename_label = self._norm_rename(rename_label, len(iters))
+        self._capacity = max(1, int(capacity))
+        self._closed = False
+        self._lock = threading.Lock()
+        self.stats = {"produced": 0, "consumed": 0, "queue_depth": 0,
+                      "producer_wait_s": 0.0, "consumer_wait_s": 0.0}
+        from . import profiler as _profiler
+        self._depth_counter = _profiler.Counter(
+            None, "PrefetchingIter::queue_depth")
+        # producers start lazily on the first next(): construction and
+        # back-to-back resets (an explicit reset() followed by the one
+        # DataIter.__iter__ issues) then cost no decoded-and-dropped
+        # batches and no thread churn
+        self._started = False
+        self._exhausted = False
+        self._queues = []
+        self._threads = []
+        self._stop_evt = threading.Event()
+
+    @staticmethod
+    def _norm_rename(rename, n):
+        if rename is None:
+            return None
+        if isinstance(rename, dict):
+            rename = [rename] * n
+        if len(rename) != n:
+            raise ValueError("rename list must have one dict per iterator")
+        return list(rename)
+
+    # ----------------------------------------------------------- threads --
+    def _start(self):
+        self._stop_evt = threading.Event()
+        self._queues = [_queue.Queue(self._capacity) for _ in self._iters]
+        self._threads = []
+        for it, q in zip(self._iters, self._queues):
+            t = threading.Thread(target=self._produce, args=(it, q),
+                                 name="PrefetchingIter-producer", daemon=True)
+            t.start()
+            self._threads.append(t)
+        self._exhausted = False
+        self._started = True
+
+    def _produce(self, it, q):
+        stop = self._stop_evt
+        while not stop.is_set():
+            try:
+                batch = it.next()
+            except StopIteration:
+                batch = self._STOP
+            except Exception as exc:  # surface in the consumer, then die
+                batch = exc
+            t0 = time.perf_counter()
+            enqueued = False
+            while not stop.is_set():
+                try:
+                    q.put(batch, timeout=0.05)
+                    enqueued = True
+                    break
+                except _queue.Full:
+                    continue  # bounded queue: block until consumer drains
+            wait = time.perf_counter() - t0
+            with self._lock:
+                self.stats["producer_wait_s"] += wait
+                if enqueued and batch is not self._STOP \
+                        and not isinstance(batch, Exception):
+                    # a batch dropped by a shutdown is NOT produced: keeps
+                    # produced == consumed + queue_depth honest
+                    self.stats["produced"] += 1
+                self._set_depth_locked()
+            if batch is self._STOP or isinstance(batch, Exception):
+                return  # epoch over: the thread exits; reset() restarts
+
+    def _set_depth_locked(self):
+        depth = sum(q.qsize() for q in self._queues)
+        self.stats["queue_depth"] = depth
+        self._depth_counter.set_value(depth)
+
+    # ---------------------------------------------------------- protocol --
+    def reset(self):
+        """Stop + join producers, DROP any prefetched-but-unconsumed
+        batches, reset the wrapped iterators.  Fresh producers start
+        lazily on the next ``next()``."""
+        if self._closed:
+            raise RuntimeError("PrefetchingIter is closed")
+        self._shutdown()
+        for it in self._iters:
+            it.reset()
+        self._exhausted = False
+
+    def _shutdown(self):
+        if not self._started:
+            return
+        self._started = False
+        self._stop_evt.set()
+        for q in self._queues:  # unblock a producer parked on a full queue
+            while True:
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+        for t in self._threads:
+            t.join()
+        with self._lock:
+            self._set_depth_locked()
+
+    def next(self):
+        if self._closed:
+            raise RuntimeError("PrefetchingIter is closed")
+        if self._exhausted:
+            raise StopIteration
+        if not self._started:
+            self._start()
+        from . import profiler as _profiler
+        parts = []
+        for q in self._queues:
+            t0 = time.perf_counter()
+            with _profiler.scope("PrefetchingIter.consumer_wait", cat="wait"):
+                batch = q.get()
+            with self._lock:
+                self.stats["consumer_wait_s"] += time.perf_counter() - t0
+                self._set_depth_locked()
+            if isinstance(batch, Exception):
+                self._exhausted = True
+                self._shutdown()  # stop sibling producers, don't spin
+                raise batch
+            if batch is self._STOP:
+                self._exhausted = True
+            else:
+                parts.append(batch)
+        if self._exhausted:
+            # with unequal-length iterators the longer ones are still
+            # producing: stop + join them now, not at gc/close time
+            self._shutdown()
+            raise StopIteration
+        with self._lock:
+            self.stats["consumed"] += 1
+        if len(parts) == 1:
+            return parts[0]
+        return DataBatch(sum((b.data for b in parts), []),
+                         sum((b.label or [] for b in parts), []) or None,
+                         pad=parts[0].pad, index=parts[0].index,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    # ------------------------------------------------------------- descs --
+    def _descs(self, attr, rename):
+        out = []
+        for i, it in enumerate(self._iters):
+            for d in getattr(it, attr):
+                if rename is not None:
+                    d = DataDesc(rename[i].get(d.name, d.name), d.shape,
+                                 d.dtype, d.layout)
+                out.append(d)
+        return out
+
+    @property
+    def provide_data(self):
+        return self._descs("provide_data", self._rename_data)
+
+    @property
+    def provide_label(self):
+        return self._descs("provide_label", self._rename_label)
+
+    # ----------------------------------------------------------- cleanup --
+    def close(self):
+        """Join producer threads; idempotent.  The wrapped iterators are
+        NOT closed (the caller may not own them)."""
+        if self._closed:
+            return
+        self._shutdown()
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 class ResizeIter(DataIter):

@@ -283,8 +283,13 @@ class Module:
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, num_epoch=1, batch_end_callback=None,
-            epoch_end_callback=None, force_rebind=False, force_init=False):
-        """ref: BaseModule.fit — the classic epoch loop."""
+            epoch_end_callback=None, force_rebind=False, force_init=False,
+            prefetch=0):
+        """ref: BaseModule.fit — the classic epoch loop.
+
+        ``prefetch>0`` wraps ``train_data`` in ``mx.io.PrefetchingIter``
+        with that queue capacity, overlapping decode/host work for the next
+        batches with the current step."""
         self.bind([(d.name, d.shape) for d in train_data.provide_data],
                   [(d.name, d.shape) for d in train_data.provide_label],
                   for_training=True, force_rebind=force_rebind)
@@ -296,7 +301,7 @@ class Module:
                             force_init=force_init)
         _fit_loop(self, self._symbol, self._logger, train_data, eval_data,
                   eval_metric, num_epoch, batch_end_callback,
-                  epoch_end_callback)
+                  epoch_end_callback, prefetch=prefetch)
 
     def score(self, eval_data, eval_metric, num_batch=None):
         """ref: BaseModule.score."""
@@ -340,30 +345,39 @@ class Module:
 # ---------------------------------------------------------------------------
 
 def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
-              num_epoch, batch_end_callback, epoch_end_callback):
+              num_epoch, batch_end_callback, epoch_end_callback, prefetch=0):
     if isinstance(eval_metric, str):
         eval_metric = _metric.create(eval_metric)
-    for epoch in range(num_epoch):
-        t0 = time.time()
-        eval_metric.reset()
-        train_data.reset()
-        for nbatch, batch in enumerate(train_data):
-            mod.forward(batch, is_train=True)
-            mod.backward()
-            mod.update()
-            mod.update_metric(eval_metric, batch.label)
-            if batch_end_callback:
-                batch_end_callback(_callback.BatchEndParam(
-                    epoch=epoch, nbatch=nbatch, eval_metric=eval_metric))
-        name, val = eval_metric.get()
-        logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
-                    epoch, name, val, time.time() - t0)
-        if eval_data is not None:
-            for name, val in mod.score(eval_data, eval_metric):
-                logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-        if epoch_end_callback:
-            arg, aux = mod.get_params()
-            epoch_end_callback(epoch, symbol, arg, aux)
+    wrapped = None
+    if prefetch:
+        from .io import PrefetchingIter
+        train_data = wrapped = PrefetchingIter(train_data,
+                                               capacity=int(prefetch))
+    try:
+        for epoch in range(num_epoch):
+            t0 = time.time()
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                mod.forward(batch, is_train=True)
+                mod.backward()
+                mod.update()
+                mod.update_metric(eval_metric, batch.label)
+                if batch_end_callback:
+                    batch_end_callback(_callback.BatchEndParam(
+                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric))
+            name, val = eval_metric.get()
+            logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
+                        epoch, name, val, time.time() - t0)
+            if eval_data is not None:
+                for name, val in mod.score(eval_data, eval_metric):
+                    logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
+            if epoch_end_callback:
+                arg, aux = mod.get_params()
+                epoch_end_callback(epoch, symbol, arg, aux)
+    finally:
+        if wrapped is not None:  # join producer threads deterministically
+            wrapped.close()
 
 
 def _score_loop(mod, eval_data, eval_metric, num_batch=None):
@@ -582,7 +596,8 @@ class BucketingModule:
             optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, num_epoch=1, batch_end_callback=None,
-            epoch_end_callback=None, force_rebind=False, force_init=False):
+            epoch_end_callback=None, force_rebind=False, force_init=False,
+            prefetch=0):
         """ref: BaseModule.fit routed through switch_bucket — same
         signature as Module.fit."""
         self._bind_from_iter(train_data, force_rebind)
@@ -594,7 +609,7 @@ class BucketingModule:
                             force_init=force_init)
         _fit_loop(self, self._default_module.symbol, self._logger,
                   train_data, eval_data, eval_metric, num_epoch,
-                  batch_end_callback, epoch_end_callback)
+                  batch_end_callback, epoch_end_callback, prefetch=prefetch)
 
 
 # ---------------------------------------------------------------------------

@@ -17,7 +17,9 @@ from .functional import functional_call, param_names_and_values
 from .moe import MoEFFN, moe_dispatch
 from .pipeline import PipelineStack, gpipe
 from .sequence import ring_attention, sp_attention, ulysses_attention
-from .step import EvalStep, TrainStep
+from .prefetch import DevicePrefetcher
+from .step import (EvalStep, TrainStep, add_transfer_hook,
+                   remove_transfer_hook)
 from .checkpoint import (load_train_step, load_train_step_sharded,
                          save_train_step, save_train_step_sharded)
 
@@ -32,5 +34,6 @@ __all__ = [
     "ring_attention", "sp_attention", "ulysses_attention",
     "PipelineStack", "gpipe",
     "MoEFFN", "moe_dispatch",
-    "EvalStep", "TrainStep",
+    "EvalStep", "TrainStep", "DevicePrefetcher",
+    "add_transfer_hook", "remove_transfer_hook",
 ]

@@ -25,7 +25,31 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["AXES", "make_mesh", "current_mesh", "default_mesh", "MeshScope",
-           "replicated", "named_sharding"]
+           "replicated", "named_sharding", "shard_map"]
+
+
+def _compat_shard_map():
+    """jax.shard_map across versions: older jax exposes it only under
+    jax.experimental with the replication-check kwarg named ``check_rep``
+    (renamed ``check_vma`` when promoted to the top level)."""
+    try:
+        from jax import shard_map as sm
+        return sm
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _sm
+        import functools
+
+        @functools.wraps(_sm)
+        def sm(f=None, **kw):
+            if "check_vma" in kw:
+                kw["check_rep"] = kw.pop("check_vma")
+            if f is None:
+                return lambda g: _sm(g, **kw)
+            return _sm(f, **kw)
+        return sm
+
+
+shard_map = _compat_shard_map()
 
 # Canonical axis order: collectives that ride adjacent devices (tp, sp) go
 # last so they land on the fastest ICI neighbours in the device enumeration.

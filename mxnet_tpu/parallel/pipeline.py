@@ -19,10 +19,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec
 
-from .mesh import current_mesh
+from .mesh import current_mesh, shard_map
 
 __all__ = ["gpipe", "PipelineStack"]
 

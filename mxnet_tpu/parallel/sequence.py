@@ -23,9 +23,8 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
-from jax import shard_map
 
-from .mesh import current_mesh
+from .mesh import current_mesh, shard_map
 
 __all__ = ["ring_attention", "ulysses_attention", "sp_attention"]
 

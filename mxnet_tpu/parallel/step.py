@@ -34,7 +34,24 @@ from .functional import (FunctionalState, functional_call,
                          param_names_and_values, trainable_split)
 from .functional_opt import pure_update, state_template
 
-__all__ = ["TrainStep", "EvalStep"]
+__all__ = ["TrainStep", "EvalStep", "add_transfer_hook",
+           "remove_transfer_hook"]
+
+# Observers of actual host→device batch transfers (called as fn(leaf,
+# sharding) right before each real device_put in _put_batch — NOT for
+# pre-placed batches, which skip the put).  Tests and the profiler use this
+# to assert/see that the async feed does exactly one transfer per leaf.
+_TRANSFER_HOOKS = []
+
+
+def add_transfer_hook(fn):
+    """Register ``fn(leaf, sharding)`` to run on every real batch H2D put."""
+    _TRANSFER_HOOKS.append(fn)
+    return fn
+
+
+def remove_transfer_hook(fn):
+    _TRANSFER_HOOKS.remove(fn)
 
 
 def _leaves(args):
@@ -62,11 +79,23 @@ def _put_batch(leaf, sharding):
     own slice of the dataset; SURVEY §3.3) — so the global batch is assembled
     from per-process shards without any cross-host copy.  A leaf that is
     already a global (not fully addressable) jax.Array is already placed;
-    hand it to device_put for a sharding-to-sharding transfer instead."""
+    hand it to device_put for a sharding-to-sharding transfer instead.
+
+    A leaf that ALREADY carries the target sharding (a DevicePrefetcher
+    placed it while the previous step ran) is returned as-is: no second
+    device_put, no transfer-hook callback — the async feed's steady state
+    costs zero extra HBM traffic."""
+    if isinstance(leaf, jax.Array) \
+            and getattr(leaf, "sharding", None) == sharding:
+        return leaf
     if jax.process_count() > 1:
         if not (isinstance(leaf, jax.Array) and not leaf.is_fully_addressable):
+            for fn in _TRANSFER_HOOKS:
+                fn(leaf, sharding)
             return jax.make_array_from_process_local_data(
                 sharding, np.asarray(leaf))
+    for fn in _TRANSFER_HOOKS:
+        fn(leaf, sharding)
     return jax.device_put(leaf, sharding)
 
 
@@ -74,7 +103,7 @@ class TrainStep:
     """Compiled (params, states, batch) → (params', states', loss) on a mesh."""
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
-                 data_spec=None, loss_reduce="mean"):
+                 data_spec=None, loss_reduce="mean", donate_batch=False):
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -83,9 +112,22 @@ class TrainStep:
         self._data_pspec = data_spec if data_spec is not None \
             else batch_spec(self.mesh)
         self._loss_reduce = loss_reduce
+        # donate_batch=True donates the batch buffers to the XLA program so
+        # a prefetched feed costs no steady-state HBM beyond the in-flight
+        # batches.  Only safe when every batch is consumed exactly once
+        # (DevicePrefetcher feed) — NOT when the caller re-steps the same
+        # arrays (bench-style loops).
+        self._donate_batch = bool(donate_batch)
         self._built = False
         self._jit = None
         self._num_update = optimizer.begin_num_update
+
+    @property
+    def data_sharding(self):
+        """The NamedSharding batch leaves are placed with — what a
+        DevicePrefetcher needs to pre-place batches this step will accept
+        without a second transfer."""
+        return NamedSharding(self.mesh, self._data_pspec)
 
     # --------------------------------------------------------------- build --
     def _batch_axis(self):
@@ -200,11 +242,15 @@ class TrainStep:
         in_sh = (train_sh, aux_sh, state_sh, self._repl, self._repl,
                  self._repl)
         out_sh = (train_sh, aux_sh, state_sh, self._repl, self._repl)
+        donate = (0, 1, 2)
+        if self._donate_batch:
+            # batch leaves sit after (train, aux, states, t, key, lr)
+            donate += tuple(range(6, 6 + n_data + self._n_label))
         return jax.jit(
             fn,
             in_shardings=in_sh + tuple([dat_sh] * (n_data + self._n_label)),
             out_shardings=out_sh,
-            donate_argnums=(0, 1, 2))
+            donate_argnums=donate)
 
     # ---------------------------------------------------------------- call --
     def __call__(self, data, label):
@@ -232,6 +278,7 @@ class TrainStep:
             self._sig = sig
             self._last_avals = None  # refresh lazily on the next step
             self._cost_cache = None
+            self._fresh_jit = True
         key = _random.next_key()
         lr = jnp.float32(self._base_lr())
         dat_sh = NamedSharding(self.mesh, self._data_pspec)
@@ -244,8 +291,20 @@ class TrainStep:
             # with (shapes are fixed until sig changes)
             self._last_avals = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+        if self._donate_batch and getattr(self, "_fresh_jit", False):
+            # batch buffers rarely alias an output shape: XLA's "donated
+            # buffers were not usable" notice is expected on this compile,
+            # and is suppressed only for it (not process-wide)
+            import warnings
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                out = self._jit(*args)
+            self._fresh_jit = False
+        else:
+            out = self._jit(*args)
         (self._train_arrays, self._aux_arrays, self._states, self._t,
-         loss) = self._jit(*args)
+         loss) = out
         self._num_update += 1
         self.optimizer.num_update = self._num_update
         return NDArray(loss)
@@ -315,6 +374,11 @@ class EvalStep:
             else batch_spec(self.mesh)
         self._jit = None
         self._built = False
+
+    @property
+    def data_sharding(self):
+        """See TrainStep.data_sharding."""
+        return NamedSharding(self.mesh, self._data_pspec)
 
     def _build(self, sample_args):
         if any(p._deferred_init is not None

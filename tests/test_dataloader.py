@@ -1,0 +1,107 @@
+"""Multi-worker gluon DataLoader semantics through the prefetch path:
+batch ordering, last_batch modes, timeout behavior, pin_memory async-put,
+and deterministic close().  (ref: tests/python/unittest/test_gluon_data.py)
+"""
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+
+
+def _ds(n=22, d=3):
+    x = np.arange(n * d, dtype=np.float32).reshape(n, d)
+    y = np.arange(n, dtype=np.float32)
+    return gluon.data.ArrayDataset(x, y)
+
+
+def _collect(loader):
+    return [(d.asnumpy(), l.asnumpy()) for d, l in loader]
+
+
+@pytest.mark.parametrize("thread_pool", [False, True])
+@pytest.mark.parametrize("last_batch", ["keep", "discard", "rollover"])
+def test_multiworker_matches_serial_in_order(thread_pool, last_batch):
+    """The bounded-prefetch worker path must preserve batch order and
+    last_batch semantics exactly — compare against the num_workers=0 path."""
+    ds = _ds()
+    want = _collect(gluon.data.DataLoader(ds, batch_size=4, num_workers=0,
+                                          last_batch=last_batch))
+    with gluon.data.DataLoader(ds, batch_size=4, num_workers=2,
+                               thread_pool=thread_pool,
+                               last_batch=last_batch) as loader:
+        got = _collect(loader)
+    assert len(got) == len(want)
+    expected = {"keep": 6, "discard": 5, "rollover": 5}[last_batch]
+    assert len(got) == expected
+    for (gd, gl), (wd, wl) in zip(got, want):
+        np.testing.assert_array_equal(gd, wd)
+        np.testing.assert_array_equal(gl, wl)
+
+
+class _SlowDataset(gluon.data.Dataset):
+    def __init__(self, n=8, delay=2.0):
+        self._n = n
+        self._delay = delay
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        time.sleep(self._delay)
+        return np.zeros(2, np.float32), np.float32(i)
+
+
+def test_timeout_raises_timeout_error_not_hang():
+    loader = gluon.data.DataLoader(_SlowDataset(), batch_size=4,
+                                   num_workers=1, thread_pool=True,
+                                   timeout=0.1)
+    t0 = time.perf_counter()
+    with pytest.raises(TimeoutError, match="timeout=0.1"):
+        next(iter(loader))
+    assert time.perf_counter() - t0 < 5.0  # raised promptly, no hang
+    loader.close()
+
+
+@pytest.mark.parametrize("num_workers", [0, 2])
+def test_pin_memory_async_put_path(num_workers):
+    ds = _ds(16)
+    want = _collect(gluon.data.DataLoader(ds, batch_size=4, num_workers=0))
+    with gluon.data.DataLoader(ds, batch_size=4, num_workers=num_workers,
+                               thread_pool=True, pin_memory=True) as loader:
+        got = _collect(loader)
+        # a second pass works (the async stage restarts cleanly)
+        got2 = _collect(loader)
+    for pass_got in (got, got2):
+        assert len(pass_got) == len(want)
+        for (gd, gl), (wd, wl) in zip(pass_got, want):
+            np.testing.assert_array_equal(gd, wd)
+            np.testing.assert_array_equal(gl, wl)
+
+
+def test_pin_memory_yields_device_ndarrays():
+    with gluon.data.DataLoader(_ds(8), batch_size=4,
+                               pin_memory=True) as loader:
+        d, l = next(iter(loader))
+    assert isinstance(d, mx.nd.NDArray) and isinstance(l, mx.nd.NDArray)
+    assert d.shape == (4, 3)
+
+
+def test_close_is_deterministic_and_idempotent():
+    loader = gluon.data.DataLoader(_ds(8), batch_size=4, num_workers=2,
+                                   thread_pool=True)
+    assert len(_collect(loader)) == 2
+    loader.close()
+    loader.close()  # idempotent
+    assert loader._pool is None
+    with pytest.raises(RuntimeError, match="closed"):
+        next(iter(loader))
+
+
+def test_context_manager_closes_pool():
+    with gluon.data.DataLoader(_ds(8), batch_size=4, num_workers=2,
+                               thread_pool=True) as loader:
+        _collect(loader)
+    assert loader._pool is None

@@ -4,6 +4,9 @@ interpreter sweeps so every default run still catches Mosaic regressions."""
 import numpy as np
 
 import jax
+# older jax does not auto-import the export submodule: the bare
+# `jax.export` attribute raises until this import runs (see gluon/block.py)
+from jax import export as _jax_export  # noqa: F401
 import jax.numpy as jnp
 
 
