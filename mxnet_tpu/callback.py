@@ -5,7 +5,8 @@ from __future__ import annotations
 import logging
 import time
 
-__all__ = ["Speedometer", "do_checkpoint", "log_train_metric", "ProgressBar"]
+__all__ = ["Speedometer", "do_checkpoint", "do_step_checkpoint",
+           "log_train_metric", "ProgressBar"]
 
 
 class BatchEndParam:
@@ -79,6 +80,19 @@ def do_checkpoint(prefix, period=1):
             from . import ndarray as nd
             nd.save(fname, net)
         logging.info("Saved checkpoint to \"%s\"", fname)
+
+    return _callback
+
+
+def do_step_checkpoint(manager):
+    """Batch-end callback driving a ``parallel.CheckpointManager`` —
+    ``save_every_n_steps`` for step-driven training loops: hand the
+    manager here and every batch boundary calls ``maybe_save()``, which
+    snapshots atomically whenever ``every_n_steps`` divides the step
+    count (see docs/api.md "Fault tolerance")."""
+
+    def _callback(param):
+        manager.maybe_save()
 
     return _callback
 

@@ -11,23 +11,36 @@ reference launch scripts port over unchanged.
 """
 from __future__ import annotations
 
+import logging
 import os
 
 import jax
+
+from . import fault as _fault
 
 __all__ = ["init", "shutdown", "rank", "num_workers", "barrier",
            "all_sum", "all_gather", "broadcast"]
 
 _initialized = False
+_logger = logging.getLogger(__name__)
 
 
-def init(coordinator=None, num_processes=None, process_id=None):
+def init(coordinator=None, num_processes=None, process_id=None,
+         retries=None, timeout=None, backoff_base=0.5):
     """Initialize the coordination service from args or DMLC_*/env config.
 
     Reads (in priority order) explicit args, then ``DMLC_PS_ROOT_URI`` /
     ``DMLC_PS_ROOT_PORT`` / ``DMLC_NUM_WORKER`` / ``DMLC_WORKER_ID``.
     Single-process runs (no env, no args) are a no-op so user scripts can
-    call init() unconditionally.  Idempotent."""
+    call init() unconditionally.  Idempotent.
+
+    Bring-up is RETRYING (ref: ps-lite Van connect resend; the tracker
+    restarts workers that raced the scheduler): each connect attempt that
+    fails is repeated with exponential backoff + jitter, ``retries`` extra
+    times (env ``DMLC_RETRY``, default 4) within a ``timeout``-second
+    deadline (env ``DMLC_INIT_TIMEOUT``, default 300) — so a worker that
+    comes up before its coordinator, the normal case on a preempted-and-
+    restarted TPU slice, connects instead of dying."""
     global _initialized
     if _initialized:
         return
@@ -42,8 +55,19 @@ def init(coordinator=None, num_processes=None, process_id=None):
     if process_id is None:
         i = os.environ.get("DMLC_WORKER_ID")
         process_id = int(i) if i else (0 if num_processes else None)
+    if num_processes is not None and process_id is not None \
+            and not 0 <= process_id < num_processes:
+        raise ValueError(
+            f"distributed.init: process_id={process_id} is outside "
+            f"[0, num_processes={num_processes}) — check DMLC_WORKER_ID "
+            f"against DMLC_NUM_WORKER (every worker id must be a unique "
+            f"integer below the worker count)")
     if coordinator is None or num_processes is None or num_processes <= 1:
         return  # single-process
+    if retries is None:
+        retries = int(os.environ.get("DMLC_RETRY", "4") or 4)
+    if timeout is None:
+        timeout = float(os.environ.get("DMLC_INIT_TIMEOUT", "300") or 300)
     # CPU backend rehearsal (SURVEY.md §4 distributed-without-a-cluster)
     # needs gloo for cross-process collectives; on TPU the ICI/DCN fabric
     # is used and this config is ignored.
@@ -51,9 +75,36 @@ def init(coordinator=None, num_processes=None, process_id=None):
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+
+    def _connect():
+        _fault.fire("distributed.connect")
+        try:
+            jax.distributed.initialize(coordinator_address=coordinator,
+                                       num_processes=num_processes,
+                                       process_id=process_id)
+        except Exception:
+            # jax assigns its global client/service BEFORE connect, and a
+            # second initialize() on partially-set state raises 'should
+            # only be called once' — tear the half-open state down so the
+            # retry really reconnects instead of dying on that error
+            try:
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            raise
+
+    def _on_retry(attempt, delay, exc):
+        _logger.warning(
+            "distributed.init: connect to %s failed (%s); retry %d/%d in "
+            "%.1fs", coordinator, exc, attempt, retries, delay)
+
+    _fault.retry_call(_connect, retries=retries, base_delay=backoff_base,
+                      max_delay=30.0, deadline=timeout,
+                      on_retry=_on_retry,
+                      # a backend that already ran computations will fail
+                      # identically forever — surface the usage error now
+                      giveup=lambda e: "before any JAX computations"
+                                       in str(e))
     _initialized = True
 
 

@@ -1,0 +1,280 @@
+"""Fault-tolerance runtime: injection harness, retry/backoff, graceful exit.
+
+ref: the reference stack's resilience story is spread across ps-lite (Van
+resend/retry on connect), model.py epoch checkpoints, and operator-level
+NaN policing left to the user.  TensorFlow (Abadi et al., PAPERS.md §)
+treats user-level checkpointing plus runtime health checks as a design
+axis; Cloud TPU fleets add preemption as a *normal* lifecycle event.  This
+module is the shared substrate the rest of the stack builds on:
+
+- ``inject(point, error, after_n=0, times=None)`` — deterministic fault
+  injection.  Production code calls ``fire(point)`` at named points; a test
+  (or ``tools/chaos_check.py``) arms a point inside a ``with`` block and
+  the error is raised there, so kill-and-resume / producer-crash /
+  NaN-batch scenarios are repeatable tests instead of prayers.
+- ``retry_call(fn, ...)`` — exponential backoff with jitter and a deadline
+  (the ps-lite Van connect-retry loop, generalised).
+- ``GracefulExit`` — SIGTERM/SIGINT latch used by ``Module.fit`` to
+  snapshot-then-exit instead of dying mid-step on preemption.
+- ``with_context(exc, msg)`` — attach producer/worker provenance to an
+  exception that crosses a thread boundary before it is re-raised.
+
+Known injection points (``fire`` call sites):
+
+===========================  ==============================================
+point                        location
+===========================  ==============================================
+``io.producer``              PrefetchingIter producer thread (per batch) and
+                             DataLoader host-batch production (per batch)
+``prefetch.device_put``      DevicePrefetcher producer, before placement
+``checkpoint.write``         save_train_step entry (before any file I/O)
+``checkpoint.replace``       save_train_step, after the temp payload is
+                             written, before ``os.replace`` commits it
+``step``                     TrainStep._step entry (before batch placement)
+``distributed.connect``      distributed.init, inside each connect attempt
+===========================  ==============================================
+
+This module imports only the standard library (it is pulled in by
+``distributed.py`` before the jax backend comes up).
+"""
+from __future__ import annotations
+
+import random as _random
+import signal as _signal
+import threading
+import time
+
+__all__ = ["inject", "fire", "points", "retry_call", "GracefulExit",
+           "with_context"]
+
+_REGISTRY = {}            # point -> _Injection (armed faults)
+_lock = threading.Lock()
+
+
+class _Injection:
+    """One armed fault.  ``calls`` counts every ``fire(point)`` hit while
+    armed; ``fired`` counts the hits that actually raised."""
+
+    def __init__(self, point, error, after_n=0, times=None):
+        # Exception only, NOT BaseException: producer threads catch
+        # Exception to forward the fault to their consumer — an injected
+        # SystemExit/KeyboardInterrupt would kill the thread silently and
+        # deadlock the consumer on an empty queue
+        if not (isinstance(error, Exception)
+                or (isinstance(error, type)
+                    and issubclass(error, Exception))):
+            raise TypeError("error must be an Exception instance or class "
+                            "(BaseException-only types would kill producer "
+                            "threads without surfacing)")
+        self.point = point
+        self.error = error
+        self.after_n = int(after_n)
+        self.times = times if times is None else int(times)
+        self.calls = 0
+        self.fired = 0
+
+    def _should_fire_locked(self):
+        self.calls += 1
+        if self.calls <= self.after_n:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        self.fired += 1
+        return True
+
+    def make_error(self):
+        if isinstance(self.error, type):
+            return self.error(f"fault injected at {self.point!r}")
+        return self.error
+
+
+class inject:
+    """Arm ``point`` to raise ``error`` when production code reaches it.
+
+    ``after_n`` fires pass through before the fault triggers; ``times``
+    caps how many triggers happen (``None`` = every subsequent hit).  The
+    context value exposes ``calls``/``fired`` counters for assertions::
+
+        with fault.inject("step", RuntimeError("preempted"), after_n=4) as h:
+            ...
+        assert h.fired == 1
+
+    Arming a point that is already armed replaces it for the duration and
+    restores the previous injection on exit (nesting-safe).
+    """
+
+    def __init__(self, point, error, after_n=0, times=None):
+        self._inj = _Injection(point, error, after_n=after_n, times=times)
+        self._prev = None
+
+    def __enter__(self):
+        with _lock:
+            self._prev = _REGISTRY.get(self._inj.point)
+            _REGISTRY[self._inj.point] = self._inj
+        return self._inj
+
+    def __exit__(self, *exc):
+        with _lock:
+            if _REGISTRY.get(self._inj.point) is self._inj:
+                if self._prev is None:
+                    del _REGISTRY[self._inj.point]
+                else:
+                    _REGISTRY[self._inj.point] = self._prev
+        return False
+
+
+def fire(point):
+    """Injection hook.  No-op (one dict lookup) unless a test armed
+    ``point`` via ``inject``; then raises the armed error per its
+    ``after_n``/``times`` schedule.  Thread-safe — producer threads and
+    the training thread may hit points concurrently."""
+    if not _REGISTRY:          # fast path: nothing armed anywhere
+        return
+    with _lock:
+        inj = _REGISTRY.get(point)
+        if inj is None or not inj._should_fire_locked():
+            return
+        err = inj.make_error()
+    raise err
+
+
+def points():
+    """Names of the currently armed injection points."""
+    with _lock:
+        return sorted(_REGISTRY)
+
+
+# ------------------------------------------------------------------ retry --
+def retry_call(fn, retries=4, base_delay=0.5, max_delay=8.0, deadline=None,
+               jitter=0.5, retry_on=(Exception,), on_retry=None,
+               giveup=None):
+    """Call ``fn()`` with exponential backoff (the ps-lite Van retry loop).
+
+    ``retries`` extra attempts follow the first failure; delays grow as
+    ``base_delay * 2**k`` capped at ``max_delay``, each stretched by up to
+    ``jitter`` fraction of itself (decorrelates a fleet of workers all
+    retrying the same coordinator).  ``deadline`` (seconds, measured from
+    the first attempt) bounds the whole loop: once passed, the last error
+    re-raises immediately.  ``giveup(exc) -> bool`` marks an error as
+    non-retryable (a misconfiguration that will fail identically every
+    time): it re-raises at once instead of burning the backoff schedule.
+    ``on_retry(attempt, delay, exc)`` observes each scheduled retry.
+    Returns ``fn()``'s value."""
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except retry_on as exc:
+            if giveup is not None and giveup(exc):
+                raise
+            attempt += 1
+            if attempt > retries:
+                raise
+            delay = min(float(max_delay), float(base_delay) * 2 ** (attempt - 1))
+            delay *= 1.0 + jitter * _random.random()
+            if deadline is not None:
+                remaining = deadline - (time.monotonic() - t0)
+                if remaining <= 0:
+                    raise
+                delay = min(delay, remaining)
+            if on_retry is not None:
+                on_retry(attempt, delay, exc)
+            time.sleep(delay)
+
+
+# ---------------------------------------------------------------- signals --
+class GracefulExit:
+    """Latch SIGTERM/SIGINT instead of dying mid-step.
+
+    Inside the ``with`` block the signals set ``requested`` (and remember
+    which signal) rather than raising, so a training loop can finish the
+    current batch, snapshot, and return cleanly — the Cloud-TPU preemption
+    contract.  Handlers are restored on exit; a second signal while the
+    latch is already set falls through to the previous handler (so a
+    stuck snapshot can still be killed).  Outside the main thread (where
+    ``signal.signal`` is illegal) the latch is inert and ``enabled`` is
+    False."""
+
+    def __init__(self, signals=(_signal.SIGTERM, _signal.SIGINT),
+                 enabled=True):
+        self._signals = tuple(signals)
+        self._want = enabled
+        self._prev = {}
+        self.enabled = False
+        self.requested = False
+        self.signum = None
+
+    def _handler(self, signum, frame):
+        if self.requested:        # second signal: escalate to the old handler
+            prev = self._prev.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+                return
+            raise KeyboardInterrupt
+        self.requested = True
+        self.signum = signum
+
+    def __enter__(self):
+        if not self._want:
+            return self
+        try:
+            for s in self._signals:
+                self._prev[s] = _signal.signal(s, self._handler)
+            self.enabled = True
+        except ValueError:        # not the main thread — run unlatched
+            for s, prev in self._prev.items():
+                _signal.signal(s, prev)
+            self._prev.clear()
+            self.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._prev.items():
+            try:
+                _signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._prev.clear()
+        self.enabled = False
+        return False
+
+    def __bool__(self):
+        return self.requested
+
+
+# ---------------------------------------------------------------- context --
+def with_context(exc, msg):
+    """Return ``exc`` carrying ``msg`` provenance (which producer thread /
+    worker / iterator it came from), preserving the exception type so
+    callers' ``except`` clauses keep matching.  When the type can be
+    rebuilt from a single string the message is prefixed and the original
+    chained as ``__cause__``; otherwise the note is attached to the
+    original object (``fault_context`` attribute, plus ``add_note`` where
+    the runtime has it)."""
+    ctx = list(getattr(exc, "fault_context", ())) + [str(msg)]
+    try:
+        new = type(exc)(f"[{msg}] {exc}")
+        new.__cause__ = exc
+        new.with_traceback(exc.__traceback__)
+        # a string-rebuilt OSError loses errno/filename; callers branch on
+        # those (retry-on-ENOENT vs abort), so carry them over
+        for attr in ("errno", "strerror", "filename", "filename2"):
+            v = getattr(exc, attr, None)
+            if v is not None and getattr(new, attr, None) is None:
+                try:
+                    setattr(new, attr, v)
+                except Exception:
+                    pass
+    except Exception:
+        new = exc
+        if hasattr(new, "add_note"):      # py3.11+
+            try:
+                new.add_note(str(msg))
+            except Exception:
+                pass
+    try:
+        new.fault_context = ctx
+    except Exception:
+        pass
+    return new

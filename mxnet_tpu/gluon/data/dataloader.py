@@ -138,9 +138,16 @@ class DataLoader:
 
     def _host_batches(self):
         """Yield batchified HOST (numpy) batches, multi-worker when a pool
-        exists (ref: _MultiWorkerIter — async map with bounded prefetch)."""
+        exists (ref: _MultiWorkerIter — async map with bounded prefetch).
+
+        A worker exception (bad sample, decode failure) re-raises here
+        tagged with the batch index it came from, AFTER ``close()`` has
+        torn the pool down — a failed loader never leaks worker
+        processes."""
+        from ... import fault as _fault
         if self._pool is None:
             for samples in self._batch_sampler:
+                _fault.fire("io.producer")
                 yield self._batchify_fn(
                     [_as_numpy_sample(self._dataset[i]) for i in samples])
             return
@@ -161,11 +168,19 @@ class DataLoader:
             _issue()
         while next_yield < len(batches):
             try:
+                _fault.fire("io.producer")
                 key, batch = issued[next_yield].get(self._timeout)
             except mp.TimeoutError:
+                # no close() here: joining a (thread-)pool that is still
+                # stuck inside the slow task would turn a prompt timeout
+                # into a hang — the caller owns teardown after a timeout
                 raise TimeoutError(
                     f"DataLoader worker batch {next_yield} not ready within "
                     f"timeout={self._timeout}s") from None
+            except Exception as exc:
+                self.close()
+                raise _fault.with_context(
+                    exc, f"DataLoader worker, batch {next_yield}") from exc
             del issued[next_yield]
             _issue()
             next_yield += 1

@@ -18,6 +18,7 @@ from collections import namedtuple
 import numpy as np
 
 from . import recordio
+from .fault import fire as _fire, with_context as _with_context
 from .ndarray import NDArray
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
@@ -875,23 +876,28 @@ class PrefetchingIter(DataIter):
         self._stop_evt = threading.Event()
         self._queues = [_queue.Queue(self._capacity) for _ in self._iters]
         self._threads = []
-        for it, q in zip(self._iters, self._queues):
-            t = threading.Thread(target=self._produce, args=(it, q),
+        for idx, (it, q) in enumerate(zip(self._iters, self._queues)):
+            t = threading.Thread(target=self._produce, args=(idx, it, q),
                                  name="PrefetchingIter-producer", daemon=True)
             t.start()
             self._threads.append(t)
         self._exhausted = False
         self._started = True
 
-    def _produce(self, it, q):
+    def _produce(self, idx, it, q):
         stop = self._stop_evt
         while not stop.is_set():
             try:
+                _fire("io.producer")
                 batch = it.next()
             except StopIteration:
                 batch = self._STOP
-            except Exception as exc:  # surface in the consumer, then die
-                batch = exc
+            except Exception as exc:  # surface in the consumer, then die —
+                # tagged with WHICH wrapped iterator raised (with several
+                # iterators merged, the bare traceback does not say)
+                batch = _with_context(
+                    exc, f"PrefetchingIter producer, iter {idx} "
+                         f"({type(it).__name__})")
             t0 = time.perf_counter()
             enqueued = False
             while not stop.is_set():
@@ -964,7 +970,11 @@ class PrefetchingIter(DataIter):
                 self._set_depth_locked()
             if isinstance(batch, Exception):
                 self._exhausted = True
-                self._shutdown()  # stop sibling producers, don't spin
+                self._shutdown()  # join THIS and sibling producers: a
+                # failed iterator must never leak threads.  NOT close() —
+                # the iterator stays usable: reset() retries the epoch
+                # (transient error), or re-wrap the still-open wrapped
+                # iterators to continue mid-epoch past the bad batch
                 raise batch
             if batch is self._STOP:
                 self._exhausted = True

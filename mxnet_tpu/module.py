@@ -9,13 +9,16 @@ contract for ported scripts (Gluon is the primary modern API).
 """
 from __future__ import annotations
 
+import json
 import logging
+import os
 import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from . import callback as _callback
+from . import fault as _fault
 from . import initializer as _init
 from . import metric as _metric
 from . import optimizer as _opt
@@ -284,12 +287,27 @@ class Module:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, num_epoch=1, batch_end_callback=None,
             epoch_end_callback=None, force_rebind=False, force_init=False,
-            prefetch=0):
+            prefetch=0, checkpoint_prefix=None, resume=False,
+            bad_batch_budget=0):
         """ref: BaseModule.fit — the classic epoch loop.
 
         ``prefetch>0`` wraps ``train_data`` in ``mx.io.PrefetchingIter``
         with that queue capacity, overlapping decode/host work for the next
-        batches with the current step."""
+        batches with the current step.
+
+        Fault tolerance (docs/api.md "Fault tolerance"):
+
+        - ``checkpoint_prefix`` arms SIGTERM/SIGINT preemption handling —
+          on signal the loop finishes the current batch, snapshots params
+          + optimizer state + a ``<prefix>-resume.json`` position marker,
+          and returns cleanly.
+        - ``resume=True`` restores that snapshot (params, optimizer state,
+          update counts) and continues MID-EPOCH from the recorded batch
+          counter; with no snapshot present it trains from scratch.
+        - ``bad_batch_budget`` tolerates that many data-pipeline errors
+          (decode failures surfaced by ``PrefetchingIter``/``DataLoader``
+          producers) across the run: each is logged and skipped, the
+          budget-exceeding one re-raises."""
         self.bind([(d.name, d.shape) for d in train_data.provide_data],
                   [(d.name, d.shape) for d in train_data.provide_label],
                   for_training=True, force_rebind=force_rebind)
@@ -301,7 +319,9 @@ class Module:
                             force_init=force_init)
         _fit_loop(self, self._symbol, self._logger, train_data, eval_data,
                   eval_metric, num_epoch, batch_end_callback,
-                  epoch_end_callback, prefetch=prefetch)
+                  epoch_end_callback, prefetch=prefetch,
+                  checkpoint_prefix=checkpoint_prefix, resume=resume,
+                  bad_batch_budget=bad_batch_budget)
 
     def score(self, eval_data, eval_metric, num_batch=None):
         """ref: BaseModule.score."""
@@ -345,39 +365,272 @@ class Module:
 # ---------------------------------------------------------------------------
 
 def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
-              num_epoch, batch_end_callback, epoch_end_callback, prefetch=0):
+              num_epoch, batch_end_callback, epoch_end_callback, prefetch=0,
+              checkpoint_prefix=None, resume=False, bad_batch_budget=0):
     if isinstance(eval_metric, str):
         eval_metric = _metric.create(eval_metric)
+    base_iter = train_data
     wrapped = None
-    if prefetch:
-        from .io import PrefetchingIter
-        train_data = wrapped = PrefetchingIter(train_data,
-                                               capacity=int(prefetch))
+
+    def _wrap():
+        nonlocal train_data, wrapped
+        if prefetch:
+            from .io import PrefetchingIter
+            train_data = wrapped = PrefetchingIter(base_iter,
+                                                   capacity=int(prefetch))
+
+    _wrap()
+
+    def _next_fn(src):
+        # DataIter-style sources pull through .next() so the iterator's own
+        # cursor survives a re-wrap after a bad batch; anything else (plain
+        # iterables, generators) goes through the standard protocol, giving
+        # the seed's `for batch in train_data` duck-typing back
+        nx = getattr(src, "next", None)
+        return nx if callable(nx) else iter(src).__next__
+
+    start_epoch, skip_batches = 0, 0
+    if resume:
+        if not checkpoint_prefix:
+            raise ValueError("fit(resume=True) needs checkpoint_prefix")
+        pos = _load_fit_snapshot(mod, checkpoint_prefix, logger)
+        if pos is not None:
+            start_epoch, skip_batches = pos
+    bad_batches = 0
+
+    def _skip_bad(exc, epoch, nbatch, nxt):
+        """Budgeted bad-batch handling, shared by the resume fast-forward
+        and the main loop; returns the (possibly re-wrapped) puller."""
+        nonlocal bad_batches
+        if bad_batches >= bad_batch_budget:
+            raise
+        bad_batches += 1
+        logger.warning(
+            "Epoch[%d] Batch[%d] bad batch (%d of %d budgeted), "
+            "skipping: %s", epoch, nbatch, bad_batches, bad_batch_budget,
+            exc)
+        if wrapped is not None and wrapped._exhausted:
+            # the failed PrefetchingIter joined its producers and went
+            # exhausted (thread hygiene); re-wrap the still-open base
+            # iterator — its cursor is already past the bad batch, so
+            # the epoch continues
+            _wrap()
+            return _next_fn(train_data)
+        return nxt
+
     try:
-        for epoch in range(num_epoch):
-            t0 = time.time()
-            eval_metric.reset()
-            train_data.reset()
-            for nbatch, batch in enumerate(train_data):
-                mod.forward(batch, is_train=True)
-                mod.backward()
-                mod.update()
-                mod.update_metric(eval_metric, batch.label)
-                if batch_end_callback:
-                    batch_end_callback(_callback.BatchEndParam(
-                        epoch=epoch, nbatch=nbatch, eval_metric=eval_metric))
-            name, val = eval_metric.get()
-            logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
-                        epoch, name, val, time.time() - t0)
-            if eval_data is not None:
-                for name, val in mod.score(eval_data, eval_metric):
-                    logger.info("Epoch[%d] Validation-%s=%f", epoch, name, val)
-            if epoch_end_callback:
-                arg, aux = mod.get_params()
-                epoch_end_callback(epoch, symbol, arg, aux)
+        # the latch turns SIGTERM/SIGINT (preemption notice, ^C) into a
+        # snapshot-then-clean-return at the next batch boundary instead of
+        # a mid-update death (only armed when there is somewhere to save)
+        with _fault.GracefulExit(
+                enabled=checkpoint_prefix is not None) as gexit:
+            for epoch in range(start_epoch, num_epoch):
+                t0 = time.time()
+                eval_metric.reset()
+                train_data.reset()
+                nxt = _next_fn(train_data)
+                nbatch = 0
+                while skip_batches > 0:
+                    # mid-epoch resume: fast-forward past the batches the
+                    # preempted run already trained on (deterministic
+                    # iterators replay the same pulls — including the same
+                    # bad batches, which trained nothing and are budgeted
+                    # again here — and land on the exact same remainder)
+                    try:
+                        nxt()
+                    except StopIteration:
+                        break
+                    except Exception as exc:
+                        nxt = _skip_bad(exc, epoch, nbatch, nxt)
+                        continue
+                    skip_batches -= 1
+                    nbatch += 1
+                skip_batches = 0
+                while True:
+                    try:
+                        batch = nxt()
+                    except StopIteration:
+                        break
+                    except Exception as exc:
+                        nxt = _skip_bad(exc, epoch, nbatch, nxt)
+                        continue
+                    mod.forward(batch, is_train=True)
+                    mod.backward()
+                    mod.update()
+                    mod.update_metric(eval_metric, batch.label)
+                    if batch_end_callback:
+                        batch_end_callback(_callback.BatchEndParam(
+                            epoch=epoch, nbatch=nbatch,
+                            eval_metric=eval_metric))
+                    nbatch += 1
+                    if gexit.requested:
+                        _save_fit_snapshot(mod, symbol, checkpoint_prefix,
+                                           epoch, nbatch)
+                        logger.info(
+                            "Epoch[%d] Batch[%d] caught signal %s: snapshot "
+                            "saved under %r, exiting cleanly (resume with "
+                            "fit(..., resume=True))", epoch, nbatch,
+                            gexit.signum, checkpoint_prefix)
+                        return
+                name, val = eval_metric.get()
+                logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
+                            epoch, name, val, time.time() - t0)
+                if eval_data is not None:
+                    for name, val in mod.score(eval_data, eval_metric):
+                        logger.info("Epoch[%d] Validation-%s=%f",
+                                    epoch, name, val)
+                if epoch_end_callback:
+                    arg, aux = mod.get_params()
+                    epoch_end_callback(epoch, symbol, arg, aux)
+            if gexit.requested:
+                # signal landed after the last batch (during eval /
+                # epoch-end callbacks): every epoch DID finish, so this is
+                # a completed run — fall through to clear the marker, but
+                # say so instead of swallowing the signal silently
+                logger.info("caught signal %s after the final batch; "
+                            "training had already completed", gexit.signum)
     finally:
         if wrapped is not None:  # join producer threads deterministically
             wrapped.close()
+    # only reached when every epoch ran (a preemption returns from inside
+    # the try): drop the marker so a later fit(resume=True) does not rewind
+    # into a stale spot (a crash mid-run keeps it — the snapshot is still
+    # the best restart point)
+    if checkpoint_prefix:
+        _clear_fit_snapshot(checkpoint_prefix)
+
+
+# ------------------------------------------------- preemption snapshots --
+# The classic Module path's counterpart of parallel.CheckpointManager:
+# params ride the 1.x artifact layout (symbol json + params file), optimizer
+# state and the mid-epoch position ride beside it.  Each snapshot's payload
+# files carry a unique epoch+batch stamp, every file goes through tmp +
+# os.replace, and the json marker (which names the stamp) is written LAST —
+# so a crash at any point, including a SIGKILL while RE-snapshotting after
+# an earlier resume, leaves the marker referencing only one complete,
+# mutually-consistent set: the old one or the new one, never a torn mix.
+# Stale stamped sets are pruned after each marker commit.
+
+def _flatten_opt_state(st, key, out):
+    if st is None:
+        return
+    if isinstance(st, (tuple, list)):
+        for i, s in enumerate(st):
+            _flatten_opt_state(s, f"{key}.{i}", out)
+    else:
+        out[key] = st
+
+
+def _assign_opt_state(st, key, payload):
+    if st is None:
+        return
+    if isinstance(st, (tuple, list)):
+        for i, s in enumerate(st):
+            _assign_opt_state(s, f"{key}.{i}", payload)
+    else:
+        st._data = payload[key]._data
+
+
+def _opt_owner(mod):
+    """The module holding the (possibly shared) optimizer + state set —
+    the default bucket for BucketingModule, the module itself otherwise."""
+    return getattr(mod, "_default_module", mod)
+
+
+def _replace_committed(write_fn, path):
+    write_fn(path + ".tmp")
+    os.replace(path + ".tmp", path)
+
+
+def _prune_fit_snapshots(prefix, keep_stamp=None):
+    """Remove stamped snapshot payloads except ``keep_stamp``'s set.
+
+    Matches ONLY the exact stamp shape this module writes
+    (``<prefix>-n####b######-…`` and its ``.tmp-…`` orphans) — a bare
+    startswith would eat unrelated user files living next to the prefix
+    (``model-notes.txt``, a ``do_checkpoint('model-new')`` artifact)."""
+    import re
+    d = os.path.dirname(prefix) or "."
+    pat = re.compile(re.escape(os.path.basename(prefix))
+                     + r"-(n\d{4}b\d{6})[.-]")
+    for name in os.listdir(d):
+        m = pat.match(name)
+        if m and m.group(1) != keep_stamp:
+            try:
+                os.remove(os.path.join(d, name))
+            except OSError:
+                pass
+
+
+def _save_fit_snapshot(mod, symbol, prefix, epoch, nbatch):
+    arg, aux = mod.get_params()
+    # unique per-snapshot stamp: a re-snapshot after a resume must never
+    # overwrite files the still-committed old marker points at
+    stamp = f"n{epoch:04d}b{nbatch:06d}"
+    snap = f"{prefix}-{stamp}"
+    # reuse the 1.x artifact writer, committed atomically: write the pair
+    # under a tmp prefix, then os.replace each file into place
+    tmp_prefix = snap + ".tmp"
+    save_checkpoint(tmp_prefix, epoch, symbol, arg, aux)
+    os.replace(f"{tmp_prefix}-symbol.json", f"{snap}-symbol.json")
+    os.replace(f"{tmp_prefix}-{epoch:04d}.params",
+               f"{snap}-{epoch:04d}.params")
+    owner = _opt_owner(mod)
+    states = {}
+    for n, st in owner._opt_states.items():
+        _flatten_opt_state(st, n, states)
+    if states:
+        _replace_committed(lambda p: nd.save(p, states),
+                           f"{snap}-{epoch:04d}.optstate.params")
+    opt = owner._optimizer
+    marker = {"epoch": epoch, "nbatch": nbatch, "stamp": stamp,
+              "num_update": int(opt.num_update),
+              "index_update_count": {str(k): int(v) for k, v in
+                                     opt._index_update_count.items()},
+              "has_optstate": bool(states)}
+    path = f"{prefix}-resume.json"
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(marker, f)
+    os.replace(tmp, path)
+    _prune_fit_snapshots(prefix, keep_stamp=stamp)
+
+
+def _load_fit_snapshot(mod, prefix, logger):
+    """Restore a preemption snapshot; (epoch, completed_batches) to resume
+    from, or None for a fresh start."""
+    path = f"{prefix}-resume.json"
+    if not os.path.exists(path):
+        logger.info("fit(resume=True): no snapshot at %r, training from "
+                    "scratch", path)
+        return None
+    with open(path) as f:
+        marker = json.load(f)
+    epoch = int(marker["epoch"])
+    snap = f"{prefix}-{marker['stamp']}" if marker.get("stamp") else prefix
+    _, arg, aux = load_checkpoint(snap, epoch)
+    mod.set_params(arg, aux)
+    owner = _opt_owner(mod)
+    if marker.get("has_optstate"):
+        payload = nd.load(f"{snap}-{epoch:04d}.optstate.params")
+        for n, st in owner._opt_states.items():
+            _assign_opt_state(st, n, payload)
+    opt = owner._optimizer
+    opt.num_update = int(marker["num_update"])
+    opt._index_update_count.update(
+        {int(k): int(v) for k, v in marker["index_update_count"].items()})
+    logger.info("fit(resume=True): resuming at epoch %d, batch %d "
+                "(num_update=%d)", epoch, marker["nbatch"],
+                opt.num_update)
+    return epoch, int(marker["nbatch"])
+
+
+def _clear_fit_snapshot(prefix):
+    try:
+        os.remove(f"{prefix}-resume.json")
+    except OSError:
+        pass
+    _prune_fit_snapshots(prefix)
 
 
 def _score_loop(mod, eval_data, eval_metric, num_batch=None):
@@ -597,9 +850,10 @@ class BucketingModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, num_epoch=1, batch_end_callback=None,
             epoch_end_callback=None, force_rebind=False, force_init=False,
-            prefetch=0):
+            prefetch=0, checkpoint_prefix=None, resume=False,
+            bad_batch_budget=0):
         """ref: BaseModule.fit routed through switch_bucket — same
-        signature as Module.fit."""
+        signature as Module.fit (incl. the fault-tolerance knobs)."""
         self._bind_from_iter(train_data, force_rebind)
         self.init_params(initializer=initializer, arg_params=arg_params,
                          aux_params=aux_params, allow_missing=allow_missing,
@@ -609,7 +863,9 @@ class BucketingModule:
                             force_init=force_init)
         _fit_loop(self, self._default_module.symbol, self._logger,
                   train_data, eval_data, eval_metric, num_epoch,
-                  batch_end_callback, epoch_end_callback, prefetch=prefetch)
+                  batch_end_callback, epoch_end_callback, prefetch=prefetch,
+                  checkpoint_prefix=checkpoint_prefix, resume=resume,
+                  bad_batch_budget=bad_batch_budget)
 
 
 # ---------------------------------------------------------------------------

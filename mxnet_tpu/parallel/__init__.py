@@ -20,12 +20,17 @@ from .sequence import ring_attention, sp_attention, ulysses_attention
 from .prefetch import DevicePrefetcher
 from .step import (EvalStep, TrainStep, add_transfer_hook,
                    remove_transfer_hook)
-from .checkpoint import (load_train_step, load_train_step_sharded,
+from .checkpoint import (CheckpointManager, CheckpointMismatchError,
+                         list_checkpoints,
+                         load_train_step, load_train_step_sharded,
+                         resume_latest,
                          save_train_step, save_train_step_sharded)
 
 __all__ = [
     "load_train_step", "save_train_step",
     "load_train_step_sharded", "save_train_step_sharded",
+    "CheckpointManager", "CheckpointMismatchError", "list_checkpoints",
+    "resume_latest",
     "AXES", "MeshScope", "current_mesh", "default_mesh", "make_mesh",
     "named_sharding", "replicated",
     "ShardingRules", "batch_spec", "fsdp_rules", "param_sharding",

@@ -21,14 +21,29 @@ test's contract (tests/test_checkpoint.py).
 from __future__ import annotations
 
 import json
+import logging
+import os
+import re as _re
 
 import numpy as np
 import jax
 
+from ..fault import fire as _fire
+
 __all__ = ["save_train_step", "load_train_step",
-           "save_train_step_sharded", "load_train_step_sharded"]
+           "save_train_step_sharded", "load_train_step_sharded",
+           "CheckpointManager", "CheckpointMismatchError",
+           "resume_latest", "list_checkpoints"]
 
 _MANIFEST = "__manifest__"
+_logger = logging.getLogger(__name__)
+
+
+class CheckpointMismatchError(ValueError):
+    """A checkpoint that READ fine does not MATCH the model (param
+    name/shape, aux, or optimizer disagreement).  Distinct from unreadable
+    (truncated/corrupt) files so recovery paths like ``resume_latest`` can
+    skip damage but refuse to paper over a user error."""
 
 
 def _norm_name(n):
@@ -68,9 +83,16 @@ def save_train_step(step, fname):
 
     Layout: ``p.<i>`` trainable param i (in ``step._train_idx`` order),
     ``s.<i>.<j>`` its j-th optimizer state array, ``a.<i>`` aux array i,
-    plus a JSON manifest with the param names for name-checked restore."""
+    plus a JSON manifest with the param names for name-checked restore.
+
+    Preemption-safe: the ``.npz`` payload lands in ``fname + '.tmp'`` and
+    is committed with ``os.replace`` (atomic on POSIX), so a crash at ANY
+    point leaves either the previous complete checkpoint or the new one —
+    never a truncated payload under the final name.  Manifest and payload
+    live in the one file, so they can never disagree."""
     if not step._built:
         raise ValueError("TrainStep has not run yet — nothing to checkpoint")
+    _fire("checkpoint.write")
     payload = {}
     for k, a in enumerate(step._train_arrays):
         payload[f"p.{k}"] = _to_host(step, a)
@@ -89,8 +111,11 @@ def save_train_step(step, fname):
     payload[_MANIFEST] = np.frombuffer(
         json.dumps(manifest).encode(), dtype=np.uint8)
     if jax.process_index() == 0:
-        with open(fname, "wb") as f:
+        tmp = fname + ".tmp"
+        with open(tmp, "wb") as f:
             np.savez(f, **payload)
+        _fire("checkpoint.replace")
+        os.replace(tmp, fname)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ckpt_save")
@@ -109,7 +134,7 @@ def load_train_step(step, fname):
     names = [step._names[i] for i in step._train_idx]
     saved_names = manifest["train_names"]
     if len(saved_names) != len(names):
-        raise ValueError(
+        raise CheckpointMismatchError(
             f"checkpoint/model mismatch: file has {len(saved_names)} "
             f"trainable params, model expects {len(names)}")
     # pair by natural order on both sides; counter-normalised names and
@@ -120,16 +145,20 @@ def load_train_step(step, fname):
         if _norm_name(saved_names[sk]) != _norm_name(names[wk]) or \
                 tuple(z[f"p.{sk}"].shape) != \
                 tuple(step._train_arrays[wk].shape):
-            raise ValueError(
+            raise CheckpointMismatchError(
                 f"checkpoint/model mismatch: saved param "
                 f"{saved_names[sk]!r} {z[f'p.{sk}'].shape} does not match "
                 f"model param {names[wk]!r} "
                 f"{tuple(step._train_arrays[wk].shape)}")
     if manifest["optimizer"] != type(step.optimizer).__name__:
-        raise ValueError(
+        raise CheckpointMismatchError(
             f"optimizer mismatch: checkpoint={manifest['optimizer']} "
             f"step={type(step.optimizer).__name__}")
 
+    # stage + validate the ENTIRE payload before touching the step: a
+    # raise from a truncated later section (aux, a state slot) must leave
+    # the step exactly as it was, so resume_latest can fall back to an
+    # older file — a half-restored step is worse than a failed load
     shard = [step._param_shardings[i] for i in step._train_idx]
     aux_shard = [step._param_shardings[i] for i in step._aux_idx]
     new_train = list(step._train_arrays)
@@ -139,12 +168,10 @@ def load_train_step(step, fname):
         new_states[wk] = tuple(
             jax.device_put(z[f"s.{sk}.{j}"], shard[wk])
             for j in range(manifest["state_counts"][sk]))
-    step._train_arrays = new_train
-    step._states = tuple(new_states)
     aux_names = [step._names[i] for i in step._aux_idx]
     saved_aux = manifest["aux_names"]
     if len(saved_aux) != len(aux_names):
-        raise ValueError(
+        raise CheckpointMismatchError(
             f"checkpoint/model mismatch: file has {len(saved_aux)} aux "
             f"arrays, model expects {len(aux_names)}")
     new_aux = list(step._aux_arrays)
@@ -152,16 +179,20 @@ def load_train_step(step, fname):
         if _norm_name(saved_aux[sk]) != _norm_name(aux_names[wk]) or \
                 tuple(z[f"a.{sk}"].shape) != \
                 tuple(step._aux_arrays[wk].shape):
-            raise ValueError(
+            raise CheckpointMismatchError(
                 f"checkpoint/model mismatch: saved aux {saved_aux[sk]!r} "
                 f"{z[f'a.{sk}'].shape} does not match model aux "
                 f"{aux_names[wk]!r} {tuple(step._aux_arrays[wk].shape)}")
         new_aux[wk] = jax.device_put(z[f"a.{sk}"], aux_shard[wk])
+    num_update = int(manifest["num_update"])
+
+    step._train_arrays = new_train
+    step._states = tuple(new_states)
     step._aux_arrays = new_aux
-    step._num_update = manifest["num_update"]
-    step.optimizer.num_update = step._num_update
+    step._num_update = num_update
+    step.optimizer.num_update = num_update
     import jax.numpy as jnp
-    step._t = jax.device_put(jnp.zeros((), jnp.int32) + step._num_update,
+    step._t = jax.device_put(jnp.zeros((), jnp.int32) + num_update,
                              step._repl)
 
 
@@ -355,3 +386,122 @@ def load_train_step_sharded(step, directory):
     import jax.numpy as jnp
     step._t = jax.device_put(jnp.zeros((), jnp.int32) + step._num_update,
                              step._repl)
+
+
+# ------------------------------------------------- retention / discovery --
+# Preemption-safe training needs more than one atomic write: periodic
+# snapshots (save_every_n_steps), bounded disk (keep-last-K), and a resume
+# path that discovers the newest LOADABLE checkpoint by itself — a
+# preempted VM restarts with nothing but the directory name.
+
+def list_checkpoints(directory, prefix="ckpt"):
+    """``(num_update, path)`` pairs for every ``<prefix>-<n>.npz`` in
+    ``directory``, ascending by step.  Orphan ``.tmp`` files (a crash
+    mid-write) are ignored — they were never committed."""
+    pat = _re.compile(_re.escape(prefix) + r"-(\d+)\.npz$")
+    out = []
+    if os.path.isdir(directory):
+        for name in os.listdir(directory):
+            m = pat.fullmatch(name)
+            if m:
+                out.append((int(m.group(1)), os.path.join(directory, name)))
+    return sorted(out)
+
+
+def resume_latest(step, directory, prefix="ckpt"):
+    """Restore the newest loadable checkpoint in ``directory`` into a
+    BUILT TrainStep; returns its ``num_update``, or None when the
+    directory holds no usable checkpoint (fresh start).
+
+    A checkpoint that cannot be READ (truncated zip, corrupt json,
+    truncated inner array — e.g. the process died while an external copy
+    was happening) is skipped with a warning and the next-older one is
+    tried: preemption recovery must not be wedged by one bad file.  A
+    checkpoint that reads fine but does not MATCH the model raises
+    ``CheckpointMismatchError`` — that is a user error, and silently
+    resuming an older file would hide it."""
+    if not step._built:
+        raise ValueError("build the TrainStep (run one step) before "
+                         "resume_latest")
+    for num_update, path in reversed(list_checkpoints(directory, prefix)):
+        try:
+            load_train_step(step, path)
+            return num_update
+        except CheckpointMismatchError:
+            raise
+        except Exception as exc:   # truncated/corrupt in ANY layer (zip,
+            # manifest json, inner .npy header): damage, not user error
+            _logger.warning("resume_latest: skipping unreadable checkpoint "
+                            "%s (%s)", path, exc)
+    return None
+
+
+class CheckpointManager:
+    """Periodic, retained, preemption-safe checkpoints for a TrainStep.
+
+    ``every_n_steps`` drives ``maybe_save()`` (call it after each step, or
+    hand ``callback.do_step_checkpoint(manager)`` to ``fit`` as a
+    batch-end callback); ``keep_last`` bounds disk by deleting the oldest
+    snapshots after each successful save.  Writes go through
+    ``save_train_step`` so every snapshot is atomic; stale ``.tmp`` orphans
+    from crashed writes are cleaned opportunistically.  Multi-process:
+    rank 0 writes and prunes, every rank synchronises inside
+    ``save_train_step``.
+    """
+
+    def __init__(self, step, directory, every_n_steps=0, keep_last=3,
+                 prefix="ckpt"):
+        self.step = step
+        self.directory = str(directory)
+        self.every_n_steps = int(every_n_steps)
+        self.keep_last = max(1, int(keep_last))
+        self.prefix = prefix
+        self._last_saved = None
+        if jax.process_index() == 0:
+            os.makedirs(self.directory, exist_ok=True)
+
+    def _fname(self, num_update):
+        return os.path.join(self.directory,
+                            f"{self.prefix}-{num_update:08d}.npz")
+
+    def save(self):
+        """Snapshot now; returns the committed path."""
+        n = int(self.step._num_update)
+        fname = self._fname(n)
+        save_train_step(self.step, fname)
+        self._last_saved = n
+        self._retain()
+        return fname
+
+    def maybe_save(self):
+        """Snapshot iff ``every_n_steps`` divides the step count (and this
+        step was not already saved); returns the path or None."""
+        n = int(self.step._num_update)
+        if self.every_n_steps <= 0 or n == 0 or n % self.every_n_steps:
+            return None
+        if self._last_saved == n:
+            return None
+        return self.save()
+
+    def checkpoints(self):
+        return list_checkpoints(self.directory, self.prefix)
+
+    def resume_latest(self):
+        """``resume_latest(step, directory)`` with this manager's step."""
+        return resume_latest(self.step, self.directory, self.prefix)
+
+    def _retain(self):
+        if jax.process_index() != 0:
+            return
+        cks = self.checkpoints()
+        for _, path in cks[:-self.keep_last]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        for name in os.listdir(self.directory):
+            if name.startswith(self.prefix + "-") and name.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(self.directory, name))
+                except OSError:
+                    pass

@@ -47,6 +47,7 @@ import numpy as np
 import jax
 
 from .. import profiler as _profiler
+from ..fault import fire as _fire, with_context as _with_context
 from ..ndarray import NDArray
 from .step import _put_batch
 
@@ -119,11 +120,14 @@ class DevicePrefetcher:
     def _produce(self, it, q, stop):
         while not stop.is_set():
             try:
+                _fire("prefetch.device_put")
                 item = _map_leaves(self._put, next(it))
             except StopIteration:
                 item = self._STOP
-            except Exception as exc:  # re-raised on the consumer side
-                item = exc
+            except Exception as exc:  # re-raised on the consumer side,
+                # tagged as placement-thread provenance (the consumer's
+                # traceback otherwise points at the blameless q.get)
+                item = _with_context(exc, "DevicePrefetcher producer")
             t0 = time.perf_counter()
             enqueued = False
             while not stop.is_set():

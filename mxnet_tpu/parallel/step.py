@@ -25,6 +25,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import random as _random
 from .. import autograd as _autograd
+from ..fault import fire as _fire
+from .. import profiler as _profiler
 from ..profiler import scope as _pscope
 from ..ndarray import NDArray
 from ..gluon.block import Block, _flatten_nd, _unflatten_nd
@@ -103,7 +105,8 @@ class TrainStep:
     """Compiled (params, states, batch) → (params', states', loss) on a mesh."""
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
-                 data_spec=None, loss_reduce="mean", donate_batch=False):
+                 data_spec=None, loss_reduce="mean", donate_batch=False,
+                 skip_nonfinite=False, nonfinite_budget=10):
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -118,6 +121,20 @@ class TrainStep:
         # (DevicePrefetcher feed) — NOT when the caller re-steps the same
         # arrays (bench-style loops).
         self._donate_batch = bool(donate_batch)
+        # skip_nonfinite=True guards the update with a fused all-finite
+        # check over loss+grads INSIDE the compiled program: a NaN/Inf
+        # batch leaves params, optimizer state, aux state and the step
+        # counter untouched (the update is a select, not a branch — no
+        # retrace, no host round-trip beyond the verdict scalar).  After
+        # ``nonfinite_budget`` CONSECUTIVE skips the step aborts with a
+        # diagnostic instead of silently treading water while the run
+        # diverges; ``nonfinite_budget=None`` disables the abort.
+        self._skip_nonfinite = bool(skip_nonfinite)
+        self._nonfinite_budget = nonfinite_budget
+        self.skipped_steps = 0
+        self.consecutive_skips = 0
+        self._skip_counter = _profiler.Counter(
+            None, "TrainStep::nonfinite_skips")
         self._built = False
         self._jit = None
         self._num_update = optimizer.begin_num_update
@@ -232,7 +249,23 @@ class TrainStep:
             # aux_states path in cached_op.cc)
             mut_map = {i: v for (i, _), v in zip(state_holder.mutated, mut)}
             new_aux = [mut_map.get(i, a) for i, a in zip(aux_idx, aux_arrays)]
-            return new_train, new_aux, tuple(new_states), t1, loss
+            if not self._skip_nonfinite:
+                return new_train, new_aux, tuple(new_states), t1, loss
+            # fused all-finite guard: one reduction over loss+grads, then
+            # every state transition becomes a select against it.  XLA
+            # fuses the isfinite/and tree into the backward pass; a bad
+            # batch costs the same step wall-clock as a good one.
+            finite = jnp.all(jnp.isfinite(loss))   # scalar even when
+            for g in grads:                        # loss_reduce="none"
+                finite = jnp.logical_and(finite,
+                                         jnp.all(jnp.isfinite(g)))
+            keep = lambda new, old: jnp.where(finite, new, old)  # noqa: E731
+            new_train = [keep(n, o) for n, o in zip(new_train, train_arrays)]
+            new_states = [tuple(keep(n, o) for n, o in zip(ns, os))
+                          for ns, os in zip(new_states, states)]
+            new_aux = [keep(n, o) for n, o in zip(new_aux, aux_arrays)]
+            t1 = jnp.where(finite, t1, t)
+            return new_train, new_aux, tuple(new_states), t1, loss, finite
 
         train_sh = [self._param_shardings[i] for i in train_idx]
         aux_sh = [self._param_shardings[i] for i in aux_idx]
@@ -242,6 +275,8 @@ class TrainStep:
         in_sh = (train_sh, aux_sh, state_sh, self._repl, self._repl,
                  self._repl)
         out_sh = (train_sh, aux_sh, state_sh, self._repl, self._repl)
+        if self._skip_nonfinite:
+            out_sh = out_sh + (self._repl,)
         donate = (0, 1, 2)
         if self._donate_batch:
             # batch leaves sit after (train, aux, states, t, key, lr)
@@ -261,6 +296,7 @@ class TrainStep:
             return self._step(data, label)
 
     def _step(self, data, label):
+        _fire("step")
         data, label = _coerce_arrays(data), _coerce_arrays(label)
         data_args = data if isinstance(data, (tuple, list)) else (data,)
         data_args = tuple(data_args)
@@ -303,9 +339,37 @@ class TrainStep:
             self._fresh_jit = False
         else:
             out = self._jit(*args)
-        (self._train_arrays, self._aux_arrays, self._states, self._t,
-         loss) = out
-        self._num_update += 1
+        if self._skip_nonfinite:
+            (self._train_arrays, self._aux_arrays, self._states, self._t,
+             loss, finite) = out
+            # the verdict is the one host round-trip the guard costs; the
+            # arrays themselves stay async on the mesh
+            if bool(finite):
+                self._num_update += 1
+                self.consecutive_skips = 0
+            else:
+                self.skipped_steps += 1
+                self.consecutive_skips += 1
+                self._skip_counter.increment()
+                budget = self._nonfinite_budget
+                if budget is not None and self.consecutive_skips >= budget:
+                    try:
+                        lv = float(np.asarray(loss))
+                    except Exception:
+                        lv = float("nan")
+                    raise RuntimeError(
+                        f"TrainStep: {self.consecutive_skips} consecutive "
+                        f"non-finite updates (budget {budget}) at "
+                        f"num_update={self._num_update}; last loss={lv}. "
+                        f"Params and optimizer state are unchanged since the "
+                        f"last finite step — check the input pipeline for "
+                        f"corrupt batches or lower the learning rate "
+                        f"(skipped {self.skipped_steps} steps total this "
+                        f"run)")
+        else:
+            (self._train_arrays, self._aux_arrays, self._states, self._t,
+             loss) = out
+            self._num_update += 1
         self.optimizer.num_update = self._num_update
         return NDArray(loss)
 

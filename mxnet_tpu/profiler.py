@@ -25,7 +25,7 @@ import time
 
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "reset", "Task", "Frame", "Event", "Counter",
-           "Marker", "scope"]
+           "Marker", "scope", "counter_value"]
 
 _lock = threading.Lock()
 
@@ -255,6 +255,19 @@ class Event(Task):
         self._cat = "event"
 
 
+_COUNTERS = {}   # name -> most recent Counter instance (see counter_value)
+
+
+def counter_value(name, default=None):
+    """Current value of the most recently created Counter named ``name``,
+    or ``default`` when none exists.  Values track regardless of profiler
+    state (only trace EMISSION is gated on ACTIVE), so health counters
+    like ``TrainStep::nonfinite_skips`` are readable in production runs
+    with the profiler off."""
+    c = _COUNTERS.get(name)
+    return default if c is None else c._value
+
+
 class Counter:
     """Numeric counter series (ref: profiler.Counter)."""
 
@@ -262,6 +275,7 @@ class Counter:
         self.name = (name if domain is None
                      else f"{getattr(domain, 'name', domain)}::{name}")
         self._value = value
+        _COUNTERS[self.name] = self
 
     def _emit(self):
         if not ACTIVE:

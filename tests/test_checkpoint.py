@@ -8,9 +8,12 @@ import pytest
 import jax
 
 import mxnet_tpu as mx
-from mxnet_tpu import gluon, parallel
+from mxnet_tpu import fault, gluon, parallel
 from mxnet_tpu.gluon import nn
-from mxnet_tpu.parallel.checkpoint import save_train_step, load_train_step
+from mxnet_tpu.parallel.checkpoint import (CheckpointManager,
+                                           list_checkpoints,
+                                           resume_latest,
+                                           save_train_step, load_train_step)
 
 
 def _net(seed):
@@ -234,3 +237,203 @@ def test_sharded_v2_state_slot_mismatch_raises(tmp_path):
     sB(*_batches(1)[0])
     with pytest.raises(ValueError, match="state slots"):
         load_train_step_sharded(sB, d)
+
+
+# ------------------------------------------------------- fault tolerance --
+# ISSUE 2: preemption-safe checkpoints — atomic payloads, keep-last-K
+# retention, resume_latest auto-discovery, and deterministic kill-and-
+# resume via the fault-injection harness.
+
+chaos = pytest.mark.chaos
+
+
+@chaos
+def test_atomic_payload_crash_mid_write_keeps_previous(tmp_path):
+    """A crash after the temp payload is written but before os.replace
+    commits it must leave the previous checkpoint intact and loadable —
+    the manifest+payload live in one file, so they can never disagree."""
+    f = str(tmp_path / "ckpt.npz")
+    batches = _batches(4, seed=9)
+    step = _step_for(_net(3))
+    for x, y in batches[:2]:
+        step(x, y)
+    save_train_step(step, f)
+    good = os.path.getmtime(f)
+    at_save = [np.asarray(a).copy() for a in step._train_arrays]
+
+    for x, y in batches[2:]:
+        step(x, y)
+    with fault.inject("checkpoint.replace", OSError("killed mid-write")):
+        with pytest.raises(OSError):
+            save_train_step(step, f)
+    assert os.path.exists(f + ".tmp")        # orphan from the dead write
+    assert os.path.getmtime(f) == good       # committed file untouched
+
+    step2 = _step_for(_net(44))
+    step2(*batches[0])
+    load_train_step(step2, f)                # previous checkpoint loads
+    for b, a in zip(at_save, step2._train_arrays):
+        np.testing.assert_array_equal(b, np.asarray(a))
+
+
+@chaos
+def test_checkpoint_write_point_fires_before_io(tmp_path):
+    f = str(tmp_path / "never.npz")
+    step = _step_for(_net(3))
+    step(*_batches(1)[0])
+    with fault.inject("checkpoint.write", RuntimeError("preempted")):
+        with pytest.raises(RuntimeError):
+            save_train_step(step, f)
+    assert not os.path.exists(f) and not os.path.exists(f + ".tmp")
+
+
+def test_manager_every_n_and_retention(tmp_path):
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    mgr = CheckpointManager(step, d, every_n_steps=2, keep_last=2)
+    for x, y in _batches(7, seed=2):
+        step(x, y)
+        mgr.maybe_save()
+    # saves landed at steps 2, 4, 6; keep_last=2 pruned step 2
+    assert [n for n, _ in mgr.checkpoints()] == [4, 6]
+    assert mgr.maybe_save() is None          # step 7: not on cadence
+
+
+def test_manager_cleans_orphan_tmp(tmp_path):
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=2)
+    step(*_batches(1)[0])
+    mgr.save()
+    orphan = os.path.join(d, mgr.prefix + "-junk.npz.tmp")
+    with open(orphan, "wb") as f:
+        f.write(b"dead write")
+    step(*_batches(1, seed=4)[0])
+    mgr.save()
+    assert not os.path.exists(orphan)
+
+
+def test_resume_latest_empty_dir_returns_none(tmp_path):
+    step = _step_for(_net(3))
+    step(*_batches(1)[0])
+    assert resume_latest(step, str(tmp_path / "nope")) is None
+
+
+def test_resume_latest_skips_unreadable_newest(tmp_path):
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=3)
+    batches = _batches(3, seed=6)
+    for x, y in batches:
+        step(x, y)
+        mgr.maybe_save()
+    # newest file is truncated garbage (e.g. died while being copied off)
+    newest = mgr.checkpoints()[-1][1]
+    with open(newest, "wb") as f:
+        f.write(b"PK\x03\x04 not really a zip")
+
+    step2 = _step_for(_net(44))
+    step2(*batches[0])
+    assert resume_latest(step2, d) == 2      # fell back to the older one
+
+
+def test_resume_latest_model_mismatch_raises(tmp_path):
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    step(*_batches(1)[0])
+    CheckpointManager(step, d, every_n_steps=1).save()
+
+    other = nn.HybridSequential()
+    other.add(nn.Dense(3, in_units=8))
+    other.initialize()
+    s2 = _step_for(other)
+    s2(np.random.randn(16, 8).astype(np.float32),
+       np.random.randint(0, 3, (16,)))
+    with pytest.raises(ValueError):          # user error — never silent
+        resume_latest(s2, d)
+
+
+@chaos
+def test_kill_and_resume_via_inject_bit_exact(tmp_path):
+    """The acceptance contract: crash mid-run via fault.inject, rediscover
+    with resume_latest, and the loss trajectory matches an uninterrupted
+    run bit-exactly."""
+    d = str(tmp_path / "ckpts")
+    batches = _batches(8, seed=1)
+
+    ref_step = _step_for(_net(7))
+    ref = [float(ref_step(x, y).asnumpy()) for x, y in batches]
+
+    step1 = _step_for(_net(7))
+    mgr = CheckpointManager(step1, d, every_n_steps=2, keep_last=2)
+    with fault.inject("step", RuntimeError("preempted"), after_n=5) as h:
+        with pytest.raises(RuntimeError, match="preempted"):
+            for x, y in batches:
+                step1(x, y)
+                mgr.maybe_save()
+    assert h.fired == 1
+    del step1, mgr
+
+    step2 = _step_for(_net(99))              # different init — must not matter
+    step2(*batches[0])                       # build (one step to compile)
+    n = resume_latest(step2, d)
+    assert n == 4                            # newest snapshot on the cadence
+    resumed = [float(step2(x, y).asnumpy()) for x, y in batches[n:]]
+    np.testing.assert_array_equal(np.array(resumed), np.array(ref[n:]))
+
+
+def test_resume_latest_skips_truncated_inner_array(tmp_path):
+    """Outer zip valid, inner .npy member truncated (process died while
+    the file was being copied): np.load raises ValueError mid-parse — that
+    is damage, not a model mismatch, and must fall back to the older
+    snapshot instead of wedging recovery."""
+    import zipfile
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=3)
+    batches = _batches(3, seed=8)
+    for x, y in batches:
+        step(x, y)
+        mgr.maybe_save()
+    newest = mgr.checkpoints()[-1][1]
+    with zipfile.ZipFile(newest) as z:
+        members = {n: z.read(n) for n in z.namelist()}
+    big = max(members, key=lambda n: len(members[n]))
+    members[big] = members[big][:len(members[big]) // 2]  # torn payload
+    with zipfile.ZipFile(newest, "w") as z:
+        for n, blob in members.items():
+            z.writestr(n, blob)
+
+    step2 = _step_for(_net(44))
+    step2(*batches[0])
+    assert resume_latest(step2, d) == 2      # skipped 3, restored 2
+
+
+def test_failed_load_leaves_step_untouched(tmp_path):
+    """A checkpoint whose params read fine but whose aux section is torn
+    must not half-restore: the step keeps its previous state so training
+    (or a fresh start after resume_latest -> None) stays consistent."""
+    import zipfile
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=1)
+    step(*_batches(1, seed=8)[0])
+    mgr.save()
+    only = mgr.checkpoints()[-1][1]
+    with zipfile.ZipFile(only) as z:
+        members = {n: z.read(n) for n in z.namelist()}
+    for n in list(members):
+        if n.startswith("a."):                   # tear every aux member
+            members[n] = members[n][:10]
+    with zipfile.ZipFile(only, "w") as z:
+        for n, blob in members.items():
+            z.writestr(n, blob)
+
+    step2 = _step_for(_net(44))
+    step2(*_batches(1, seed=8)[0])
+    params = [np.asarray(a).copy() for a in step2._train_arrays]
+    n_before = step2._num_update
+    assert resume_latest(step2, d) is None       # nothing loadable
+    for b, a in zip(params, step2._train_arrays):
+        np.testing.assert_array_equal(b, np.asarray(a))
+    assert step2._num_update == n_before

@@ -1,0 +1,668 @@
+"""Fault-tolerance runtime (ISSUE 2): injection harness mechanics, the
+TrainStep non-finite guard, Module.fit preemption/bad-batch handling,
+producer provenance + thread hygiene, and retrying distributed bring-up.
+
+The chaos marker tags the tests that arm `fault.inject` points or raise
+real signals — they run in tier-1 (fast, deterministic), the marker only
+exists so `pytest -m chaos` can run the injection suite alone."""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, gluon, parallel
+from mxnet_tpu.gluon import nn
+
+chaos = pytest.mark.chaos
+
+
+# ------------------------------------------------------- inject mechanics --
+def test_fire_is_noop_when_unarmed():
+    fault.fire("step")  # nothing armed: must not raise
+    assert fault.points() == []
+
+
+def test_inject_after_n_and_times():
+    with fault.inject("p", RuntimeError, after_n=2, times=2) as h:
+        fault.fire("p")
+        fault.fire("p")          # first two pass through
+        with pytest.raises(RuntimeError):
+            fault.fire("p")
+        with pytest.raises(RuntimeError):
+            fault.fire("p")
+        fault.fire("p")          # times=2 exhausted: passes again
+        assert h.calls == 5 and h.fired == 2
+    assert fault.points() == []  # disarmed on exit
+
+
+def test_inject_instance_and_nesting():
+    err = ValueError("boom")
+    with fault.inject("p", err):
+        with fault.inject("p", KeyError):       # inner shadows outer
+            with pytest.raises(KeyError):
+                fault.fire("p")
+        with pytest.raises(ValueError) as ei:   # outer restored
+            fault.fire("p")
+        assert ei.value is err
+    assert fault.points() == []
+
+
+def test_inject_rejects_non_exception():
+    with pytest.raises(TypeError):
+        fault.inject("p", "not an error")
+
+
+def test_fire_thread_safe_counting():
+    with fault.inject("p", RuntimeError, after_n=10**9) as h:  # never fires
+        def hammer():
+            for _ in range(200):
+                fault.fire("p")
+        ts = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert h.calls == 800 and h.fired == 0
+
+
+# ---------------------------------------------------------------- retry --
+def test_retry_call_succeeds_after_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("not yet")
+        return "ok"
+
+    seen = []
+    out = fault.retry_call(flaky, retries=4, base_delay=0.001,
+                           on_retry=lambda a, d, e: seen.append((a, d)))
+    assert out == "ok" and len(calls) == 3
+    assert [a for a, _ in seen] == [1, 2]
+    assert all(d > 0 for _, d in seen)
+
+
+def test_retry_call_exhausts_and_reraises():
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        fault.retry_call(always, retries=2, base_delay=0.001)
+
+
+def test_retry_call_deadline_cuts_short():
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        fault.retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                         retries=50, base_delay=0.05, deadline=0.15)
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_retry_call_only_retries_listed_types():
+    def raises_value_error():
+        raise ValueError("no retry for me")
+
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raises_value_error()
+
+    with pytest.raises(ValueError):
+        fault.retry_call(fn, retries=5, base_delay=0.001, retry_on=(OSError,))
+    assert len(calls) == 1
+
+
+# ---------------------------------------------------------- with_context --
+def test_with_context_preserves_type_and_tags():
+    exc = ValueError("decode failed")
+    out = fault.with_context(exc, "worker 3")
+    assert isinstance(out, ValueError)
+    assert "worker 3" in str(out) and "decode failed" in str(out)
+    assert out.fault_context == ["worker 3"]
+    out2 = fault.with_context(out, "stage 2")
+    assert out2.fault_context == ["worker 3", "stage 2"]
+
+
+# ---------------------------------------------------------- GracefulExit --
+@chaos
+def test_graceful_exit_latches_sigterm():
+    before = signal.getsignal(signal.SIGTERM)
+    with fault.GracefulExit() as g:
+        assert g.enabled and not g.requested
+        signal.raise_signal(signal.SIGTERM)
+        assert g.requested and g.signum == signal.SIGTERM
+        assert bool(g)
+    assert signal.getsignal(signal.SIGTERM) is before  # restored
+
+
+@chaos
+def test_graceful_exit_second_signal_escalates():
+    with fault.GracefulExit(signals=(signal.SIGTERM,)) as g:
+        signal.raise_signal(signal.SIGTERM)
+        assert g.requested
+        with pytest.raises(KeyboardInterrupt):  # SIG_DFL prev → escalate
+            signal.raise_signal(signal.SIGTERM)
+
+
+def test_graceful_exit_inert_off_main_thread():
+    out = {}
+
+    def run():
+        with fault.GracefulExit() as g:
+            out["enabled"] = g.enabled
+
+    t = threading.Thread(target=run)
+    t.start()
+    t.join()
+    assert out["enabled"] is False
+
+
+def test_graceful_exit_disabled():
+    before = signal.getsignal(signal.SIGINT)
+    with fault.GracefulExit(enabled=False) as g:
+        assert not g.enabled
+        assert signal.getsignal(signal.SIGINT) is before  # untouched
+
+
+# ------------------------------------------------- TrainStep NaN guards --
+def _net(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+def _guarded_step(seed=7, budget=3):
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    return parallel.TrainStep(
+        _net(seed), gluon.loss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.create("adam"), mesh=mesh,
+        skip_nonfinite=True, nonfinite_budget=budget)
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(16, 8).astype(np.float32),
+             rng.randint(0, 4, (16,))) for _ in range(n)]
+
+
+def _nan_batch():
+    x, y = _batches(1, seed=5)[0]
+    x[0, 0] = np.nan
+    return x, y
+
+
+@chaos
+def test_nan_batch_leaves_params_and_opt_state_unchanged():
+    step = _guarded_step()
+    for x, y in _batches(3):
+        step(x, y)
+    params = [np.asarray(a).copy() for a in step._train_arrays]
+    states = [[np.asarray(s).copy() for s in ss] for ss in step._states]
+    n_before = step._num_update
+    t_before = int(np.asarray(step._t))
+
+    loss = step(*_nan_batch())
+    assert not np.isfinite(float(loss.asnumpy()))
+    for b, a in zip(params, step._train_arrays):
+        np.testing.assert_array_equal(b, np.asarray(a))
+    for bs, as_ in zip(states, step._states):
+        for b, a in zip(bs, as_):
+            np.testing.assert_array_equal(b, np.asarray(a))
+    assert step._num_update == n_before
+    assert int(np.asarray(step._t)) == t_before
+    assert step.skipped_steps == 1 and step.consecutive_skips == 1
+
+    # the skip is visible as a health counter even with the profiler off
+    from mxnet_tpu import profiler
+    assert profiler.counter_value("TrainStep::nonfinite_skips") >= 1
+
+
+@chaos
+def test_nan_skip_trajectory_matches_clean_run():
+    """A skipped batch must be a true no-op: the guarded run's losses on
+    good batches equal a run that never saw the NaN batch at all."""
+    batches = _batches(6, seed=2)
+    ref_step = _guarded_step(seed=11)
+    ref = [float(ref_step(x, y).asnumpy()) for x, y in batches]
+
+    step = _guarded_step(seed=11)
+    got = []
+    for i, (x, y) in enumerate(batches):
+        if i == 3:
+            step(*_nan_batch())  # poison mid-run, must not perturb
+        got.append(float(step(x, y).asnumpy()))
+    np.testing.assert_array_equal(np.array(got), np.array(ref))
+    assert step.skipped_steps == 1
+
+
+@chaos
+def test_consecutive_skip_budget_aborts():
+    step = _guarded_step(budget=3)
+    step(*_batches(1)[0])
+    bad = _nan_batch()
+    step(bad[0], bad[1])
+    step(bad[0], bad[1])
+    with pytest.raises(RuntimeError, match="consecutive non-finite"):
+        step(bad[0], bad[1])
+    assert step.consecutive_skips == 3
+
+
+@chaos
+def test_finite_step_resets_consecutive_budget():
+    step = _guarded_step(budget=2)
+    good = _batches(1)[0]
+    bad = _nan_batch()
+    step(*good)
+    step(*bad)
+    step(*good)      # resets the consecutive counter
+    step(*bad)       # 1 consecutive again — under budget
+    assert step.skipped_steps == 2 and step.consecutive_skips == 1
+
+
+@chaos
+def test_budget_none_never_aborts():
+    step = _guarded_step(budget=None)
+    bad = _nan_batch()
+    step(*_batches(1)[0])
+    for _ in range(6):
+        step(*bad)
+    assert step.skipped_steps == 6
+
+
+# ---------------------------------------------- step injection point  --
+@chaos
+def test_step_injection_point():
+    step = _guarded_step()
+    batches = _batches(4)
+    with fault.inject("step", RuntimeError("preempted"), after_n=2) as h:
+        step(*batches[0])
+        step(*batches[1])
+        with pytest.raises(RuntimeError, match="preempted"):
+            step(*batches[2])
+    assert h.fired == 1
+    step(*batches[3])  # disarmed: trains again
+
+
+# ------------------------------------------ producer provenance/hygiene --
+def _thread_names():
+    return [t.name for t in threading.enumerate()]
+
+
+@chaos
+def test_prefetching_iter_producer_context_and_join():
+    it = mx.io.NDArrayIter(np.zeros((64, 4), np.float32),
+                           np.zeros((64,), np.float32), batch_size=8)
+    pf = mx.io.PrefetchingIter(it, capacity=2)
+    with fault.inject("io.producer", ValueError("decode error"), after_n=2):
+        pf.next()
+        pf.next()
+        with pytest.raises(ValueError) as ei:
+            for _ in range(8):
+                pf.next()
+    assert "PrefetchingIter producer, iter 0" in str(ei.value)
+    assert ei.value.fault_context
+    # producers joined — no leaked threads — but NOT closed: a transient
+    # error is recoverable, reset() retries the epoch
+    assert "PrefetchingIter-producer" not in _thread_names()
+    assert not pf._closed
+    pf.reset()
+    assert pf.next() is not None
+    pf.close()
+
+
+@chaos
+def test_device_prefetcher_injection_context_and_join():
+    from mxnet_tpu.parallel.prefetch import DevicePrefetcher
+
+    def gen():
+        for _ in range(8):
+            yield np.zeros((8, 4), np.float32)
+
+    with fault.inject("prefetch.device_put", OSError("xfer failed"),
+                      after_n=2):
+        with pytest.raises(OSError) as ei:
+            with DevicePrefetcher(gen(), depth=2) as feed:
+                for _ in feed:
+                    pass
+    assert "DevicePrefetcher producer" in str(ei.value)
+    assert not any("DevicePrefetcher" in t.name
+                   for t in threading.enumerate())
+
+
+@chaos
+def test_dataloader_worker_error_context_and_teardown():
+    class BadDataset:
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            if i == 19:
+                raise ValueError(f"corrupt sample {i}")
+            return np.zeros(3, np.float32), np.float32(0)
+
+    loader = gluon.data.DataLoader(BadDataset(), batch_size=8,
+                                   num_workers=2, thread_pool=True)
+    with pytest.raises(ValueError) as ei:
+        for _ in loader:
+            pass
+    assert "DataLoader worker, batch 2" in str(ei.value)
+    assert loader._closed  # pool torn down — no leaked workers
+
+
+# ------------------------------------------------ Module.fit bad batches --
+def _fit_sym():
+    data = mx.sym.Variable("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    return mx.sym.SoftmaxOutput(fc, name="softmax")
+
+
+def _fit_iter(n=6, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n * 16, 8).astype(np.float32)
+    Y = rng.randint(0, 4, (n * 16,)).astype(np.float32)
+    return mx.io.NDArrayIter(X, Y, batch_size=16, label_name="softmax_label")
+
+
+class _FlakyIter(mx.io.DataIter):
+    """Wraps an iterator; raises on the given (0-based) batch indices."""
+
+    def __init__(self, base, bad_at):
+        super().__init__(base.batch_size)
+        self._base = base
+        self._bad_at = set(bad_at)
+        self._i = 0
+
+    @property
+    def provide_data(self):
+        return self._base.provide_data
+
+    @property
+    def provide_label(self):
+        return self._base.provide_label
+
+    def reset(self):
+        self._base.reset()
+        self._i = 0
+
+    def next(self):
+        i, self._i = self._i, self._i + 1
+        batch = self._base.next()       # consume even when poisoned
+        if i in self._bad_at:
+            raise ValueError(f"decode failure at batch {i}")
+        return batch
+
+
+@chaos
+def test_fit_bad_batch_budget_continues():
+    mx.random.seed(3)
+    mod = mx.mod.Module(_fit_sym(), context=mx.cpu())
+    seen = []
+    mod.fit(_FlakyIter(_fit_iter(), bad_at={2, 4}), optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1),), eval_metric="acc",
+            num_epoch=1, bad_batch_budget=2,
+            batch_end_callback=lambda p: seen.append(p.nbatch))
+    assert len(seen) == 4  # 6 batches, 2 skipped
+
+
+@chaos
+def test_fit_bad_batch_budget_exhausted_raises():
+    mx.random.seed(3)
+    mod = mx.mod.Module(_fit_sym(), context=mx.cpu())
+    with pytest.raises(ValueError, match="decode failure"):
+        mod.fit(_FlakyIter(_fit_iter(), bad_at={1, 2}), optimizer="sgd",
+                eval_metric="acc", num_epoch=1, bad_batch_budget=1)
+
+
+@chaos
+def test_fit_bad_batch_budget_with_prefetch_rewraps():
+    """A producer failure closes the PrefetchingIter (thread hygiene); the
+    budgeted path re-wraps the still-open base iterator and the epoch
+    finishes — with no producer threads left behind."""
+    mx.random.seed(3)
+    mod = mx.mod.Module(_fit_sym(), context=mx.cpu())
+    seen = []
+    mod.fit(_FlakyIter(_fit_iter(), bad_at={2}), optimizer="sgd",
+            eval_metric="acc", num_epoch=1, prefetch=2, bad_batch_budget=1,
+            batch_end_callback=lambda p: seen.append(p.nbatch))
+    assert len(seen) == 5
+    assert "PrefetchingIter-producer" not in _thread_names()
+
+
+# ------------------------------------------- Module.fit preemption/resume --
+def _train_fit(prefix, resume=False, kill_at=None, num_epoch=2):
+    mx.random.seed(3)
+    mod = mx.mod.Module(_fit_sym(), context=mx.cpu())
+    seen = []
+
+    def cb(p):
+        seen.append((p.epoch, p.nbatch))
+        if kill_at is not None and (p.epoch, p.nbatch) == kill_at:
+            signal.raise_signal(signal.SIGTERM)
+
+    mod.fit(_fit_iter(n=8), optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.1), ("momentum", 0.9)),
+            eval_metric="acc", num_epoch=num_epoch, batch_end_callback=cb,
+            checkpoint_prefix=prefix, resume=resume)
+    return mod, seen
+
+
+@chaos
+def test_fit_sigterm_snapshots_and_resumes_bit_exact(tmp_path):
+    ref_mod, _ = _train_fit(str(tmp_path / "ref"))
+    ref_arg, _ = ref_mod.get_params()
+
+    prefix = str(tmp_path / "ck")
+    _, seen1 = _train_fit(prefix, kill_at=(1, 2))       # preempted mid-epoch
+    assert seen1[-1] == (1, 2)
+    assert os.path.exists(prefix + "-resume.json")
+
+    mod2, seen2 = _train_fit(prefix, resume=True)       # picks up at (1, 3)
+    assert seen2[0] == (1, 3)
+    arg2, _ = mod2.get_params()
+    for k in ref_arg:
+        np.testing.assert_array_equal(ref_arg[k].asnumpy(),
+                                      arg2[k].asnumpy())
+    # completed run clears the marker; resume now starts from scratch
+    assert not os.path.exists(prefix + "-resume.json")
+
+
+@chaos
+def test_fit_resume_without_snapshot_trains_from_scratch(tmp_path):
+    prefix = str(tmp_path / "fresh")
+    mod, seen = _train_fit(prefix, resume=True, num_epoch=1)
+    assert seen[0] == (0, 0)
+
+
+def test_fit_resume_requires_prefix():
+    mod = mx.mod.Module(_fit_sym(), context=mx.cpu())
+    with pytest.raises(ValueError, match="checkpoint_prefix"):
+        mod.fit(_fit_iter(), optimizer="sgd", eval_metric="acc",
+                num_epoch=1, resume=True)
+
+
+# --------------------------------------------------- distributed bring-up --
+def test_distributed_init_validates_process_id():
+    with pytest.raises(ValueError, match="process_id=5"):
+        mx.distributed.init(coordinator="127.0.0.1:9999",
+                            num_processes=2, process_id=5)
+
+
+@chaos
+def test_distributed_init_retries_with_backoff():
+    attempts = []
+    with fault.inject("distributed.connect", OSError("conn refused")) as h:
+        with pytest.raises(OSError, match="conn refused"):
+            mx.distributed.init(coordinator="127.0.0.1:9999",
+                                num_processes=2, process_id=0,
+                                retries=2, timeout=30, backoff_base=0.01)
+    assert h.calls == 3  # 1 try + 2 retries
+    assert not mx.distributed._initialized
+
+
+@chaos
+def test_distributed_init_dmlc_retry_env(monkeypatch):
+    monkeypatch.setenv("DMLC_RETRY", "1")
+    monkeypatch.setenv("DMLC_INIT_TIMEOUT", "30")
+    with fault.inject("distributed.connect", OSError("refused")) as h:
+        with pytest.raises(OSError):
+            mx.distributed.init(coordinator="127.0.0.1:9999",
+                                num_processes=2, process_id=0,
+                                backoff_base=0.01)
+    assert h.calls == 2  # 1 try + DMLC_RETRY=1 retry
+
+
+@chaos
+def test_fit_double_preemption_same_epoch(tmp_path):
+    """Preempted twice inside the same epoch: the second snapshot rewrites
+    the epoch-tagged payload files (atomically) and the final resume still
+    lands bit-exact on the uninterrupted trajectory."""
+    ref_mod, _ = _train_fit(str(tmp_path / "ref"))
+    ref_arg, _ = ref_mod.get_params()
+
+    prefix = str(tmp_path / "ck")
+    _train_fit(prefix, kill_at=(1, 1))
+    _, seen = _train_fit(prefix, resume=True, kill_at=(1, 4))
+    assert seen[0] == (1, 2) and seen[-1] == (1, 4)
+    mod3, seen3 = _train_fit(prefix, resume=True)
+    assert seen3[0] == (1, 5)
+    arg3, _ = mod3.get_params()
+    for k in ref_arg:
+        np.testing.assert_array_equal(ref_arg[k].asnumpy(),
+                                      arg3[k].asnumpy())
+
+
+@chaos
+def test_fit_signal_after_final_batch_completes_and_clears_marker(tmp_path):
+    """A signal landing after the last batch (during epoch-end work) must
+    not leave a stale resume marker behind — the run did complete, and a
+    later fit(resume=True) must start fresh, not rewind."""
+    prefix = str(tmp_path / "ck")
+    mx.random.seed(3)
+    mod = mx.mod.Module(_fit_sym(), context=mx.cpu())
+    mod.fit(_fit_iter(n=4), optimizer="sgd", eval_metric="acc", num_epoch=1,
+            checkpoint_prefix=prefix,
+            epoch_end_callback=lambda *a: signal.raise_signal(signal.SIGTERM))
+    assert not os.path.exists(prefix + "-resume.json")
+    _, seen = _train_fit(prefix, resume=True, num_epoch=1)
+    assert seen[0] == (0, 0)                 # fresh start, no rewind
+
+
+def test_with_context_preserves_oserror_attrs():
+    import errno as _errno
+    exc = FileNotFoundError(_errno.ENOENT, "No such file", "img123.jpg")
+    out = fault.with_context(exc, "DataLoader worker, batch 3")
+    assert isinstance(out, FileNotFoundError)
+    assert out.errno == _errno.ENOENT
+    assert out.filename == "img123.jpg"
+
+
+@chaos
+def test_fit_resume_fast_forwards_past_deterministic_bad_batch(tmp_path):
+    """A deterministically-corrupt batch raises again during the resume
+    fast-forward: it must be budgeted and skipped there too (it trained
+    nothing in the original run), keeping the replayed remainder aligned."""
+    def train(prefix, resume=False, kill_at=None):
+        mx.random.seed(3)
+        mod = mx.mod.Module(_fit_sym(), context=mx.cpu())
+
+        def cb(p):
+            if kill_at and (p.epoch, p.nbatch) == kill_at:
+                signal.raise_signal(signal.SIGTERM)
+
+        mod.fit(_FlakyIter(_fit_iter(n=8), bad_at={1}), optimizer="sgd",
+                optimizer_params=(("learning_rate", 0.1),),
+                eval_metric="acc", num_epoch=2, batch_end_callback=cb,
+                bad_batch_budget=2, checkpoint_prefix=prefix, resume=resume)
+        return mod
+
+    ref_arg, _ = train(str(tmp_path / "ref")).get_params()
+    prefix = str(tmp_path / "ck")
+    train(prefix, kill_at=(0, 4))        # preempt past the bad batch
+    arg, _ = train(prefix, resume=True).get_params()
+    for k in ref_arg:
+        np.testing.assert_array_equal(ref_arg[k].asnumpy(), arg[k].asnumpy())
+
+
+@chaos
+def test_distributed_init_shuts_down_half_open_jax_state(monkeypatch):
+    """jax assigns its global client BEFORE connect; without a shutdown
+    between attempts every retry dies on 'should only be called once'
+    instead of reconnecting — the retry loop must tear half-open state
+    down so attempt 2 can actually succeed."""
+    monkeypatch.setattr(mx.distributed, "_initialized", False)
+    calls, state = [], {"half_open": False}
+
+    def fake_init(**kw):
+        calls.append(1)
+        if state["half_open"]:
+            raise RuntimeError(
+                "distributed.initialize should only be called once.")
+        state["half_open"] = True
+        if len(calls) < 3:
+            raise OSError("connect failed")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setattr(jax.distributed, "shutdown",
+                        lambda: state.update(half_open=False))
+    mx.distributed.init(coordinator="127.0.0.1:9999", num_processes=2,
+                        process_id=0, retries=4, backoff_base=0.01)
+    assert len(calls) == 3               # failed, failed, connected
+    assert mx.distributed._initialized
+
+
+def test_retry_call_giveup_short_circuits():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise RuntimeError("fatal misconfiguration")
+
+    with pytest.raises(RuntimeError, match="fatal"):
+        fault.retry_call(fn, retries=5, base_delay=0.001,
+                         giveup=lambda e: "misconfiguration" in str(e))
+    assert len(calls) == 1
+
+
+def test_inject_rejects_base_exception():
+    with pytest.raises(TypeError):
+        fault.inject("p", SystemExit)
+    with pytest.raises(TypeError):
+        fault.inject("p", KeyboardInterrupt())
+
+
+@chaos
+def test_prune_spares_neighbouring_user_files(tmp_path):
+    """Snapshot cleanup must only touch the exact stamped-file shape —
+    never a user's 'model-notes.txt' living next to the prefix."""
+    prefix = str(tmp_path / "model")
+    bystanders = ["model-notes.txt", "model-norm_stats.json",
+                  "model-new-0000.params"]
+    for n in bystanders:
+        with open(str(tmp_path / n), "w") as f:
+            f.write("precious")
+    mx.random.seed(3)
+    mod = mx.mod.Module(_fit_sym(), context=mx.cpu())
+
+    def cb(p):
+        if (p.epoch, p.nbatch) == (0, 2):
+            signal.raise_signal(signal.SIGTERM)
+
+    mod.fit(_fit_iter(n=4), optimizer="sgd", eval_metric="acc", num_epoch=1,
+            batch_end_callback=cb, checkpoint_prefix=prefix)  # preempted
+    mod2 = mx.mod.Module(_fit_sym(), context=mx.cpu())
+    mod2.fit(_fit_iter(n=4), optimizer="sgd", eval_metric="acc", num_epoch=1,
+             checkpoint_prefix=prefix, resume=True)           # completes
+    left = sorted(os.listdir(tmp_path))
+    for n in bystanders:
+        assert n in left                       # user files untouched
+    assert not any("-n00" in n or "resume.json" in n for n in left)

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Chaos smoke: one kill-and-resume cycle on the CPU backend.
+
+Runs a small training loop with periodic checkpoints, injects a crash
+mid-run via ``fault.inject``, rediscovers the newest snapshot with
+``resume_latest``, and checks the resumed loss trajectory matches an
+uninterrupted run bit-exactly — the acceptance contract of ISSUE 2, as a
+single command for CI and for eyeballing a fresh checkout::
+
+    python tools/chaos_check.py [--steps 8] [--every 2] [--keep 2]
+
+Exit code 0 on success, 1 on any mismatch.  Forces ``JAX_PLATFORMS=cpu``
+(and an 8-device virtual mesh) so it runs anywhere, TPU or not.
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+# must precede any jax import — same bring-up as tests/conftest.py
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=8,
+                    help="total training steps in the reference run")
+    ap.add_argument("--every", type=int, default=2,
+                    help="checkpoint cadence (steps)")
+    ap.add_argument("--keep", type=int, default=2,
+                    help="retention: keep-last-K snapshots")
+    ap.add_argument("--crash-after", type=int, default=None,
+                    help="crash on this step call (default: steps//2 + 1)")
+    args = ap.parse_args(argv)
+    crash_after = (args.crash_after if args.crash_after is not None
+                   else args.steps // 2 + 1)
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault, gluon, parallel
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.checkpoint import CheckpointManager, resume_latest
+
+    def net(seed):
+        mx.random.seed(seed)
+        n = nn.HybridSequential()
+        n.add(nn.Dense(16, activation="relu", in_units=8),
+              nn.Dense(4, in_units=16))
+        n.initialize()
+        return n
+
+    def step_for(seed):
+        mesh = parallel.make_mesh(dp=len(jax.devices()))
+        return parallel.TrainStep(net(seed),
+                                  gluon.loss.SoftmaxCrossEntropyLoss(),
+                                  mx.optimizer.create("adam"), mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    batches = [(rng.randn(16, 8).astype(np.float32),
+                rng.randint(0, 4, (16,))) for _ in range(args.steps)]
+
+    print(f"[chaos_check] reference run: {args.steps} steps")
+    ref = []
+    ref_step = step_for(7)
+    for x, y in batches:
+        ref.append(float(ref_step(x, y).asnumpy()))
+
+    d = tempfile.mkdtemp(prefix="chaos_check_")
+    print(f"[chaos_check] victim run: checkpoints every {args.every} steps "
+          f"to {d}, crash injected on step {crash_after}")
+    victim = step_for(7)
+    mgr = CheckpointManager(victim, d, every_n_steps=args.every,
+                            keep_last=args.keep)
+    crashed = False
+    with fault.inject("step", RuntimeError("injected preemption"),
+                      after_n=crash_after - 1):
+        try:
+            for x, y in batches:
+                victim(x, y)
+                mgr.maybe_save()
+        except RuntimeError as exc:
+            crashed = True
+            print(f"[chaos_check] victim died as planned: {exc}")
+    if not crashed:
+        print("[chaos_check] FAIL: injected crash never fired")
+        return 1
+    del victim, mgr
+
+    survivor = step_for(99)        # different init — checkpoint must win
+    survivor(*batches[0])          # build/compile
+    n = resume_latest(survivor, d)
+    if n is None:
+        print("[chaos_check] FAIL: resume_latest found no checkpoint")
+        return 1
+    print(f"[chaos_check] resumed from step {n}, replaying "
+          f"{args.steps - n} steps")
+    resumed = [float(survivor(x, y).asnumpy()) for x, y in batches[n:]]
+
+    if resumed == ref[n:]:
+        print(f"[chaos_check] PASS: resumed trajectory bit-exact over "
+              f"{len(resumed)} steps")
+        return 0
+    diff = np.max(np.abs(np.array(resumed) - np.array(ref[n:])))
+    print(f"[chaos_check] FAIL: trajectories diverge (max |diff|={diff})")
+    print(f"  reference: {ref[n:]}")
+    print(f"  resumed  : {resumed}")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
