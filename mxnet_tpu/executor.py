@@ -21,7 +21,8 @@ from . import random as _random
 from .context import current_context, Context
 from .ndarray import NDArray
 from .ops.registry import OP_META, get_op
-from .symbol import LAYERS, Symbol, infer_arg_shapes, node_threads_aux
+from .symbol import (LAYERS, Symbol, infer_arg_shapes, node_threads_aux,
+                     observe_n_out)
 
 
 # ---------------------------------------------------------------------------
@@ -59,7 +60,11 @@ def walk_graph(sym: Symbol, leaf, apply_op, aux_update):
                 memo[key] = res
         res = memo[key]
         if isinstance(res, tuple):
-            node.n_out = len(res)
+            # arity is static (symbol._static_n_out): for ruled ops the
+            # trace only CHECKS it (a mismatch raises — list_outputs
+            # must agree before and after the first eval); custom ops
+            # the probe couldn't evaluate reconcile to the traced arity
+            observe_n_out(node, len(res))
             return res[s._index]
         return res
 

@@ -232,17 +232,43 @@ class CSVIter(_WrappedIter):
 
 def _read_idx(path):
     """Parse one IDX file (ref: the MNIST ubyte format the reference's
-    MNISTIter reads), .gz or raw."""
+    MNISTIter reads), .gz or raw.
+
+    The 4-byte magic is validated before any parsing: bytes 0-1 must be
+    zero, byte 2 is the dtype code (only ``0x08`` = uint8 is supported —
+    the MNIST family), byte 3 the rank; and the payload must hold exactly
+    ``prod(dims)`` bytes.  A truncated download, an int32 IDX file, or a
+    gzip-of-something-else raises a ``ValueError`` naming the path
+    instead of being reinterpreted as uint8 garbage pixels."""
     import gzip
 
     opener = gzip.open if str(path).endswith(".gz") else open
     with opener(path, "rb") as f:
         raw = f.read()
+    if len(raw) < 4 or raw[0] != 0 or raw[1] != 0:
+        raise ValueError(
+            f"{path!r} is not an IDX file: magic bytes 0-1 must be zero "
+            f"(got {raw[:2]!r})")
+    if raw[2] != 0x08:
+        raise ValueError(
+            f"{path!r}: IDX dtype byte is 0x{raw[2]:02x}, only 0x08 "
+            f"(uint8, the MNIST family) is supported — convert the file "
+            f"or use NDArrayIter over your own arrays")
     ndim = raw[3]
+    header_len = 4 + 4 * ndim
+    if len(raw) < header_len:
+        raise ValueError(
+            f"{path!r}: truncated IDX header (rank {ndim} needs "
+            f"{header_len} bytes, file has {len(raw)})")
     dims = [int.from_bytes(raw[4 + 4 * i:8 + 4 * i], "big")
             for i in range(ndim)]
-    return np.frombuffer(raw, np.uint8,
-                         offset=4 + 4 * ndim).reshape(dims)
+    expect = header_len + int(np.prod(dims, dtype=np.int64))
+    if len(raw) != expect:
+        raise ValueError(
+            f"{path!r}: IDX payload is {len(raw) - header_len} bytes but "
+            f"dims {tuple(dims)} require {expect - header_len} "
+            f"(truncated or corrupt download?)")
+    return np.frombuffer(raw, np.uint8, offset=header_len).reshape(dims)
 
 
 class MNISTIter(_WrappedIter):

@@ -551,8 +551,11 @@ def _prune_fit_snapshots(prefix, keep_stamp=None):
     (``model-notes.txt``, a ``do_checkpoint('model-new')`` artifact)."""
     import re
     d = os.path.dirname(prefix) or "."
+    # {4,}/{6,}: the f"{epoch:04d}"/"{nbatch:06d}" stamp widths are
+    # MINIMUMS — epoch 10000 / batch 1000000 widen the field, and a
+    # fixed-width match would leave those snapshots unpruned forever
     pat = re.compile(re.escape(os.path.basename(prefix))
-                     + r"-(n\d{4}b\d{6})[.-]")
+                     + r"-(n\d{4,}b\d{6,})[.-]")
     for name in os.listdir(d):
         m = pat.match(name)
         if m and m.group(1) != keep_stamp:

@@ -132,6 +132,122 @@ LAYERS["MakeLoss"] = LAYERS["make_loss"]
 _AUX_STATE_OPS = {"BatchNorm": ("moving_mean", "moving_var")}
 
 
+# ---------------------------------------------------------------------------
+# static output arity.  ``_Node.n_out`` used to be discovered as a side
+# effect of tracing (walk_graph measured the result tuple), which made
+# ``list_outputs``/``tojson`` non-deterministic: a fresh or json-loaded
+# multi-output symbol reported one output until the first eval.  The arity
+# of every multi-output op is a pure function of its attrs (the reference
+# computes it the same way — each op's ListOutputNames), so compute it from
+# this table; ops without a rule get a ONE-TIME ``jax.eval_shape`` probe
+# (cached per (op, attrs, arity) — probing costs no compile) and fall back
+# to 1 when the op cannot be abstractly evaluated on placeholder shapes.
+# ---------------------------------------------------------------------------
+
+def _n_out_split(a):
+    return int(a.get("num_outputs", 1))
+
+
+def _n_out_split_v2(a):
+    if a.get("sections"):
+        return int(a["sections"])
+    return len(tuple(a.get("indices", ()))) + 1
+
+
+def _n_out_mean_var(a):
+    return 3 if a.get("output_mean_var", False) else 1
+
+
+_N_OUT_RULES = {
+    "split": _n_out_split, "SliceChannel": _n_out_split,
+    "split_v2": _n_out_split_v2,
+    "topk": lambda a: 2 if a.get("ret_typ") == "both" else 1,
+    "RNN": lambda a: 3 if a.get("mode", "lstm") == "lstm" else 2,
+    "BatchNorm": _n_out_mean_var,
+    "LayerNorm": _n_out_mean_var, "layer_norm": _n_out_mean_var,
+    "FusedNormReluConv": lambda a: 3, "fused_norm_relu_conv": lambda a: 3,
+    "MultiBoxTarget": lambda a: 3, "multibox_target": lambda a: 3,
+    "_contrib_MultiBoxTarget": lambda a: 3,
+    "Proposal": lambda a: 2 if a.get("output_score", False) else 1,
+    "proposal": lambda a: 2 if a.get("output_score", False) else 1,
+    "_contrib_Proposal": lambda a: 2 if a.get("output_score", False) else 1,
+    "quantize_v2": lambda a: 3,
+    "_sample_multinomial": lambda a: 2 if a.get("get_prob", False) else 1,
+    "sample_multinomial": lambda a: 2 if a.get("get_prob", False) else 1,
+}
+
+_N_OUT_PROBED: Dict[tuple, int] = {}
+
+
+def _probe_key(op: str, attrs: dict, n_inputs: int) -> tuple:
+    return (op, tuple(sorted((k, str(v)) for k, v in attrs.items()
+                             if not k.startswith("__"))), n_inputs)
+
+
+def _probe_n_out(op: str, attrs: dict, n_inputs: int) -> int:
+    """jax.eval_shape an unruled op on placeholder inputs to count its
+    outputs — once per (op, attrs, arity); unprobeable ops (shape-
+    incompatible placeholders, missing required attrs) default to 1."""
+    key = _probe_key(op, attrs, n_inputs)
+    if key not in _N_OUT_PROBED:
+        import jax
+
+        from .ops.registry import OP_META
+        n = 1
+        fn = OPS.get(op)
+        if fn is not None:
+            kwargs = {k: v for k, v in attrs.items()
+                      if not k.startswith("__")}
+            if OP_META.get(op, {}).get("has_training"):
+                kwargs.setdefault("training", False)
+            import jax.numpy as _jnp
+            for shape in ((2, 8, 4, 4), (2, 8), (8,)):
+                args = [jax.ShapeDtypeStruct(shape, _jnp.float32)] * n_inputs
+                try:
+                    res = jax.eval_shape(lambda *xs: fn(*xs, **kwargs),
+                                         *args)
+                except Exception:
+                    continue
+                n = len(res) if isinstance(res, tuple) else 1
+                break
+        _N_OUT_PROBED[key] = n
+    return _N_OUT_PROBED[key]
+
+
+def _static_n_out(node) -> int:
+    if node.op is None:
+        return 1
+    rule = _N_OUT_RULES.get(node.op)
+    if rule is not None:
+        n = int(rule(node.attrs))
+    else:
+        n = _probe_n_out(node.op, node.attrs, len(node.inputs))
+    if n > 1 and node_threads_aux(node):
+        n = 1  # trailing outputs thread back into aux state, not heads
+    # NB: not ``max(1, n)`` — this module's namespace is op-builder
+    # territory (sym.max shadows the builtin after generation)
+    return n if n > 1 else 1
+
+
+def observe_n_out(node, observed: int):
+    """Executor callback when a trace yields a tuple of ``observed``
+    outputs.  For ops with a static rule a mismatch is a BUG in
+    ``_N_OUT_RULES`` and raises.  For probe-fallback ops (a custom
+    ``register_op`` the placeholder probe could not abstractly evaluate,
+    which defaults to 1) the observed arity wins: the node and the probe
+    cache reconcile, so the op keeps working — at the documented cost
+    that ``list_outputs`` on such an op reads 1 until its first eval."""
+    if observed == node.n_out:
+        return
+    if node.op in _N_OUT_RULES:
+        raise RuntimeError(
+            f"op {node.op!r}: traced output arity {observed} != static "
+            f"rule value {node.n_out}; fix symbol._N_OUT_RULES")
+    _N_OUT_PROBED[_probe_key(node.op, node.attrs,
+                             len(node.inputs))] = observed
+    node._n_out = observed
+
+
 def node_threads_aux(node) -> bool:
     """True when this node's trailing outputs are aux-state updates to
     thread back (NOT when BatchNorm's output_mean_var=True turns them into
@@ -184,7 +300,7 @@ def reset_auto_names():
 
 
 class _Node:
-    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "n_out")
+    __slots__ = ("op", "name", "attrs", "inputs", "is_aux", "_n_out")
 
     def __init__(self, op: Optional[str], name: str, attrs=None, inputs=(),
                  is_aux=False):
@@ -193,7 +309,18 @@ class _Node:
         self.attrs = dict(attrs or {})
         self.inputs = list(inputs)  # list[Symbol]
         self.is_aux = is_aux
-        self.n_out = 1
+        self._n_out = None
+
+    @property
+    def n_out(self) -> int:
+        """Output arity, fixed by (op, attrs) at construction — NOT a
+        tracing side effect, so list_outputs/tojson agree on fresh and
+        loaded symbols.  Resolved lazily (first read) only so plain
+        single-output graph building never pays the probe for exotic
+        ops; the value itself is deterministic."""
+        if self._n_out is None:
+            self._n_out = _static_n_out(self)
+        return self._n_out
 
 
 class Symbol:
@@ -360,8 +487,17 @@ class Symbol:
                 "inputs": [[idx[id(s._node)], s._index, 0]
                            for s in n.inputs],
             })
-        heads = [[idx[id(s._node)], s._index, 0]
-                 for s in self._outputs_list()]
+        # one heads entry PER OUTPUT: a whole multi-output head
+        # (SliceChannel, BatchNorm output_mean_var, RNN state heads)
+        # contributes every output index, so fromjson(tojson()) keeps
+        # outputs 1+ instead of silently collapsing to output 0
+        heads = []
+        for s in self._outputs_list():
+            n = s._node.n_out
+            if s._whole and n > 1:
+                heads.extend([idx[id(s._node)], i, 0] for i in range(n))
+            else:
+                heads.append([idx[id(s._node)], s._index, 0])
         return json.dumps({"nodes": nodes,
                            "arg_nodes": [i for i, n in enumerate(nodes_list)
                                          if n.op is None],
@@ -616,7 +752,17 @@ def infer_arg_shapes(sym: Symbol, known: Dict[str, tuple]) -> Dict[str, tuple]:
                 if slot in spec.labels and s._node.op is None \
                         and s._node.name in missing and dshape:
                     if n.op == "SoftmaxOutput":
-                        shapes[s._node.name] = (int(dshape[0]),)
+                        # ref: softmax_output-inl.h label shape — one
+                        # class id per sample; with multi_output=True
+                        # softmax runs over axis 1 and the label carries
+                        # the REMAINING spatial axes (d[0], d[2:]), not
+                        # a bare (d[0],) (which made simple_bind
+                        # allocate a wrong-shaped label buffer)
+                        if n.attrs.get("multi_output", False):
+                            shapes[s._node.name] = (int(dshape[0]),) + \
+                                tuple(int(x) for x in dshape[2:])
+                        else:
+                            shapes[s._node.name] = (int(dshape[0]),)
                     else:
                         shapes[s._node.name] = tuple(dshape)
                     missing.remove(s._node.name)
@@ -665,7 +811,12 @@ def fromjson(text: str) -> Symbol:
             node = _Node(nd_["op"], nd_["name"], attrs, ins)
             built.append(Symbol(node))
     heads = [built[i][oi] for i, oi, _ in d["heads"]]
-    return heads[0] if len(heads) == 1 else Group(heads)
+    if len(heads) == 1:
+        return heads[0]
+    # a multi-output head was serialized as one entry per output index —
+    # rebuild a Group so list_outputs/bind see every output, like the
+    # symbol that was saved
+    return Group(heads)
 
 
 def load(fname: str) -> Symbol:

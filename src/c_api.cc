@@ -193,8 +193,10 @@ void *mxtpu_ndarray_create_dtype(const void *data, const long *shape,
     return nullptr;
   }
   // Pass the dtype explicitly: nd.array's MXNet-compatible default maps
-  // float64 inputs to float32, but a C caller who asked for float64
-  // must get float64.
+  // wider inputs down to float32, but a C caller who asked for a
+  // specific entry of the 32-bit-and-under table above (float16,
+  // bfloat16, int8, ...) must get exactly that dtype back.  (64-bit
+  // dtypes never reach here — lookup_dtype already rejected them.)
   PyObject *dt2 = dtype_object(info);
   PyObject *nd = dt2 != nullptr
                      ? PyObject_CallMethod(g_nd_module, "array", "OOO",
@@ -214,14 +216,32 @@ void *mxtpu_ndarray_create(const float *data, const long *shape, int ndim) {
   return mxtpu_ndarray_create_dtype(data, shape, ndim, "float32");
 }
 
+namespace {
+
+// Shared pre-init guard for the handle-taking entry points: a handle can
+// only have come from a successful post-init call, so g_nd_module==nullptr
+// means the client skipped mxtpu_init() (or called after shutdown) — and
+// taking the GIL of an uninitialized interpreter would crash instead of
+// error-returning.
+bool require_init() {
+  if (g_nd_module == nullptr) {
+    g_last_error = "mxtpu_init() not called";
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
 int mxtpu_ndarray_free(void *handle) {
-  if (handle == nullptr) return -1;
+  if (handle == nullptr || !require_init()) return -1;
   Gil gil;
   Py_DECREF(reinterpret_cast<PyObject *>(handle));
   return 0;
 }
 
 int mxtpu_ndarray_ndim(void *handle) {
+  if (!require_init()) return -1;
   Gil gil;
   PyObject *shp = PyObject_GetAttrString(
       reinterpret_cast<PyObject *>(handle), "shape");
@@ -235,6 +255,7 @@ int mxtpu_ndarray_ndim(void *handle) {
 }
 
 int mxtpu_ndarray_shape(void *handle, long *out) {
+  if (!require_init()) return -1;
   Gil gil;
   PyObject *shp = PyObject_GetAttrString(
       reinterpret_cast<PyObject *>(handle), "shape");
@@ -252,6 +273,7 @@ int mxtpu_ndarray_shape(void *handle, long *out) {
 
 // Write the array's dtype name into out; returns 0 (or -1).
 int mxtpu_ndarray_dtype(void *handle, char *out, int capacity) {
+  if (!require_init()) return -1;
   Gil gil;
   PyObject *dt = PyObject_GetAttrString(
       reinterpret_cast<PyObject *>(handle), "dtype");
@@ -283,6 +305,7 @@ int mxtpu_ndarray_dtype(void *handle, char *out, int capacity) {
 // Blocking device->host copy in the array's OWN dtype.  capacity in
 // bytes; returns bytes copied or -1.
 long mxtpu_ndarray_to_host_bytes(void *handle, void *out, long capacity) {
+  if (!require_init()) return -1;
   Gil gil;
   PyObject *np_arr = PyObject_CallMethod(
       reinterpret_cast<PyObject *>(handle), "asnumpy", nullptr);
@@ -310,6 +333,7 @@ long mxtpu_ndarray_to_host_bytes(void *handle, void *out, long capacity) {
 // Blocking device->host copy of a float32 array (ref:
 // MXNDArraySyncCopyToCPU).  capacity is the element count of out.
 int mxtpu_ndarray_to_host(void *handle, float *out, long capacity) {
+  if (!require_init()) return -1;
   Gil gil;
   PyObject *np_arr = PyObject_CallMethod(
       reinterpret_cast<PyObject *>(handle), "asnumpy", nullptr);
@@ -469,6 +493,7 @@ int mxtpu_autograd_set_recording(int on) {
 
 // Allocate a gradient buffer on the array so the tape tracks it.
 int mxtpu_ndarray_attach_grad(void *handle) {
+  if (!require_init()) return -1;
   Gil gil;
   PyObject *r = PyObject_CallMethod(reinterpret_cast<PyObject *>(handle),
                                     "attach_grad", nullptr);
@@ -482,6 +507,7 @@ int mxtpu_ndarray_attach_grad(void *handle) {
 
 // Run backward from a (scalar) head, filling attached grads.
 int mxtpu_backward(void *handle) {
+  if (!require_init()) return -1;
   Gil gil;
   PyObject *r = PyObject_CallMethod(reinterpret_cast<PyObject *>(handle),
                                     "backward", nullptr);
@@ -496,6 +522,7 @@ int mxtpu_backward(void *handle) {
 // Owned handle to the array's accumulated gradient, or NULL when no
 // grad is attached (distinguish from errors via mxtpu_last_error()).
 void *mxtpu_ndarray_grad(void *handle) {
+  if (!require_init()) return nullptr;
   Gil gil;
   PyObject *g = PyObject_GetAttrString(reinterpret_cast<PyObject *>(handle),
                                        "grad");

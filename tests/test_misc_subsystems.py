@@ -459,3 +459,75 @@ def test_mnist_iter(tmp_path):
     mod.fit(flat, optimizer="adam",
             optimizer_params=(("learning_rate", 0.05),), num_epoch=2)
     assert mod.score(flat, "acc")[0][1] > 0.5
+
+
+def test_read_idx_validates_header(tmp_path):
+    """ISSUE 3 satellite: _read_idx must reject non-IDX/corrupt/int32
+    files with a ValueError naming the path instead of parsing them as
+    uint8 garbage."""
+    import gzip
+    import struct
+
+    from mxnet_tpu.io import _read_idx
+
+    good = tmp_path / "ok-idx1-ubyte"
+    good.write_bytes(struct.pack(">HBB", 0, 8, 1) + struct.pack(">I", 4)
+                     + bytes([1, 2, 3, 4]))
+    np.testing.assert_array_equal(_read_idx(str(good)), [1, 2, 3, 4])
+
+    # bad magic (bytes 0-1 non-zero): e.g. a PNG or text file
+    bad_magic = tmp_path / "not-idx"
+    bad_magic.write_bytes(b"\x89PNG....")
+    with pytest.raises(ValueError, match="not-idx.*magic"):
+        _read_idx(str(bad_magic))
+
+    # int32 dtype byte (0x0c) must not be read as uint8 garbage
+    int32 = tmp_path / "int32-idx"
+    int32.write_bytes(struct.pack(">HBB", 0, 0x0C, 1)
+                      + struct.pack(">I", 2) + b"\x00" * 8)
+    with pytest.raises(ValueError, match="int32-idx.*0x0c"):
+        _read_idx(str(int32))
+
+    # truncated payload: dims promise more bytes than the file holds
+    trunc = tmp_path / "trunc-idx.gz"
+    with gzip.open(trunc, "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3)
+                + struct.pack(">III", 10, 28, 28) + b"\x00" * 100)
+    with pytest.raises(ValueError, match="trunc-idx.*truncated or corrupt"):
+        _read_idx(str(trunc))
+
+    # truncated header: rank promises dims the header doesn't contain
+    short = tmp_path / "short-idx"
+    short.write_bytes(struct.pack(">HBB", 0, 8, 3) + b"\x00\x00")
+    with pytest.raises(ValueError, match="short-idx.*truncated IDX header"):
+        _read_idx(str(short))
+
+    # MNISTIter surfaces the same error (not garbage batches)
+    lab = tmp_path / "labels-idx1-ubyte"
+    lab.write_bytes(struct.pack(">HBB", 0, 8, 1) + struct.pack(">I", 4)
+                    + bytes([0, 1, 2, 3]))
+    with pytest.raises(ValueError, match="magic"):
+        mx.io.MNISTIter(image=str(bad_magic), label=str(lab), batch_size=2)
+
+
+def test_prune_fit_snapshots_wide_stamps(tmp_path):
+    """The n%04d/b%06d stamp widths are minimums: epoch>=10000 or
+    nbatch>=1e6 widen the field and must still be pruned (fixed-width
+    \\d{4}/\\d{6} left them on disk forever)."""
+    from mxnet_tpu.module import _prune_fit_snapshots
+
+    prefix = str(tmp_path / "model")
+    keep = "n0001b000005"
+    names = [f"model-{keep}.params", f"model-{keep}-symbol.json",
+             "model-n0002b000001.params",          # stale, classic width
+             "model-n10000b1000000.params",        # stale, wide stamp
+             "model-n10000b1000000.tmp-optstate",  # orphan tmp, wide
+             "model-notes.txt",                    # unrelated user file
+             "model-new-symbol.json"]              # unrelated prefix-ish
+    for n in names:
+        (tmp_path / n).write_text("x")
+    _prune_fit_snapshots(prefix, keep_stamp=keep)
+    left = sorted(p.name for p in tmp_path.iterdir())
+    assert left == sorted([f"model-{keep}.params",
+                           f"model-{keep}-symbol.json",
+                           "model-notes.txt", "model-new-symbol.json"])

@@ -1,0 +1,2 @@
+"""Project tooling (im2rec, launch, chaos_check, and the ``analysis``
+static-analysis suite — ``python -m tools.analysis mxnet_tpu/``)."""

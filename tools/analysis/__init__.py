@@ -1,0 +1,25 @@
+"""mxlint — project static analysis for trace-safety, thread-safety,
+donation hazards, and registry/docs consistency.
+
+Usage (CLI)::
+
+    python -m tools.analysis mxnet_tpu/            # human output, exit 1
+    python -m tools.analysis mxnet_tpu/ --json     # machine output
+    python -m tools.analysis --list-rules
+
+Usage (API, what tests/test_mxlint.py drives)::
+
+    from tools.analysis import analyze, Config
+    findings = analyze(["mxnet_tpu/"], root=repo_root)
+    assert not [f for f in findings if not f.suppressed]
+
+Rules are documented in docs/analysis.md; suppression is
+``# mxlint: disable=RULE -- justification`` (justification required).
+"""
+from .core import (BAD_SUPPRESSION, Config, Finding, ModuleInfo, Rule,
+                   ProjectRule, analyze, default_rules, exit_code,
+                   summarize, to_json)
+
+__all__ = ["BAD_SUPPRESSION", "Config", "Finding", "ModuleInfo", "Rule",
+           "ProjectRule", "analyze", "default_rules", "exit_code",
+           "summarize", "to_json"]

@@ -1,0 +1,70 @@
+"""mxlint CLI: ``python -m tools.analysis mxnet_tpu/``.
+
+Exit code 0 = no unsuppressed error-severity findings (the tier-1 gate
+in tests/test_mxlint.py asserts exactly this), 1 = findings, 2 = usage.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import (Config, analyze, default_rules, exit_code, summarize,
+                   to_json)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="mxlint: trace-safety / thread-safety / donation / "
+                    "registry static analysis (docs/analysis.md)")
+    parser.add_argument("paths", nargs="*", default=["mxnet_tpu"],
+                        help="files or directories to analyze "
+                             "(default: mxnet_tpu)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings as JSON (suppressed ones "
+                             "included, marked)")
+    parser.add_argument("--disable", action="append", default=[],
+                        metavar="RULE", help="disable a rule id")
+    parser.add_argument("--severity", action="append", default=[],
+                        metavar="RULE=LEVEL",
+                        help="override a rule's severity "
+                             "(error|warning|info)")
+    parser.add_argument("--show-suppressed", action="store_true",
+                        help="also print suppressed findings")
+    parser.add_argument("--root", default=None,
+                        help="repo root for relative paths + docs "
+                             "(default: cwd)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="list rule ids and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in default_rules():
+            print(f"{rule.id:26s} {rule.description}")
+        return 0
+
+    severities = {}
+    for spec in args.severity:
+        if "=" not in spec:
+            parser.error(f"--severity expects RULE=LEVEL, got {spec!r}")
+        rid, sev = spec.split("=", 1)
+        severities[rid] = sev
+    config = Config(disabled=args.disable, severities=severities)
+
+    root = Path(args.root) if args.root else Path.cwd()
+    findings = analyze(args.paths, config=config, root=root)
+
+    if args.json:
+        print(to_json(findings))
+    else:
+        for f in findings:
+            if f.suppressed and not args.show_suppressed:
+                continue
+            print(f.render())
+        print(summarize(findings))
+    return exit_code(findings)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,303 @@
+"""mxlint rule engine.
+
+The analysis counterpart of the runtime's fault harness: where
+``mx.fault`` makes concurrency/preemption failures *repeatable*, mxlint
+makes the invariants that PREVENT them *mechanical*.  TensorFlow's
+production experience (PAPERS.md: Abadi et al.) is that large dataflow
+frameworks survive on invariant checking in CI, not review; the
+whole-program-compile stacks (Julia→TPU, PAPERS.md) show that
+trace/compile-boundary discipline is the correctness frontier.  This
+engine walks Python sources with ``ast`` (no imports, no execution — it
+must be runnable on a broken tree) and applies per-file and
+whole-project rules.
+
+Suppression contract (docs/analysis.md):
+
+    x = float(traced)  # mxlint: disable=trace-host-sync -- verdict scalar,
+                       # one round-trip per step by design
+
+``disable=`` names one or more comma-separated rule ids; the text after
+``--`` is a REQUIRED justification.  A disable comment without a
+justification does not suppress anything and itself raises
+``bad-suppression`` — an unexplained suppression is how invariants rot.
+The comment suppresses findings on its own line, or (as a standalone
+comment line) on the next code line.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+SEVERITIES = ("error", "warning", "info")
+
+# id of the meta-rule emitted for malformed disable comments; it cannot
+# itself be suppressed (suppressing the suppression-checker is turtles).
+BAD_SUPPRESSION = "bad-suppression"
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    suppressed: bool = False
+    justification: Optional[str] = None
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    def render(self):
+        tag = " (suppressed)" if self.suppressed else ""
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.severity}] {self.rule}: {self.message}{tag}")
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One parsed source file, shared by every rule."""
+    path: Path          # absolute
+    relpath: str        # repo-root-relative (stable in output/tests)
+    source: str
+    tree: ast.Module
+    lines: List[str]
+
+
+class Rule:
+    """Per-file rule: ``check_module`` yields findings for one file."""
+
+    id: str = ""
+    default_severity: str = "error"
+    description: str = ""
+
+    def check_module(self, mod: ModuleInfo) -> Iterable[Finding]:
+        return ()
+
+    def finding(self, mod: ModuleInfo, node, message, rule_id=None):
+        return Finding(rule=rule_id or self.id, path=mod.relpath,
+                       line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0) + 1,
+                       message=message)
+
+
+class ProjectRule(Rule):
+    """Whole-project rule: sees every module at once (cross-file state
+    like the op registry, plus non-Python inputs like docs/api.md)."""
+
+    def check_project(self, modules: List[ModuleInfo],
+                      root: Path) -> Iterable[Finding]:
+        return ()
+
+
+# --------------------------------------------------------------------------
+# suppression comments
+# --------------------------------------------------------------------------
+
+_DISABLE_RE = re.compile(
+    r"#\s*mxlint:\s*disable=([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
+    r"(?:\s*--\s*(.*\S))?\s*$")
+
+
+def parse_suppressions(mod: ModuleInfo):
+    """line -> (set of rule ids, justification | None) and the
+    bad-suppression findings for comments missing a justification.
+
+    A suppression comment applies to its own line; when the line holds
+    ONLY the comment, it applies to the next line instead (the long-line
+    form).  Consecutive standalone comment lines chain, so a wrapped
+    justification still points at the first code line after the block.
+    """
+    table: Dict[int, Tuple[set, Optional[str]]] = {}
+    bad: List[Finding] = []
+    pending: Optional[Tuple[set, Optional[str]]] = None
+    for i, text in enumerate(mod.lines, start=1):
+        m = _DISABLE_RE.search(text)
+        stripped = text.strip()
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",")}
+            just = m.group(2)
+            if not just:
+                bad.append(Finding(
+                    rule=BAD_SUPPRESSION, path=mod.relpath, line=i, col=1,
+                    message=f"mxlint disable={','.join(sorted(rules))} has "
+                            f"no justification: write "
+                            f"'# mxlint: disable=RULE -- why it is safe'"))
+                pending = None
+                continue
+            if stripped.startswith("#"):
+                pending = (rules, just)      # standalone: arm for next code line
+            else:
+                table[i] = (rules, just)     # inline
+                pending = None
+        elif pending is not None:
+            if stripped.startswith("#") or not stripped:
+                continue                     # comment block / blank: keep arming
+            table[i] = pending
+            pending = None
+    return table, bad
+
+
+# --------------------------------------------------------------------------
+# config + engine
+# --------------------------------------------------------------------------
+
+class Config:
+    """Per-rule enable/severity knobs (CLI: --disable / --severity)."""
+
+    def __init__(self, disabled=(), severities=None):
+        self.disabled = set(disabled)
+        self.severities = dict(severities or {})
+        for rid, sev in self.severities.items():
+            if sev not in SEVERITIES:
+                raise ValueError(f"unknown severity {sev!r} for rule {rid!r} "
+                                 f"(one of {SEVERITIES})")
+
+    def enabled(self, rule_id):
+        return rule_id not in self.disabled
+
+    def severity(self, rule: Rule):
+        return self.severities.get(rule.id, rule.default_severity)
+
+
+def default_rules() -> List[Rule]:
+    from .trace_rules import (HostSyncRule, TracedBranchRule,
+                              MutableGlobalRule, UnhashableStaticRule)
+    from .thread_rules import UnlockedAttrRule
+    from .donation_rules import DonatedReuseRule
+    from .registry_rules import (DuplicateRegistrationRule,
+                                 MissingGradientRule, StaleDocSymbolRule)
+
+    return [HostSyncRule(), TracedBranchRule(), MutableGlobalRule(),
+            UnhashableStaticRule(), UnlockedAttrRule(), DonatedReuseRule(),
+            DuplicateRegistrationRule(), MissingGradientRule(),
+            StaleDocSymbolRule()]
+
+
+def _collect_files(paths) -> List[Path]:
+    out = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def load_module(path: Path, root: Path) -> Optional[ModuleInfo]:
+    source = path.read_text(encoding="utf-8", errors="replace")
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError:
+        return None  # a syntax error is the interpreter's finding, not ours
+    try:
+        rel = str(path.resolve().relative_to(root.resolve()))
+    except ValueError:
+        rel = str(path)
+    return ModuleInfo(path=path, relpath=rel, source=source, tree=tree,
+                      lines=source.splitlines())
+
+
+def analyze(paths, config: Optional[Config] = None, rules=None,
+            root: Optional[Path] = None) -> List[Finding]:
+    """Run every enabled rule over ``paths`` (files or directories).
+
+    Returns ALL findings, with suppressed ones marked rather than
+    dropped — the JSON output keeps them visible (an audit of what is
+    being waived), the exit code ignores them.
+    """
+    config = config or Config()
+    rules = list(rules) if rules is not None else default_rules()
+    root = Path(root) if root is not None else Path.cwd()
+    files = _collect_files(paths)
+    modules = [m for m in (load_module(f, root) for f in files)
+               if m is not None]
+
+    findings: List[Finding] = []
+    suppress_tables = {}
+    for mod in modules:
+        table, bad = parse_suppressions(mod)
+        suppress_tables[mod.relpath] = table
+        if config.enabled(BAD_SUPPRESSION):
+            findings.extend(bad)
+    for rule in rules:
+        if not config.enabled(rule.id):
+            continue
+        sev = config.severity(rule)
+        emitted: Iterable[Finding]
+        if isinstance(rule, ProjectRule):
+            emitted = rule.check_project(modules, root)
+        else:
+            emitted = (f for mod in modules for f in rule.check_module(mod))
+        for f in emitted:
+            f.severity = sev
+            findings.append(f)
+
+    # apply suppressions (bad-suppression is exempt by design)
+    for f in findings:
+        if f.rule == BAD_SUPPRESSION:
+            continue
+        table = suppress_tables.get(f.path, {})
+        hit = table.get(f.line)
+        if hit and f.rule in hit[0]:
+            f.suppressed = True
+            f.justification = hit[1]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def summarize(findings: List[Finding]) -> str:
+    active = [f for f in findings if not f.suppressed]
+    sup = len(findings) - len(active)
+    errs = sum(1 for f in active if f.severity == "error")
+    return (f"{len(active)} finding(s) ({errs} error(s)), "
+            f"{sup} suppressed")
+
+
+def to_json(findings: List[Finding]) -> str:
+    return json.dumps([f.to_dict() for f in findings], indent=2)
+
+
+def exit_code(findings: List[Finding]) -> int:
+    return 1 if any(not f.suppressed and f.severity == "error"
+                    for f in findings) else 0
+
+
+# --------------------------------------------------------------------------
+# shared AST helpers (used by the rule modules)
+# --------------------------------------------------------------------------
+
+def dotted_name(node) -> Optional[str]:
+    """'jax.numpy.asarray' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_component(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def assigned_names(target) -> set:
+    """Names bound by an assignment target (handles tuple unpacking)."""
+    out = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store,
+                                                          ast.Del)):
+            out.add(n.id)
+    return out

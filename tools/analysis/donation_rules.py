@@ -1,0 +1,156 @@
+"""Donation-safety rule.
+
+``TrainStep(donate_batch=True)`` and ``jax.jit(..., donate_argnums=...)``
+hand the input buffers to XLA: after the call the donated arrays are
+DELETED, and touching them again raises (CPU backend) or reads freed
+HBM semantics (the reason the async-feed docs say "safe only when each
+batch is consumed exactly once").  The hazard is invisible locally —
+the donation happens at the call site, the crash at the later use.
+
+``donated-batch-reuse``
+    Within one function, flags any read of a variable after it was
+    passed in a donated position of a call to (a) a local name bound to
+    ``jax.jit(fn, donate_argnums=...)`` or (b) a local name bound to
+    ``TrainStep(..., donate_batch=True)`` (every batch argument of a
+    donate_batch step call is donated).  Rebinding the variable clears
+    the hazard.  Statement order is textual: a use *before* the donating
+    call inside the same loop body is not flagged (the rule is a
+    first-order linter, not a dataflow engine — see docs/analysis.md).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from .core import Rule, last_component
+
+
+def _walk_scope(scope):
+    """Yield nodes of one function/module scope WITHOUT descending into
+    nested function/class bodies (those are separate scopes, analyzed on
+    their own)."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class DonatedReuseRule(Rule):
+    id = "donated-batch-reuse"
+    description = "variable used after its buffer was donated to XLA"
+
+    def check_module(self, mod):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Module)):
+                yield from self._check_scope(mod, node)
+
+    @staticmethod
+    def _donators(scope) -> Dict[str, object]:
+        """name -> 'all' (donate_batch step) or set of donated positions."""
+        out: Dict[str, object] = {}
+        for node in _walk_scope(scope):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            call = node.value
+            callee = last_component(call.func)
+            spec = None
+            if callee in ("jit", "pjit"):
+                for k in call.keywords:
+                    if k.arg == "donate_argnums" \
+                            and isinstance(k.value, (ast.Tuple, ast.List)):
+                        spec = {e.value for e in k.value.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, int)}
+                    elif k.arg == "donate_argnums" \
+                            and isinstance(k.value, ast.Constant) \
+                            and isinstance(k.value.value, int):
+                        spec = {k.value.value}
+            elif callee in ("TrainStep", "EvalStep"):
+                for k in call.keywords:
+                    if k.arg == "donate_batch" \
+                            and isinstance(k.value, ast.Constant) \
+                            and k.value.value is True:
+                        spec = "all"
+            if spec:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = spec
+        return out
+
+    def _check_scope(self, mod, scope):
+        donators = self._donators(scope)
+        if not donators:
+            return
+
+        # events in evaluation order: loads fire where the name is read;
+        # donations fire at the END of their call; stores fire at the END
+        # of their whole statement (Python evaluates the RHS first, so
+        # `x = g(x)` donates x, then the store re-binds it clean).  For
+        # loop targets the binding point is the header (iter end), not
+        # the body end.
+        events: List[tuple] = []  # (line, col, prio, kind, name, node)
+
+        def store_events(target, anchor):
+            end = (anchor.end_lineno or anchor.lineno,
+                   anchor.end_col_offset or anchor.col_offset)
+            for n in ast.walk(target):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                    events.append((end[0], end[1], 2, "store", n.id, n))
+
+        for node in _walk_scope(scope):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Load):
+                    events.append((node.lineno, node.col_offset, 0,
+                                   "load", node.id, node))
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                for t in (node.targets if isinstance(node, ast.Assign)
+                          else [node.target]):
+                    store_events(t, node)
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Name):
+                    # x += v reads x too
+                    events.append((node.target.lineno,
+                                   node.target.col_offset, 0, "load",
+                                   node.target.id, node.target))
+                store_events(node.target, node)
+            elif isinstance(node, ast.NamedExpr):
+                store_events(node.target, node)
+            elif isinstance(node, ast.For):
+                store_events(node.target, node.iter)
+            elif isinstance(node, ast.withitem) \
+                    and node.optional_vars is not None:
+                store_events(node.optional_vars, node.context_expr)
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in donators:
+                spec = donators[node.func.id]
+                for i, a in enumerate(node.args):
+                    if not isinstance(a, ast.Name):
+                        continue
+                    if spec == "all" or i in spec:
+                        events.append((node.end_lineno or node.lineno,
+                                       node.end_col_offset or
+                                       node.col_offset, 1,
+                                       "donate", a.id, node))
+        events.sort(key=lambda e: (e[0], e[1], e[2]))
+
+        donated: Dict[str, int] = {}
+        for line, _col, _p, kind, name, node in events:
+            if kind == "load" and name in donated:
+                yield self.finding(
+                    mod, node,
+                    f"'{name}' is read after being donated on line "
+                    f"{donated[name]}: the buffer belongs to XLA now "
+                    f"(deleted array) — copy it first, re-bind the name, "
+                    f"or drop donate_batch/donate_argnums for this path")
+                del donated[name]  # one finding per donation
+            elif kind == "donate":
+                donated[name] = line
+            elif kind == "store":
+                donated.pop(name, None)

@@ -1,0 +1,268 @@
+"""Registry- and docs-consistency rules.
+
+The op registry (``mxnet_tpu/ops/registry.py``) is string-keyed: a
+second ``register_op("X")`` silently *shadows* the first (last writer
+wins, like the reference's NNVM registry refusing duplicates — which we
+don't, at runtime).  Similarly, a ``jax.custom_vjp`` wrapper whose
+``defvjp`` call was dropped in a refactor imports fine and fails only
+when ``jax.grad`` first touches it.  And ``docs/api.md`` rows rot as
+symbols are renamed.  All three are cross-file facts no single-file
+review sees — exactly what a project rule is for.
+
+``registry-duplicate``   the same op name registered (or aliased) from
+                         two distinct source sites
+``registry-missing-grad`` a ``jax.custom_vjp`` function with no
+                         ``.defvjp(...)`` installation in its module
+``docs-stale-symbol``    a ``docs/api.md`` "Here" cell naming a file
+                         that does not exist or a project symbol that is
+                         defined nowhere in the tree
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from .core import ProjectRule, Rule, last_component
+
+
+# --------------------------------------------------------------------------
+# registry registrations
+# --------------------------------------------------------------------------
+
+def _registrations(mod):
+    """(name, lineno) pairs this module registers: register_op first
+    args, their aliases= entries, and alias_op targets.  Only literal
+    names count — f-string loops (broadcast_* generation) are runtime
+    facts, not static ones."""
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = last_component(node.func)
+        if callee == "register_op":
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                out.append((node.args[0].value, node.lineno))
+            for k in node.keywords:
+                if k.arg == "aliases" \
+                        and isinstance(k.value, (ast.Tuple, ast.List)):
+                    out.extend((e.value, node.lineno) for e in k.value.elts
+                               if isinstance(e, ast.Constant)
+                               and isinstance(e.value, str))
+        elif callee == "alias_op":
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                out.append((node.args[0].value, node.lineno))
+    return out
+
+
+class DuplicateRegistrationRule(ProjectRule):
+    id = "registry-duplicate"
+    description = "op name registered/aliased from two distinct sites"
+
+    def check_project(self, modules, root):
+        sites: Dict[str, List[Tuple[str, int]]] = {}
+        mods = {}
+        for mod in modules:
+            mods[mod.relpath] = mod
+            for name, line in _registrations(mod):
+                sites.setdefault(name, []).append((mod.relpath, line))
+        for name, where in sorted(sites.items()):
+            if len(where) < 2:
+                continue
+            first = where[0]
+            for path, line in where[1:]:
+                yield Rule.finding(
+                    self, mods[path],
+                    type("L", (), {"lineno": line, "col_offset": 0}),
+                    f"op '{name}' is registered here but already "
+                    f"registered at {first[0]}:{first[1]} — the later "
+                    f"registration silently shadows the earlier one "
+                    f"(rename it or register an explicit alias of the "
+                    f"same function)")
+
+
+class MissingGradientRule(Rule):
+    id = "registry-missing-grad"
+    description = ("jax.custom_vjp function without a .defvjp "
+                   "installation (declared gradient never provided)")
+
+    def check_module(self, mod):
+        declared: Dict[str, ast.AST] = {}
+        installed = set()
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in node.decorator_list:
+                    base = d.func if isinstance(d, ast.Call) else d
+                    # @jax.custom_vjp and @partial(jax.custom_vjp, ...)
+                    if last_component(base) == "custom_vjp" or (
+                            isinstance(d, ast.Call)
+                            and last_component(d.func) == "partial"
+                            and d.args
+                            and last_component(d.args[0]) == "custom_vjp"):
+                        declared[node.name] = node
+            elif isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and last_component(node.value.func) == "custom_vjp":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        declared[t.id] = node
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "defvjp" \
+                    and isinstance(node.func.value, ast.Name):
+                installed.add(node.func.value.id)
+        for name, node in declared.items():
+            if name not in installed:
+                yield self.finding(
+                    mod, node,
+                    f"'{name}' is wrapped in jax.custom_vjp but no "
+                    f"'{name}.defvjp(fwd, bwd)' call exists in this "
+                    f"module: the declared custom gradient is never "
+                    f"installed and jax.grad will raise at runtime")
+
+
+# --------------------------------------------------------------------------
+# docs/api.md staleness
+# --------------------------------------------------------------------------
+
+_TOKEN_RE = re.compile(r"`([^`]+)`")
+_IDENT_RE = re.compile(r"^[A-Za-z_][A-Za-z0-9_.]*$")
+_PATH_EXTS = (".py", ".cc", ".c", ".h", ".md", ".json", ".so")
+# dotted tokens are resolved only under these project roots — `os.replace`
+# or `jax.distributed` in prose are not ours to check
+_PROJECT_PREFIXES = {
+    "mx", "mxnet_tpu", "parallel", "fault", "callback", "gluon", "nd",
+    "sym", "np", "npx", "contrib", "io", "profiler", "checkpoint",
+    "optimizer", "image", "random", "symbol", "executor", "module", "nn",
+    "rnn", "kvstore", "metric", "model", "viz", "mon", "amp", "onnx",
+    "recordio", "config", "runtime", "util", "tools", "step",
+}
+
+
+def build_symbol_index(modules) -> set:
+    """Every name the tree defines: functions/classes/methods at any
+    depth, assignments (including ``self.attr`` instance attributes),
+    registered op names, fault-injection point names, and module
+    basenames."""
+    index = set()
+    for mod in modules:
+        index.add(Path(mod.relpath).stem)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                index.add(node.name)
+            elif isinstance(node, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name):
+                            index.add(n.id)
+                        elif isinstance(n, ast.Attribute):
+                            index.add(n.attr)
+            elif isinstance(node, ast.Call) \
+                    and last_component(node.func) in ("fire", "_fire",
+                                                      "inject") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                # fault-injection point names are a documented surface
+                # (`io.producer` etc.) — docs referencing them are not
+                # stale as long as the fire() site exists
+                index.add(node.args[0].value)
+        for name, _ in _registrations(mod):
+            index.add(name)
+    return index
+
+
+class StaleDocSymbolRule(ProjectRule):
+    id = "docs-stale-symbol"
+    description = ("docs/api.md names a file or project symbol that no "
+                   "longer exists")
+    doc_path = Path("docs/api.md")
+
+    def check_project(self, modules, root):
+        doc = root / self.doc_path
+        if not doc.exists():
+            return
+        # the docs contract is against the WHOLE tree, not whatever
+        # subset this run analyzes: linting a single file must not make
+        # every doc row look stale
+        from .core import _collect_files, load_module
+        extra = []
+        have = {m.path.resolve() for m in modules}
+        for sub in ("mxnet_tpu", "tools", "bench.py"):
+            if (root / sub).exists():
+                extra.extend(m for m in (load_module(f, root)
+                                         for f in _collect_files([root / sub]))
+                             if m is not None
+                             and m.path.resolve() not in have)
+        index = build_symbol_index(list(modules) + extra)
+        lines = doc.read_text(encoding="utf-8").splitlines()
+        doc_mod = type("Doc", (), {"relpath": str(self.doc_path)})
+        for lineno, line in enumerate(lines, start=1):
+            for token in self._checkable_tokens(line):
+                yield from self._check_token(doc_mod, lineno, token, index,
+                                             root)
+
+    @staticmethod
+    def _checkable_tokens(line):
+        """Backticked tokens from the line's project-side cells.  In
+        tables the first cell is the *reference* column (MXNet 1.x
+        symbols, which legitimately do not exist here) — skip it."""
+        if line.strip().startswith("|"):
+            cells = line.split("|")[2:]  # drop leading '' + reference cell
+            text = "|".join(cells)
+        else:
+            text = line
+        return _TOKEN_RE.findall(text)
+
+    def _check_token(self, doc_mod, lineno, token, index, root):
+        token = token.strip().rstrip(",.;:")
+        if any(ch in token for ch in "*<>$= \""):
+            # globs, placeholders, flags, and `key=value` snippets are
+            # illustrative, not symbol references
+            token = token.split(" ")[0]
+            if any(ch in token for ch in "*<>$=\""):
+                return
+        # call-form: `fit(...)` / `mx.fault.inject(...)`
+        base = token.split("(")[0] if "(" in token else token
+        if "/" in base:
+            last = base.rsplit("/", 1)[-1]
+            if base.endswith("/") or last.endswith(_PATH_EXTS):
+                for cand in (root / base, root / "mxnet_tpu" / base):
+                    if cand.exists():
+                        return
+                yield Rule.finding(
+                    self, doc_mod,
+                    type("L", (), {"lineno": lineno, "col_offset": 0}),
+                    f"docs/api.md references path `{base}` which does "
+                    f"not exist in the tree")
+            return
+        if not _IDENT_RE.match(base):
+            return
+        if "." in base:
+            if base in index:  # full dotted name known (fault points)
+                return
+            first, last = base.split(".", 1)[0], base.rsplit(".", 1)[-1]
+            if first not in _PROJECT_PREFIXES:
+                return
+            if last not in index and first != last:
+                yield Rule.finding(
+                    self, doc_mod,
+                    type("L", (), {"lineno": lineno, "col_offset": 0}),
+                    f"docs/api.md names `{base}` but '{last}' is not "
+                    f"defined anywhere in the tree (renamed or removed?)")
+        elif "(" in token:
+            # bare call like `maybe_save()` — the parens mark it as a
+            # project callable claim
+            if base not in index:
+                yield Rule.finding(
+                    self, doc_mod,
+                    type("L", (), {"lineno": lineno, "col_offset": 0}),
+                    f"docs/api.md names callable `{base}()` but it is "
+                    f"not defined anywhere in the tree")

@@ -1,0 +1,187 @@
+"""Thread-safety rule.
+
+The async input feed (PrefetchingIter / DevicePrefetcher producer
+threads, DataLoader pools) made instance attributes shared state: an
+attribute a producer thread writes and a public method reads without
+the instance's lock is a torn read waiting for a scheduler change.  The
+rule is mechanical (the TensorFlow lesson — invariants, not review):
+
+``thread-unlocked-attr``
+    For every class that starts a ``threading.Thread`` on one of its
+    own methods (or subclasses ``Thread`` with a ``run``), every
+    attribute that producer-side code writes must be accessed from
+    public methods either under a ``with self.<lock>:`` block (any
+    attribute holding a ``Lock``/``RLock``/``Condition``) or through an
+    inherently thread-safe channel (``queue.Queue``/``Event``/
+    ``Semaphore`` attributes are exempt).
+
+Producer-side code is the transitive closure of ``self.X()`` calls from
+the thread target — a helper the producer calls runs on the producer
+thread too.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Set
+
+from .core import Rule, last_component
+
+_LOCK_TYPES = {"Lock", "RLock", "Condition"}
+_SAFE_TYPES = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+               "Event", "Semaphore", "BoundedSemaphore", "Barrier",
+               "deque", "Counter"}
+_MUTATORS = {"append", "extend", "insert", "add", "update", "pop",
+             "popitem", "remove", "discard", "clear", "setdefault",
+             "popleft", "appendleft", "__setitem__"}
+# dunders that are part of the public protocol surface (__init__ is not:
+# it runs before any thread exists)
+_PUBLIC_DUNDERS = {"__iter__", "__next__", "__enter__", "__exit__",
+                   "__len__", "__call__", "__contains__", "__getitem__"}
+
+
+def _self_attr(node) -> str | None:
+    """'X' for an ``self.X`` attribute node, else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class UnlockedAttrRule(Rule):
+    id = "thread-unlocked-attr"
+    description = ("producer-thread-written attribute accessed from a "
+                   "public method without the instance lock")
+
+    def check_module(self, mod):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(mod, node)
+
+    # ---- per-class analysis ----
+    def _check_class(self, mod, cls: ast.ClassDef):
+        methods: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+        producers = self._producer_methods(cls, methods)
+        if not producers:
+            return
+
+        locks, safe = self._attr_types(methods)
+        written = self._producer_writes(producers, methods, safe)
+        if not written:
+            return
+
+        for name, fn in methods.items():
+            if name in producers or name == "__init__":
+                continue
+            if name.startswith("_") and name not in _PUBLIC_DUNDERS:
+                continue
+            yield from self._check_public(mod, cls, name, fn, written,
+                                          locks, producers)
+
+    def _producer_methods(self, cls, methods) -> Set[str]:
+        """Thread targets + run() + the self-methods they call."""
+        producers: Set[str] = set()
+        if any(last_component(b) == "Thread" for b in cls.bases) \
+                and "run" in methods:
+            producers.add("run")
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call) \
+                    and last_component(node.func) == "Thread":
+                for k in node.keywords:
+                    if k.arg == "target":
+                        attr = _self_attr(k.value)
+                        if attr in methods:
+                            producers.add(attr)
+        # transitive: helpers invoked as self.X() from producer code run
+        # on the producer thread as well
+        while True:
+            grew = False
+            for p in list(producers):
+                for node in ast.walk(methods[p]):
+                    if isinstance(node, ast.Call):
+                        attr = _self_attr(node.func)
+                        if attr in methods and attr not in producers:
+                            producers.add(attr)
+                            grew = True
+            if not grew:
+                break
+        return producers
+
+    def _attr_types(self, methods):
+        """(lock attrs, thread-safe-channel attrs) by constructor name."""
+        locks, safe = set(), set()
+        for fn in methods.values():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call):
+                    ctor = last_component(node.value.func)
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        if ctor in _LOCK_TYPES:
+                            locks.add(attr)
+                        elif ctor in _SAFE_TYPES:
+                            safe.add(attr)
+        return locks, safe
+
+    def _producer_writes(self, producers, methods, safe) -> Dict[str, str]:
+        """attr -> producer method that writes it (plain rebinds of the
+        whole attribute and in-place mutation of its contents both
+        count; safe-channel attrs are exempt)."""
+        written: Dict[str, str] = {}
+
+        def note(attr, pname):
+            if attr is not None and attr not in safe:
+                written.setdefault(attr, pname)
+
+        for pname in producers:
+            for node in ast.walk(methods[pname]):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        note(_self_attr(t), pname)
+                        if isinstance(t, ast.Subscript):
+                            note(_self_attr(t.value), pname)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _MUTATORS:
+                    note(_self_attr(node.func.value), pname)
+        return written
+
+    def _check_public(self, mod, cls, name, fn, written, locks, producers):
+        """Flag accesses of producer-written attrs outside lock blocks."""
+
+        def walk(node, locked):
+            held = locked
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    expr = item.context_expr
+                    # `with self._lock:` (Lock attrs used as ctx managers)
+                    if _self_attr(expr) in locks:
+                        held = True
+                    # `with self._lock.acquire_timeout(...)`-style helpers
+                    elif isinstance(expr, ast.Call) \
+                            and isinstance(expr.func, ast.Attribute) \
+                            and _self_attr(expr.func.value) in locks:
+                        held = True
+            hits = []
+            if not held:
+                attr = _self_attr(node)
+                if attr in written:
+                    hits.append(self.finding(
+                        mod, node,
+                        f"{cls.name}.{name} accesses self.{attr} without "
+                        f"holding the instance lock, but "
+                        f"'{written[attr]}' writes it from the producer "
+                        f"thread — wrap the access in `with self."
+                        f"{sorted(locks)[0] if locks else '<lock>'}:` or "
+                        f"route it through a Queue/Event"))
+            for child in ast.iter_child_nodes(node):
+                hits.extend(walk(child, held))
+            return hits
+
+        yield from walk(fn, False)

@@ -1,0 +1,505 @@
+"""Trace-safety rules.
+
+A function that runs under ``jax.jit`` (directly, through a transform
+like ``grad``/``vmap``/``shard_map``, or because TrainStep/EvalStep/the
+symbolic executor compiles it) is *traced*: its array arguments are
+abstract tracers, and any operation that needs a concrete value — a
+host sync (``.item()``, ``float()``, ``np.asarray``, ``device_get``), a
+Python ``if``/``while`` on an array, a ``print`` — either fails at
+trace time or, worse, silently bakes trace-time state into the compiled
+program (the Julia→TPU literature calls this the compile-boundary
+discipline; it is the #1 hazard class of a whole-program-compile
+stack).  These rules find such operations *statically*, with a
+first-order taint walk: the traced function's parameters are tainted,
+assignment propagates taint, and accesses that are static even under
+trace (``.shape``/``.ndim``/``.dtype``, ``isinstance``/``len``,
+``is None``) are exempt.
+
+Rules:
+
+``trace-host-sync``      host-sync call on a traced value inside a
+                         traced function (also any ``print``: it runs
+                         at trace time, once, not per step)
+``trace-python-branch``  ``if``/``while``/ternary/``assert`` on a
+                         traced value (needs ``jnp.where``/``lax.cond``)
+``trace-mutable-global`` mutating module-level state from inside a
+                         traced function (runs at trace time only; the
+                         compiled steps never see it — and with the
+                         producer threads of the async feed it is a
+                         data race as well)
+``trace-unhashable-static``  list/dict/set literal passed in a
+                         ``static_argnums``/``static_argnames``
+                         position of a jitted callable (or any arg of
+                         an ``lru_cache``-ed one): unhashable statics
+                         fail at call time
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Rule, dotted_name, last_component, assigned_names
+
+# transforms whose function arguments execute under trace
+_JIT_WRAPPERS = {
+    "jit", "pjit", "grad", "value_and_grad", "vjp", "jvp", "linearize",
+    "eval_shape", "make_jaxpr", "vmap", "pmap", "checkpoint", "remat",
+    "shard_map", "pallas_call", "scan", "while_loop", "fori_loop", "cond",
+    "custom_vjp", "custom_jvp", "associative_scan",
+}
+
+# compile-path constructors of THIS framework: the named argument is
+# traced by the fused step (parallel/step.py) / the symbolic executor
+_COMPILE_SINKS = {"TrainStep": (1, "loss_fn"), "EvalStep": (None, None)}
+
+# attribute reads that are static under trace (abstract-value metadata)
+_STATIC_ATTRS = {
+    "shape", "ndim", "dtype", "size", "nbytes", "aval", "sharding",
+    "is_fully_addressable", "is_fully_replicated", "weak_type", "_fields",
+}
+
+# calls whose results are static under trace even on traced inputs
+_STATIC_CALLS = {
+    "isinstance", "issubclass", "len", "hasattr", "getattr", "callable",
+    "type", "id", "repr", "str", "format",
+}
+
+_HOST_SYNC_METHODS = {"item", "tolist", "asnumpy", "block_until_ready"}
+_HOST_SYNC_FUNCS = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "jax.device_get", "device_get", "np.copyto",
+}
+_CASTS = {"float", "int", "bool", "complex"}
+
+_MUTATORS = {
+    "append", "extend", "insert", "add", "update", "pop", "popitem",
+    "remove", "discard", "clear", "setdefault", "popleft", "appendleft",
+    "__setitem__",
+}
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.SetComp,
+               ast.DictComp, ast.GeneratorExp)
+
+
+# --------------------------------------------------------------------------
+# traced-function discovery
+# --------------------------------------------------------------------------
+
+def _is_jit_wrapper(node) -> bool:
+    """True for a decorator/callee that traces its function argument:
+    ``jax.jit``, ``lax.scan``, ``functools.partial(jax.jit, ...)``..."""
+    name = last_component(node)
+    if name in _JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call) and last_component(node.func) == "partial" \
+            and node.args and last_component(node.args[0]) in _JIT_WRAPPERS:
+        return True
+    return False
+
+
+def _returned_defs(fn: ast.FunctionDef) -> Set[str]:
+    """Names of nested defs this factory function returns."""
+    nested = {n.name for n in fn.body if isinstance(n, ast.FunctionDef)}
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Name) \
+                and node.value.id in nested:
+            out.add(node.value.id)
+    return out
+
+
+def _static_positions(call: Optional[ast.Call]):
+    """(param indices, param names) a jit/custom_vjp call marks static —
+    those arguments are concrete Python values, not tracers."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    if call is None:
+        return nums, names
+    for k in call.keywords:
+        if k.arg in ("static_argnums", "nondiff_argnums"):
+            if isinstance(k.value, (ast.Tuple, ast.List)):
+                nums |= {e.value for e in k.value.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, int)}
+            elif isinstance(k.value, ast.Constant) \
+                    and isinstance(k.value.value, int):
+                nums.add(k.value.value)
+        elif k.arg == "static_argnames":
+            if isinstance(k.value, (ast.Tuple, ast.List)):
+                names |= {e.value for e in k.value.elts
+                          if isinstance(e, ast.Constant)
+                          and isinstance(e.value, str)}
+            elif isinstance(k.value, ast.Constant) \
+                    and isinstance(k.value.value, str):
+                names.add(k.value.value)
+    return nums, names
+
+
+def find_traced_functions(tree: ast.Module) -> List[tuple]:
+    """(fn, static param indices, static param names) triples for the
+    functions in this module whose bodies execute under trace."""
+    defs: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    traced: List[tuple] = []
+    seen = {}
+
+    def mark(name_or_fn, nums=(), names=()):
+        fns = [name_or_fn] if isinstance(name_or_fn, ast.AST) \
+            else defs.get(name_or_fn or "", ())
+        for fn in fns:
+            if id(fn) not in seen:
+                entry = [fn, set(nums), set(names)]
+                seen[id(fn)] = entry
+                traced.append(entry)
+            else:  # merge static info from a second marking site
+                seen[id(fn)][1] |= set(nums)
+                seen[id(fn)][2] |= set(names)
+
+    # decorated: @jax.jit / @partial(jax.jit, static_argnums=...) ...
+    for fns in defs.values():
+        for fn in fns:
+            for d in fn.decorator_list:
+                if _is_jit_wrapper(d):
+                    nums, names = _static_positions(
+                        d if isinstance(d, ast.Call) else None)
+                    mark(fn, nums, names)
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_wrapper(node.func):
+            nums, names = _static_positions(node)
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    mark(arg.id, nums, names)
+                elif isinstance(arg, ast.Call) \
+                        and last_component(arg.func) == "partial" \
+                        and arg.args and isinstance(arg.args[0], ast.Name):
+                    # pallas_call(partial(kernel, ...)) — the partial'd
+                    # function is the one that traces; everything the
+                    # partial binds (positionally or by keyword) is a
+                    # concrete Python value, not a tracer
+                    bound_nums = set(range(len(arg.args) - 1))
+                    bound_names = {k.arg for k in arg.keywords if k.arg}
+                    mark(arg.args[0].id, nums | bound_nums,
+                         names | bound_names)
+                elif isinstance(arg, ast.Call) and \
+                        isinstance(arg.func, ast.Name):
+                    # factory pattern: jax.jit(make_fn(...)) traces the
+                    # nested def make_fn returns
+                    for fn in defs.get(arg.func.id, ()):
+                        for name in _returned_defs(fn):
+                            mark(name, nums, names)
+        sink = _COMPILE_SINKS.get(last_component(node.func) or "")
+        if sink:
+            pos, kw = sink
+            cand = None
+            if pos is not None and len(node.args) > pos:
+                cand = node.args[pos]
+            for k in node.keywords:
+                if k.arg == kw:
+                    cand = k.value
+            if isinstance(cand, ast.Name):
+                mark(cand.id)
+    return [tuple(e) for e in traced]
+
+
+# --------------------------------------------------------------------------
+# taint
+# --------------------------------------------------------------------------
+
+def _tainted_params(fn, static_nums=(), static_names=()) -> Set[str]:
+    args = fn.args
+    pos = [a.arg for a in args.posonlyargs + args.args]
+    names = [n for i, n in enumerate(pos) if i not in set(static_nums)]
+    names += [a.arg for a in args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return {n for n in names if n != "self" and n not in set(static_names)}
+
+
+def compute_taint(fn, static_nums=(), static_names=()) -> Set[str]:
+    """Parameters of ``fn`` (and of its nested defs — they run under the
+    same trace) plus everything assignment-reachable from them.  Params
+    in static/nondiff positions are concrete, not traced, and metadata
+    reads (``x.shape``) do not propagate taint."""
+    tainted = set(_tainted_params(fn, static_nums, static_names))
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            tainted |= _tainted_params(node)
+    for _ in range(3):  # small fixpoint: chains are short in practice
+        before = len(tainted)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign,
+                                 ast.NamedExpr)):
+                value = node.value
+                if value is None or not effective_taint(value, tainted):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    tainted |= assigned_names(t)
+            elif isinstance(node, ast.For):
+                if effective_taint(node.iter, tainted):
+                    tainted |= assigned_names(node.target)
+            elif isinstance(node, ast.comprehension):
+                if effective_taint(node.iter, tainted):
+                    tainted |= assigned_names(node.target)
+        if len(tainted) == before:
+            break
+    return tainted
+
+
+def effective_taint(expr, tainted: Set[str]) -> Set[str]:
+    """Tainted names whose VALUE (not just metadata) feeds ``expr``.
+
+    Skips subtrees that are static under trace: ``x.shape``-style
+    metadata reads, ``isinstance``/``len``-style calls, and
+    ``is (not) None`` comparisons.
+    """
+    out: Set[str] = set()
+
+    def walk(n):
+        if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+            return
+        if isinstance(n, ast.Call):
+            fname = last_component(n.func)
+            if fname in _STATIC_CALLS:
+                return
+        if isinstance(n, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in n.ops):
+            # identity compares are Python-object-level: always static
+            # under trace, never concretize a tracer
+            return
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                and n.id in tainted:
+            out.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    walk(expr)
+    return out
+
+
+# --------------------------------------------------------------------------
+# rules
+# --------------------------------------------------------------------------
+
+class _TracedRule(Rule):
+    """Base: iterates (traced function, taint set) pairs per module."""
+
+    def check_module(self, mod):
+        for fn, static_nums, static_names in find_traced_functions(mod.tree):
+            tainted = compute_taint(fn, static_nums, static_names)
+            yield from self.check_traced(mod, fn, tainted)
+
+    def check_traced(self, mod, fn, tainted):
+        return ()
+
+
+class HostSyncRule(_TracedRule):
+    id = "trace-host-sync"
+    description = ("host-synchronizing call on a traced value inside a "
+                   "jit-compiled function")
+
+    def check_traced(self, mod, fn, tainted):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if isinstance(func, ast.Attribute) \
+                    and func.attr in _HOST_SYNC_METHODS \
+                    and effective_taint(func.value, tainted):
+                yield self.finding(
+                    mod, node,
+                    f".{func.attr}() on traced value inside traced function "
+                    f"'{fn.name}': forces a host sync / fails under jit — "
+                    f"keep the value on device or move the sync outside "
+                    f"the compiled path")
+            dname = dotted_name(func)
+            if (dname in _HOST_SYNC_FUNCS
+                    or (last_component(func) or "") == "device_get") \
+                    and any(effective_taint(a, tainted) for a in node.args):
+                yield self.finding(
+                    mod, node,
+                    f"{dname or last_component(func)}() on traced value "
+                    f"inside traced function '{fn.name}': host sync under "
+                    f"jit — use jnp/lax equivalents on device")
+            if isinstance(func, ast.Name) and func.id in _CASTS \
+                    and node.args \
+                    and effective_taint(node.args[0], tainted):
+                yield self.finding(
+                    mod, node,
+                    f"{func.id}() on traced value inside traced function "
+                    f"'{fn.name}': concretizes the tracer (host sync / "
+                    f"ConcretizationTypeError) — use .astype or jnp casts")
+            if isinstance(func, ast.Name) and func.id == "print":
+                yield self.finding(
+                    mod, node,
+                    f"print() inside traced function '{fn.name}' runs at "
+                    f"TRACE time (once), not per step — use "
+                    f"jax.debug.print or log outside the compiled path")
+
+
+class TracedBranchRule(_TracedRule):
+    id = "trace-python-branch"
+    description = ("Python control flow on a traced value inside a "
+                   "jit-compiled function")
+
+    def check_traced(self, mod, fn, tainted):
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                names = effective_taint(node.test, tainted)
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression"}[type(node)]
+                if names:
+                    yield self.finding(
+                        mod, node,
+                        f"Python {kind} on traced value(s) "
+                        f"{sorted(names)} inside traced function "
+                        f"'{fn.name}': branches are resolved at trace "
+                        f"time — use jnp.where / lax.cond / lax.select")
+            elif isinstance(node, ast.Assert):
+                names = effective_taint(node.test, tainted)
+                if names:
+                    yield self.finding(
+                        mod, node,
+                        f"assert on traced value(s) {sorted(names)} inside "
+                        f"traced function '{fn.name}': evaluated at trace "
+                        f"time only — use checkify or a fused finite-guard")
+
+
+class MutableGlobalRule(_TracedRule):
+    id = "trace-mutable-global"
+    description = ("module-level state mutated from inside a "
+                   "jit-compiled function")
+
+    def check_module(self, mod):
+        module_names = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    module_names |= assigned_names(t)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                module_names |= assigned_names(node.target)
+        self._module_names = module_names
+        yield from super().check_module(mod)
+
+    def _root_name(self, node):
+        while isinstance(node, (ast.Attribute, ast.Subscript)):
+            node = node.value
+        return node.id if isinstance(node, ast.Name) else None
+
+    def check_traced(self, mod, fn, tainted):
+        local = set(_tainted_params(fn))
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        local.add(t.id)
+        globals_hit = self._module_names - local
+
+        def flag(node, root, how):
+            return self.finding(
+                mod, node,
+                f"traced function '{fn.name}' {how} module-level "
+                f"'{root}': runs at trace time only and races concurrent "
+                f"tracers — thread state through the function instead")
+
+        declared_global = {name for node in ast.walk(fn)
+                           if isinstance(node, ast.Global)
+                           for name in node.names}
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                yield self.finding(
+                    mod, node,
+                    f"'global {', '.join(node.names)}' inside traced "
+                    f"function '{fn.name}': writes happen at trace time "
+                    f"only — return the value instead")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    if isinstance(t, (ast.Subscript, ast.Attribute)):
+                        root = self._root_name(t)
+                        if root in globals_hit:
+                            yield flag(node, root, "mutates")
+                    elif isinstance(t, ast.Name) \
+                            and t.id in declared_global:
+                        yield flag(node, t.id, "rebinds")
+            elif isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _MUTATORS:
+                root = self._root_name(node.func.value)
+                if root in globals_hit:
+                    yield flag(node, root, "mutates")
+
+
+class UnhashableStaticRule(Rule):
+    id = "trace-unhashable-static"
+    description = ("unhashable literal passed in a static_argnums/"
+                   "static_argnames position (or to an lru_cache'd "
+                   "function)")
+
+    def check_module(self, mod):
+        jitted: Dict[str, tuple] = {}   # name -> (static names, nums)
+        cached: Set[str] = set()        # lru_cache'd defs: all args hashable
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and last_component(node.value.func) in ("jit", "pjit"):
+                nums, names = _static_positions(node.value)
+                if names or nums:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            jitted[t.id] = (names, nums)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for d in node.decorator_list:
+                    base = d.func if isinstance(d, ast.Call) else d
+                    if last_component(base) == "lru_cache":
+                        cached.add(node.name)
+                    if isinstance(d, ast.Call) \
+                            and last_component(d.func) in ("jit", "pjit"):
+                        nums, names = _static_positions(d)
+                        if names or nums:
+                            jitted[node.name] = (names, nums)
+
+        if not jitted and not cached:
+            return
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)):
+                continue
+            name = node.func.id
+            if name in jitted:
+                snames, snums = jitted[name]
+                for i, a in enumerate(node.args):
+                    if i in snums and isinstance(a, _UNHASHABLE):
+                        yield self.finding(
+                            mod, a,
+                            f"unhashable literal in static position {i} of "
+                            f"jitted '{name}': static args key the compile "
+                            f"cache and must be hashable — use a tuple")
+                for k in node.keywords:
+                    if k.arg in snames and isinstance(k.value, _UNHASHABLE):
+                        yield self.finding(
+                            mod, k.value,
+                            f"unhashable literal for static arg "
+                            f"'{k.arg}' of jitted '{name}' — use a tuple")
+            elif name in cached:
+                for a in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(a, _UNHASHABLE):
+                        yield self.finding(
+                            mod, a,
+                            f"unhashable literal passed to lru_cache'd "
+                            f"'{name}': every argument is a cache key — "
+                            f"use a tuple")
