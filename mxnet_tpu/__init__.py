@@ -69,6 +69,7 @@ from . import symbol as sym          # mx.sym — symbolic graph frontend
 from . import executor
 from . import module
 from . import module as mod          # mx.mod — Module API
+from . import serving                # mx.serving — inference serving runtime
 from . import model                  # mx.model — checkpoint helpers
 from . import rnn                    # mx.rnn — legacy symbolic RNN cells
 from . import name                   # mx.name — NameManager/Prefix scopes

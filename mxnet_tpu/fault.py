@@ -19,7 +19,9 @@ module is the shared substrate the rest of the stack builds on:
 - ``with_context(exc, msg)`` — attach producer/worker provenance to an
   exception that crosses a thread boundary before it is re-raised.
 
-Known injection points (``fire`` call sites):
+Known injection points (``fire`` call sites; the same table is what
+``points()`` returns and what ``inject`` validates against — a typo'd
+point name raises instead of silently never firing):
 
 ===========================  ==============================================
 point                        location
@@ -32,6 +34,14 @@ point                        location
                              written, before ``os.replace`` commits it
 ``step``                     TrainStep._step entry (before batch placement)
 ``distributed.connect``      distributed.init, inside each connect attempt
+``serving.admit``            InferenceServer.submit entry (before any
+                             admission decision)
+``serving.batch``            DynamicBatcher dispatch, before padding a
+                             coalesced group
+``serving.step``             InferenceServer batch/probe execution, before
+                             the apply fn touches the device
+``serving.drain``            InferenceServer.drain entry (before admission
+                             stops)
 ===========================  ==============================================
 
 This module imports only the standard library (it is pulled in by
@@ -44,11 +54,27 @@ import signal as _signal
 import threading
 import time
 
-__all__ = ["inject", "fire", "points", "retry_call", "GracefulExit",
-           "with_context"]
+__all__ = ["inject", "fire", "points", "armed", "register_point",
+           "retry_call", "backoff_delay", "GracefulExit", "with_context"]
 
 _REGISTRY = {}            # point -> _Injection (armed faults)
+_KNOWN = {}               # point -> location blurb (the documented surface)
 _lock = threading.Lock()
+
+
+def register_point(point, where=""):
+    """Declare ``point`` as a known ``fire()`` surface.
+
+    ``inject`` only arms registered points — a typo'd name raises a
+    ``ValueError`` immediately instead of silently never firing (the
+    failure mode this registry exists to kill).  Registration is
+    idempotent; subsystems with their own points (tests included) call
+    this at import time.  Returns ``point`` so it can annotate a
+    constant."""
+    point = str(point)
+    with _lock:
+        _KNOWN.setdefault(point, str(where))
+    return point
 
 
 class _Injection:
@@ -104,6 +130,14 @@ class inject:
     """
 
     def __init__(self, point, error, after_n=0, times=None):
+        with _lock:
+            known = point in _KNOWN
+        if not known:
+            raise ValueError(
+                f"inject: unknown fault point {point!r} — it has no fire() "
+                f"site and would silently never trigger.  Known points: "
+                f"{sorted(_KNOWN)}; fault.register_point() declares a new "
+                f"one")
         self._inj = _Injection(point, error, after_n=after_n, times=times)
         self._prev = None
 
@@ -139,12 +173,51 @@ def fire(point):
 
 
 def points():
-    """Names of the currently armed injection points."""
+    """Names of every REGISTERED injection point — the documented fault
+    surface of the stack (the docstring table), whether or not anything
+    is currently armed.  ``armed()`` gives the armed subset."""
+    with _lock:
+        return sorted(_KNOWN)
+
+
+def armed():
+    """Names of the injection points currently armed via ``inject``."""
     with _lock:
         return sorted(_REGISTRY)
 
 
+# the shipped fault surface (keep in sync with the docstring table; the
+# serving.* points belong to mxnet_tpu/serving, registered here so the
+# surface is complete even before that package imports)
+for _p, _w in (
+    ("io.producer", "PrefetchingIter/DataLoader producers, per batch"),
+    ("prefetch.device_put", "DevicePrefetcher producer, before placement"),
+    ("checkpoint.write", "save_train_step entry, before any file I/O"),
+    ("checkpoint.replace", "save_train_step, before os.replace commits"),
+    ("step", "TrainStep._step entry, before batch placement"),
+    ("distributed.connect", "distributed.init, inside each connect attempt"),
+    ("serving.admit", "InferenceServer.submit entry"),
+    ("serving.batch", "DynamicBatcher dispatch, before padding a group"),
+    ("serving.step", "InferenceServer batch/probe apply, before the device"),
+    ("serving.drain", "InferenceServer.drain entry"),
+):
+    register_point(_p, _w)
+del _p, _w
+
+
 # ------------------------------------------------------------------ retry --
+def backoff_delay(attempt, base_delay=0.5, max_delay=8.0, jitter=0.5):
+    """Backoff before retry ``attempt`` (1-based): ``base_delay *
+    2**(attempt-1)`` capped at ``max_delay``, stretched by up to
+    ``jitter`` fraction of itself.  The one exponential-backoff policy in
+    the stack — ``retry_call`` consumes it as a blocking loop, the serving
+    circuit breaker as a state-machine probe schedule (a serving thread
+    must never sleep out a backoff)."""
+    delay = min(float(max_delay), float(base_delay) * 2 ** (int(attempt) - 1))
+    return delay * (1.0 + float(jitter) * _random.random())
+
+
+
 def retry_call(fn, retries=4, base_delay=0.5, max_delay=8.0, deadline=None,
                jitter=0.5, retry_on=(Exception,), on_retry=None,
                giveup=None):
@@ -171,8 +244,7 @@ def retry_call(fn, retries=4, base_delay=0.5, max_delay=8.0, deadline=None,
             attempt += 1
             if attempt > retries:
                 raise
-            delay = min(float(max_delay), float(base_delay) * 2 ** (attempt - 1))
-            delay *= 1.0 + jitter * _random.random()
+            delay = backoff_delay(attempt, base_delay, max_delay, jitter)
             if deadline is not None:
                 remaining = deadline - (time.monotonic() - t0)
                 if remaining <= 0:
@@ -204,6 +276,13 @@ class GracefulExit:
         self.enabled = False
         self.requested = False
         self.signum = None
+        # True when the latched signal was also delivered to an ENCLOSING
+        # GracefulExit.  A scope that arms a latch purely for cleanup
+        # (Module.predict/score) checks this to decide between returning
+        # gracefully (an outer latch owns the lifecycle) and re-delivering
+        # the signal (nobody asked for graceful handling — swallowing a
+        # SIGTERM would keep a process alive its operator tried to stop).
+        self.forwarded = False
 
     def _handler(self, signum, frame):
         if self.requested:        # second signal: escalate to the old handler
@@ -214,6 +293,23 @@ class GracefulExit:
             raise KeyboardInterrupt
         self.requested = True
         self.signum = signum
+        # Nested latches (Module.predict/score arm one inside fit's) must
+        # not swallow the signal for the outer scope: a SIGTERM during the
+        # eval pass still has to make the training loop snapshot-and-exit.
+        # Forward the latch to the enclosing GracefulExit, if that is who
+        # we displaced.
+        prev = self._prev.get(signum)
+        outer = getattr(prev, "__self__", None)
+        if isinstance(outer, GracefulExit):
+            if not outer.requested:
+                # invoke the displaced handler rather than poking attrs:
+                # IT forwards to ITS predecessor too, so the latch chain
+                # cascades to any depth (user latch around fit around
+                # score).  Only when the outer is un-requested — its
+                # handler's requested-branch is the second-signal
+                # escalation path, not a forward.
+                prev(signum, frame)
+            self.forwarded = True
 
     def __enter__(self):
         if not self._want:

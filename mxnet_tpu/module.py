@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import logging
 import os
+import signal as _signal
 import time
 from typing import Dict, List, Optional
 
@@ -636,27 +637,79 @@ def _clear_fit_snapshot(prefix):
     _prune_fit_snapshots(prefix)
 
 
+def _close_feed(it):
+    """Join a wrapped async feed's producer threads (PrefetchingIter /
+    DevicePrefetcher / DataLoader expose ``close()``).  Only called on
+    EARLY exit or error — a cleanly-exhausted iterator stays open so the
+    caller can ``reset()`` and reuse it."""
+    close = getattr(it, "close", None)
+    if callable(close):
+        try:
+            close()
+        except Exception:
+            pass
+
+
+def _redeliver_unclaimed(gexit):
+    """An inference loop's latch caught a signal, cleanup is done, and
+    the handlers are restored.  If an ENCLOSING latch also saw it (fit's
+    preemption latch, a serving runtime's) the graceful path is theirs —
+    return normally.  If nobody else asked for graceful handling,
+    re-deliver the signal under the restored handlers: swallowing a
+    SIGTERM here would leave a process its operator tried to kill
+    training for another 99 epochs."""
+    if gexit.requested and not gexit.forwarded:
+        _signal.raise_signal(gexit.signum)
+
+
+def _infer_loop(mod, eval_data, num_batch, on_batch):
+    """The interrupt/cleanup scaffold score and predict share.  Both
+    honor ``fault.GracefulExit`` (inside an armed latch — fit's, or a
+    caller's — a SIGTERM/SIGINT stops at the next batch boundary with
+    partial results; with no outer latch the signal is re-delivered after
+    cleanup) and close a wrapped async feed on early exit or error, so an
+    interrupted inference pass never leaks producer threads (PR 2 gave
+    ``fit`` this hygiene; these are the inference paths).  ``on_batch``
+    consumes each completed forward."""
+    if callable(getattr(eval_data, "reset", None)):
+        eval_data.reset()
+    with _fault.GracefulExit() as gexit:
+        try:
+            for i, batch in enumerate(eval_data):
+                if num_batch is not None and i >= num_batch:
+                    break
+                mod.forward(batch, is_train=False)
+                on_batch(batch)
+                if gexit.requested:
+                    _close_feed(eval_data)
+                    break
+        except BaseException:
+            _close_feed(eval_data)
+            raise
+    _redeliver_unclaimed(gexit)
+
+
 def _score_loop(mod, eval_data, eval_metric, num_batch=None):
     if isinstance(eval_metric, str):
         eval_metric = _metric.create(eval_metric)
     eval_metric.reset()
-    eval_data.reset()
-    for i, batch in enumerate(eval_data):
-        if num_batch is not None and i >= num_batch:
-            break
-        mod.forward(batch, is_train=False)
-        mod.update_metric(eval_metric, batch.label)
+    _infer_loop(mod, eval_data, num_batch,
+                lambda batch: mod.update_metric(eval_metric, batch.label))
     return [eval_metric.get()]
 
 
 def _predict_loop(mod, eval_data, num_batch=None):
-    eval_data.reset()
     chunks = []
-    for i, batch in enumerate(eval_data):
-        if num_batch is not None and i >= num_batch:
-            break
-        mod.forward(batch, is_train=False)
-        chunks.append(mod.get_outputs()[0].asnumpy())
+    _infer_loop(mod, eval_data, num_batch,
+                lambda batch: chunks.append(mod.get_outputs()[0].asnumpy()))
+    if not chunks:
+        # no batch completed (empty iterator, or an outer-latched signal
+        # before the first one): there is no output to infer a correct
+        # shape/dtype from, and a fabricated (0,)-shaped float32 array
+        # would crash callers later (preds[:, k]) instead of here
+        raise ValueError("predict: no batches were processed — the data "
+                         "iterator was empty or a signal stopped the pass "
+                         "before the first batch completed")
     return nd.array(np.concatenate(chunks, axis=0))
 
 

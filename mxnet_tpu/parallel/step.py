@@ -36,8 +36,35 @@ from .functional import (FunctionalState, functional_call,
                          param_names_and_values, trainable_split)
 from .functional_opt import pure_update, state_template
 
-__all__ = ["TrainStep", "EvalStep", "add_transfer_hook",
+__all__ = ["TrainStep", "EvalStep", "all_finite_rows", "add_transfer_hook",
            "remove_transfer_hook"]
+
+
+def all_finite_rows(arrays):
+    """Per-example all-finite verdict over batch-major arrays.
+
+    The serving-side counterpart of ``TrainStep(skip_nonfinite=True)``'s
+    fused guard: the same isfinite/and reduction, taken per ROW instead
+    of over the whole update, so the InferenceServer can fail exactly the
+    poisoned request in a batch while its neighbours (and the server)
+    carry on.  ``arrays`` is one array or a list whose leading axis is
+    the batch; returns a host bool mask of shape ``(batch,)`` — True
+    where every element of that example's outputs is finite."""
+    mask = None
+    for a in arrays if isinstance(arrays, (list, tuple)) else (arrays,):
+        x = a._data if isinstance(a, NDArray) else a
+        if isinstance(x, np.ndarray):
+            # already on host (the serving path lands here after its
+            # outputs were pulled for splitting): a host reduction —
+            # round-tripping through the device would ADD two transfers
+            # plus a sync per batch
+            m = np.isfinite(x.reshape((x.shape[0], -1))).all(axis=1)
+        else:
+            # still on device: reduce there, ship back one bool per row
+            m = np.asarray(jnp.all(
+                jnp.isfinite(jnp.reshape(x, (x.shape[0], -1))), axis=1))
+        mask = m if mask is None else np.logical_and(mask, m)
+    return np.asarray(mask)
 
 # Observers of actual host→device batch transfers (called as fn(leaf,
 # sharding) right before each real device_put in _put_batch — NOT for

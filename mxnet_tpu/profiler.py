@@ -25,7 +25,7 @@ import time
 
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "reset", "Task", "Frame", "Event", "Counter",
-           "Marker", "scope", "counter_value"]
+           "Marker", "scope", "counter_value", "counters"]
 
 _lock = threading.Lock()
 
@@ -268,6 +268,17 @@ def counter_value(name, default=None):
     return default if c is None else c._value
 
 
+def counters(prefix=None):
+    """``{name: value}`` snapshot over the live Counters, optionally
+    filtered to names starting with ``prefix``.  Like ``counter_value``,
+    reads regardless of profiler state — a serving health endpoint polls
+    ``counters("InferenceServer::")`` with the profiler off."""
+    with _lock:
+        items = list(_COUNTERS.items())
+    return {n: c._value for n, c in items
+            if prefix is None or n.startswith(prefix)}
+
+
 class Counter:
     """Numeric counter series (ref: profiler.Counter)."""
 
@@ -289,12 +300,17 @@ class Counter:
         self._value = value
         self._emit()
 
+    # increments are read-modify-write and counters are shared across
+    # threads (serving sheds from every client thread) — take the module
+    # lock for the update, emit outside it (_emit re-acquires)
     def increment(self, delta=1):
-        self._value += delta
+        with _lock:
+            self._value += delta
         self._emit()
 
     def decrement(self, delta=1):
-        self._value -= delta
+        with _lock:
+            self._value -= delta
         self._emit()
 
 

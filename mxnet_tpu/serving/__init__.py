@@ -1,0 +1,26 @@
+"""mx.serving — fault-hardened inference serving runtime (ISSUE 4).
+
+The inference-side sibling of ``mx.fault``'s training runtime: admission
+control with load shedding, deadline-aware shape-bucketed dynamic
+batching (bounded jit cache — recompiles are the TPU availability
+killer), a circuit breaker with exponential half-open probing, health
+predicates, and SIGTERM graceful drain.  See ``docs/api.md`` "Serving".
+
+    from mxnet_tpu import serving
+
+    srv = serving.InferenceServer(apply_fn, buckets=(1, 4, 8),
+                                  sample=example).start()
+    out = srv(example, deadline=0.1)          # submit + blocking result
+    srv.drain()                               # or serve_forever() + SIGTERM
+"""
+from .admission import (RejectedError, CircuitOpenError, ServerClosedError,
+                        DeadlineExceededError, NonFiniteOutputError,
+                        TokenBucket, Request)
+from .batcher import BucketSpec, DynamicBatcher
+from .breaker import CircuitBreaker
+from .server import InferenceServer, module_apply
+
+__all__ = ["InferenceServer", "module_apply", "BucketSpec",
+           "DynamicBatcher", "CircuitBreaker", "TokenBucket", "Request",
+           "RejectedError", "CircuitOpenError", "ServerClosedError",
+           "DeadlineExceededError", "NonFiniteOutputError"]

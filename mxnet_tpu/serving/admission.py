@@ -1,0 +1,139 @@
+"""Admission-control primitives: errors, rate limiting, request futures.
+
+ref: the reference stack has no serving layer at all (Module.predict is a
+bare loop); the design here follows the TPU-serving literature's stance
+(PAPERS.md — Ragged Paged Attention, the Gemma-on-TPU serving comparison)
+that overload is a *normal* lifecycle event: a server that cannot keep up
+must say so immediately (bounded queue, explicit ``RejectedError``) rather
+than buffer without bound and melt every request into a timeout.
+
+Everything here is stdlib-only; the device-facing pieces live in
+``serving.server``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["RejectedError", "CircuitOpenError", "ServerClosedError",
+           "DeadlineExceededError", "NonFiniteOutputError", "TokenBucket",
+           "Request"]
+
+
+class RejectedError(RuntimeError):
+    """The server refused the request at admission (queue full, rate
+    limit, oversize shape).  Shedding is an explicit, immediate verdict
+    the client can retry against another replica — never an unbounded
+    queue.  The request did NOT touch the device."""
+
+
+class CircuitOpenError(RejectedError):
+    """Fast-fail: the circuit breaker is open after consecutive step
+    failures; new work is refused until a half-open probe succeeds."""
+
+
+class ServerClosedError(RejectedError):
+    """The server is draining or has shut down — not admitting."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed while it waited in queue; it was
+    expired without touching the device."""
+
+
+class NonFiniteOutputError(RuntimeError):
+    """This request's rows of the batched output contained NaN/Inf — the
+    request fails alone; batch neighbours and the server are unaffected
+    (the serving counterpart of ``TrainStep(skip_nonfinite=True)``)."""
+
+
+class TokenBucket:
+    """Token-bucket rate limiter: ``rate`` tokens/second refill up to a
+    ``burst`` capacity; ``try_acquire`` never blocks (admission control
+    sheds, it does not queue the client thread)."""
+
+    def __init__(self, rate, burst=None):
+        if rate <= 0:
+            raise ValueError("TokenBucket: rate must be > 0")
+        self._rate = float(rate)
+        self._capacity = float(burst) if burst is not None \
+            else max(1.0, self._rate)
+        if self._capacity < 1.0:
+            raise ValueError("TokenBucket: burst must allow >= 1 token")
+        self._tokens = self._capacity
+        self._stamp = time.monotonic()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, n=1.0):
+        """Take ``n`` tokens if available; False (no side effect) if not."""
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self._capacity,
+                               self._tokens + (now - self._stamp) * self._rate)
+            self._stamp = now
+            if self._tokens >= n:
+                self._tokens -= n
+                return True
+            return False
+
+    def refund(self, n=1.0):
+        """Return tokens a request charged but never used (it was shed
+        downstream of the limiter) — otherwise refused work burns the
+        budget valid clients needed.  Capped at capacity."""
+        with self._lock:
+            self._tokens = min(self._capacity, self._tokens + n)
+
+
+class Request:
+    """One accepted inference request: payload + deadline + a future.
+
+    The client thread blocks in ``result()``; the batch thread resolves
+    it with ``set_result``/``set_error``.  The handoff is the
+    ``threading.Event`` — by the time ``wait()`` returns, the write is
+    visible.  ``deadline`` is seconds from submission; an expired request
+    is failed with ``DeadlineExceededError`` *in queue*, without touching
+    the device.
+    """
+
+    __slots__ = ("data", "submitted_at", "deadline", "_event", "_result",
+                 "_error")
+
+    def __init__(self, data, deadline=None):
+        self.data = data
+        self.submitted_at = time.monotonic()
+        self.deadline = None if deadline is None \
+            else self.submitted_at + float(deadline)
+        self._event = threading.Event()
+        self._result = None
+        self._error = None
+
+    def expired(self, now=None):
+        return self.deadline is not None and \
+            (time.monotonic() if now is None else now) >= self.deadline
+
+    # ---- resolution (batch-thread side) ----
+    def set_result(self, value):
+        self._result = value
+        self._event.set()
+
+    def set_error(self, exc):
+        self._error = exc
+        self._event.set()
+
+    # ---- future protocol (client side) ----
+    def done(self):
+        return self._event.is_set()
+
+    def exception(self, timeout=None):
+        """The error this request resolved with (None on success); raises
+        builtin ``TimeoutError`` if unresolved within ``timeout``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("Request: not resolved within "
+                               f"{timeout}s")
+        return self._error
+
+    def result(self, timeout=None):
+        err = self.exception(timeout)
+        if err is not None:
+            raise err
+        return self._result

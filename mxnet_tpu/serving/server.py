@@ -1,0 +1,480 @@
+"""InferenceServer: the fault-hardened serving front of the stack.
+
+Turns a compiled apply fn (a jitted function, an ``EvalStep``, a Gluon
+net, or a bound ``Module`` via ``module_apply``) into a request server
+with the full robustness lifecycle (ISSUE 4):
+
+- **admission control** — bounded queue + optional token-bucket rate
+  limit; overload sheds with ``RejectedError`` instead of growing a
+  queue.
+- **dynamic batching** — requests coalesce into fixed shape buckets
+  (``serving.BucketSpec``) so the jit cache stays a configuration
+  constant; recompiles, the TPU availability killer, cannot be triggered
+  by traffic.
+- **deadlines + circuit breaker** — queued requests expire without
+  touching the device; consecutive step failures trip into fast-fail with
+  exponential half-open probing (``serving.CircuitBreaker``).
+- **health + drain** — ``alive()``/``ready()``/``healthz()`` predicates
+  (readiness flips only after warmup compiles), profiler counters, and a
+  SIGTERM drain (``serve_forever`` on ``fault.GracefulExit``): stop
+  admitting, flush every accepted request to a terminal state, exit.  An
+  accepted request is NEVER silently dropped.
+
+Every failure path is deterministically testable through the
+``serving.admit`` / ``serving.batch`` / ``serving.step`` /
+``serving.drain`` fault points (``fault.inject``).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from .. import fault as _fault
+from .. import profiler as _profiler
+from .admission import (CircuitOpenError, DeadlineExceededError,
+                        NonFiniteOutputError, RejectedError, Request,
+                        ServerClosedError, TokenBucket)
+from .batcher import BucketSpec, DynamicBatcher
+from .breaker import OPEN, CircuitBreaker
+
+__all__ = ["InferenceServer", "module_apply"]
+
+
+def _to_np(out):
+    """Normalize one apply-fn output to a numpy batch array."""
+    if hasattr(out, "asnumpy"):         # NDArray
+        return out.asnumpy()
+    return np.asarray(out)
+
+
+class InferenceServer:
+    """Robust request server over a batched apply fn.
+
+    ``apply_fn(*batch_leaves) -> batch_out | tuple`` runs on the batch
+    thread only, always on shapes from the bucket grid.  Per-request
+    payloads are single examples (one row of the batch; tuples for
+    multi-input models).
+
+    Lifecycle: construct → ``start()`` (warmup-compiles every batch
+    bucket when ``sample`` is given, THEN flips readiness — a recompile
+    stall never lands on a live request) → ``submit()``/``__call__`` →
+    ``drain()`` (or ``serve_forever()`` + SIGTERM).
+
+    Thread contract (mxlint-gated): client threads and the batch thread
+    share state only through the batcher's bounded queue, ``Event``s,
+    profiler ``Counter``s, the breaker's own lock, and the
+    ``self._lock``-guarded stats dict.
+
+    Profiler series (readable with the profiler off via
+    ``profiler.counter_value`` / ``profiler.counters``):
+    ``<name>::queue_depth``, ``<name>::shed``, ``<name>::expired``,
+    ``<name>::batch_occupancy`` (percent, last dispatched batch),
+    ``<name>::breaker_state`` (0 closed / 1 half-open / 2 open).
+    """
+
+    def __init__(self, apply_fn, buckets=(1, 2, 4, 8), *, max_queue=128,
+                 max_delay=0.005, rate=None, burst=None, breaker=None,
+                 sample=None, default_deadline=None, guard_nonfinite=True,
+                 pin_signature=True, name="InferenceServer"):
+        self._apply = apply_fn
+        self.buckets = buckets if isinstance(buckets, BucketSpec) \
+            else BucketSpec(buckets)
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._limiter = None if rate is None else TokenBucket(rate, burst)
+        self._default_deadline = default_deadline
+        self._guard = bool(guard_nonfinite)
+        # pin_signature (default on): the served example signature is
+        # fixed — by ``sample``, else by the first accepted request — and
+        # any later payload with a different leaf count/dtype/shape
+        # (beyond the length grid) is REJECTED at admission.  Without
+        # this, one stray float64 list or transposed array from a client
+        # is a fresh XLA compile stalling the device under live
+        # deadlines — the exact failure the bucket grid exists to kill.
+        self._pin = bool(pin_signature)
+        self._name = name
+        self._sample = None if sample is None \
+            else self.buckets.pad_example(sample)
+        # only (shape, dtype) per leaf is ever compared — storing the
+        # actual first-request arrays would pin them (and alias the
+        # client's buffers) for the server's lifetime
+        self._template = None if self._sample is None \
+            else self._sig_of(self._sample)
+        self._lock = threading.Lock()
+        self._stats = {"admitted": 0, "completed": 0, "failed": 0,
+                       "shed": 0, "expired": 0, "rejected": 0,
+                       "batches": 0, "probes": 0}
+        self._shapes = set()          # distinct dispatched signatures
+        self._ready = threading.Event()
+        self._draining = threading.Event()
+        self._c_depth = _profiler.Counter(None, f"{name}::queue_depth")
+        self._c_shed = _profiler.Counter(None, f"{name}::shed")
+        self._c_expired = _profiler.Counter(None, f"{name}::expired")
+        self._c_occupancy = _profiler.Counter(None,
+                                              f"{name}::batch_occupancy")
+        self._c_breaker = _profiler.Counter(None, f"{name}::breaker_state")
+        self._batcher = DynamicBatcher(
+            self._run_batch, self.buckets, max_delay=max_delay,
+            capacity=max_queue, on_expire=self._expire,
+            on_fail=lambda req, exc: self._bump("failed"),
+            idle=self._idle_probe, name=f"{name}-batcher")
+
+    # ------------------------------------------------------------ lifecycle --
+    def start(self, warmup=None):
+        """Start the batch thread.  ``warmup`` (default: on when a
+        ``sample`` payload was given) first pushes the sample through
+        every batch bucket so EVERY executable the grid allows exists
+        before readiness flips — compiles happen here, not under a live
+        deadline."""
+        if self._draining.is_set():
+            raise ServerClosedError(f"{self._name}: already drained")
+        if warmup is None:
+            warmup = self._sample is not None
+        if warmup:
+            if self._sample is None:
+                raise ValueError("start(warmup=True) needs sample= at "
+                                 "construction")
+            for leaves in self._sample_grid():
+                for b in self.buckets.batch:
+                    self._apply(*self._padded(leaves, b))
+                    with self._lock:
+                        self._shapes.add((b,)
+                                         + BucketSpec.signature(leaves))
+        self._batcher.start()
+        self._ready.set()
+        return self
+
+    def __enter__(self):
+        if not self._batcher.alive():
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.drain()
+        return False
+
+    def _sample_grid(self):
+        """The sample resized onto every length bucket (the whole grid a
+        request could dispatch as — warmup must compile all of it, not
+        just the sample's own bucket)."""
+        if self.buckets.length is None:
+            return [self._sample]
+        head, rest = self._sample[0], self._sample[1:]
+        out = []
+        for L in self.buckets.length:
+            h = head[:L]
+            if h.shape[0] < L:
+                h = np.concatenate(
+                    [h, np.full((L - h.shape[0],) + h.shape[1:],
+                                self.buckets.pad_value, h.dtype)], axis=0)
+            out.append((h,) + rest)
+        return out
+
+    @staticmethod
+    def _padded(leaves, b):
+        return tuple(np.stack([leaf] * b, axis=0) for leaf in leaves)
+
+    @staticmethod
+    def _sig_of(leaves):
+        return tuple((tuple(l.shape), l.dtype) for l in leaves)
+
+    def _check_signature(self, payload):
+        """Admission-time signature pinning (see ``pin_signature``)."""
+        if not self._pin:
+            return
+        sig = self._sig_of(payload)
+        with self._lock:
+            tpl = self._template
+            if tpl is None:
+                self._template = sig       # first request defines the API
+                return
+        if len(sig) != len(tpl):
+            raise RejectedError(
+                f"payload has {len(sig)} leaves, this server serves "
+                f"{len(tpl)} — a new signature would recompile")
+        for i, ((p_shape, p_dt), (t_shape, t_dt)) in enumerate(zip(sig,
+                                                                   tpl)):
+            if p_dt != t_dt:
+                raise RejectedError(
+                    f"payload leaf {i} dtype {p_dt} != served "
+                    f"{t_dt} — a new signature would recompile (lists "
+                    f"arrive float64; cast explicitly)")
+            ragged = i == 0 and self.buckets.length is not None
+            if (p_shape[1:] if ragged else p_shape) != \
+                    (t_shape[1:] if ragged else t_shape):
+                raise RejectedError(
+                    f"payload leaf {i} shape {p_shape} does not match the "
+                    f"served signature {t_shape}"
+                    f"{' beyond the length axis' if ragged else ''} — a "
+                    f"new signature would recompile")
+
+    # ------------------------------------------------------------ admission --
+    def submit(self, data, deadline=None):
+        """Admit one request; returns its ``Request`` future.
+
+        Refusals are immediate and explicit: ``ServerClosedError`` while
+        draining, ``CircuitOpenError`` while the breaker fast-fails,
+        ``RejectedError`` on rate-limit, full queue, or an un-bucketable
+        shape.  None of them touched the device or consumed queue space."""
+        _fault.fire("serving.admit")
+        if self._draining.is_set():
+            self._bump("rejected")
+            raise ServerClosedError(f"{self._name}: draining — "
+                                    f"not admitting")
+        if not self._ready.is_set():
+            self._bump("rejected")
+            raise RejectedError(f"{self._name}: not started")
+        if not self._batcher.alive():
+            self._bump("rejected")
+            raise ServerClosedError(f"{self._name}: batch thread is not "
+                                    f"running — not admitting")
+        if self.breaker.engaged():
+            self._bump("rejected")
+            raise CircuitOpenError(
+                f"{self._name}: circuit open after repeated step failures "
+                f"— fast-failing until a probe succeeds")
+        # validate BEFORE charging the rate limiter: both checks are pure
+        # host work, and an unservable payload must not burn a token a
+        # valid client needed (a misbehaving client would otherwise
+        # starve everyone at zero served throughput)
+        try:
+            payload = self.buckets.pad_example(data)
+            self._check_signature(payload)
+        except RejectedError:
+            self._bump("rejected")
+            raise
+        if self._limiter is not None and not self._limiter.try_acquire():
+            self._shed("rate limit exceeded — shedding")
+        req = Request(payload, deadline=deadline if deadline is not None
+                      else self._default_deadline)
+        try:
+            self._batcher.offer(req)
+        except ServerClosedError:
+            if self._limiter is not None:    # the refusal served no one —
+                self._limiter.refund()       # give the token back
+            self._bump("rejected")
+            raise
+        except RejectedError as exc:
+            if self._limiter is not None:
+                self._limiter.refund()
+            self._shed(str(exc))
+        self._bump("admitted")
+        self._c_depth.set_value(self._batcher.depth())
+        return req
+
+    def __call__(self, data, deadline=None, timeout=None):
+        """Blocking convenience: submit + ``result()``."""
+        return self.submit(data, deadline=deadline).result(timeout)
+
+    def _shed(self, msg):
+        self._bump("shed")
+        self._c_shed.increment()
+        raise RejectedError(f"{self._name}: {msg}")
+
+    def _bump(self, key, n=1):
+        with self._lock:
+            self._stats[key] += n
+
+    # ---------------------------------------------------------- batch thread --
+    def _expire(self, req):
+        """Deadline passed in queue: resolve WITHOUT device work."""
+        self._bump("expired")
+        self._c_expired.increment()
+        waited = time.monotonic() - req.submitted_at
+        req.set_error(DeadlineExceededError(
+            f"deadline exceeded after {waited * 1e3:.1f} ms in queue — "
+            f"the request never touched the device"))
+
+    def _run_batch(self, group, padded):
+        """Execute one padded group on the batch thread: breaker gate →
+        ``serving.step`` fault point → apply → per-request splitting with
+        the all-finite row guard (a NaN output fails ONE request, not the
+        server)."""
+        if not self.breaker.allow():
+            err = CircuitOpenError(
+                f"{self._name}: circuit open — fast-failing queued work")
+            for r in group:
+                r.set_error(err)
+            self._bump("failed", len(group))
+            return
+        target = padded[0].shape[0]
+        try:
+            _fault.fire("serving.step")
+            with _profiler.scope(f"{self._name}.step", cat="serving"):
+                out = self._apply(*padded)
+        except Exception as exc:      # noqa: BLE001 — resolved per request
+            self.breaker.record_failure()
+            self._c_breaker.set_value(self.breaker.state_code())
+            err = _fault.with_context(
+                exc, f"{self._name} batch of {len(group)}")
+            for r in group:
+                r.set_error(err)
+            self._bump("failed", len(group))
+            return
+        outs = tuple(_to_np(o) for o in
+                     (out if isinstance(out, (tuple, list)) else (out,)))
+        bad_dim = [o for o in outs if o.shape[:1] != (target,)]
+        if bad_dim:
+            # malformed output IS a step failure (a wedged/poisoned
+            # executable that cannot serve anyone) — the breaker must see
+            # it, or a replica erroring 100% of requests stays "ready"
+            # and load balancers keep feeding it
+            self.breaker.record_failure()
+            self._c_breaker.set_value(self.breaker.state_code())
+            err = ValueError(
+                f"{self._name}: apply fn returned leading dim "
+                f"{bad_dim[0].shape[:1]} for a batch of {target} — serving "
+                f"apply fns must be batch-major")
+            for r in group:
+                r.set_error(err)
+            self._bump("failed", len(group))
+            return
+        if self._guard:
+            from ..parallel.step import all_finite_rows
+            mask = all_finite_rows([o[:len(group)] for o in outs])
+            # SOME rows bad = poisoned inputs (data fault: neighbours are
+            # served, breaker untouched).  EVERY row of a MULTI-request
+            # batch bad = nothing served — step-failure territory (a
+            # poisoned executable kills whole batches under load).  A
+            # single-request batch is excluded: at idle traffic one
+            # client's NaN input is indistinguishable from a server fault,
+            # and counting it would let one buggy client trip the breaker
+            # for the whole replica.
+            batch_dead = len(group) > 1 and not mask.any()
+        else:
+            batch_dead = False
+        if batch_dead:
+            self.breaker.record_failure()
+        else:
+            self.breaker.record_success()
+        self._c_breaker.set_value(self.breaker.state_code())
+        with self._lock:
+            self._stats["batches"] += 1
+            self._shapes.add((target,) + BucketSpec.signature(group[0].data))
+        self._c_occupancy.set_value(int(100 * len(group) / target))
+        for i, r in enumerate(group):
+            if self._guard and not mask[i]:
+                r.set_error(NonFiniteOutputError(
+                    f"{self._name}: non-finite values in this request's "
+                    f"output row — input likely corrupt; batch neighbours "
+                    f"were served normally"))
+                self._bump("failed")
+                continue
+            row = tuple(o[i] for o in outs)
+            r.set_result(row[0] if len(row) == 1 else row)
+            self._bump("completed")
+        self._c_depth.set_value(self._batcher.depth())
+
+    def _idle_probe(self):
+        """Half-open probing without traffic: while the breaker is open
+        and admission fast-fails everything, there may be no request left
+        to probe with — so when the backoff expires, push the warmup
+        sample through the ``serving.step`` path instead.  Runs on the
+        batch thread's idle ticks; never raises."""
+        if self._sample is None or self.breaker.state != OPEN:
+            return
+        if not self.breaker.allow():
+            return                       # backoff not elapsed yet
+        self._bump("probes")
+        try:
+            _fault.fire("serving.step")
+            self._apply(*self._padded(self._sample, self.buckets.batch[0]))
+        except Exception:                # noqa: BLE001 — probe verdicts
+            self.breaker.record_failure()
+        else:
+            self.breaker.record_success()
+        self._c_breaker.set_value(self.breaker.state_code())
+
+    # --------------------------------------------------------------- health --
+    def alive(self):
+        """Liveness: the batch thread is running."""
+        return self._batcher.alive()
+
+    def ready(self):
+        """Readiness: started, warmed up, not draining, breaker not
+        fast-failing.  False means "send traffic elsewhere", not "dead"."""
+        return (self._ready.is_set() and self.alive()
+                and not self._draining.is_set()
+                and not self.breaker.engaged())
+
+    def healthz(self):
+        """The ``/healthz``-style snapshot a probe endpoint would serve."""
+        return {"alive": self.alive(), "ready": self.ready(),
+                "draining": self._draining.is_set(),
+                "breaker": self.breaker.state,
+                "queue_depth": self._batcher.depth()}
+
+    @property
+    def stats(self):
+        with self._lock:
+            out = dict(self._stats)
+            out["distinct_shapes"] = len(self._shapes)
+        out["queue_depth"] = self._batcher.depth()
+        out["breaker"] = self.breaker.state
+        return out
+
+    @property
+    def distinct_shapes(self):
+        """Signatures ever dispatched (warmup included) — the executable
+        count the bucket grid bounds; the load-test acceptance reads
+        this next to the jit cache size."""
+        with self._lock:
+            return set(self._shapes)
+
+    # ---------------------------------------------------------------- drain --
+    def drain(self, timeout=None):
+        """Graceful shutdown: stop admitting (submits raise
+        ``ServerClosedError``), flush every queued and in-flight request
+        to a terminal state — result, or an explicit error — then stop
+        and join the batch thread.  After ``drain()`` every ``Request``
+        ever returned by ``submit`` is ``done()``; an accepted request is
+        never silently dropped.  True when the thread exited in time."""
+        _fault.fire("serving.drain")
+        self._draining.set()
+        self._ready.clear()
+        ok = self._batcher.drain(timeout)
+        self._c_depth.set_value(self._batcher.depth())
+        return ok
+
+    close = drain
+
+    def serve_forever(self, poll=0.05):
+        """Block until SIGTERM/SIGINT (via ``fault.GracefulExit``), then
+        drain — the Cloud-TPU preemption contract on the serving side:
+        stop admitting, flush accepted work, exit clean."""
+        with _fault.GracefulExit() as g:
+            while not g.requested and self.alive():
+                time.sleep(poll)
+        return self.drain()
+
+
+def module_apply(module):
+    """Adapt a bound ``mx.mod.Module`` into a serving apply fn.
+
+    Feeds batch leaves through ``Module.forward(is_train=False)``; label
+    arguments the symbol declares are fed zeros of the batch's size
+    (inference heads ignore them — they only shape the executor's traced
+    signature).  Each distinct padded signature traces once in the
+    executor's jit cache, so the compile count stays bounded by the
+    batcher's bucket grid.  The returned fn runs on the batch thread
+    only — it is not itself thread-safe."""
+    from ..io import DataBatch
+    from ..ndarray import array as _nd_array
+
+    if not module.binded:
+        raise ValueError("module_apply: bind() the module first")
+    label_shapes = {n: tuple(module._exec.arg_dict[n].shape[1:])
+                    for n in module._label_names
+                    if n in module._exec.arg_dict}
+
+    def apply(*leaves):
+        b = leaves[0].shape[0]
+        label = [_nd_array(np.zeros((b,) + s, np.float32))
+                 for s in label_shapes.values()] or None
+        module.forward(DataBatch(data=[_nd_array(l) for l in leaves],
+                                 label=label), is_train=False)
+        outs = [o.asnumpy() for o in module.get_outputs()]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    return apply

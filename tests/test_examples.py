@@ -34,6 +34,12 @@ def test_module_symbolic_example():
     assert "SymbolBlock serve" in out
 
 
+def test_serve_mnist_example():
+    out = _run("serve_mnist.py", "--requests", "64", "--train-batches", "8")
+    assert "drained=True" in out
+    assert "distinct_shapes=4" in out      # bucket grid bounded the compiles
+
+
 def test_bucketing_lstm_example():
     out = _run("bucketing_lstm.py", "--epochs", "2", "--batch-size", "16")
     assert "over buckets [4, 8, 12]" in out

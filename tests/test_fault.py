@@ -20,11 +20,37 @@ from mxnet_tpu.gluon import nn
 
 chaos = pytest.mark.chaos
 
+# a scratch point for harness-mechanics tests (inject validates against
+# the registered surface — an unregistered name is a typo, see below)
+fault.register_point("p", "test-only scratch point")
+
 
 # ------------------------------------------------------- inject mechanics --
 def test_fire_is_noop_when_unarmed():
     fault.fire("step")  # nothing armed: must not raise
-    assert fault.points() == []
+    assert fault.armed() == []
+
+
+def test_points_is_the_registered_surface():
+    pts = fault.points()
+    for p in ("io.producer", "prefetch.device_put", "checkpoint.write",
+              "checkpoint.replace", "step", "distributed.connect",
+              "serving.admit", "serving.batch", "serving.step",
+              "serving.drain"):
+        assert p in pts
+    with fault.inject("step", RuntimeError):
+        assert fault.armed() == ["step"]
+        assert "step" in fault.points()         # registry unchanged
+    assert fault.armed() == []
+
+
+def test_inject_unknown_point_raises():
+    """A typo'd point name must fail loudly — the old behavior (silently
+    never firing) made chaos tests vacuously green."""
+    with pytest.raises(ValueError, match="unknown fault point"):
+        fault.inject("serving.stpe", RuntimeError)
+    with pytest.raises(ValueError, match="register_point"):
+        fault.inject("io.prodcuer", RuntimeError)
 
 
 def test_inject_after_n_and_times():
@@ -37,7 +63,7 @@ def test_inject_after_n_and_times():
             fault.fire("p")
         fault.fire("p")          # times=2 exhausted: passes again
         assert h.calls == 5 and h.fired == 2
-    assert fault.points() == []  # disarmed on exit
+    assert fault.armed() == []  # disarmed on exit
 
 
 def test_inject_instance_and_nesting():
@@ -49,7 +75,7 @@ def test_inject_instance_and_nesting():
         with pytest.raises(ValueError) as ei:   # outer restored
             fault.fire("p")
         assert ei.value is err
-    assert fault.points() == []
+    assert fault.armed() == []
 
 
 def test_inject_rejects_non_exception():
@@ -117,6 +143,15 @@ def test_retry_call_only_retries_listed_types():
     with pytest.raises(ValueError):
         fault.retry_call(fn, retries=5, base_delay=0.001, retry_on=(OSError,))
     assert len(calls) == 1
+
+
+def test_backoff_delay_schedule():
+    """The shared policy retry_call sleeps through and the serving
+    breaker schedules probes with: exponential, capped, jittered."""
+    for k, want in ((1, 0.1), (2, 0.2), (3, 0.4), (4, 0.8), (5, 1.0)):
+        d = fault.backoff_delay(k, base_delay=0.1, max_delay=1.0, jitter=0.5)
+        assert want <= d <= want * 1.5
+    assert fault.backoff_delay(3, base_delay=0.1, jitter=0.0) == 0.4
 
 
 # ---------------------------------------------------------- with_context --

@@ -549,3 +549,20 @@ def test_mxlint_gate_covers_tools_and_bench():
                        root=REPO)
     live = [f for f in findings if not f.suppressed]
     assert not live, "\n".join(f.render() for f in live)
+
+
+def test_mxlint_gate_covers_serving():
+    """mxnet_tpu/serving/ is inside the main gate's tree, but pin it
+    explicitly: the DynamicBatcher is exactly the producer-thread /
+    shared-attribute shape ``thread-unlocked-attr`` exists for, and this
+    test is the proof the rule actually walks it (an empty module list
+    would be a vacuous pass)."""
+    from tools.analysis.core import _collect_files
+    serving_dir = REPO / "mxnet_tpu" / "serving"
+    files = _collect_files([serving_dir])
+    assert any(f.name == "batcher.py" for f in files), \
+        "serving package missing from the scan set"
+    findings = analyze([serving_dir], root=REPO)
+    live = [f for f in findings if not f.suppressed]
+    assert not live, "mxlint findings on mxnet_tpu/serving/:\n" + "\n".join(
+        f.render() for f in live)

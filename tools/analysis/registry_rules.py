@@ -138,7 +138,7 @@ _PROJECT_PREFIXES = {
     "sym", "np", "npx", "contrib", "io", "profiler", "checkpoint",
     "optimizer", "image", "random", "symbol", "executor", "module", "nn",
     "rnn", "kvstore", "metric", "model", "viz", "mon", "amp", "onnx",
-    "recordio", "config", "runtime", "util", "tools", "step",
+    "recordio", "config", "runtime", "util", "tools", "step", "serving",
 }
 
 

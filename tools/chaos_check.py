@@ -1,13 +1,20 @@
 #!/usr/bin/env python
-"""Chaos smoke: one kill-and-resume cycle on the CPU backend.
+"""Chaos smoke: kill-and-resume (train) and inject-and-drain (serve).
 
-Runs a small training loop with periodic checkpoints, injects a crash
-mid-run via ``fault.inject``, rediscovers the newest snapshot with
-``resume_latest``, and checks the resumed loss trajectory matches an
-uninterrupted run bit-exactly — the acceptance contract of ISSUE 2, as a
-single command for CI and for eyeballing a fresh checkout::
+``--mode train`` (default) runs a small training loop with periodic
+checkpoints, injects a crash mid-run via ``fault.inject``, rediscovers
+the newest snapshot with ``resume_latest``, and checks the resumed loss
+trajectory matches an uninterrupted run bit-exactly — the acceptance
+contract of ISSUE 2.
 
-    python tools/chaos_check.py [--steps 8] [--every 2] [--keep 2]
+``--mode serve`` starts an ``mx.serving.InferenceServer``, drives it
+from client threads while injecting a ``serving.step`` failure burst,
+then lands a SIGTERM mid-flight: the drain must complete with every
+ACCEPTED request resolved (result or explicit error — zero silently
+dropped) and the breaker must have tripped and fast-failed — the
+acceptance contract of ISSUE 4::
+
+    python tools/chaos_check.py [--mode train|serve] [--steps 8] ...
 
 Exit code 0 on success, 1 on any mismatch.  Forces ``JAX_PLATFORMS=cpu``
 (and an 8-device virtual mesh) so it runs anywhere, TPU or not.
@@ -16,6 +23,7 @@ import argparse
 import os
 import sys
 import tempfile
+import time
 
 # must precede any jax import — same bring-up as tests/conftest.py
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
@@ -29,8 +37,107 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np  # noqa: E402
 
 
+def serve_mode(args):
+    """Inject-and-drain smoke on the serving runtime (ISSUE 4)."""
+    import signal
+    import threading
+
+    import jax
+    from mxnet_tpu import fault, serving
+
+    rng = np.random.RandomState(0)
+    w = rng.randn(8, 4).astype(np.float32)
+
+    @jax.jit
+    def mlp(x):
+        return x @ w
+
+    def apply(x):
+        time.sleep(0.01)           # keep work in flight when SIGTERM lands
+        return np.asarray(mlp(x))
+
+    srv = serving.InferenceServer(
+        apply, buckets=(1, 2, 4), max_delay=0.002, max_queue=64,
+        sample=np.zeros((8,), np.float32),
+        breaker=serving.CircuitBreaker(threshold=3, base_delay=0.02,
+                                       max_delay=0.1))
+    srv.start()
+    print(f"[chaos_check] serve: warmed {len(srv.distinct_shapes)} "
+          f"bucket executables, ready={srv.ready()}")
+
+    accepted, sheds = [], [0]
+    count_lock = threading.Lock()
+    stop_submitting = threading.Event()
+
+    def client(k):
+        r = np.random.RandomState(k).randn(8).astype(np.float32)
+        for i in range(args.requests):
+            if stop_submitting.is_set():
+                return
+            try:
+                req = srv.submit(r)
+                with count_lock:
+                    accepted.append(req)
+            except serving.RejectedError:
+                with count_lock:
+                    sheds[0] += 1
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(4)]
+    with fault.inject("serving.step", RuntimeError("injected step fault"),
+                      after_n=5, times=4) as h:
+        for t in threads:
+            t.start()
+        # SIGTERM lands while clients are still submitting and batches are
+        # in flight — serve_forever must drain, not drop
+        threading.Timer(0.25, os.kill, (os.getpid(), signal.SIGTERM)).start()
+        drained = srv.serve_forever(poll=0.01)
+    stop_submitting.set()
+    for t in threads:
+        t.join()
+
+    resolved = sum(1 for r in accepted if r.done())
+    oks, errs = 0, 0
+    for r in accepted:
+        if not r.done():
+            continue                 # counted as dropped below — the very
+            #                          failure this smoke exists to catch
+        if r.exception(timeout=0) is None:
+            oks += 1
+        else:
+            errs += 1
+    st = srv.stats
+    print(f"[chaos_check] serve: accepted={len(accepted)} ok={oks} "
+          f"errored={errs} shed={sheds[0]} injected_fired={h.fired} "
+          f"breaker_trips={srv.breaker.trips} stats={st}")
+    fails = []
+    if not drained:
+        fails.append("drain did not complete")
+    if resolved != len(accepted):
+        fails.append(f"{len(accepted) - resolved} accepted requests were "
+                     f"silently dropped")
+    if h.fired == 0:
+        fails.append("injected step faults never fired")
+    if errs == 0:
+        fails.append("no request surfaced the injected failure")
+    if srv.alive():
+        fails.append("batch thread survived the drain")
+    if len(st_shapes := srv.distinct_shapes) > 3:
+        fails.append(f"bucketing leaked {len(st_shapes)} signatures (> 3)")
+    if fails:
+        for f in fails:
+            print(f"[chaos_check] FAIL: {f}")
+        return 1
+    print(f"[chaos_check] PASS: drain completed with every accepted "
+          f"request resolved ({oks} served, {errs} explicitly errored, "
+          f"0 dropped)")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", choices=("train", "serve"), default="train",
+                    help="train: kill-and-resume; serve: inject-and-drain")
     ap.add_argument("--steps", type=int, default=8,
                     help="total training steps in the reference run")
     ap.add_argument("--every", type=int, default=2,
@@ -39,7 +146,11 @@ def main(argv=None):
                     help="retention: keep-last-K snapshots")
     ap.add_argument("--crash-after", type=int, default=None,
                     help="crash on this step call (default: steps//2 + 1)")
+    ap.add_argument("--requests", type=int, default=25,
+                    help="serve mode: requests per client thread")
     args = ap.parse_args(argv)
+    if args.mode == "serve":
+        return serve_mode(args)
     crash_after = (args.crash_after if args.crash_after is not None
                    else args.steps // 2 + 1)
 
