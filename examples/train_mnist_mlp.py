@@ -28,15 +28,20 @@ def main():
     ap.add_argument("--hybridize", action="store_true")
     args = ap.parse_args()
 
-    train_data = gluon.data.DataLoader(
-        gluon.data.vision.MNIST(train=True).transform_first(
-            gluon.data.vision.transforms.ToTensor()),
-        batch_size=args.batch_size, shuffle=True)
-    val_data = gluon.data.DataLoader(
-        gluon.data.vision.MNIST(train=False).transform_first(
-            gluon.data.vision.transforms.ToTensor()),
-        batch_size=args.batch_size)
+    # context-managed: a crash mid-epoch must not strand loader worker
+    # machinery (mxlint resource-leak-on-error — the exemplar users copy)
+    with gluon.data.DataLoader(
+            gluon.data.vision.MNIST(train=True).transform_first(
+                gluon.data.vision.transforms.ToTensor()),
+            batch_size=args.batch_size, shuffle=True) as train_data, \
+         gluon.data.DataLoader(
+            gluon.data.vision.MNIST(train=False).transform_first(
+                gluon.data.vision.transforms.ToTensor()),
+            batch_size=args.batch_size) as val_data:
+        _run(args, train_data, val_data)
 
+
+def _run(args, train_data, val_data):
     net = gluon.nn.HybridSequential()
     net.add(gluon.nn.Dense(128, activation="relu"),
             gluon.nn.Dense(64, activation="relu"),

@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Regenerate tests/goldens/mxlint_sarif.json.
+
+Run after an INTENTIONAL change to the SARIF envelope or to rule
+metadata (ids, descriptions, default severities), then review the diff
+like any other source change — the golden is the CI-ingestion contract
+of ``python -m tools.analysis --format sarif``:
+
+    python tests/goldens/regen_sarif.py
+
+The fixture here must stay byte-for-byte in sync with
+``tests/test_mxlint.py::test_sarif_golden_envelope``.
+"""
+import subprocess
+import sys
+import tempfile
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+FIXTURE = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        y = float(x)  # mxlint: disable=trace-host-sync -- golden: suppressed row
+        return x.item()
+"""
+
+
+def main():
+    with tempfile.TemporaryDirectory() as d:
+        bad = Path(d) / "bad.py"
+        bad.write_text(textwrap.dedent(FIXTURE))
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis", str(bad),
+             "--format", "sarif", "--root", d, "--no-cache"],
+            capture_output=True, text=True, cwd=REPO)
+    if proc.returncode != 1:
+        print(proc.stderr, file=sys.stderr)
+        raise SystemExit(f"expected exit 1 from the fixture, got "
+                         f"{proc.returncode}")
+    out = REPO / "tests" / "goldens" / "mxlint_sarif.json"
+    out.write_text(proc.stdout)
+    print(f"wrote {out} ({len(proc.stdout)} bytes)")
+
+
+if __name__ == "__main__":
+    main()

@@ -351,6 +351,876 @@ def test_donated_batch_reuse_clean(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# interprocedural taint (the PR 3 single-hop blind spot, closed)
+# ---------------------------------------------------------------------------
+
+def test_taint_crosses_self_helper_call(tmp_path):
+    """Regression for the known single-hop blind spot: a host sync in a
+    ``self._helper`` the jitted method calls with a traced value was
+    invisible to the first-order walk.  The dataflow engine seeds the
+    helper's matching parameter and finds it."""
+    fs = lint(tmp_path, """
+        import jax
+
+        class Model:
+            @jax.jit
+            def forward(self, x):
+                return self._helper(x)
+
+            def _helper(self, v):
+                return float(v)          # BAD: traced via forward
+
+            def untraced(self):
+                return float(3.0)        # plain python: fine
+        """)
+    hits = fired(fs, "trace-host-sync")
+    assert len(hits) == 1, [f.message for f in fs]
+    assert "_helper" in hits[0].message and "traced via" in hits[0].message
+
+
+def test_taint_crosses_module_helper_two_levels(tmp_path):
+    # helper-of-helper is still seen (bounded two-level inlining);
+    # untainted arguments stay concrete
+    fs = lint(tmp_path, """
+        import jax
+
+        def second(w):
+            return w.item()              # BAD: two hops from the jit
+
+        def first(v, mode):
+            if mode == "x":              # mode untainted: fine
+                return second(v)
+            return v
+
+        @jax.jit
+        def f(x):
+            return first(x, "x")
+        """)
+    assert len(fired(fs, "trace-host-sync")) == 1
+    assert not fired(fs, "trace-python-branch")
+
+
+def test_taint_helper_suppression_still_works(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        class Model:
+            @jax.jit
+            def forward(self, x):
+                return self._helper(x)
+
+            def _helper(self, v):
+                return float(v)  # mxlint: disable=trace-host-sync -- fixture: verdict read
+        """)
+    assert not fired(fs, "trace-host-sync")
+    assert len(suppressed(fs, "trace-host-sync")) == 1
+
+
+# ---------------------------------------------------------------------------
+# CFG builder (tools/analysis/cfg.py)
+# ---------------------------------------------------------------------------
+
+def _build(src, name):
+    import ast as _ast
+    from tools.analysis.cfg import build_cfg
+    tree = _ast.parse(textwrap.dedent(src))
+    fn = next(n for n in _ast.walk(tree)
+              if isinstance(n, (_ast.FunctionDef, _ast.AsyncFunctionDef))
+              and n.name == name)
+    return build_cfg(fn), fn, tree
+
+
+def _lockset_at(src, name, lineno, must=True):
+    """Lock-set fact at the entry of the node anchored at ``lineno``."""
+    from tools.analysis.dataflow import LockModel, ModuleFunctions, \
+        held_names, lock_facts
+    cfg, fn, tree = _build(src, name)
+    locks = LockModel(tree, "m")
+    funcs = ModuleFunctions(tree)
+    facts = lock_facts(cfg, locks, fn, funcs.class_of(fn), must=must)
+    out = None
+    for node in cfg.nodes():
+        if node.lineno == lineno and id(node) in facts:
+            fact = held_names(facts[id(node)])
+            out = fact if out is None else (out & fact if must
+                                            else out | fact)
+    return out
+
+
+_LOOP_LOCK_SRC = """
+    import threading
+
+    _lock = threading.Lock()
+
+    def f(xs):
+        total = 0
+        for x in xs:
+            with _lock:
+                total += x           # line 10: lock held
+        return total                 # line 11: released every iteration
+"""
+
+
+def test_cfg_loop_carried_lock_state():
+    assert _lockset_at(_LOOP_LOCK_SRC, "f", 10) == frozenset({"m:_lock"})
+    assert _lockset_at(_LOOP_LOCK_SRC, "f", 11) == frozenset()
+
+
+_EARLY_RETURN_SRC = """
+    import threading
+
+    _lock = threading.Lock()
+
+    def f(a):
+        with _lock:
+            if a:
+                return 1             # line 9: exits through __exit__
+        return 2                     # line 10: lock long gone
+"""
+
+
+def test_cfg_early_return_releases_with_block():
+    assert _lockset_at(_EARLY_RETURN_SRC, "f", 9) == \
+        frozenset({"m:_lock"})
+    assert _lockset_at(_EARLY_RETURN_SRC, "f", 10) == frozenset()
+    # and the early return actually reaches the function exit
+    cfg, _, _ = _build(_EARLY_RETURN_SRC, "f")
+    kinds = {n.kind for n in cfg.nodes()}
+    assert "with_exit" in kinds and "exit" in kinds
+
+
+def test_cfg_try_finally_resource_release(tmp_path):
+    # finally-release survives the exceptional path: no leak finding;
+    # dropping the finally turns it into one
+    clean = lint(tmp_path, """
+        def read(path, risky):
+            f = open(path)
+            try:
+                return risky(f.name)
+            finally:
+                f.close()
+        """)
+    assert not fired(clean, "resource-leak-on-error")
+    leaky = lint(tmp_path, """
+        def read(path, risky):
+            f = open(path)
+            out = risky(f.name)      # raises -> f leaks
+            f.close()
+            return out
+        """, name="leaky.py")
+    assert len(fired(leaky, "resource-leak-on-error")) == 1
+
+
+def test_cfg_async_def_is_skipped_not_guessed(tmp_path):
+    # the builder declines async defs...
+    cfg, _, _ = _build("async def f():\n    return 1", "f")
+    assert cfg is None
+    # ...and every CFG-hosted rule treats that as "not analyzed": no
+    # crash, no false positive, even on a body that would fire if sync
+    fs = lint(tmp_path, """
+        import queue
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(2)
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                self.depth = 1
+
+            async def weird(self):
+                with self._lock:
+                    return self._q.get()
+
+            async def leaky(self, path):
+                f = open(path)
+                self._q.get()
+                f.close()
+        """)
+    for rid in ("blocking-under-lock", "resource-leak-on-error",
+                "thread-unlocked-attr"):
+        assert not fired(fs, rid), rid
+
+
+# ---------------------------------------------------------------------------
+# blocking-under-lock
+# ---------------------------------------------------------------------------
+
+_BLOCKING_BAD = """
+    import queue
+    import threading
+    import time
+
+    class Pump:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._q = queue.Queue(4)
+
+        def bad_get(self):
+            with self._lock:
+                return self._q.get()         # BAD: unbounded get
+
+        def bad_sleep(self):
+            with self._lock:
+                time.sleep(1.0)              # BAD: sleep under lock
+
+        def _helper(self):
+            return self._q.get()             # BAD when caller holds lock
+
+        def bad_via_helper(self):
+            with self._lock:
+                return self._helper()
+"""
+
+
+def test_blocking_under_lock_bad(tmp_path):
+    hits = fired(lint(tmp_path, _BLOCKING_BAD), "blocking-under-lock")
+    assert len(hits) == 3, [f"{f.line}: {f.message}" for f in hits]
+    joined = " ".join(f.message for f in hits)
+    assert "Queue.get" in joined and "sleep" in joined
+    assert "reached via" in joined          # the interprocedural one
+
+
+def test_blocking_under_lock_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(4)
+                self._cache = {}
+
+            def ok(self, k):
+                with self._lock:
+                    a = self._q.get_nowait()       # non-blocking: fine
+                    b = self._q.get(timeout=0.1)   # bounded: fine
+                    c = self._cache.get(k)         # dict.get: not a queue
+                d = self._q.get()                  # lock released: fine
+                return a, b, c, d
+
+            def drain(self, timeout=None):
+                with self._lock:
+                    self._stopped = True
+                self._thread.join(timeout)         # outside the lock
+        """)
+    assert not fired(fs, "blocking-under-lock")
+
+
+def test_blocking_under_lock_suppression(tmp_path):
+    src = _BLOCKING_BAD.replace(
+        "time.sleep(1.0)              # BAD: sleep under lock",
+        "time.sleep(1.0)  "
+        "# mxlint: disable=blocking-under-lock -- fixture: single-"
+        "threaded test harness, lock uncontended by construction")
+    fs = lint(tmp_path, src)
+    assert len(fired(fs, "blocking-under-lock")) == 2
+    assert len(suppressed(fs, "blocking-under-lock")) == 1
+
+
+def test_blocking_under_lock_fire_point(tmp_path):
+    # a fault.fire() site is a raise point AND nests the registry lock
+    fs = lint(tmp_path, """
+        import threading
+        from mxnet_tpu import fault
+
+        class Srv:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def admit(self, req):
+                with self._lock:
+                    fault.fire("serving.admit")    # BAD
+                    return req
+
+            def admit_ok(self, req):
+                fault.fire("serving.admit")        # outside: fine
+                with self._lock:
+                    return req
+        """)
+    hits = fired(fs, "blocking-under-lock")
+    assert len(hits) == 1 and "fault point" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# lock-order-inversion
+# ---------------------------------------------------------------------------
+
+_LOCK_ORDER_BAD = """
+    import threading
+
+    class Duo:
+        def __init__(self):
+            self._mu = threading.Lock()
+            self._nu = threading.Lock()
+
+        def one(self):
+            with self._mu:
+                with self._nu:                 # mu -> nu
+                    return 1
+
+        def two(self):
+            with self._nu:
+                with self._mu:                 # nu -> mu: inversion
+                    return 2
+"""
+
+
+def test_lock_order_inversion_bad(tmp_path):
+    hits = fired(lint(tmp_path, _LOCK_ORDER_BAD), "lock-order-inversion")
+    assert hits, "no inversion reported"
+    joined = " ".join(f.message for f in hits)
+    assert "Duo._mu" in joined and "Duo._nu" in joined
+
+
+def test_lock_order_inversion_through_helper(tmp_path):
+    # the second-order edge: a helper that takes nu is CALLED under mu
+    # in one class, while another path takes them inverted
+    fs = lint(tmp_path, """
+        import threading
+
+        class Duo:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._nu = threading.Lock()
+
+            def _inner(self):
+                with self._nu:
+                    return 1
+
+            def outer(self):
+                with self._mu:
+                    return self._inner()       # mu -> nu via call
+
+            def inverted(self):
+                with self._nu:
+                    with self._mu:             # nu -> mu
+                        return 2
+        """)
+    assert fired(fs, "lock-order-inversion")
+
+
+def test_lock_order_three_lock_cycle(tmp_path):
+    # a -> c, c -> b, b -> a: no two-lock inversion anywhere, but the
+    # three orders together deadlock — every edge of the cycle reports
+    fs = lint(tmp_path, """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+        _c = threading.Lock()
+
+        def one():
+            with _a:
+                with _c:
+                    return 1
+
+        def two():
+            with _c:
+                with _b:
+                    return 2
+
+        def three():
+            with _b:
+                with _a:
+                    return 3
+        """)
+    hits = fired(fs, "lock-order-inversion")
+    assert len(hits) == 3, [f.message for f in hits]
+    joined = " ".join(f.message for f in hits)
+    assert "snippet.py:_a" in joined and "snippet.py:_b" in joined \
+        and "snippet.py:_c" in joined
+
+
+def test_blocking_under_lock_positional_timeout_is_bounded(tmp_path):
+    # get(block, timeout) / put(item, block, timeout) positional forms
+    # are bounded and must not fire
+    fs = lint(tmp_path, """
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(4)
+
+            def ok(self, item):
+                with self._lock:
+                    a = self._q.get(True, 0.1)
+                    self._q.put(item, True, 0.1)
+                return a
+        """)
+    assert not fired(fs, "blocking-under-lock")
+
+
+def test_lock_order_same_name_different_files_not_conflated(tmp_path):
+    # two FILES each defining a class named Worker with identically
+    # named locks, in opposite orders: different lock objects, no
+    # deadlock — tokens are file-qualified so no cycle appears
+    one = """
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._nu = threading.Lock()
+
+            def go(self):
+                with self._mu:
+                    with self._nu:
+                        return 1
+    """
+    two = one.replace("with self._mu:", "with self._XX:").replace(
+        "with self._nu:", "with self._mu:").replace(
+        "with self._XX:", "with self._nu:")
+    (tmp_path / "a.py").write_text(textwrap.dedent(one))
+    (tmp_path / "b.py").write_text(textwrap.dedent(two))
+    fs = analyze([tmp_path / "a.py", tmp_path / "b.py"], root=tmp_path)
+    assert not fired(fs, "lock-order-inversion"), \
+        [f.message for f in fired(fs, "lock-order-inversion")]
+
+
+def test_lock_order_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class Duo:
+            def __init__(self):
+                self._mu = threading.Lock()
+                self._nu = threading.Lock()
+
+            def one(self):
+                with self._mu:
+                    with self._nu:
+                        return 1
+
+            def two(self):
+                with self._mu:
+                    with self._nu:
+                        return 2               # same global order: fine
+        """)
+    assert not fired(fs, "lock-order-inversion")
+
+
+def test_lock_order_suppression(tmp_path):
+    src = _LOCK_ORDER_BAD.replace(
+        "with self._mu:                 # nu -> mu: inversion",
+        "with self._mu:  "
+        "# mxlint: disable=lock-order-inversion -- fixture: two() only "
+        "ever runs single-threaded during shutdown")
+    fs = lint(tmp_path, src)
+    assert len(suppressed(fs, "lock-order-inversion")) >= 1
+    # the OTHER direction's site may still be reported (it is half of
+    # the same cycle) — what matters is the waived edge is waived
+    assert all(f.line != 16 for f in fired(fs, "lock-order-inversion"))
+
+
+# ---------------------------------------------------------------------------
+# signal-handler-unsafe
+# ---------------------------------------------------------------------------
+
+_SIGNAL_BAD = """
+    import signal
+    import threading
+
+    _lock = threading.Lock()
+
+    def handler(signum, frame):
+        with _lock:                    # BAD: lock in handler
+            pass
+        print("dying")                 # BAD: I/O in handler
+        raise RuntimeError("boom")     # BAD: non-exit raise
+
+    signal.signal(signal.SIGTERM, handler)
+"""
+
+
+def test_signal_handler_unsafe_bad(tmp_path):
+    hits = fired(lint(tmp_path, _SIGNAL_BAD), "signal-handler-unsafe")
+    assert len(hits) == 3, [f.message for f in hits]
+    joined = " ".join(f.message for f in hits)
+    assert "acquires" in joined and "print" in joined \
+        and "RuntimeError" in joined
+
+
+def test_signal_handler_clean_latch(tmp_path):
+    # the GracefulExit pattern: set flags, remember the signum, at most
+    # re-raise KeyboardInterrupt — nothing to report
+    fs = lint(tmp_path, """
+        import signal
+
+        class Latch:
+            def __init__(self):
+                self.requested = False
+                self.signum = None
+                self._prev = {}
+
+            def _on_signal(self, signum, frame):
+                if self.requested:
+                    raise KeyboardInterrupt    # conventional: fine
+                self.requested = True
+                self.signum = signum
+
+            def __enter__(self):
+                for s in (signal.SIGTERM, signal.SIGINT):
+                    self._prev[s] = signal.signal(s, self._on_signal)
+                return self
+        """)
+    assert not fired(fs, "signal-handler-unsafe")
+
+
+def test_signal_handler_unsafe_helper_and_suppression(tmp_path):
+    fs = lint(tmp_path, """
+        import signal
+        import threading
+
+        _lock = threading.Lock()
+
+        def _record():
+            with _lock:                # BAD: called from the handler
+                pass
+
+        def handler(signum, frame):
+            _record()
+
+        signal.signal(signal.SIGTERM, handler)
+        """)
+    hits = fired(fs, "signal-handler-unsafe")
+    assert len(hits) == 1 and "via" in hits[0].message
+    src = _SIGNAL_BAD.replace(
+        'print("dying")                 # BAD: I/O in handler',
+        'print("dying")  '
+        '# mxlint: disable=signal-handler-unsafe -- fixture: diagnostic '
+        'of last resort on the exit path, torn output acceptable')
+    fs2 = lint(tmp_path, src, name="sig2.py")
+    assert len(fired(fs2, "signal-handler-unsafe")) == 2
+    assert len(suppressed(fs2, "signal-handler-unsafe")) == 1
+
+
+# ---------------------------------------------------------------------------
+# resource-leak-on-error
+# ---------------------------------------------------------------------------
+
+_LEAK_BAD = """
+    import threading
+
+    def leak_file(path, risky):
+        f = open(path)
+        data = risky(f.name)         # raises -> f leaks
+        f.close()
+        return data
+
+    def leak_thread(work):
+        t = threading.Thread(target=work)
+        t.start()
+        work()                       # raises -> t never joined
+        t.join()
+"""
+
+
+def test_resource_leak_bad(tmp_path):
+    hits = fired(lint(tmp_path, _LEAK_BAD), "resource-leak-on-error")
+    assert len(hits) == 2, [f"{f.line}: {f.message}" for f in hits]
+    joined = " ".join(f.message for f in hits)
+    assert "file handle" in joined and "started thread" in joined
+
+
+def test_resource_leak_clean(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        def ok_with(path, risky):
+            with open(path) as f:
+                return risky(f.name)
+
+        def ok_finally(path, risky):
+            f = open(path)
+            try:
+                return risky(f.name)
+            finally:
+                f.close()
+
+        def ok_escape_self(self, work):
+            t = threading.Thread(target=work)
+            t.start()
+            self._threads.append(t)    # ownership handed off
+            work()
+
+        def ok_unstarted(work, risky):
+            t = threading.Thread(target=work)
+            risky()                    # t never started: no obligation
+            t.start()
+            t.join()
+
+        def ok_return(path):
+            f = open(path)
+            return f                   # constructor pattern: caller owns
+        """)
+    assert not fired(fs, "resource-leak-on-error")
+
+
+def test_resource_leak_suppression(tmp_path):
+    src = _LEAK_BAD.replace(
+        "f = open(path)",
+        "f = open(path)  "
+        "# mxlint: disable=resource-leak-on-error -- fixture: process "
+        "exits right after, the OS reaps the handle")
+    fs = lint(tmp_path, src)
+    assert len(fired(fs, "resource-leak-on-error")) == 1   # thread one
+    assert len(suppressed(fs, "resource-leak-on-error")) == 1
+
+
+def test_resource_leak_rebind_keeps_old_handle_on_raise(tmp_path):
+    # `f = open(y)` over an earlier `f = open(x)`: if the second open
+    # raises, the store never ran — the FIRST handle is still bound and
+    # leaks (the acquiring statement's raise edge carries the
+    # pre-statement state, not "nothing acquired")
+    fs = lint(tmp_path, """
+        def f(a, b):
+            h = open(a)
+            h = open(b)
+            h.close()
+        """)
+    hits = fired(fs, "resource-leak-on-error")
+    assert len(hits) == 1 and hits[0].line == 3, \
+        [f"{x.line}: {x.message}" for x in hits]
+
+
+def test_blocking_under_lock_false_value_still_blocks(tmp_path):
+    # q.put(False) enqueues the VALUE False — it blocks like any put;
+    # only the block-FLAG slot (or block=False) means non-blocking
+    fs = lint(tmp_path, """
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(4)
+
+            def bad(self):
+                with self._lock:
+                    self._q.put(False)           # BAD: blocking put
+
+            def ok(self):
+                with self._lock:
+                    self._q.put(1, False)        # block-flag: fine
+                    self._q.get(block=False)     # keyword flag: fine
+        """)
+    hits = fired(fs, "blocking-under-lock")
+    assert len(hits) == 1 and hits[0].line == 12, \
+        [f"{x.line}: {x.message}" for x in hits]
+
+
+def test_reentrant_lock_nesting_balances(tmp_path):
+    # `with self._lock:` inside `with self._lock:` (RLock): the inner
+    # exit must not release the outer hold — the access after the
+    # inner block is still locked (thread rule), and a blocking op
+    # there is still under-lock (blocking rule)
+    fs = lint(tmp_path, """
+        import queue
+        import threading
+
+        class Feed:
+            def __init__(self):
+                self._lock = threading.RLock()
+                self._q = queue.Queue(2)
+                self.count = 0
+                self._t = threading.Thread(target=self._produce)
+
+            def _produce(self):
+                with self._lock:
+                    self.count += 1
+
+            def read(self):
+                with self._lock:
+                    with self._lock:
+                        a = self.count
+                    b = self.count       # outer lock STILL held: fine
+                return a + b
+
+            def bad(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+                    self._q.get()        # BAD: outer lock still held
+        """)
+    assert not fired(fs, "thread-unlocked-attr"), \
+        [f.message for f in fired(fs, "thread-unlocked-attr")]
+    hits = fired(fs, "blocking-under-lock")
+    assert len(hits) == 1 and "Queue.get" in hits[0].message
+
+
+def test_blocking_under_lock_thread_list_join(tmp_path):
+    # the PrefetchingIter shape: threads kept in a self._threads list,
+    # joined in a loop — under a lock that loop join must be flagged
+    fs = lint(tmp_path, """
+        import threading
+
+        class Feed:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._threads = []
+                for i in range(2):
+                    self._threads.append(
+                        threading.Thread(target=self._run))
+
+            def _run(self):
+                pass
+
+            def stop_bad(self):
+                with self._lock:
+                    for t in self._threads:
+                        t.join()             # BAD: join under lock
+
+            def stop_ok(self):
+                with self._lock:
+                    threads = list(self._threads)
+                for t in self._threads:
+                    t.join()                 # outside the lock: fine
+                return threads
+        """)
+    hits = fired(fs, "blocking-under-lock")
+    assert len(hits) == 1 and "join" in hits[0].message, \
+        [f"{f.line}: {f.message}" for f in hits]
+
+
+def test_blocking_under_lock_only_local_locks(tmp_path):
+    # a module whose ONLY lock is function-local must still be swept
+    fs = lint(tmp_path, """
+        import queue
+        import threading
+
+        _q = queue.Queue(2)
+
+        def g():
+            local = threading.Lock()
+            with local:
+                return _q.get()          # BAD: blocking under lock
+        """)
+    assert len(fired(fs, "blocking-under-lock")) == 1
+
+
+def test_trace_membership_numeric_vs_key(tmp_path):
+    # `0 in x` on a traced array is an element comparison (flags);
+    # `"k" in store` / `name in store` are key probes (exempt)
+    fs = lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def f(x, store):
+            if 0 in x:                   # BAD: concretizes the tracer
+                return 1
+            if "k" in store:             # key probe: fine
+                return 2
+            if x.ndim in store:          # static metadata key: fine
+                return 3
+            return 4
+        """)
+    hits = fired(fs, "trace-python-branch")
+    assert len(hits) == 1, [f.message for f in hits]
+
+
+def test_blocking_under_lock_local_lock_acquire(tmp_path):
+    # a function-LOCAL lock blocking-acquired under a held lock
+    fs = lint(tmp_path, """
+        import threading
+
+        _g = threading.Lock()
+
+        def f():
+            local = threading.Lock()
+            with _g:
+                local.acquire()                  # BAD: nested blocking
+            local.release()
+        """)
+    hits = fired(fs, "blocking-under-lock")
+    assert len(hits) == 1 and "acquire" in hits[0].message
+
+
+def test_resource_leak_prefetcher(tmp_path):
+    # the exact bug shape PR 1/2 fixed by hand: a wrapped feed whose
+    # close() is unreachable when the loop body raises
+    fs = lint(tmp_path, """
+        def train(base, step):
+            it = PrefetchingIter(base)
+            for batch in it:
+                step(batch)            # raises -> producer threads leak
+            it.close()
+
+        def train_ok(base, step):
+            it = PrefetchingIter(base)
+            try:
+                for batch in it:
+                    step(batch)
+            finally:
+                it.close()
+        """)
+    hits = fired(fs, "resource-leak-on-error")
+    assert len(hits) == 1 and "prefetcher" in hits[0].message
+
+
+def test_donated_reuse_same_statement(tmp_path):
+    # the donation and the stale read share one statement: evaluation
+    # order (call ends before the later read) still flags it — the
+    # PR 3 textual model, preserved within a CFG node
+    fs = lint(tmp_path, """
+        import jax
+
+        def run(batch):
+            step = jax.jit(lambda a: a + 1, donate_argnums=(0,))
+            out = (step(batch), batch.sum())   # BAD: read after donate
+            return out
+        """)
+    assert len(fired(fs, "donated-batch-reuse")) == 1
+
+
+def test_blocking_under_lock_lambda_is_deferred(tmp_path):
+    # a lambda body runs at its call site, not where the literal sits:
+    # constructing a worker under the lock must not count as blocking
+    fs = lint(tmp_path, """
+        import queue
+        import threading
+
+        class Pump:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._q = queue.Queue(4)
+
+            def spawn(self):
+                with self._lock:
+                    t = threading.Thread(target=lambda: self._q.get())
+                t.start()
+                return t
+        """)
+    assert not fired(fs, "blocking-under-lock")
+
+
+def test_lock_order_inversion_multi_item_with(tmp_path):
+    # `with a, b:` acquires left to right — inverting it with nested
+    # withs elsewhere is the same ABBA deadlock
+    fs = lint(tmp_path, """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a, _b:
+                return 1
+
+        def two():
+            with _b:
+                with _a:
+                    return 2
+        """)
+    assert fired(fs, "lock-order-inversion")
+
+
+# ---------------------------------------------------------------------------
 # registry + docs consistency
 # ---------------------------------------------------------------------------
 
@@ -524,7 +1394,216 @@ def test_cli_json_output(tmp_path):
 
 
 # ---------------------------------------------------------------------------
-# THE GATE: the shipped tree is clean (tier-1; ISSUE 3 acceptance)
+# incremental cache + --changed (ISSUE 5 satellites)
+# ---------------------------------------------------------------------------
+
+_CACHE_BAD = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()
+"""
+
+
+def test_incremental_cache_roundtrip_and_invalidation(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(_CACHE_BAD))
+    cold = analyze([p], root=tmp_path, use_cache=True)
+    assert (tmp_path / ".mxlint_cache").is_dir(), \
+        "cache directory never materialized"
+    warm = analyze([p], root=tmp_path, use_cache=True)
+    assert [f.to_dict() for f in cold] == [f.to_dict() for f in warm]
+    assert len(fired(warm, "trace-host-sync")) == 1
+    # content change invalidates: the fixed file must come back clean
+    p.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x * 2
+        """))
+    fixed = analyze([p], root=tmp_path, use_cache=True)
+    assert not fired(fixed, "trace-host-sync")
+
+
+def test_cache_records_carry_suppressions(tmp_path):
+    # the suppression table rides in the cache record: a warm run must
+    # report the same suppressed finding WITH its justification
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x)  # mxlint: disable=trace-host-sync -- fixture: cached waiver
+        """))
+    analyze([p], root=tmp_path, use_cache=True)
+    warm = analyze([p], root=tmp_path, use_cache=True)
+    sup = suppressed(warm, "trace-host-sync")
+    assert len(sup) == 1 and "cached waiver" in sup[0].justification
+
+
+def test_cache_is_keyed_on_path_too(tmp_path):
+    # identical content at two paths must not share one record: the
+    # findings carry path anchors
+    (tmp_path / "a.py").write_text(textwrap.dedent(_CACHE_BAD))
+    (tmp_path / "b.py").write_text(textwrap.dedent(_CACHE_BAD))
+    fs = analyze([tmp_path / "a.py", tmp_path / "b.py"], root=tmp_path,
+                 use_cache=True)
+    fs2 = analyze([tmp_path / "a.py", tmp_path / "b.py"], root=tmp_path,
+                  use_cache=True)
+    for run in (fs, fs2):
+        assert sorted(f.path for f in fired(run, "trace-host-sync")) \
+            == ["a.py", "b.py"]
+
+
+def test_changed_only_filters_to_git_diff(tmp_path):
+    import subprocess as sp
+
+    def git(*args):
+        return sp.run(["git", "-C", str(tmp_path), "-c",
+                       "user.email=t@t", "-c", "user.name=t"] + list(args),
+                      capture_output=True, text=True, check=True)
+
+    (tmp_path / "stale.py").write_text(textwrap.dedent(_CACHE_BAD))
+    (tmp_path / "fresh.py").write_text("x = 1\n")
+    git("init")
+    git("add", "-A")
+    git("commit", "-m", "seed")
+    # edit only fresh.py (now carrying a finding)
+    (tmp_path / "fresh.py").write_text(textwrap.dedent(_CACHE_BAD))
+    fs = analyze([tmp_path], root=tmp_path, changed_only=True)
+    hit_paths = {f.path for f in fired(fs, "trace-host-sync")}
+    assert hit_paths == {"fresh.py"}, \
+        "expected only the git-changed file to be linted"
+    # without the flag both fire
+    full = analyze([tmp_path], root=tmp_path)
+    assert {f.path for f in fired(full, "trace-host-sync")} \
+        == {"stale.py", "fresh.py"}
+
+
+def test_changed_only_with_root_below_git_toplevel(tmp_path):
+    # git reports toplevel-relative names; linting a SUBPACKAGE with
+    # --changed must still match them (regression: the intersection was
+    # empty and the gate silently linted nothing)
+    import subprocess as sp
+
+    def git(*args):
+        return sp.run(["git", "-C", str(tmp_path), "-c",
+                       "user.email=t@t", "-c", "user.name=t"] + list(args),
+                      capture_output=True, text=True, check=True)
+
+    sub = tmp_path / "pkg"
+    sub.mkdir()
+    (sub / "mod.py").write_text("x = 1\n")
+    git("init")
+    git("add", "-A")
+    git("commit", "-m", "seed")
+    (sub / "mod.py").write_text(textwrap.dedent(_CACHE_BAD))
+    fs = analyze([sub], root=sub, changed_only=True)
+    assert len(fired(fs, "trace-host-sync")) == 1, \
+        "changed file below a sub-root was silently skipped"
+
+
+def test_cli_changed_default_paths_cover_gated_surface(tmp_path):
+    # `python -m tools.analysis --changed --root X` with NO explicit
+    # paths: the defaults are anchored at the root (not the cwd) and
+    # span the gated surface, so an edited tools/ file is seen
+    import subprocess as sp
+
+    def git(*args):
+        return sp.run(["git", "-C", str(tmp_path), "-c",
+                       "user.email=t@t", "-c", "user.name=t"] + list(args),
+                      capture_output=True, text=True, check=True)
+
+    (tmp_path / "mxnet_tpu").mkdir()
+    (tmp_path / "tools").mkdir()
+    (tmp_path / "mxnet_tpu" / "ok.py").write_text("x = 1\n")
+    (tmp_path / "tools" / "t.py").write_text("x = 1\n")
+    git("init")
+    git("add", "-A")
+    git("commit", "-m", "seed")
+    (tmp_path / "tools" / "t.py").write_text(textwrap.dedent(_CACHE_BAD))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--changed",
+         "--root", str(tmp_path), "--no-cache", "--json"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    payload = json.loads(proc.stdout)
+    assert any(f["rule"] == "trace-host-sync"
+               and f["path"].endswith("t.py") for f in payload), payload
+
+
+def test_changed_only_fails_open_without_git(tmp_path):
+    # no git repo: --changed must analyze everything rather than
+    # silently narrowing to nothing
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(_CACHE_BAD))
+    fs = analyze([p], root=tmp_path, changed_only=True)
+    assert len(fired(fs, "trace-host-sync")) == 1
+
+
+# ---------------------------------------------------------------------------
+# SARIF output
+# ---------------------------------------------------------------------------
+
+def test_sarif_golden_envelope(tmp_path):
+    """Golden-file contract for the SARIF envelope: CI annotation
+    tooling parses this exact shape.  Regenerate the golden with
+    ``python tests/goldens/regen_sarif.py`` after an intentional
+    format/rule-metadata change."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            y = float(x)  # mxlint: disable=trace-host-sync -- golden: suppressed row
+            return x.item()
+        """))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", str(bad),
+         "--format", "sarif", "--root", str(tmp_path), "--no-cache"],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1, proc.stderr
+    golden = (REPO / "tests" / "goldens" / "mxlint_sarif.json").read_text()
+    assert proc.stdout == golden, (
+        "SARIF output drifted from tests/goldens/mxlint_sarif.json — "
+        "if intentional, regenerate via tests/goldens/regen_sarif.py")
+    log = json.loads(proc.stdout)
+    run = log["runs"][0]
+    assert log["version"] == "2.1.0"
+    assert run["tool"]["driver"]["name"] == "mxlint"
+    ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"trace-host-sync", "blocking-under-lock",
+            "lock-order-inversion", "signal-handler-unsafe",
+            "resource-leak-on-error"} <= ids
+    results = run["results"]
+    assert any(r["ruleId"] == "trace-host-sync"
+               and r["locations"][0]["physicalLocation"]
+               ["artifactLocation"]["uri"] == "bad.py"
+               for r in results)
+    # suppressed findings ride along as SARIF suppressions, not drops
+    assert any(r.get("suppressions") for r in results)
+
+
+def test_sarif_levels_map_severity(tmp_path):
+    from tools.analysis import to_sarif
+    fs = lint(tmp_path, _CACHE_BAD,
+              config=Config(severities={"trace-host-sync": "warning"}))
+    log = json.loads(to_sarif(fs))
+    res = [r for r in log["runs"][0]["results"]
+           if r["ruleId"] == "trace-host-sync"]
+    assert res and res[0]["level"] == "warning"
+
+
+# ---------------------------------------------------------------------------
+# THE GATE: the shipped tree is clean (tier-1; ISSUE 3 acceptance,
+# re-hosted on the CFG/dataflow engine by ISSUE 5 — the gate now also
+# covers blocking-under-lock / lock-order-inversion /
+# signal-handler-unsafe / resource-leak-on-error, and runs through the
+# incremental cache so its wall-time stays flat as the suite grows)
 # ---------------------------------------------------------------------------
 
 def test_mxlint_self_check_gate():
@@ -532,7 +1611,7 @@ def test_mxlint_self_check_gate():
     tree: zero unsuppressed findings, and every suppression that does
     exist carries a justification.  New code that breaks a trace/thread/
     donation/registry invariant fails HERE, in tier-1, not in review."""
-    findings = analyze([REPO / "mxnet_tpu"], root=REPO)
+    findings = analyze([REPO / "mxnet_tpu"], root=REPO, use_cache=True)
     live = [f for f in findings if not f.suppressed]
     assert not live, "mxlint findings on mxnet_tpu/:\n" + "\n".join(
         f.render() for f in live)
@@ -546,9 +1625,20 @@ def test_mxlint_gate_covers_tools_and_bench():
     """The analysis package itself and the benchmark drivers stay clean
     too (they construct TrainStep feeds — donation hazards live there)."""
     findings = analyze([REPO / "tools" / "analysis", REPO / "bench.py"],
-                       root=REPO)
+                       root=REPO, use_cache=True)
     live = [f for f in findings if not f.suppressed]
     assert not live, "\n".join(f.render() for f in live)
+
+
+def test_mxlint_gate_covers_examples():
+    """examples/ is the code users copy: the concurrency/lifecycle suite
+    gates it too (this caught real leaks — DataLoaders with producer
+    machinery stranded on a mid-epoch crash — now fixed with the
+    context-manager form the docs teach)."""
+    findings = analyze([REPO / "examples"], root=REPO, use_cache=True)
+    live = [f for f in findings if not f.suppressed]
+    assert not live, "mxlint findings on examples/:\n" + "\n".join(
+        f.render() for f in live)
 
 
 def test_mxlint_gate_covers_serving():
@@ -562,7 +1652,7 @@ def test_mxlint_gate_covers_serving():
     files = _collect_files([serving_dir])
     assert any(f.name == "batcher.py" for f in files), \
         "serving package missing from the scan set"
-    findings = analyze([serving_dir], root=REPO)
+    findings = analyze([serving_dir], root=REPO, use_cache=True)
     live = [f for f in findings if not f.suppressed]
     assert not live, "mxlint findings on mxnet_tpu/serving/:\n" + "\n".join(
         f.render() for f in live)

@@ -16,10 +16,12 @@ Usage (API, what tests/test_mxlint.py drives)::
 Rules are documented in docs/analysis.md; suppression is
 ``# mxlint: disable=RULE -- justification`` (justification required).
 """
-from .core import (BAD_SUPPRESSION, Config, Finding, ModuleInfo, Rule,
-                   ProjectRule, analyze, default_rules, exit_code,
-                   summarize, to_json)
+from .core import (BAD_SUPPRESSION, ENGINE_VERSION, Config, Finding,
+                   ModuleInfo, Rule, ProjectRule, analyze, default_rules,
+                   exit_code, summarize, to_json)
+from .sarif import to_sarif
 
-__all__ = ["BAD_SUPPRESSION", "Config", "Finding", "ModuleInfo", "Rule",
-           "ProjectRule", "analyze", "default_rules", "exit_code",
-           "summarize", "to_json"]
+__all__ = ["BAD_SUPPRESSION", "ENGINE_VERSION", "Config", "Finding",
+           "ModuleInfo", "Rule", "ProjectRule", "analyze",
+           "default_rules", "exit_code", "summarize", "to_json",
+           "to_sarif"]

@@ -1,0 +1,87 @@
+"""Incremental cache for mxlint (``.mxlint_cache/``).
+
+One JSON record per (file content, rule set, engine version): the
+per-file findings, the suppression table, and every project rule's
+facts — everything ``core.analyze`` needs, so a fully-cached run never
+parses a single source file.  That is what makes the tier-1 full-tree
+gate O(changed files) instead of O(tree) as the CFG/dataflow suite
+grows (and what ``tools/chaos_check.py --mode lint`` asserts: the warm
+run is ≥5x faster and byte-identical in findings).
+
+Layout: ONE record per source file, named by ``sha256(relpath)`` and
+overwritten in place — the cache is bounded by the number of files the
+tree has ever had, not by how many revisions each went through (the
+tier-1 gate runs warm on every pytest invocation; an append-only
+layout would grow a long-lived checkout without bound).  Validity is
+checked INSIDE the record: it stores the content key
+``sha256(signature || relpath || bytes)`` — where ``signature`` embeds
+``core.ENGINE_VERSION``, the Python minor version (AST shapes differ),
+and the sorted rule ids — and a mismatch is a miss.  Any analyzer
+change that should invalidate every record is a one-line
+``ENGINE_VERSION`` bump.  The relpath is part of the content key
+because records carry path-anchored findings: two identical files at
+different paths must not share one.
+
+Writes are atomic (tmp + ``os.replace``) and best-effort: a read-only
+checkout or a lost race degrades to a cache miss, never to an error —
+the analyzer must stay runnable anywhere the tree is.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from pathlib import Path
+from typing import Optional
+
+CACHE_DIR_NAME = ".mxlint_cache"
+_CK = "_content_key"
+
+
+class FileCache:
+    def __init__(self, root: Path, directory=None, signature: str = ""):
+        self.dir = Path(directory) if directory else \
+            Path(root) / CACHE_DIR_NAME
+        self.signature = signature
+        self.hits = 0
+        self.misses = 0
+
+    def key(self, relpath: str, data: bytes) -> str:
+        h = hashlib.sha256()
+        h.update(self.signature.encode("utf-8"))
+        h.update(b"\x00")
+        h.update(relpath.encode("utf-8"))
+        h.update(b"\x00")
+        h.update(data)
+        return h.hexdigest()[:32]
+
+    def _name(self, relpath: str) -> str:
+        return hashlib.sha256(
+            relpath.encode("utf-8")).hexdigest()[:32] + ".json"
+
+    def get(self, relpath: str, key: str) -> Optional[dict]:
+        try:
+            with open(self.dir / self._name(relpath),
+                      encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            self.misses += 1
+            return None
+        if rec.get(_CK) != key:
+            self.misses += 1       # stale revision / other rule set
+            return None
+        self.hits += 1
+        return rec
+
+    def put(self, relpath: str, key: str, record: dict):
+        try:
+            record = dict(record)
+            record[_CK] = key
+            self.dir.mkdir(parents=True, exist_ok=True)
+            name = self._name(relpath)
+            tmp = self.dir / f".{name}.{os.getpid()}.tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(record, f)
+            os.replace(tmp, self.dir / name)
+        except OSError:
+            pass      # best-effort: a miss next run, never a failure

@@ -1,0 +1,427 @@
+"""Per-function control-flow graphs for the mxlint dataflow engine.
+
+PR 3's rules were per-function AST *walks*: they see syntax, not paths.
+The bug classes this PR gates — a lock held across a blocking call, a
+resource acquired and then leaked when an exception exits the function
+early, taint that crosses a helper call — are properties of *paths*
+through a function, so the engine needs a real CFG: nodes are
+statements (plus a few synthetic markers), edges are ``normal`` or
+``exception``, and ``try``/``except``/``finally``, ``with`` blocks,
+loops, ``break``/``continue`` and early ``return`` are all modeled.
+
+Design notes (kept deliberately boring — this runs in tier-1 CI):
+
+- One node per statement.  Compound statements get a node for their
+  header (the ``if`` test, the loop header, the ``with`` enter) and
+  their bodies are sub-graphs.
+- ``finally`` bodies (and the synthetic ``__exit__`` of ``with``) are
+  DUPLICATED per continuation — one copy on the fall-through path, one
+  on the exceptional path, one per early ``return``/``break``/
+  ``continue`` that crosses them.  Duplication keeps every path
+  explicit, which is what the leak rule needs; function bodies in this
+  tree are small enough that the blow-up is irrelevant.
+- Exception edges are added from any statement that *can plausibly
+  raise* (``raise``/``assert``, or anything containing a call or a
+  subscript) to the innermost handler, else to ``raise_exit`` — the
+  function's exceptional exit.  This is the approximation that makes
+  "can exit via exception without reaching close()" a reachability
+  question.
+- ``except`` dispatch is approximated: an exception edge reaches a
+  dispatch node that fans out to every handler; unless some handler is
+  a bare ``except:`` / ``except (Base)Exception``, the dispatch also
+  keeps an exception edge outward (the handlers may not match).
+- ``async def`` (and anything else the builder does not model) is NOT
+  analyzed: ``build_cfg`` returns ``None`` and CFG-hosted rules skip
+  the function cleanly instead of guessing (tested in
+  tests/test_mxlint.py).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+NORMAL = "normal"
+EXC = "exception"
+
+# node kinds (``stmt`` carries the AST anchor for line numbers)
+ENTRY = "entry"
+EXIT = "exit"            # normal return
+RAISE_EXIT = "raise"     # uncaught exception leaves the function
+STMT = "stmt"
+BRANCH = "branch"        # if/match header
+LOOP = "loop"            # while/for header (iter/test evaluation + bind)
+WITH_ENTER = "with_enter"  # context managers entered (locks acquired)
+WITH_EXIT = "with_exit"    # context managers exited (locks released)
+DISPATCH = "except_dispatch"
+BRIDGE = "bridge"        # re-raise hop after a duplicated finally body
+
+
+class Node:
+    __slots__ = ("stmt", "kind", "succ")
+
+    def __init__(self, stmt=None, kind=STMT):
+        self.stmt = stmt
+        self.kind = kind
+        self.succ: List[Tuple["Node", str]] = []
+
+    def link(self, other: "Node", edge: str = NORMAL):
+        if other is not None and (other, edge) not in self.succ:
+            self.succ.append((other, edge))
+
+    @property
+    def lineno(self):
+        return getattr(self.stmt, "lineno", 0)
+
+    def __repr__(self):  # debugging aid only
+        return f"<{self.kind}@{self.lineno}>"
+
+
+class CFG:
+    """entry/exit/raise_exit plus every reachable node of one function
+    (or module) body."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.entry = Node(fn, ENTRY)
+        self.exit = Node(fn, EXIT)
+        self.raise_exit = Node(fn, RAISE_EXIT)
+
+    def nodes(self) -> List[Node]:
+        """Reachable nodes in a stable (BFS) order."""
+        seen = {id(self.entry): self.entry}
+        order = [self.entry]
+        i = 0
+        while i < len(order):
+            for nxt, _ in order[i].succ:
+                if id(nxt) not in seen:
+                    seen[id(nxt)] = nxt
+                    order.append(nxt)
+            i += 1
+        return order
+
+
+_MAY_RAISE = (ast.Call, ast.Raise, ast.Assert, ast.Subscript, ast.Await)
+
+
+def may_raise(stmt) -> bool:
+    """Can this statement plausibly raise?  Calls, subscripts, asserts
+    and explicit raises; attribute reads and arithmetic are treated as
+    non-raising (the rules this feeds want actionable paths, not the
+    truism that any bytecode can fault).  Nested function/lambda BODIES
+    are skipped — defining a function never raises; for a ``def``
+    statement itself only its decorators and default values (which run
+    at definition time) count."""
+    stack = []
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        stack.extend(stmt.decorator_list)
+        stack.extend(stmt.args.defaults + stmt.args.kw_defaults)
+    else:
+        stack.append(stmt)
+    while stack:
+        node = stack.pop()
+        if node is None:
+            continue
+        if isinstance(node, _MAY_RAISE):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            stack.extend(node.decorator_list
+                         if not isinstance(node, ast.Lambda) else ())
+            stack.extend(node.args.defaults + node.args.kw_defaults)
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return False
+
+
+class _Ctx:
+    """Continuation targets while building: where ``return`` / ``break``
+    / ``continue`` / an uncaught exception go from here."""
+
+    __slots__ = ("ret", "brk", "cont", "exc")
+
+    def __init__(self, ret, brk, cont, exc):
+        self.ret = ret
+        self.brk = brk
+        self.cont = cont
+        self.exc = exc
+
+    def replace(self, **kw):
+        out = _Ctx(self.ret, self.brk, self.cont, self.exc)
+        for k, v in kw.items():
+            setattr(out, k, v)
+        return out
+
+
+def _catches_everything(handlers) -> bool:
+    for h in handlers:
+        if h.type is None:
+            return True
+        names = []
+        if isinstance(h.type, ast.Tuple):
+            names = [getattr(e, "attr", getattr(e, "id", None))
+                     for e in h.type.elts]
+        else:
+            names = [getattr(h.type, "attr", getattr(h.type, "id", None))]
+        if any(n in ("Exception", "BaseException") for n in names):
+            return True
+    return False
+
+
+class _Builder:
+    def __init__(self, cfg: CFG):
+        self.cfg = cfg
+
+    # -- sequencing ---------------------------------------------------------
+    def seq(self, stmts, follow: Node, ctx: _Ctx) -> Node:
+        cur = follow
+        for stmt in reversed(stmts):
+            cur = self.stmt(stmt, cur, ctx)
+        return cur
+
+    # -- finally duplication ------------------------------------------------
+    def _wrap_finally(self, finalbody, cache: Dict, target: Node,
+                      edge: str, ctx: _Ctx) -> Node:
+        """Entry of a fresh copy of ``finalbody`` that continues to
+        ``target`` via ``edge`` (memoized per (target, edge))."""
+        if not finalbody or target is None:
+            return target
+        key = (id(target), edge)
+        if key not in cache:
+            bridge = Node(finalbody[0], BRIDGE)
+            bridge.link(target, edge)
+            # an exception INSIDE finally abandons the original
+            # continuation and propagates outward
+            cache[key] = self.seq(finalbody, bridge, ctx)
+        return cache[key]
+
+    # -- statements ---------------------------------------------------------
+    def stmt(self, stmt, follow: Node, ctx: _Ctx) -> Node:
+        cfg = self.cfg
+        if isinstance(stmt, ast.If):
+            node = Node(stmt, BRANCH)
+            node.link(self.seq(stmt.body, follow, ctx))
+            node.link(self.seq(stmt.orelse, follow, ctx)
+                      if stmt.orelse else follow)
+            if may_raise(stmt.test):
+                node.link(ctx.exc, EXC)
+            return node
+
+        if isinstance(stmt, (ast.While, ast.For)):
+            head = Node(stmt, LOOP)
+            after = self.seq(stmt.orelse, follow, ctx) \
+                if stmt.orelse else follow
+            body_ctx = ctx.replace(brk=follow, cont=head)
+            head.link(self.seq(stmt.body, head, body_ctx))
+            head.link(after)
+            test = stmt.test if isinstance(stmt, ast.While) else stmt.iter
+            if may_raise(test) or isinstance(stmt, ast.For):
+                head.link(ctx.exc, EXC)
+            return head
+
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            enter = Node(stmt, WITH_ENTER)
+            cache: Dict = {}
+
+            def wrap(target, edge=NORMAL):
+                if target is None:
+                    return None
+                key = (id(target), edge)
+                if key not in cache:
+                    ex = Node(stmt, WITH_EXIT)
+                    ex.link(target, edge)
+                    cache[key] = ex
+                return cache[key]
+
+            body_ctx = _Ctx(ret=wrap(ctx.ret), brk=wrap(ctx.brk),
+                            cont=wrap(ctx.cont), exc=wrap(ctx.exc, EXC))
+            enter.link(self.seq(stmt.body, wrap(follow), body_ctx))
+            # exception while entering: the manager is not held yet —
+            # route through the WITH_EXIT copy anyway so a lock-set
+            # transfer that optimistically added tokens at WITH_ENTER
+            # retracts them before the edge leaves the with (Python
+            # skips __exit__ when __enter__ raises; for set-valued
+            # facts, removing a token that was never really held is
+            # the identity)
+            enter.link(wrap(ctx.exc, EXC), EXC)
+            return enter
+
+        if isinstance(stmt, ast.Try):
+            fin = stmt.finalbody
+            cache: Dict = {}
+
+            def wrap(target, edge=NORMAL):
+                if target is None or not fin:
+                    return target
+                return self._wrap_finally(fin, cache, target, edge, ctx)
+
+            w_follow = wrap(follow)
+            w_exc = wrap(ctx.exc, EXC)
+            inner = _Ctx(ret=wrap(ctx.ret), brk=wrap(ctx.brk),
+                         cont=wrap(ctx.cont), exc=w_exc)
+            if stmt.handlers:
+                dispatch = Node(stmt, DISPATCH)
+                handler_ctx = inner
+                for h in stmt.handlers:
+                    dispatch.link(self.seq(h.body, w_follow, handler_ctx))
+                if not _catches_everything(stmt.handlers):
+                    dispatch.link(w_exc, EXC)
+                body_exc = dispatch
+            else:
+                body_exc = w_exc
+            body_ctx = inner.replace(exc=body_exc)
+            after_body = self.seq(stmt.orelse, w_follow, inner) \
+                if stmt.orelse else w_follow
+            return self.seq(stmt.body, after_body, body_ctx)
+
+        if isinstance(stmt, ast.Return):
+            node = Node(stmt)
+            node.link(ctx.ret)
+            if stmt.value is not None and may_raise(stmt.value):
+                node.link(ctx.exc, EXC)
+            return node
+
+        if isinstance(stmt, ast.Raise):
+            node = Node(stmt)
+            node.link(ctx.exc, EXC)
+            return node
+
+        if isinstance(stmt, ast.Break):
+            node = Node(stmt)
+            node.link(ctx.brk or follow)
+            return node
+
+        if isinstance(stmt, ast.Continue):
+            node = Node(stmt)
+            node.link(ctx.cont or follow)
+            return node
+
+        if isinstance(stmt, ast.Assert):
+            node = Node(stmt)
+            node.link(follow)
+            node.link(ctx.exc, EXC)
+            return node
+
+        if isinstance(stmt, ast.Match):
+            node = Node(stmt, BRANCH)
+            exhausted = False
+            for case in stmt.cases:
+                node.link(self.seq(case.body, follow, ctx))
+                if isinstance(case.pattern, ast.MatchAs) \
+                        and case.pattern.pattern is None:
+                    exhausted = True  # `case _:`
+            if not exhausted:
+                node.link(follow)
+            if may_raise(stmt.subject):
+                node.link(ctx.exc, EXC)
+            return node
+
+        # nested defs/classes, simple statements, everything else: one
+        # node, fall through, exception edge when it can raise.  Nested
+        # function BODIES are separate CFGs — not descended into here.
+        node = Node(stmt)
+        node.link(follow)
+        if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and may_raise(stmt):
+            node.link(ctx.exc, EXC)
+        return node
+
+
+def build_cfg(fn) -> Optional[CFG]:
+    """CFG for one ``FunctionDef`` (or an ``ast.Module`` — the donation
+    rule analyzes module scope too).  Returns ``None`` for constructs
+    the builder does not model (``async def``): callers must treat that
+    as "not analyzed", never as "clean and verified" — and never crash.
+    """
+    if isinstance(fn, ast.AsyncFunctionDef):
+        return None
+    if not isinstance(fn, (ast.FunctionDef, ast.Module, ast.Lambda)):
+        return None
+    if isinstance(fn, ast.Lambda):
+        return None  # single expression: nothing path-sensitive to model
+    cfg = CFG(fn)
+    builder = _Builder(cfg)
+    ctx = _Ctx(ret=cfg.exit, brk=None, cont=None, exc=cfg.raise_exit)
+    cfg.entry.link(builder.seq(fn.body, cfg.exit, ctx))
+    return cfg
+
+
+def node_exprs(node: Node) -> tuple:
+    """The AST subtrees a node actually *evaluates* — the ``if`` test
+    but not its body (the body has its own nodes), the loop iterable,
+    the ``with`` context expressions.  CFG-hosted rules scan these
+    instead of ``node.stmt`` wholesale, or every expression in a
+    compound statement would be visited once per enclosing header.
+    """
+    s = node.stmt
+    if s is None or node.kind in (ENTRY, EXIT, RAISE_EXIT, BRIDGE,
+                                  DISPATCH, WITH_EXIT):
+        return ()
+    if node.kind == BRANCH:
+        if isinstance(s, ast.If):
+            return (s.test,)
+        if isinstance(s, ast.Match):
+            return (s.subject,)
+        return ()
+    if node.kind == LOOP:
+        if isinstance(s, ast.While):
+            return (s.test,)
+        return (s.target, s.iter)
+    if node.kind == WITH_ENTER:
+        out = []
+        for item in s.items:
+            out.append(item.context_expr)
+            if item.optional_vars is not None:
+                out.append(item.optional_vars)
+        return tuple(out)
+    if isinstance(s, (ast.If, ast.While, ast.For, ast.With, ast.AsyncWith,
+                      ast.Try, ast.Match)):
+        return ()   # defensive: headers are handled by kind above
+    return (s,)
+
+
+# --------------------------------------------------------------------------
+# generic forward dataflow over a CFG
+# --------------------------------------------------------------------------
+
+def forward(cfg: CFG, entry_fact, transfer, join):
+    """Classic worklist forward analysis.
+
+    ``transfer(node, fact_in)`` returns either one fact for every out
+    edge, or a ``(normal_fact, exception_fact)`` pair when the two edge
+    kinds must differ — e.g. the statement that *acquires* a resource
+    contributes it only on its normal edge (if ``open()`` raises there
+    is no handle to leak).  Facts must be hashable (frozensets);
+    ``join(a, b)`` merges at control-flow merges (union = may-analysis,
+    intersection = must-analysis).  Returns ``{id(node): fact_in}`` for
+    every reachable node.
+    """
+    facts: Dict[int, object] = {id(cfg.entry): entry_fact}
+    work = [cfg.entry]
+    iterations = 0
+    limit = 40 * (len(cfg.nodes()) + 8)   # belt + suspenders: lattices
+    while work:                           # here are finite, this bounds
+        iterations += 1                   # a builder bug, not the math
+        if iterations > limit:
+            # NEVER return partial facts: rules hosted on this engine
+            # feed a zero-findings CI gate, and silent under-reporting
+            # is the one failure mode such a gate cannot tolerate —
+            # fail loudly and fix the builder
+            raise RuntimeError(
+                f"mxlint dataflow did not converge within {limit} "
+                f"iterations on '{getattr(cfg.fn, 'name', '<module>')}'"
+                f" (line {getattr(cfg.fn, 'lineno', 0)}) — CFG builder "
+                f"bug, please report")
+        node = work.pop()
+        out = transfer(node, facts[id(node)])
+        if isinstance(out, tuple):
+            normal_out, exc_out = out
+        else:
+            normal_out = exc_out = out
+        for nxt, edge in node.succ:
+            fact = exc_out if edge == EXC else normal_out
+            prev = facts.get(id(nxt))
+            merged = fact if prev is None else join(prev, fact)
+            if merged != prev:
+                facts[id(nxt)] = merged
+                work.append(nxt)
+    return facts

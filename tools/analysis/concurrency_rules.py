@@ -1,0 +1,540 @@
+"""Concurrency rules hosted on the CFG/dataflow engine.
+
+The hot path of this tree is genuinely concurrent — PrefetchingIter
+producer threads, the DevicePrefetcher, the serving DynamicBatcher's
+batch thread, GracefulExit signal latches — and they coordinate through
+locks, bounded queues and events.  Three hazard classes there are
+*interprocedural path* properties no first-order AST walk can see:
+
+``blocking-under-lock``
+    A lock held across an unbounded blocking operation — ``Queue.get``/
+    ``put`` without a timeout, ``Thread.join()``, ``Event.wait()``,
+    ``lock.acquire()``, ``time.sleep``/``retry_call`` (it sleeps), a
+    device transfer (``device_put``/``block_until_ready``), or a
+    ``fault.fire()`` injection point (an armed fault raises — and
+    ``fire`` itself takes the fault registry's lock, so firing under a
+    local lock nests lock acquisition into every production call site).
+    One stalled consumer then wedges every thread that needs the lock.
+    The walk follows ``self.``-helper and module-level calls two levels
+    deep: a helper called under ``with self._lock`` runs under that
+    lock too.
+
+``lock-order-inversion``
+    The project-wide lock-acquisition graph (built from every
+    ``with <lock>`` site, including those reached through helper calls
+    while a lock is held) contains a cycle: somewhere A is taken then
+    B, somewhere else B then A.  Each order is locally fine; together
+    they deadlock under the right interleaving.  This is a project
+    rule: the two sites are usually in different files (batcher admit
+    lock vs. server stats lock vs. profiler counter lock).
+
+``signal-handler-unsafe``
+    A function installed via ``signal.signal(...)`` (GracefulExit's
+    latch handler pattern) that acquires a lock, blocks, performs
+    reentrancy-unsafe I/O (``print``/``open``), or raises anything
+    other than ``KeyboardInterrupt``/``SystemExit``.  A Python signal
+    handler runs on the main thread at an arbitrary bytecode boundary:
+    if the interrupted frame holds the lock the handler wants, the
+    process deadlocks; an unexpected exception surfaces at whatever
+    line happened to be executing.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .cfg import BRANCH, LOOP, STMT, WITH_ENTER, build_cfg, node_exprs
+from .core import Finding, ProjectRule, Rule, dotted_name, last_component
+from .dataflow import (INLINE_DEPTH, LockModel, ModuleFunctions,
+                       _calls_of_stmt, _self_attr, iter_calls,
+                       walk_with_locks)
+
+_QUEUE_CTORS = {"Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+                "JoinableQueue"}
+_THREAD_CTORS = {"Thread"}
+_EVENT_CTORS = {"Event"}
+_SLEEPERS = {"sleep", "retry_call"}
+_DEVICE_CALLS = {"device_put", "block_until_ready"}
+
+
+# --------------------------------------------------------------------------
+# light receiver typing (queues / threads / events)
+# --------------------------------------------------------------------------
+
+class ChannelTypes:
+    """attr/name -> 'queue' | 'thread' | 'thread_list' | 'event', per
+    class and per function, from constructor assignments (the same
+    convention thread_rules uses: types are what ``__init__`` built)."""
+
+    def __init__(self, tree: ast.Module):
+        self.class_attrs: Dict[str, Dict[str, str]] = {}
+        self.module_names: Dict[str, str] = {}
+        for node in tree.body:
+            kind = self._ctor_kind(node)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_names[t.id] = kind
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                attrs: Dict[str, str] = {}
+                for sub in ast.walk(node):
+                    kind = self._ctor_kind(sub)
+                    if kind:
+                        for t in sub.targets:
+                            a = _self_attr(t)
+                            if a is not None:
+                                attrs[a] = kind
+                    elif isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Attribute) \
+                            and sub.func.attr == "append" \
+                            and sub.args \
+                            and isinstance(sub.args[0], ast.Call) \
+                            and last_component(sub.args[0].func) \
+                            in _THREAD_CTORS:
+                        a = _self_attr(sub.func.value)
+                        if a is not None:
+                            attrs[a] = "thread_list"
+                if attrs:
+                    self.class_attrs[node.name] = attrs
+
+    @staticmethod
+    def _ctor_kind(node) -> Optional[str]:
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            return None
+        ctor = last_component(node.value.func)
+        if ctor in _QUEUE_CTORS:
+            return "queue"
+        if ctor in _THREAD_CTORS:
+            return "thread"
+        if ctor in _EVENT_CTORS:
+            return "event"
+        return None
+
+    def locals_of(self, fn, cls=None) -> Dict[str, str]:
+        out: Dict[str, str] = {}
+        for node in ast.walk(fn):
+            kind = self._ctor_kind(node)
+            if kind:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out[t.id] = kind
+        # `for t in self._threads:` — the loop variable of a
+        # thread-container is a thread (``cls`` is needed to resolve
+        # the ``self._threads`` container attribute)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.For) \
+                    and isinstance(node.target, ast.Name):
+                if self._kind_of(node.iter, fn, cls, out) == "thread_list":
+                    out[node.target.id] = "thread"
+        return out
+
+    def _kind_of(self, expr, fn, cls, local) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr is not None and cls is not None:
+            return self.class_attrs.get(cls, {}).get(attr)
+        if isinstance(expr, ast.Name):
+            if local and expr.id in local:
+                return local[expr.id]
+            return self.module_names.get(expr.id)
+        return None
+
+    def kind_of(self, expr, fn, cls, local=None) -> Optional[str]:
+        if local is None:
+            local = self.locals_of(fn, cls)
+        return self._kind_of(expr, fn, cls, local)
+
+
+def _has_timeout(call: ast.Call, tpos=None) -> bool:
+    """Is this blocking call bounded?  A non-None ``timeout=`` keyword;
+    or, when the method takes the timeout positionally at index
+    ``tpos`` (``get(block, timeout)`` → 1, ``put(item, block,
+    timeout)`` → 2, ``acquire(blocking, timeout)`` → 1), a non-None
+    positional in that slot; or a literal ``False`` in the BLOCK-FLAG
+    slot just before it / a ``block=False`` keyword (non-blocking).
+    Only those slots are inspected — ``q.put(False)`` enqueues the
+    VALUE False and blocks like any other put."""
+    for k in call.keywords:
+        if k.arg == "timeout" and not (isinstance(k.value, ast.Constant)
+                                       and k.value.value is None):
+            return True
+        if k.arg in ("block", "blocking") \
+                and isinstance(k.value, ast.Constant) \
+                and k.value.value is False:
+            return True
+    if tpos is None:
+        return False
+    if len(call.args) > tpos \
+            and not (isinstance(call.args[tpos], ast.Constant)
+                     and call.args[tpos].value is None):
+        return True
+    flag = tpos - 1
+    return len(call.args) > flag \
+        and isinstance(call.args[flag], ast.Constant) \
+        and call.args[flag].value is False
+
+
+def blocking_ops(exprs, types: ChannelTypes, locks: LockModel, fn, cls,
+                 local_types=None,
+                 local_locks=None) -> List[Tuple[ast.AST, str]]:
+    """(ast node, human description) for every unbounded blocking (or
+    fault-point) operation in the given expressions."""
+    if local_locks is None and isinstance(fn, ast.FunctionDef):
+        local_locks = locks._local_locks(fn)
+    out: List[Tuple[ast.AST, str]] = []
+    for expr in exprs:
+        for call in _calls_of_stmt(expr):
+            func = call.func
+            name = last_component(func)
+            if isinstance(func, ast.Attribute):
+                kind = types.kind_of(func.value, fn, cls, local_types)
+                if func.attr in ("get", "put") and kind == "queue" \
+                        and not _has_timeout(
+                            call, tpos=1 if func.attr == "get" else 2):
+                    out.append((call, f"Queue.{func.attr}() without a "
+                                      f"timeout"))
+                    continue
+                if func.attr == "join" and kind in ("thread",
+                                                    "thread_list") \
+                        and not call.args and not call.keywords:
+                    out.append((call, "Thread.join() with no timeout"))
+                    continue
+                if func.attr == "wait" and kind == "event" \
+                        and not call.args and not _has_timeout(call):
+                    out.append((call, "Event.wait() with no timeout"))
+                    continue
+                if func.attr == "acquire" \
+                        and locks.tokens_for_expr(func.value, fn, cls,
+                                                  local_locks) \
+                        and not _has_timeout(call, tpos=1):
+                    out.append((call, "lock.acquire() (nested blocking "
+                                      "acquisition)"))
+                    continue
+            if name in _DEVICE_CALLS:
+                out.append((call, f"device transfer {name}()"))
+            elif name in _SLEEPERS:
+                d = dotted_name(func) or name
+                if name == "sleep" and d not in ("time.sleep", "sleep"):
+                    continue   # foo.sleep() on an unknown object
+                out.append((call, f"{d}() (sleeps on this thread)"))
+            elif name == "fire" and call.args \
+                    and isinstance(call.args[0], ast.Constant):
+                out.append((call, f"fault point fire("
+                                  f"{call.args[0].value!r}) (an armed "
+                                  f"fault raises here; fire() also takes "
+                                  f"the fault-registry lock)"))
+    return out
+
+
+def _function_surface(tree: ast.Module):
+    """(fn, owning class name) for every module-level def and method."""
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            out.append((node, None))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    out.append((item, node.name))
+    return out
+
+
+# --------------------------------------------------------------------------
+# the shared lock sweep: ONE interprocedural walk per module
+# --------------------------------------------------------------------------
+
+def _lock_sweep(mod):
+    """(blocking findings' raw material, acquisition edges) of one
+    module, from a single ``walk_with_locks`` sweep over every function
+    — memoized on the ModuleInfo, because the bounded interprocedural
+    walk is the most expensive analysis in the suite and both
+    ``blocking-under-lock`` and ``lock-order-inversion`` consume it.
+    """
+    cached = getattr(mod, "_mxlint_lock_sweep", None)
+    if cached is not None:
+        return cached
+    locks = LockModel(mod.tree, mod.relpath)
+    blocked: List[tuple] = []   # (op node, why, held, chain, fn name)
+    edges: List[list] = []      # [held, acquired, line, fn name]
+    if locks.has_locks:       # incl. function-local locks
+        funcs = ModuleFunctions(mod.tree)
+        types = ChannelTypes(mod.tree)
+        local_types: Dict[int, Dict[str, str]] = {}
+        local_locks: Dict[int, set] = {}
+
+        def visit(fn, node, held, chain):
+            if node.kind not in (STMT, BRANCH, LOOP, WITH_ENTER):
+                return
+            cls = funcs.class_of(fn)
+            if id(fn) not in local_types:
+                local_types[id(fn)] = types.locals_of(fn, cls)
+                local_locks[id(fn)] = locks._local_locks(fn) \
+                    if isinstance(fn, ast.FunctionDef) else set()
+            fname = getattr(fn, "name", "?")
+            if node.kind == WITH_ENTER:
+                ordered = locks.with_token_list(node.stmt, fn, cls,
+                                                local_locks[id(fn)])
+                for tok in ordered:
+                    for h in held:
+                        if h != tok:
+                            edges.append([h, tok, node.lineno, fname])
+                # `with a, b:` acquires left to right — an ordering
+                # fact in its own right, even with nothing held
+                for i, a in enumerate(ordered):
+                    for b in ordered[i + 1:]:
+                        if a != b:
+                            edges.append([a, b, node.lineno, fname])
+            if not held:
+                return
+            for op, why in blocking_ops(node_exprs(node), types, locks,
+                                        fn, cls, local_types[id(fn)],
+                                        local_locks[id(fn)]):
+                blocked.append((op, why, held, chain, fname))
+
+        for fn, _cls in _function_surface(mod.tree):
+            walk_with_locks(mod.tree, locks, funcs, fn, visit)
+    result = (blocked, edges)
+    try:
+        mod._mxlint_lock_sweep = result
+    except Exception:
+        pass                    # memo is an optimization, never a need
+    return result
+
+
+# --------------------------------------------------------------------------
+# blocking-under-lock
+# --------------------------------------------------------------------------
+
+class BlockingUnderLockRule(Rule):
+    id = "blocking-under-lock"
+    description = ("unbounded blocking operation (queue get/put, join, "
+                   "wait, sleep, device transfer, fault point) while "
+                   "holding a lock")
+
+    def check_module(self, mod):
+        for op, why, held, chain, fname in _lock_sweep(mod)[0]:
+            via = f" (reached via {' -> '.join(chain)}" \
+                  f" -> {fname})" if chain else ""
+            yield self.finding(
+                mod, op,
+                f"{why} while holding {sorted(held)}{via}: one "
+                f"stalled thread wedges every thread that needs the "
+                f"lock — move the blocking call outside the lock or "
+                f"bound it with a timeout")
+
+
+# --------------------------------------------------------------------------
+# lock-order-inversion (project rule: cross-file acquisition graph)
+# --------------------------------------------------------------------------
+
+class LockOrderRule(ProjectRule):
+    id = "lock-order-inversion"
+    description = ("cycle in the global lock-acquisition order graph "
+                   "(deadlock under the right interleaving)")
+
+    def facts(self, mod):
+        """Directed acquisition edges this file contributes:
+        ``[held_token, acquired_token, line, function]``."""
+        return _lock_sweep(mod)[1]
+
+    def check_facts(self, facts, root, analyzed):
+        graph: Dict[str, Set[str]] = {}
+        sites: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+        for relpath, edges in facts:
+            for held, acq, line, fname in edges or ():
+                graph.setdefault(held, set()).add(acq)
+                graph.setdefault(acq, set())
+                sites.setdefault((held, acq), []).append(
+                    (relpath, line, fname))
+        for comp in self._cyclic_sccs(graph):
+            comp_set = set(comp)
+            # every edge INSIDE a cyclic SCC lies on some cycle (an SCC
+            # property) — report each of its sites, never a synthetic
+            # ordering of the component (for 3+ locks the sorted order
+            # is generally not a real cycle and would match no edges)
+            intra = [(a, b) for a in comp
+                     for b in sorted(graph.get(a, ()))
+                     if b in comp_set]
+            for a, b in intra:
+                for relpath, line, fname in sites.get((a, b), ()):
+                    if relpath not in analyzed:
+                        continue
+                    others = "; ".join(
+                        f"{x}->{y} at {s[0]}:{s[1]} ({s[2]})"
+                        for x, y in intra if (x, y) != (a, b)
+                        for s in sites.get((x, y), ())[:1])
+                    yield Finding(
+                        rule=self.id, path=relpath, line=line, col=1,
+                        message=f"lock order inversion: acquiring '{b}' "
+                                f"while holding '{a}' is part of an "
+                                f"acquisition cycle among "
+                                f"{{{', '.join(comp)}}} ({others}) — "
+                                f"two threads taking these locks in "
+                                f"opposite orders deadlock; pick one "
+                                f"global order")
+
+    @staticmethod
+    def _cyclic_sccs(graph: Dict[str, Set[str]]) -> List[List[str]]:
+        """Strongly-connected components containing a cycle (>1 node,
+        or a self-loop), sorted for deterministic output."""
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on: Set[str] = set()
+        stack: List[str] = []
+        out: List[List[str]] = []
+        counter = [0]
+
+        def strongconnect(v):
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            for w in sorted(graph.get(v, ())):
+                if w not in index:
+                    strongconnect(w)
+                    low[v] = min(low[v], low[w])
+                elif w in on:
+                    low[v] = min(low[v], index[w])
+            if low[v] == index[v]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on.discard(w)
+                    comp.append(w)
+                    if w == v:
+                        break
+                if len(comp) > 1 or v in graph.get(v, ()):
+                    out.append(sorted(comp))
+
+        for v in sorted(graph):
+            if v not in index:
+                strongconnect(v)
+        return out
+
+
+# --------------------------------------------------------------------------
+# signal-handler-unsafe
+# --------------------------------------------------------------------------
+
+_HANDLER_SAFE_RAISES = {"KeyboardInterrupt", "SystemExit"}
+_UNSAFE_IO = {"print", "open"}
+
+
+class SignalHandlerRule(Rule):
+    id = "signal-handler-unsafe"
+    description = ("signal handler (or a helper it calls) acquires a "
+                   "lock, blocks, does reentrancy-unsafe I/O, or raises "
+                   "a non-exit exception")
+
+    def check_module(self, mod):
+        funcs = ModuleFunctions(mod.tree)
+        handlers = self._handlers(mod.tree, funcs)
+        if not handlers:
+            return
+        locks = LockModel(mod.tree, mod.relpath)
+        types = ChannelTypes(mod.tree)
+        seen: Set[int] = set()
+        for handler in handlers:
+            yield from self._check_handler(mod, handler, funcs, locks,
+                                           types, handler.name, (),
+                                           INLINE_DEPTH, seen)
+
+    @staticmethod
+    def _handlers(tree, funcs: ModuleFunctions):
+        """FunctionDefs registered via ``signal.signal(sig, h)``."""
+        out = []
+        for node in ast.walk(tree):
+            cls = None
+            if isinstance(node, ast.ClassDef):
+                cls = node.name
+                calls = [c for m in node.body
+                         if isinstance(m, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef))
+                         for c in ast.walk(m) if isinstance(c, ast.Call)]
+            elif isinstance(node, ast.Module):
+                calls = [c for c in ast.walk(node)
+                         if isinstance(c, ast.Call)]
+            else:
+                continue
+            for call in calls:
+                if last_component(call.func) != "signal" \
+                        or len(call.args) < 2:
+                    continue
+                target = call.args[1]
+                attr = _self_attr(target)
+                fn = None
+                if attr is not None and cls is not None:
+                    fn = funcs.methods.get((cls, attr))
+                elif isinstance(target, ast.Name):
+                    fn = funcs.module_defs.get(target.id)
+                if isinstance(fn, ast.FunctionDef) \
+                        and not any(f is fn for f in out):
+                    out.append(fn)
+        return out
+
+    def _check_handler(self, mod, fn, funcs, locks, types, root_name,
+                       chain, depth, seen):
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        cfg = build_cfg(fn)
+        if cfg is None:      # async handler: not analyzed, skip cleanly
+            return
+        cls = funcs.class_of(fn)
+        local_types = types.locals_of(fn, cls)
+        local_locks = locks._local_locks(fn)   # hoisted: one walk per fn
+        via = f" (via {' -> '.join(chain)})" if chain else ""
+        prefix = f"signal handler '{root_name}'{via}"
+        for node in cfg.nodes():
+            if node.kind == WITH_ENTER:
+                toks = locks.with_tokens(
+                    node.stmt, fn, cls, local_locks)
+                if toks:
+                    yield self.finding(
+                        mod, node.stmt,
+                        f"{prefix} acquires {sorted(toks)}: it runs on "
+                        f"the main thread at an arbitrary bytecode "
+                        f"boundary — if the interrupted frame holds the "
+                        f"lock, the process deadlocks.  Set a flag/"
+                        f"Event and do the work outside the handler")
+            exprs = node_exprs(node)
+            for op, why in blocking_ops(exprs, types, locks, fn, cls,
+                                        local_types, local_locks):
+                yield self.finding(
+                    mod, op,
+                    f"{prefix} performs {why}: a handler must never "
+                    f"block — latch state and return")
+            for expr in exprs:
+                for call in _calls_of_stmt(expr):
+                    if isinstance(call.func, ast.Name) \
+                            and call.func.id in _UNSAFE_IO:
+                        yield self.finding(
+                            mod, call,
+                            f"{prefix} calls {call.func.id}(): I/O from "
+                            f"a signal handler can re-enter whatever "
+                            f"stream operation it interrupted — latch "
+                            f"and report outside the handler")
+            if isinstance(node.stmt, ast.Raise) and node.kind == STMT \
+                    and node.stmt.exc is not None:
+                raised = last_component(
+                    node.stmt.exc.func
+                    if isinstance(node.stmt.exc, ast.Call)
+                    else node.stmt.exc)
+                if raised not in _HANDLER_SAFE_RAISES:
+                    yield self.finding(
+                        mod, node.stmt,
+                        f"{prefix} raises {raised}: the exception "
+                        f"surfaces at whatever line the signal "
+                        f"interrupted, far from any handling — only "
+                        f"KeyboardInterrupt/SystemExit are "
+                        f"conventional from handlers")
+        if depth > 0:
+            for call in iter_calls(fn):
+                callee = funcs.resolve_call(fn, call)
+                if callee is not None \
+                        and isinstance(callee, ast.FunctionDef):
+                    yield from self._check_handler(
+                        mod, callee, funcs, locks, types, root_name,
+                        chain + (fn.name,), depth - 1, seen)

@@ -1,0 +1,406 @@
+"""Project dataflow layer on top of the CFG builder.
+
+Three facilities the rule suites share:
+
+``ModuleFunctions``
+    Per-module call resolution: ``self._helper()`` to the enclosing
+    class's method, ``helper()`` to a module-level def.  This is the
+    boundary the first-order rules of PR 3 could not see across —
+    deadlocks and leaked taint are interprocedural facts.  Resolution
+    stays *within one module* on purpose: per-file findings must depend
+    only on that file's content, or the incremental cache (core.py)
+    would go stale silently.  Cross-module facts (the global
+    lock-acquisition graph) travel through the project-rule facts
+    channel instead.
+
+``LockModel`` / ``lock_facts``
+    Which expressions are locks (``self._lock = threading.Lock()``
+    attributes, module-level ``_lock = threading.Lock()`` globals,
+    function-local locks) and a forward lock-set analysis over a CFG:
+    ``with``-acquisition adds the token at ``WITH_ENTER``, every exit
+    path releases it at the duplicated ``WITH_EXIT`` — so exceptional
+    paths release correctly, matching ``with`` semantics.
+
+``traced_closure``
+    Bounded (two-level) interprocedural taint for the trace rules: a
+    traced function's taint crosses ``self._helper(x)`` / ``helper(x)``
+    call boundaries into the callee's matching parameters.  Two levels
+    is enough for this tree (helpers of helpers), and the bound keeps
+    the analyzer's runtime linear in practice.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .cfg import (BRANCH, CFG, LOOP, STMT, WITH_ENTER, WITH_EXIT, Node,
+                  build_cfg, forward, node_exprs)
+from .core import last_component
+
+#: how many call levels interprocedural walks descend (the ISSUE's
+#: "bounded, two-level inlining is enough for this tree")
+INLINE_DEPTH = 2
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+
+
+def _self_attr(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+# --------------------------------------------------------------------------
+# call resolution
+# --------------------------------------------------------------------------
+
+class ModuleFunctions:
+    """Function/method tables for one parsed module."""
+
+    def __init__(self, tree: ast.Module):
+        self.module_defs: Dict[str, ast.FunctionDef] = {}
+        self.methods: Dict[Tuple[str, str], ast.FunctionDef] = {}
+        self.owner: Dict[int, str] = {}   # id(fn) -> class name
+        for node in tree.body:
+            if isinstance(node, ast.FunctionDef):
+                self.module_defs[node.name] = node
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        self.methods[(node.name, item.name)] = item
+                        self.owner[id(item)] = node.name
+
+    def class_of(self, fn) -> Optional[str]:
+        return self.owner.get(id(fn))
+
+    def resolve_call(self, caller, call: ast.Call):
+        """The same-module FunctionDef a call dispatches to, or None.
+        ``self.X()`` resolves within the caller's class; a bare name
+        resolves to a module-level def."""
+        attr = _self_attr(call.func)
+        if attr is not None:
+            cls = self.class_of(caller)
+            if cls is not None:
+                target = self.methods.get((cls, attr))
+                if isinstance(target, ast.FunctionDef):
+                    return target
+            return None
+        if isinstance(call.func, ast.Name):
+            target = self.module_defs.get(call.func.id)
+            if target is not None and target is not caller:
+                return target
+        return None
+
+
+def iter_scope_nodes(root) -> Iterable[ast.AST]:
+    """Nodes lexically in ``root``'s own scope: the canonical pruned
+    walk every rule shares.  Nested function/lambda/class BODIES are
+    skipped — they are separate scopes with their own analyses, and
+    their code does not execute where it is defined.  The root itself
+    is expanded regardless of its type (so ``iter_scope_nodes(fn)``
+    walks the function's body) and is yielded first."""
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if node is not root and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                       ast.Lambda, ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def iter_calls(root) -> Iterable[ast.Call]:
+    """Calls lexically inside ``root``'s own scope (pruned walk)."""
+    for node in iter_scope_nodes(root):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def bind_args(fn: ast.FunctionDef, call: ast.Call,
+              flagged) -> Set[str]:
+    """Parameter names of ``fn`` that receive a *flagged* argument at
+    this call site.  ``flagged(expr) -> bool``.  ``self`` receivers are
+    skipped for method calls."""
+    args = fn.args
+    params = [a.arg for a in args.posonlyargs + args.args]
+    offset = 1 if params[:1] == ["self"] and _self_attr(call.func) else 0
+    out: Set[str] = set()
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Starred):
+            if flagged(a.value) and args.vararg is not None:
+                out.add(args.vararg.arg)
+            continue
+        idx = i + offset
+        if flagged(a):
+            if idx < len(params):
+                out.add(params[idx])
+            elif args.vararg is not None:
+                out.add(args.vararg.arg)
+    kw_ok = {a.arg for a in args.args + args.posonlyargs + args.kwonlyargs}
+    for k in call.keywords:
+        if not flagged(k.value):
+            continue
+        if k.arg is None or k.arg not in kw_ok:
+            if args.kwarg is not None:
+                out.add(args.kwarg.arg)
+        else:
+            out.add(k.arg)
+    return out - {"self"}
+
+
+# --------------------------------------------------------------------------
+# lock discovery + lock-set analysis
+# --------------------------------------------------------------------------
+
+class LockModel:
+    """Lock-valued names of one module.
+
+    Tokens are stable, human-meaningful identities used in findings and
+    in the global acquisition graph, QUALIFIED by the file (normally
+    the relpath) — two classes both named ``Worker`` in different files
+    hold different locks, and an unqualified token would conflate them
+    into false deadlock cycles:
+
+    - ``<qual>:ClassName._lock`` for ``self._lock = threading.Lock()``
+    - ``<qual>:_lock`` for a module-level ``_lock = threading.Lock()``
+    - ``<qual>:fn.<name>`` for a function-local lock (rare; still
+      ordered)
+    """
+
+    def __init__(self, tree: ast.Module, qualifier: str):
+        self.qualifier = qualifier.replace("\\", "/")
+        self.class_locks: Dict[str, Set[str]] = {}
+        self.module_locks: Set[str] = set()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and last_component(node.value.func) in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                attrs = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and isinstance(sub.value, ast.Call) \
+                            and last_component(sub.value.func) in _LOCK_CTORS:
+                        for t in sub.targets:
+                            a = _self_attr(t)
+                            if a is not None:
+                                attrs.add(a)
+                if attrs:
+                    self.class_locks[node.name] = attrs
+        # anywhere at all — including function locals, which the maps
+        # above don't cover (sweeps use this as their cheap gate)
+        self.has_locks = bool(self.module_locks or self.class_locks) \
+            or any(isinstance(n, ast.Assign)
+                   and isinstance(n.value, ast.Call)
+                   and last_component(n.value.func) in _LOCK_CTORS
+                   for n in ast.walk(tree))
+
+    def _local_locks(self, fn) -> Set[str]:
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and last_component(node.value.func) in _LOCK_CTORS:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+        return out
+
+    def tokens_for_expr(self, expr, fn, cls: Optional[str],
+                        local_locks: Optional[Set[str]] = None):
+        """Lock token for one ``with`` context expression (or None).
+        Accepts the bare lock and ``lock.acquire_timeout(...)``-style
+        helper calls on it."""
+        if isinstance(expr, ast.Call) and isinstance(expr.func,
+                                                     ast.Attribute):
+            return self.tokens_for_expr(expr.func.value, fn, cls,
+                                        local_locks)
+        attr = _self_attr(expr)
+        if attr is not None and cls is not None \
+                and attr in self.class_locks.get(cls, ()):
+            return f"{self.qualifier}:{cls}.{attr}"
+        if isinstance(expr, ast.Name):
+            if expr.id in self.module_locks:
+                return f"{self.qualifier}:{expr.id}"
+            if local_locks is not None and expr.id in local_locks:
+                return f"{self.qualifier}:" \
+                       f"{getattr(fn, 'name', '<module>')}.{expr.id}"
+        return None
+
+    def with_token_list(self, with_stmt, fn, cls,
+                        local_locks=None) -> List[str]:
+        """Lock tokens of one ``with`` statement IN ACQUISITION ORDER —
+        Python enters the items left to right, so ``with a, b:`` is an
+        ordering fact (a before b), not just a set."""
+        out = []
+        for item in with_stmt.items:
+            tok = self.tokens_for_expr(item.context_expr, fn, cls,
+                                       local_locks)
+            if tok is not None:
+                out.append(tok)
+        return out
+
+    def with_tokens(self, with_stmt, fn, cls, local_locks=None) -> Set[str]:
+        return set(self.with_token_list(with_stmt, fn, cls, local_locks))
+
+
+def acquire_tokens(fact: frozenset, toks) -> frozenset:
+    """Add one nesting LEVEL of each token: facts are ``(token,
+    level)`` pairs so reentrant ``with self._lock:`` blocks (RLock)
+    balance — the inner exit must not release the outer hold."""
+    out = set(fact)
+    for t in toks:
+        n = max((lvl for tk, lvl in out if tk == t), default=0)
+        out.add((t, n + 1))
+    return frozenset(out)
+
+
+def release_tokens(fact: frozenset, toks) -> frozenset:
+    out = set(fact)
+    for t in toks:
+        lvls = [lvl for tk, lvl in out if tk == t]
+        if lvls:
+            out.discard((t, max(lvls)))
+    return frozenset(out)
+
+
+def held_names(fact) -> frozenset:
+    """Plain token set from a leveled lock fact (None stays None)."""
+    if fact is None:
+        return None
+    return frozenset(t for t, _lvl in fact)
+
+
+def lock_facts(cfg: CFG, locks: LockModel, fn, cls,
+               entry: frozenset = frozenset(), must: bool = False):
+    """``{id(node): fact at node ENTRY}`` where a fact is a frozenset
+    of ``(token, nesting level)`` pairs — ``held_names`` flattens one
+    to the token set.  Levels make reentrant acquisition of the same
+    lock balance correctly on exit.
+
+    ``entry`` is a plain token set (callers pass the lock set a callee
+    inherits); ``must=False`` (union merge) answers "may this lock be
+    held here" (what blocking-under-lock wants); ``must=True``
+    (intersection) answers "is it guaranteed held" (what the thread
+    rule wants).
+    """
+    local = locks._local_locks(fn) if isinstance(fn, ast.FunctionDef) \
+        else None
+
+    def transfer(node: Node, fact):
+        if node.kind == WITH_ENTER:
+            return acquire_tokens(
+                fact, locks.with_tokens(node.stmt, fn, cls, local))
+        if node.kind == WITH_EXIT:
+            return release_tokens(
+                fact, locks.with_tokens(node.stmt, fn, cls, local))
+        return fact
+
+    join = (lambda a, b: a & b) if must else (lambda a, b: a | b)
+    return forward(cfg, frozenset((t, 1) for t in entry), transfer,
+                   join)
+
+
+# --------------------------------------------------------------------------
+# bounded interprocedural walks
+# --------------------------------------------------------------------------
+
+def walk_with_locks(mod_tree, locks: LockModel, funcs: ModuleFunctions,
+                    fn, visit, entry=frozenset(), chain=(),
+                    depth=INLINE_DEPTH, _seen=None):
+    """Drive ``visit(fn, node, held, chain)`` over every CFG node of
+    ``fn`` with its entry lock-set ``entry``, then descend (bounded)
+    into same-module callees reached while locks are held — a helper
+    called under ``with self._lock`` runs under that lock too.
+
+    ``chain`` is the call path (for messages).  Returns nothing;
+    ``visit`` accumulates.
+    """
+    if _seen is None:
+        _seen = set()
+    key = (id(fn), entry)
+    if key in _seen:
+        return
+    _seen.add(key)
+    cfg = build_cfg(fn)
+    if cfg is None:          # async def etc.: not analyzed, never guessed
+        return
+    cls = funcs.class_of(fn)
+    facts = lock_facts(cfg, locks, fn, cls, entry=entry)
+    for node in cfg.nodes():
+        held = held_names(facts.get(id(node)))
+        if held is None:
+            continue
+        # for WITH_ENTER the fact is the set held BEFORE acquiring —
+        # exactly what the lock-order edge wants
+        visit(fn, node, held, chain)
+        if depth <= 0 or not held:
+            continue
+        if node.kind not in (STMT, BRANCH, LOOP, WITH_ENTER):
+            continue
+        for expr in node_exprs(node):
+            for call in _calls_of_stmt(expr):
+                callee = funcs.resolve_call(fn, call)
+                if callee is None:
+                    continue
+                walk_with_locks(mod_tree, locks, funcs, callee, visit,
+                                entry=held,
+                                chain=chain + (getattr(fn, "name", "?"),),
+                                depth=depth - 1, _seen=_seen)
+
+
+def _calls_of_stmt(stmt) -> List[ast.Call]:
+    """Calls that execute AT this statement.  A nested def/class
+    statement executes none of its body here — defining is not calling
+    — and a lambda's body runs at its later call site, never where the
+    lambda literal appears (``Thread(target=lambda: q.get())`` does not
+    block the constructing thread)."""
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []
+    return list(iter_calls(stmt))
+
+
+def traced_closure(funcs: ModuleFunctions, fn, taint0: Set[str],
+                   compute_taint, effective_taint,
+                   depth=INLINE_DEPTH):
+    """(function, taint set, chain) triples: the traced function itself
+    plus every same-module callee a tainted value flows into, to the
+    inlining bound.  ``compute_taint(fn, seed)`` closes a seed set over
+    assignments; ``effective_taint(expr, taint)`` is the value-taint
+    test (both live in trace_rules — this keeps the engine rule-free).
+    """
+    out = []
+    seen = set()
+
+    def visit(f, taint, chain, d):
+        key = (id(f), frozenset(taint))
+        if key in seen:
+            return
+        seen.add(key)
+        out.append((f, taint, chain))
+        if d <= 0:
+            return
+        for call in iter_calls(f):
+            callee = funcs.resolve_call(f, call)
+            if callee is None or isinstance(callee, ast.AsyncFunctionDef):
+                continue
+            params = bind_args(callee, call,
+                               lambda e: bool(effective_taint(e, taint)))
+            if not params:
+                continue
+            visit(callee, compute_taint(callee, seed=params),
+                  chain + (f.name,), d - 1)
+
+    visit(fn, taint0, (), depth)
+    return out
+
+

@@ -83,74 +83,129 @@ class DonatedReuseRule(Rule):
                         out[t.id] = spec
         return out
 
-    def _check_scope(self, mod, scope):
-        donators = self._donators(scope)
-        if not donators:
-            return
-
-        # events in evaluation order: loads fire where the name is read;
-        # donations fire at the END of their call; stores fire at the END
-        # of their whole statement (Python evaluates the RHS first, so
-        # `x = g(x)` donates x, then the store re-binds it clean).  For
-        # loop targets the binding point is the header (iter end), not
-        # the body end.
-        events: List[tuple] = []  # (line, col, prio, kind, name, node)
+    @staticmethod
+    def _node_events(cnode, donators):
+        """Ordered ``(line, col, prio, kind, name, node)`` events of one
+        CFG node — the PR 3 textual evaluation model, applied WITHIN a
+        node (cross-node ordering is the CFG's job): loads fire at the
+        name's position, donations at the END of their call, stores at
+        the END of their whole statement (Python evaluates the RHS
+        first, so ``x = g(x)`` donates x, then the store re-binds it
+        clean; but ``out = (g(x), x.sum())`` reads x AFTER the donating
+        call and is flagged).  For loop headers the target's binding
+        point is the iterable's end; nested def/lambda bodies are
+        separate scopes and contribute nothing here."""
+        from .cfg import LOOP, WITH_ENTER, node_exprs
+        from .dataflow import iter_scope_nodes
+        events: List[tuple] = []
 
         def store_events(target, anchor):
             end = (anchor.end_lineno or anchor.lineno,
                    anchor.end_col_offset or anchor.col_offset)
             for n in ast.walk(target):
-                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+                if isinstance(n, ast.Name) and isinstance(n.ctx,
+                                                          ast.Store):
                     events.append((end[0], end[1], 2, "store", n.id, n))
 
-        for node in _walk_scope(scope):
-            if isinstance(node, ast.Name):
-                if isinstance(node.ctx, ast.Load):
-                    events.append((node.lineno, node.col_offset, 0,
-                                   "load", node.id, node))
-            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
-                for t in (node.targets if isinstance(node, ast.Assign)
-                          else [node.target]):
-                    store_events(t, node)
-            elif isinstance(node, ast.AugAssign):
-                if isinstance(node.target, ast.Name):
-                    # x += v reads x too
-                    events.append((node.target.lineno,
-                                   node.target.col_offset, 0, "load",
-                                   node.target.id, node.target))
-                store_events(node.target, node)
-            elif isinstance(node, ast.NamedExpr):
-                store_events(node.target, node)
-            elif isinstance(node, ast.For):
-                store_events(node.target, node.iter)
-            elif isinstance(node, ast.withitem) \
-                    and node.optional_vars is not None:
-                store_events(node.optional_vars, node.context_expr)
-            elif isinstance(node, ast.Call) \
-                    and isinstance(node.func, ast.Name) \
-                    and node.func.id in donators:
-                spec = donators[node.func.id]
-                for i, a in enumerate(node.args):
-                    if not isinstance(a, ast.Name):
-                        continue
-                    if spec == "all" or i in spec:
-                        events.append((node.end_lineno or node.lineno,
-                                       node.end_col_offset or
-                                       node.col_offset, 1,
-                                       "donate", a.id, node))
+        s = cnode.stmt
+        if cnode.kind == LOOP and isinstance(s, ast.For):
+            walk_roots = [s.iter]
+            store_events(s.target, s.iter)
+        elif cnode.kind == WITH_ENTER:
+            walk_roots = [i.context_expr for i in s.items]
+            for i in s.items:
+                if i.optional_vars is not None:
+                    store_events(i.optional_vars, i.context_expr)
+        else:
+            walk_roots = [e for e in node_exprs(cnode)
+                          if not isinstance(e, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef,
+                                                ast.ClassDef))]
+        for root in walk_roots:
+            for n in iter_scope_nodes(root):
+                if isinstance(n, ast.Name) and isinstance(n.ctx,
+                                                          ast.Load):
+                    events.append((n.lineno, n.col_offset, 0, "load",
+                                   n.id, n))
+                elif isinstance(n, (ast.Assign, ast.AnnAssign)):
+                    for t in (n.targets if isinstance(n, ast.Assign)
+                              else [n.target]):
+                        store_events(t, n)
+                elif isinstance(n, ast.AugAssign):
+                    if isinstance(n.target, ast.Name):   # x += v reads x
+                        events.append((n.target.lineno,
+                                       n.target.col_offset, 0, "load",
+                                       n.target.id, n.target))
+                    store_events(n.target, n)
+                elif isinstance(n, ast.NamedExpr):
+                    store_events(n.target, n)
+                elif isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Name) \
+                        and n.func.id in donators:
+                    spec = donators[n.func.id]
+                    for i, a in enumerate(n.args):
+                        if isinstance(a, ast.Name) \
+                                and (spec == "all" or i in spec):
+                            events.append((n.end_lineno or n.lineno,
+                                           n.end_col_offset
+                                           or n.col_offset, 1,
+                                           "donate", a.id, n))
         events.sort(key=lambda e: (e[0], e[1], e[2]))
+        return events
 
+    def _node_pass(self, cnode, donators, entry):
+        """Replay one node's events over the entry fact.  Returns
+        ``(hits, out_fact)`` — hits are ``(name, donated line, load
+        node)`` triples; the fact is a frozenset of ``(name, line)``."""
         donated: Dict[str, int] = {}
-        for line, _col, _p, kind, name, node in events:
+        for name, line in sorted(entry):
+            donated.setdefault(name, line)
+        hits = []
+        for _l, _c, _p, kind, name, node in self._node_events(cnode,
+                                                              donators):
             if kind == "load" and name in donated:
+                hits.append((name, donated[name], node))
+                del donated[name]    # one finding per donation
+            elif kind == "donate":
+                donated[name] = _l
+            elif kind == "store":
+                donated.pop(name, None)
+        return hits, frozenset(donated.items())
+
+    def _check_scope(self, mod, scope):
+        """CFG-hosted (this PR): donated-ness is a forward dataflow fact
+        of ``(name, donation line)`` pairs, so the hazard now survives
+        control flow the PR 3 textual-order walk could not represent —
+        branches that donate on one arm, and loop back edges (the loop
+        header's re-bind is what makes per-iteration donation clean) —
+        while WITHIN a statement the original evaluation-order model
+        still applies (a read in the same statement as the donating
+        call, after it, is still a use-after-donate)."""
+        from .cfg import build_cfg, forward
+        donators = self._donators(scope)
+        if not donators:
+            return
+        cfg = build_cfg(scope)
+        if cfg is None:
+            return   # async scope: not analyzed
+
+        def transfer(cnode, fact):
+            return self._node_pass(cnode, donators, fact)[1]
+
+        facts = forward(cfg, frozenset(), transfer, lambda a, b: a | b)
+        reported = set()
+        for cnode in cfg.nodes():
+            fact = facts.get(id(cnode))
+            if fact is None:
+                continue
+            for name, line, node in self._node_pass(cnode, donators,
+                                                    fact)[0]:
+                if id(node) in reported:
+                    continue
+                reported.add(id(node))
                 yield self.finding(
                     mod, node,
                     f"'{name}' is read after being donated on line "
-                    f"{donated[name]}: the buffer belongs to XLA now "
-                    f"(deleted array) — copy it first, re-bind the name, "
-                    f"or drop donate_batch/donate_argnums for this path")
-                del donated[name]  # one finding per donation
-            elif kind == "donate":
-                donated[name] = line
-            elif kind == "store":
-                donated.pop(name, None)
+                    f"{line}: the buffer belongs to XLA now (deleted "
+                    f"array) — copy it first, re-bind the name, or "
+                    f"drop donate_batch/donate_argnums for this path")

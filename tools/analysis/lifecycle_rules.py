@@ -1,0 +1,220 @@
+"""Resource-lifecycle rule: leaks on exceptional paths.
+
+``resource-leak-on-error``
+    A resource with teardown obligations — an ``open()`` file handle, a
+    *started* ``threading.Thread``, a ``PrefetchingIter``/
+    ``DevicePrefetcher``/``DataLoader`` feed, a ``ThreadPoolExecutor``/
+    ``Pool`` — is acquired into a local variable, and some CFG path can
+    exit the function via an exception without reaching its release
+    (``close``/``join``/``shutdown``/...).  This is the exact bug class
+    PRs 2 and 4 fixed by hand in review (producer threads leaked when a
+    wrapped iterator raised; prefetchers left running when predict's
+    loop died) — now it is mechanical.
+
+    The rule is deliberately conservative about ownership: tracking
+    *ends* (no finding) the moment the resource escapes the function —
+    returned, yielded, stored on ``self``/an object/a container,
+    aliased to another name, or passed to another call (ownership
+    transfer).  A ``with`` block is the canonical fix and never
+    tracked.  Only the exceptional exit is checked: returning an open
+    resource on the normal path is how constructors work.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional, Set, Tuple
+
+from .cfg import STMT, WITH_ENTER, build_cfg, forward, node_exprs
+from .core import Rule, last_component
+from .dataflow import _calls_of_stmt, iter_scope_nodes
+
+# ctor name -> (kind, release verbs). Thread is special-cased: it only
+# becomes a leak candidate once .start() runs (an unstarted Thread
+# object is garbage-collected like any object).
+_RESOURCE_CTORS = {
+    "open": ("file handle", ("close",)),
+    "PrefetchingIter": ("prefetcher", ("close",)),
+    "DevicePrefetcher": ("prefetcher", ("close",)),
+    "DataLoader": ("data loader", ("close",)),
+    "ThreadPoolExecutor": ("thread pool", ("shutdown",)),
+    "ProcessPoolExecutor": ("process pool", ("shutdown",)),
+    "Pool": ("worker pool", ("close", "terminate", "join")),
+    "socket": ("socket", ("close",)),
+    "TemporaryFile": ("temp file", ("close",)),
+    "NamedTemporaryFile": ("temp file", ("close",)),
+}
+_THREAD_CTORS = {"Thread"}
+_RELEASE_VERBS = {"close", "join", "shutdown", "terminate", "stop",
+                  "release", "__exit__"}
+
+
+def _resource_ctor(value) -> Optional[Tuple[str, Tuple[str, ...]]]:
+    if isinstance(value, ast.Call):
+        spec = _RESOURCE_CTORS.get(last_component(value.func) or "")
+        if spec:
+            return spec
+    return None
+
+
+class ResourceLeakRule(Rule):
+    id = "resource-leak-on-error"
+    description = ("locally-acquired Thread/file/prefetcher/pool can "
+                   "leak: an exception path exits the function before "
+                   "its close()/join()")
+
+    def check_module(self, mod):
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef):
+                yield from self._check_function(mod, node)
+            # async defs: build_cfg declines them; nothing to report —
+            # "not analyzed" must never decay into findings or crashes
+
+    def _check_function(self, mod, fn):
+        cfg = build_cfg(fn)
+        if cfg is None:
+            return
+        # pass 1 (lexical): candidate locals + thread locals + names
+        # that ever escape.  A name that escapes anywhere is dropped
+        # entirely — path-sensitive ownership is not worth the FPs.
+        acquires: Dict[int, Tuple[str, str, Tuple[str, ...]]] = {}
+        thread_locals: Set[str] = set()
+        for node in self._own_nodes(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                spec = _resource_ctor(node.value)
+                if spec:
+                    acquires[id(node)] = (name,) + spec
+                elif isinstance(node.value, ast.Call) \
+                        and last_component(node.value.func) \
+                        in _THREAD_CTORS:
+                    thread_locals.add(name)
+        escaped = self._escaped_names(
+            fn, {a[0] for a in acquires.values()} | thread_locals)
+        tracked = ({a[0] for a in acquires.values()} | thread_locals) \
+            - escaped
+        if not tracked:
+            return
+
+        # pass 2 (paths): forward "held resources" facts.  A fact is a
+        # frozenset of (name, acquire line, kind, release verbs).
+        def transfer(cnode, fact):
+            s = cnode.stmt
+            if s is None:
+                return fact
+            if cnode.kind == WITH_ENTER:
+                # `with open(...) as f` manages release itself; a bare
+                # `with x:` also releases x — clear anything rebound or
+                # context-managed here
+                names = {v.optional_vars.id for v in s.items
+                         if isinstance(v.optional_vars, ast.Name)}
+                names |= {v.context_expr.id for v in s.items
+                          if isinstance(v.context_expr, ast.Name)}
+                return frozenset(h for h in fact if h[0] not in names)
+            out = set(fact)
+            added = set()
+            if cnode.kind == STMT and isinstance(s, ast.Assign):
+                if id(s) in acquires:
+                    name, kind, verbs = acquires[id(s)]
+                    if name in tracked:
+                        out = {h for h in out if h[0] != name}
+                        added.add((name, s.lineno, kind, verbs))
+                        out.add((name, s.lineno, kind, verbs))
+                else:
+                    for t in s.targets:
+                        if isinstance(t, ast.Name):
+                            out = {h for h in out if h[0] != t.id}
+            # node_exprs keeps loop/branch headers from re-counting
+            # their bodies' calls (the bodies have their own nodes)
+            for expr in node_exprs(cnode):
+                for call in self._calls_in(expr):
+                    f = call.func
+                    if isinstance(f, ast.Attribute) \
+                            and isinstance(f.value, ast.Name):
+                        name = f.value.id
+                        if f.attr == "start" and name in thread_locals \
+                                and name in tracked:
+                            out = {h for h in out if h[0] != name}
+                            added.add((name, call.lineno,
+                                       "started thread", ("join",)))
+                            out.add((name, call.lineno,
+                                     "started thread", ("join",)))
+                        elif f.attr in _RELEASE_VERBS:
+                            out = {h for h in out if h[0] != name}
+            # the acquiring statement's own exception edge carries the
+            # PRE-STATEMENT state: if open()/start() itself raises, the
+            # new handle does not exist — and the store never ran, so a
+            # REBOUND name (f = open(y) over an earlier f = open(x))
+            # still holds the old handle, which therefore still leaks
+            return frozenset(out), (fact if added else frozenset(out))
+
+        facts = forward(cfg, frozenset(), transfer,
+                        lambda a, b: a | b)
+        leaked = facts.get(id(cfg.raise_exit))
+        if not leaked:
+            return
+        for name, line, kind, verbs in sorted(leaked):
+            anchor = type("L", (), {"lineno": line, "col_offset": 0})
+            yield self.finding(
+                mod, anchor,
+                f"{kind} '{name}' acquired here can leak: an exception "
+                f"path exits '{fn.name}' before "
+                f"{' / '.join(f'{name}.{v}()' for v in verbs)} — "
+                f"release it in a try/finally (or a with block), the "
+                f"way the async-feed teardown does")
+
+    # ---- lexical helpers (canonical pruned walks from dataflow) ----
+    @staticmethod
+    def _own_nodes(fn):
+        return iter_scope_nodes(fn)
+
+    @staticmethod
+    def _calls_in(stmt):
+        return _calls_of_stmt(stmt)
+
+    @staticmethod
+    def _bare_loads(expr, candidates: Set[str]) -> Set[str]:
+        """Candidate names loaded *as values* in ``expr``.  A name used
+        only as an attribute receiver (``f.read()``, ``t.is_alive()``)
+        is a *use*, not an ownership transfer, and is exempt."""
+        out: Set[str] = set()
+
+        def walk(n):
+            if isinstance(n, ast.Attribute):
+                base = n.value
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                if isinstance(base, ast.Name):
+                    return          # pure receiver chain: exempt
+                walk(n.value)
+                return
+            if isinstance(n, ast.Name) \
+                    and isinstance(n.ctx, ast.Load) \
+                    and n.id in candidates:
+                out.add(n.id)
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+
+        walk(expr)
+        return out
+
+    @classmethod
+    def _escaped_names(cls, fn, candidates: Set[str]) -> Set[str]:
+        """Names whose ownership leaves the function (returned, yielded,
+        stored on self/containers, aliased, or passed to another call):
+        no leak verdict is ever issued for these."""
+        escaped: Set[str] = set()
+        for node in cls._own_nodes(fn):
+            if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+                if node.value is not None and isinstance(
+                        node.value, (ast.Name, ast.Tuple, ast.List)):
+                    # `return f` hands the handle out; `return f.read()`
+                    # does not (and its raise-path leak stays checkable)
+                    escaped |= cls._bare_loads(node.value, candidates)
+            elif isinstance(node, ast.Assign):
+                escaped |= cls._bare_loads(node.value, candidates)
+            elif isinstance(node, ast.Call):
+                for a in list(node.args) + [k.value for k in
+                                            node.keywords]:
+                    escaped |= cls._bare_loads(a, candidates)
+        return escaped

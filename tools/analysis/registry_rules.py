@@ -24,7 +24,7 @@ import re
 from pathlib import Path
 from typing import Dict, List, Tuple
 
-from .core import ProjectRule, Rule, last_component
+from .core import Finding, ProjectRule, Rule, last_component
 
 
 # --------------------------------------------------------------------------
@@ -62,26 +62,29 @@ class DuplicateRegistrationRule(ProjectRule):
     id = "registry-duplicate"
     description = "op name registered/aliased from two distinct sites"
 
-    def check_project(self, modules, root):
+    def facts(self, mod):
+        return [[name, line] for name, line in _registrations(mod)]
+
+    def check_facts(self, facts, root, analyzed):
         sites: Dict[str, List[Tuple[str, int]]] = {}
-        mods = {}
-        for mod in modules:
-            mods[mod.relpath] = mod
-            for name, line in _registrations(mod):
-                sites.setdefault(name, []).append((mod.relpath, line))
+        for relpath, regs in facts:
+            for name, line in regs or ():
+                sites.setdefault(name, []).append((relpath, line))
         for name, where in sorted(sites.items()):
             if len(where) < 2:
                 continue
+            where.sort()
             first = where[0]
             for path, line in where[1:]:
-                yield Rule.finding(
-                    self, mods[path],
-                    type("L", (), {"lineno": line, "col_offset": 0}),
-                    f"op '{name}' is registered here but already "
-                    f"registered at {first[0]}:{first[1]} — the later "
-                    f"registration silently shadows the earlier one "
-                    f"(rename it or register an explicit alias of the "
-                    f"same function)")
+                if path not in analyzed:
+                    continue
+                yield Finding(
+                    rule=self.id, path=path, line=line, col=1,
+                    message=f"op '{name}' is registered here but already "
+                            f"registered at {first[0]}:{first[1]} — the "
+                            f"later registration silently shadows the "
+                            f"earlier one (rename it or register an "
+                            f"explicit alias of the same function)")
 
 
 class MissingGradientRule(Rule):
@@ -142,40 +145,49 @@ _PROJECT_PREFIXES = {
 }
 
 
-def build_symbol_index(modules) -> set:
-    """Every name the tree defines: functions/classes/methods at any
+def module_symbols(mod) -> list:
+    """Every name one module defines: functions/classes/methods at any
     depth, assignments (including ``self.attr`` instance attributes),
-    registered op names, fault-injection point names, and module
-    basenames."""
+    registered op names, fault-injection point names, and the module
+    basename.  This is the per-file fact the docs rule caches; the
+    whole-tree index is the union."""
+    index = set()
+    index.add(Path(mod.relpath).stem)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            index.add(node.name)
+        elif isinstance(node, (ast.Assign, ast.AnnAssign,
+                               ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        index.add(n.id)
+                    elif isinstance(n, ast.Attribute):
+                        index.add(n.attr)
+        elif isinstance(node, ast.Call) \
+                and last_component(node.func) in ("fire", "_fire",
+                                                  "inject") \
+                and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            # fault-injection point names are a documented surface
+            # (`io.producer` etc.) — docs referencing them are not
+            # stale as long as the fire() site exists
+            index.add(node.args[0].value)
+    for name, _ in _registrations(mod):
+        index.add(name)
+    return sorted(index)
+
+
+def build_symbol_index(modules) -> set:
+    """Union of ``module_symbols`` over ModuleInfo objects (kept for
+    tests/back-compat; the engine path goes through facts)."""
     index = set()
     for mod in modules:
-        index.add(Path(mod.relpath).stem)
-        for node in ast.walk(mod.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                 ast.ClassDef)):
-                index.add(node.name)
-            elif isinstance(node, (ast.Assign, ast.AnnAssign,
-                                   ast.AugAssign)):
-                targets = node.targets if isinstance(node, ast.Assign) \
-                    else [node.target]
-                for t in targets:
-                    for n in ast.walk(t):
-                        if isinstance(n, ast.Name):
-                            index.add(n.id)
-                        elif isinstance(n, ast.Attribute):
-                            index.add(n.attr)
-            elif isinstance(node, ast.Call) \
-                    and last_component(node.func) in ("fire", "_fire",
-                                                      "inject") \
-                    and node.args \
-                    and isinstance(node.args[0], ast.Constant) \
-                    and isinstance(node.args[0].value, str):
-                # fault-injection point names are a documented surface
-                # (`io.producer` etc.) — docs referencing them are not
-                # stale as long as the fire() site exists
-                index.add(node.args[0].value)
-        for name, _ in _registrations(mod):
-            index.add(name)
+        index.update(module_symbols(mod))
     return index
 
 
@@ -185,23 +197,20 @@ class StaleDocSymbolRule(ProjectRule):
                    "longer exists")
     doc_path = Path("docs/api.md")
 
-    def check_project(self, modules, root):
+    def facts(self, mod):
+        return module_symbols(mod)
+
+    def check_facts(self, facts, root, analyzed):
+        # the docs contract is against the WHOLE tree, not whatever
+        # subset this run analyzes — the engine hands project rules the
+        # analyzed set PLUS the project scope (core.PROJECT_SCOPE), so
+        # linting a single file does not make every doc row look stale
         doc = root / self.doc_path
         if not doc.exists():
             return
-        # the docs contract is against the WHOLE tree, not whatever
-        # subset this run analyzes: linting a single file must not make
-        # every doc row look stale
-        from .core import _collect_files, load_module
-        extra = []
-        have = {m.path.resolve() for m in modules}
-        for sub in ("mxnet_tpu", "tools", "bench.py"):
-            if (root / sub).exists():
-                extra.extend(m for m in (load_module(f, root)
-                                         for f in _collect_files([root / sub]))
-                             if m is not None
-                             and m.path.resolve() not in have)
-        index = build_symbol_index(list(modules) + extra)
+        index = set()
+        for _relpath, symbols in facts:
+            index.update(symbols or ())
         lines = doc.read_text(encoding="utf-8").splitlines()
         doc_mod = type("Doc", (), {"relpath": str(self.doc_path)})
         for lineno, line in enumerate(lines, start=1):

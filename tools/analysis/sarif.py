@@ -1,0 +1,97 @@
+"""SARIF 2.1.0 output for mxlint findings.
+
+SARIF (Static Analysis Results Interchange Format) is what CI
+annotation tooling ingests — GitHub code scanning, Azure DevOps, the
+VS Code SARIF viewer all consume it natively, so
+``python -m tools.analysis --format sarif mxnet_tpu/`` plugs the gate
+into PR annotations without a custom adapter.
+
+The envelope is deliberately minimal and DETERMINISTIC: no timestamps,
+no absolute paths (URIs are the repo-relative paths the engine already
+reports, ``/``-separated per the spec), findings in the engine's sorted
+order — so the golden-file test in tests/test_mxlint.py can compare
+bytes, and ``chaos_check --mode lint`` can assert cached re-runs are
+byte-identical.
+
+Suppressed findings are carried as SARIF ``suppressions`` entries
+(``kind: inSource`` with the justification) rather than dropped — the
+same audit-trail stance as ``--json``.
+"""
+from __future__ import annotations
+
+import json
+from typing import Iterable, List, Optional
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+           "master/Schemata/sarif-schema-2.1.0.json")
+
+_LEVELS = {"error": "error", "warning": "warning", "info": "note"}
+
+
+def _uri(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def to_sarif(findings, rules: Optional[Iterable] = None,
+             tool_version: Optional[str] = None) -> str:
+    """Serialize findings as a SARIF 2.1.0 log (a JSON string)."""
+    if tool_version is None:
+        from .core import ENGINE_VERSION
+        tool_version = ENGINE_VERSION
+    if rules is None:
+        from .core import default_rules
+        rules = default_rules()
+
+    rule_meta: List[dict] = []
+    seen = set()
+    for r in rules:
+        if r.id in seen:
+            continue
+        seen.add(r.id)
+        rule_meta.append({
+            "id": r.id,
+            "shortDescription": {"text": r.description or r.id},
+            "defaultConfiguration": {
+                "level": _LEVELS.get(r.default_severity, "error")},
+        })
+    rule_meta.sort(key=lambda m: m["id"])
+    rule_index = {m["id"]: i for i, m in enumerate(rule_meta)}
+
+    results = []
+    for f in findings:
+        res = {
+            "ruleId": f.rule,
+            "level": _LEVELS.get(f.severity, "error"),
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": _uri(f.path)},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": max(1, f.col)},
+                },
+            }],
+        }
+        if f.rule in rule_index:
+            res["ruleIndex"] = rule_index[f.rule]
+        if f.suppressed:
+            res["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.justification or "",
+            }]
+        results.append(res)
+
+    log = {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "mxlint",
+                "informationUri": "docs/analysis.md",
+                "version": tool_version,
+                "rules": rule_meta,
+            }},
+            "columnKind": "unicodeCodePoints",
+            "results": results,
+        }],
+    }
+    return json.dumps(log, indent=2, sort_keys=True)

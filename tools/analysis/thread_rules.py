@@ -153,35 +153,61 @@ class UnlockedAttrRule(Rule):
         return written
 
     def _check_public(self, mod, cls, name, fn, written, locks, producers):
-        """Flag accesses of producer-written attrs outside lock blocks."""
+        """Flag accesses of producer-written attrs on CFG nodes where no
+        instance lock is *guaranteed* held (must-analysis: a path that
+        reaches the access unlocked is a torn read on that path).
 
-        def walk(node, locked):
-            held = locked
-            if isinstance(node, ast.With):
-                for item in node.items:
-                    expr = item.context_expr
-                    # `with self._lock:` (Lock attrs used as ctx managers)
-                    if _self_attr(expr) in locks:
-                        held = True
-                    # `with self._lock.acquire_timeout(...)`-style helpers
-                    elif isinstance(expr, ast.Call) \
-                            and isinstance(expr.func, ast.Attribute) \
-                            and _self_attr(expr.func.value) in locks:
-                        held = True
-            hits = []
-            if not held:
-                attr = _self_attr(node)
-                if attr in written:
-                    hits.append(self.finding(
-                        mod, node,
-                        f"{cls.name}.{name} accesses self.{attr} without "
-                        f"holding the instance lock, but "
-                        f"'{written[attr]}' writes it from the producer "
-                        f"thread — wrap the access in `with self."
-                        f"{sorted(locks)[0] if locks else '<lock>'}:` or "
-                        f"route it through a Queue/Event"))
-            for child in ast.iter_child_nodes(node):
-                hits.extend(walk(child, held))
-            return hits
+        Hosted on the CFG engine (this PR): ``with self._lock:`` blocks
+        release on every exit path by construction, and intersection
+        merge means an access reachable both locked and unlocked is
+        still flagged — the lexical walk of PR 3 got the same answer
+        only for straight-line code."""
+        from .cfg import WITH_ENTER, WITH_EXIT, build_cfg, forward, \
+            node_exprs
+        from .dataflow import acquire_tokens, release_tokens
 
-        yield from walk(fn, False)
+        cfg = build_cfg(fn)
+        if cfg is None:
+            return   # async def: not analyzed (clean skip, not a guess)
+
+        def with_locks(stmt):
+            held = set()
+            for item in stmt.items:
+                expr = item.context_expr
+                # `with self._lock:` (Lock attrs used as ctx managers)
+                if _self_attr(expr) in locks:
+                    held.add(_self_attr(expr))
+                # `with self._lock.acquire_timeout(...)`-style helpers
+                elif isinstance(expr, ast.Call) \
+                        and isinstance(expr.func, ast.Attribute) \
+                        and _self_attr(expr.func.value) in locks:
+                    held.add(_self_attr(expr.func.value))
+            return frozenset(held)
+
+        def transfer(node, fact):
+            # leveled (token, depth) facts: a reentrant RLock's inner
+            # exit must not release the outer hold
+            if node.kind == WITH_ENTER:
+                return acquire_tokens(fact, with_locks(node.stmt))
+            if node.kind == WITH_EXIT:
+                return release_tokens(fact, with_locks(node.stmt))
+            return fact
+
+        facts = forward(cfg, frozenset(), transfer, lambda a, b: a & b)
+        for node in cfg.nodes():
+            fact = facts.get(id(node))
+            if fact is None or fact:
+                continue     # unreachable, or under some instance lock
+            for expr in node_exprs(node):
+                for sub in ast.walk(expr):
+                    attr = _self_attr(sub)
+                    if attr in written:
+                        yield self.finding(
+                            mod, sub,
+                            f"{cls.name}.{name} accesses self.{attr} "
+                            f"without holding the instance lock, but "
+                            f"'{written[attr]}' writes it from the "
+                            f"producer thread — wrap the access in "
+                            f"`with self."
+                            f"{sorted(locks)[0] if locks else '<lock>'}:`"
+                            f" or route it through a Queue/Event")

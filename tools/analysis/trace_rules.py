@@ -223,16 +223,25 @@ def _tainted_params(fn, static_nums=(), static_names=()) -> Set[str]:
     return {n for n in names if n != "self" and n not in set(static_names)}
 
 
-def compute_taint(fn, static_nums=(), static_names=()) -> Set[str]:
+def compute_taint(fn, static_nums=(), static_names=(),
+                  seed=None) -> Set[str]:
     """Parameters of ``fn`` (and of its nested defs — they run under the
     same trace) plus everything assignment-reachable from them.  Params
     in static/nondiff positions are concrete, not traced, and metadata
-    reads (``x.shape``) do not propagate taint."""
-    tainted = set(_tainted_params(fn, static_nums, static_names))
-    for node in ast.walk(fn):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)) and node is not fn:
-            tainted |= _tainted_params(node)
+    reads (``x.shape``) do not propagate taint.
+
+    ``seed`` overrides the initial set: for a helper reached through a
+    call boundary only the parameters the call site actually passed
+    tainted values into are traced (dataflow.traced_closure computes
+    those) — the helper's other parameters stay concrete."""
+    if seed is not None:
+        tainted = set(seed)
+    else:
+        tainted = set(_tainted_params(fn, static_nums, static_names))
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)) and node is not fn:
+                tainted |= _tainted_params(node)
     for _ in range(3):  # small fixpoint: chains are short in practice
         before = len(tainted)
         for node in ast.walk(fn):
@@ -278,6 +287,22 @@ def effective_taint(expr, tainted: Set[str]) -> Set[str]:
             # identity compares are Python-object-level: always static
             # under trace, never concretize a tracer
             return
+        if isinstance(n, ast.Compare) \
+                and all(isinstance(op, (ast.In, ast.NotIn))
+                        for op in n.ops) \
+                and not (isinstance(n.left, ast.Constant)
+                         and isinstance(n.left.value, (int, float,
+                                                       complex))
+                         and not isinstance(n.left.value, bool)):
+            # `key in store` probes a container's KEYS — for the dict
+            # stores this tree uses (param/aux dicts holding traced
+            # VALUES) that is hashing, not a tracer comparison, so only
+            # the left operand can concretize.  `tracer in xs` (left
+            # tainted) still taints and still flags — and so does a
+            # NUMERIC literal membership (`0 in x`): that shape is an
+            # element test on a traced array, not a dict-key probe.
+            walk(n.left)
+            return
         if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
                 and n.id in tainted:
             out.add(n.id)
@@ -292,15 +317,38 @@ def effective_taint(expr, tainted: Set[str]) -> Set[str]:
 # rules
 # --------------------------------------------------------------------------
 
+def _via(chain):
+    """' (traced via a -> b)' suffix for findings inside helpers the
+    taint reached through call boundaries."""
+    return f" (traced via {' -> '.join(chain)})" if chain else ""
+
 class _TracedRule(Rule):
-    """Base: iterates (traced function, taint set) pairs per module."""
+    """Base: iterates (traced function, taint set) pairs per module.
+
+    Since the CFG/dataflow engine (this PR), the pairs are the
+    *interprocedural closure*: every traced function PLUS the
+    same-module helpers its taint flows into through ``self._helper(x)``
+    / ``helper(x)`` call boundaries (two levels deep — the single-hop
+    blind spot of the PR 3 walk, closed).  Duplicate findings from a
+    helper reached via several traced callers are deduped by the
+    engine (core.analyze)."""
 
     def check_module(self, mod):
+        from .dataflow import ModuleFunctions, traced_closure
+        funcs = ModuleFunctions(mod.tree)
+        emitted = set()
         for fn, static_nums, static_names in find_traced_functions(mod.tree):
             tainted = compute_taint(fn, static_nums, static_names)
-            yield from self.check_traced(mod, fn, tainted)
+            for target, taint, chain in traced_closure(
+                    funcs, fn, tainted, compute_taint, effective_taint):
+                key = (id(target), frozenset(taint))
+                if key in emitted:
+                    continue
+                emitted.add(key)
+                yield from self.check_traced(mod, target, taint,
+                                             chain=chain)
 
-    def check_traced(self, mod, fn, tainted):
+    def check_traced(self, mod, fn, tainted, chain=()):
         return ()
 
 
@@ -309,7 +357,8 @@ class HostSyncRule(_TracedRule):
     description = ("host-synchronizing call on a traced value inside a "
                    "jit-compiled function")
 
-    def check_traced(self, mod, fn, tainted):
+    def check_traced(self, mod, fn, tainted, chain=()):
+        via = _via(chain)
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
@@ -320,7 +369,7 @@ class HostSyncRule(_TracedRule):
                 yield self.finding(
                     mod, node,
                     f".{func.attr}() on traced value inside traced function "
-                    f"'{fn.name}': forces a host sync / fails under jit — "
+                    f"'{fn.name}'{via}: forces a host sync / fails under jit — "
                     f"keep the value on device or move the sync outside "
                     f"the compiled path")
             dname = dotted_name(func)
@@ -330,7 +379,7 @@ class HostSyncRule(_TracedRule):
                 yield self.finding(
                     mod, node,
                     f"{dname or last_component(func)}() on traced value "
-                    f"inside traced function '{fn.name}': host sync under "
+                    f"inside traced function '{fn.name}'{via}: host sync under "
                     f"jit — use jnp/lax equivalents on device")
             if isinstance(func, ast.Name) and func.id in _CASTS \
                     and node.args \
@@ -338,12 +387,12 @@ class HostSyncRule(_TracedRule):
                 yield self.finding(
                     mod, node,
                     f"{func.id}() on traced value inside traced function "
-                    f"'{fn.name}': concretizes the tracer (host sync / "
+                    f"'{fn.name}'{via}: concretizes the tracer (host sync / "
                     f"ConcretizationTypeError) — use .astype or jnp casts")
             if isinstance(func, ast.Name) and func.id == "print":
                 yield self.finding(
                     mod, node,
-                    f"print() inside traced function '{fn.name}' runs at "
+                    f"print() inside traced function '{fn.name}'{via} runs at "
                     f"TRACE time (once), not per step — use "
                     f"jax.debug.print or log outside the compiled path")
 
@@ -353,7 +402,8 @@ class TracedBranchRule(_TracedRule):
     description = ("Python control flow on a traced value inside a "
                    "jit-compiled function")
 
-    def check_traced(self, mod, fn, tainted):
+    def check_traced(self, mod, fn, tainted, chain=()):
+        via = _via(chain)
         for node in ast.walk(fn):
             if isinstance(node, (ast.If, ast.While, ast.IfExp)):
                 names = effective_taint(node.test, tainted)
@@ -364,7 +414,7 @@ class TracedBranchRule(_TracedRule):
                         mod, node,
                         f"Python {kind} on traced value(s) "
                         f"{sorted(names)} inside traced function "
-                        f"'{fn.name}': branches are resolved at trace "
+                        f"'{fn.name}'{via}: branches are resolved at trace "
                         f"time — use jnp.where / lax.cond / lax.select")
             elif isinstance(node, ast.Assert):
                 names = effective_taint(node.test, tainted)
@@ -372,7 +422,7 @@ class TracedBranchRule(_TracedRule):
                     yield self.finding(
                         mod, node,
                         f"assert on traced value(s) {sorted(names)} inside "
-                        f"traced function '{fn.name}': evaluated at trace "
+                        f"traced function '{fn.name}'{via}: evaluated at trace "
                         f"time only — use checkify or a fused finite-guard")
 
 
@@ -397,7 +447,8 @@ class MutableGlobalRule(_TracedRule):
             node = node.value
         return node.id if isinstance(node, ast.Name) else None
 
-    def check_traced(self, mod, fn, tainted):
+    def check_traced(self, mod, fn, tainted, chain=()):
+        via = _via(chain)
         local = set(_tainted_params(fn))
         for node in ast.walk(fn):
             if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):

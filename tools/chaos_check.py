@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Chaos smoke: kill-and-resume (train) and inject-and-drain (serve).
+"""Chaos smoke: kill-and-resume (train), inject-and-drain (serve),
+and the incremental-analyzer contract (lint).
 
 ``--mode train`` (default) runs a small training loop with periodic
 checkpoints, injects a crash mid-run via ``fault.inject``, rediscovers
@@ -14,10 +15,16 @@ ACCEPTED request resolved (result or explicit error — zero silently
 dropped) and the breaker must have tripped and fast-failed — the
 acceptance contract of ISSUE 4::
 
-    python tools/chaos_check.py [--mode train|serve] [--steps 8] ...
+    python tools/chaos_check.py [--mode train|serve|lint] [--steps 8] ...
+
+``--mode lint`` runs the full mxlint analyzer twice against a fresh
+cache directory and asserts the second (fully cached) run is >= 5x
+faster AND byte-identical in findings — the incremental-mode contract
+of ISSUE 5 (a cache that changes answers is worse than no cache).
 
 Exit code 0 on success, 1 on any mismatch.  Forces ``JAX_PLATFORMS=cpu``
-(and an 8-device virtual mesh) so it runs anywhere, TPU or not.
+(and an 8-device virtual mesh) so it runs anywhere, TPU or not (lint
+mode never imports jax at all — mxlint is pure ast).
 """
 import argparse
 import os
@@ -134,10 +141,70 @@ def serve_mode(args):
     return 0
 
 
+def lint_mode(args):
+    """Incremental-analyzer smoke: cold run, warm run, compare (ISSUE 5).
+
+    Both runs cover the full gate surface (mxnet_tpu + tools +
+    bench.py) with ALL findings serialized — suppressed ones included —
+    so the byte-comparison covers the suppression/justification channel,
+    not just the live-findings one.
+    """
+    import json
+    import shutil
+
+    from tools.analysis import analyze, to_sarif
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cache_dir = tempfile.mkdtemp(prefix="chaos_lint_cache_")
+    paths = [os.path.join(root, "mxnet_tpu"),
+             os.path.join(root, "tools"),
+             os.path.join(root, "bench.py")]
+    try:
+        t0 = time.perf_counter()
+        cold = analyze(paths, root=root, use_cache=True,
+                       cache_dir=cache_dir)
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm = analyze(paths, root=root, use_cache=True,
+                       cache_dir=cache_dir)
+        warm_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    cold_json = json.dumps([f.to_dict() for f in cold], sort_keys=True)
+    warm_json = json.dumps([f.to_dict() for f in warm], sort_keys=True)
+    speedup = cold_s / warm_s if warm_s > 0 else float("inf")
+    print(f"[chaos_check] lint: cold={cold_s:.2f}s warm={warm_s:.2f}s "
+          f"speedup={speedup:.1f}x findings={len(cold)} "
+          f"(live={sum(1 for f in cold if not f.suppressed)})")
+    fails = []
+    if cold_json != warm_json:
+        fails.append("cached re-run changed the findings (byte mismatch)")
+    if to_sarif(cold) != to_sarif(warm):
+        fails.append("cached re-run changed the SARIF serialization")
+    if speedup < 5.0:
+        fails.append(f"cached re-run only {speedup:.1f}x faster (< 5x): "
+                     f"the cache is not actually short-circuiting")
+    if cold_s > 30.0:
+        fails.append(f"cold full-tree run took {cold_s:.1f}s (> 30s "
+                     f"budget)")
+    if warm_s > 5.0:
+        fails.append(f"warm run took {warm_s:.1f}s (> 5s budget)")
+    if fails:
+        for f in fails:
+            print(f"[chaos_check] FAIL: {f}")
+        return 1
+    print(f"[chaos_check] PASS: warm run {speedup:.1f}x faster, "
+          f"byte-identical findings")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--mode", choices=("train", "serve"), default="train",
-                    help="train: kill-and-resume; serve: inject-and-drain")
+    ap.add_argument("--mode", choices=("train", "serve", "lint"),
+                    default="train",
+                    help="train: kill-and-resume; serve: inject-and-"
+                         "drain; lint: incremental analyzer contract")
     ap.add_argument("--steps", type=int, default=8,
                     help="total training steps in the reference run")
     ap.add_argument("--every", type=int, default=2,
@@ -149,6 +216,8 @@ def main(argv=None):
     ap.add_argument("--requests", type=int, default=25,
                     help="serve mode: requests per client thread")
     args = ap.parse_args(argv)
+    if args.mode == "lint":
+        return lint_mode(args)
     if args.mode == "serve":
         return serve_mode(args)
     crash_after = (args.crash_after if args.crash_after is not None
