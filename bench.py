@@ -35,6 +35,27 @@ _METRIC_NAMES = {"resnet": "resnet50_train_throughput",
                  "ssd": "ssd512_train_throughput"}
 
 
+def _cost_fields(step):
+    """costguard report fields for a bench's JSON line: the static
+    accounting (tools/costguard; PERF.md methodology) rides next to the
+    measured throughput in every BENCH artifact.  cost_analysis() is an
+    AOT recompile of the already-run step — cached per signature, warm
+    via the persistent compile cache — but the tunnel can wedge it, so
+    this is best-effort: a bench never fails for want of its cost
+    column.  MXTPU_BENCH_COSTS=0 disables."""
+    if os.environ.get("MXTPU_BENCH_COSTS", "1").lower() in ("0", "false"):
+        return {}
+    try:
+        costs = step.cost_analysis()
+        return {
+            "flops_T": round(costs.get("flops", 0.0) / 1e12, 3),
+            "bytes_GB": round(costs.get("bytes accessed", 0.0) / 1e9, 2),
+            "n_executables": int(step._jit._cache_size()),
+        }
+    except Exception:       # noqa: BLE001 — wedged backend mid-AOT
+        return {}
+
+
 def _setup():
     import jax
 
@@ -132,6 +153,7 @@ def bench_resnet():
         "value": round(img_s, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+        **_cost_fields(step),
     }))
 
 
@@ -193,6 +215,7 @@ def bench_bert():
         "value": round(tok_s, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
+        **_cost_fields(step),
     }))
 
 
@@ -244,6 +267,7 @@ def bench_lstm():
         "value": round(tok_s, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_LSTM_TOK_S, 4),
+        **_cost_fields(step),
     }))
 
 
@@ -309,6 +333,7 @@ def bench_ssd():
         "value": round(img_s, 2),
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_SSD_IMG_S, 4),
+        **_cost_fields(step),
     }))
 
 

@@ -13,12 +13,16 @@ A/B (VERDICT r4 task #2: fused target <= 38 GB/step from 44.2).
 Prints one JSON line: {"fused": bool, "flops_T": .., "bytes_GB": ..,
 "batch": N}.  Needs a live backend (compilation happens server-side);
 runs on CPU too but CPU byte counts are not comparable to TPU's.
+
+Since ISSUE 6 this is a thin CLI over `tools/costguard`: the step is
+built by the same `resnet50_train_step` the committed budget golden
+uses, and the numbers come from `TrainStep.cost_analysis()`'s
+lower-only path — no step executes, so a wedged-but-compiling tunnel
+can still account traffic.
 """
 import json
 import os
 import sys
-
-import numpy as np
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
@@ -28,28 +32,12 @@ def main():
 
     jax.config.update("jax_default_matmul_precision", "bfloat16")
 
-    import mxnet_tpu as mx
-    from mxnet_tpu import gluon, parallel
-    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from tools.costguard.entrypoints import resnet50_train_step
 
     fused = bool(int(os.environ.get("MXTPU_BENCH_FUSED") or "0"))
     batch = int(os.environ.get("MXTPU_COST_BATCH") or "256")
-    net = resnet50_v1(layout="NHWC", fused=fused)
-    net.initialize()
-    net.cast("bfloat16")
-    mesh = parallel.make_mesh(dp=len(jax.devices()))
-    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
-                              wd=1e-4)
-    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
-                              opt, mesh=mesh)
-
-    rng = np.random.RandomState(0)
-    x = mx.nd.array(rng.randn(batch, 224, 224, 3)
-                    .astype(np.float32)).astype("bfloat16")
-    y = mx.nd.array(rng.randint(0, 1000, (batch,)).astype(np.int32))
-    step(x, y).asnumpy()  # build + compile the fused train program
-
-    costs = step.cost_analysis()
+    step, x, y = resnet50_train_step(batch=batch, fused=fused)
+    costs = step.cost_analysis(x, y)   # AOT: lower+compile, zero steps
     print(json.dumps({
         "fused": fused,
         "batch": batch,

@@ -322,8 +322,13 @@ class TrainStep:
         with _pscope("TrainStep.step", cat="step"):
             return self._step(data, label)
 
-    def _step(self, data, label):
-        _fire("step")
+    def _prepare(self, data, label):
+        """Everything a step needs short of touching the device: coerce
+        the batch args, run the deferred-init build on first use, and
+        (re)compile the jit program when the signature changed.  Shared
+        by ``_step`` (which then places the batch and executes) and the
+        AOT costing path (``lower``/``cost_analysis``, which never
+        executes).  Returns the flattened (data_leaves, label_leaves)."""
         data, label = _coerce_arrays(data), _coerce_arrays(label)
         data_args = data if isinstance(data, (tuple, list)) else (data,)
         data_args = tuple(data_args)
@@ -341,7 +346,13 @@ class TrainStep:
             self._sig = sig
             self._last_avals = None  # refresh lazily on the next step
             self._cost_cache = None
+            self._compiled_cache = None
             self._fresh_jit = True
+        return data_leaves, label_leaves
+
+    def _step(self, data, label):
+        _fire("step")
+        data_leaves, label_leaves = self._prepare(data, label)
         key = _random.next_key()
         lr = jnp.float32(self._base_lr())
         dat_sh = NamedSharding(self.mesh, self._data_pspec)
@@ -401,20 +412,91 @@ class TrainStep:
         return NDArray(loss)
 
     # ------------------------------------------------------------- costing --
-    def cost_analysis(self):
+    def _synth_avals(self, data_leaves, label_leaves):
+        """Abstract argument shapes for AOT lowering, built WITHOUT
+        running a step: params/states exist after ``_build``; batch
+        leaves are canonicalized the way ``device_put`` would (x64 off:
+        int64→int32, float64→float32); the PRNG-key aval comes from a
+        constant key so the costing path never consumes RNG state (a
+        budget audit must not perturb a seeded training run)."""
+        key_aval = jax.ShapeDtypeStruct((), jax.random.key(0).dtype)
+        lr_aval = jax.ShapeDtypeStruct((), jnp.float32)
+
+        def leaf_aval(l):
+            return jax.ShapeDtypeStruct(
+                l.shape, jax.dtypes.canonicalize_dtype(l.dtype))
+
+        args = (self._train_arrays, self._aux_arrays, self._states,
+                self._t, key_aval, lr_aval,
+                *[leaf_aval(l) for l in data_leaves],
+                *[leaf_aval(l) for l in label_leaves])
+        return jax.tree.map(
+            lambda a: a if isinstance(a, jax.ShapeDtypeStruct)
+            else jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+
+    def lower(self, data=None, label=None):
+        """AOT-lower the compiled step program WITHOUT executing a step.
+
+        After a step has run, no arguments are needed (the live
+        signature is reused).  Before any step, pass one sample
+        ``(data, label)`` batch — host numpy zeros are enough; only
+        shapes/dtypes matter — and the program is built and lowered from
+        abstract values: nothing is placed on the device and no update
+        runs (tools/costguard's budget audits drive this path in tier-1
+        under ``JAX_PLATFORMS=cpu``)."""
+        if data is not None:
+            dl, ll = self._prepare(data, label)
+            if getattr(self, "_last_avals", None) is None:
+                self._last_avals = self._synth_avals(dl, ll)
+        if self._jit is None or getattr(self, "_last_avals", None) is None:
+            raise RuntimeError(
+                "lower() needs one completed step, or a sample (data, "
+                "label) batch to lower against")
+        return self._jit.lower(*self._last_avals)
+
+    def compiled(self, data=None, label=None):
+        """The AOT-compiled step executable (cached per jit signature:
+        the lower+compile is a second full XLA compile, not worth
+        repeating through a flaky tunnel).  Accepts the same optional
+        sample batch as ``lower`` — a sample with a NEW signature
+        recompiles rather than serving the previous program's cache."""
+        if data is not None:
+            # _prepare resets _compiled_cache/_cost_cache on a signature
+            # change, so the cache check below is always against the
+            # sample's own program, never a stale one
+            dl, ll = self._prepare(data, label)
+            if getattr(self, "_last_avals", None) is None:
+                self._last_avals = self._synth_avals(dl, ll)
+        if getattr(self, "_compiled_cache", None) is None:
+            self._compiled_cache = self.lower().compile()
+        return self._compiled_cache
+
+    def _require_program(self, what, data):
+        if data is None and (self._jit is None
+                             or getattr(self, "_last_avals", None) is None):
+            raise RuntimeError(
+                f"{what} needs one completed step or a sample "
+                f"(data, label) batch")
+
+    def cost_analysis(self, data=None, label=None):
         """XLA's cost model of the compiled step program: {'flops': ...,
         'bytes accessed': ...} — the profiler substitute that works through
-        the axon tunnel (PERF.md methodology; device traces do not).  Run
-        at least one step first so the program and arg shapes exist.
-        Cached per jit signature: the AOT lower+compile is a second full
-        XLA compile, not worth repeating through a flaky tunnel."""
-        if getattr(self, "_last_avals", None) is None or self._jit is None:
-            raise RuntimeError("cost_analysis() needs one completed step")
+        the axon tunnel (PERF.md methodology; device traces do not).
+        Works after one completed step, or — the lower-only path — from a
+        sample ``(data, label)`` batch without ever executing."""
+        self._require_program("cost_analysis()", data)
+        compiled = self.compiled(data, label)
         if getattr(self, "_cost_cache", None) is None:
-            costs = (self._jit.lower(*self._last_avals).compile()
-                     .cost_analysis())
+            costs = compiled.cost_analysis()
             self._cost_cache = costs[0] if isinstance(costs, list) else costs
         return self._cost_cache
+
+    def memory_analysis(self, data=None, label=None):
+        """XLA's compiled-buffer accounting (argument/output/temp/alias
+        bytes) of the step program — ``cost_analysis``'s memory-side
+        sibling, same lower-only contract."""
+        self._require_program("memory_analysis()", data)
+        return self.compiled(data, label).memory_analysis()
 
     # ---------------------------------------------------------------- sync --
     def sync_params_to_net(self):

@@ -1221,6 +1221,143 @@ def test_lock_order_inversion_multi_item_with(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# compile-boundary family (ISSUE 6: the costguard surface)
+# ---------------------------------------------------------------------------
+
+def test_jit_in_loop_bad(tmp_path):
+    fs = lint(tmp_path, """
+        import functools
+        import jax
+
+        def retrace_everything(fns, xs, step):
+            outs = []
+            for f in fns:
+                g = jax.jit(f)              # fresh wrapper every pass
+                outs.append(g(xs))
+            while xs:
+                h = functools.partial(jax.jit, static_argnums=(1,))(step)
+                xs = h(xs, 1)
+            wrappers = [jax.jit(f) for f in fns]
+            for x in xs:
+                step.lower(x).compile()     # AOT compile per iteration
+            return outs, wrappers
+        """)
+    assert len(fired(fs, "jit-in-loop")) == 4, \
+        [f.message for f in fired(fs, "jit-in-loop")]
+
+
+def test_jit_in_loop_per_request_path(tmp_path):
+    # the serving failure mode: a handler that builds the jit per call —
+    # the executable cache hangs off the wrapper, so every request pays
+    # a full XLA compile
+    fs = lint(tmp_path, """
+        import jax
+
+        def handle(model, request):
+            return jax.jit(model)(request)
+        """)
+    msgs = fired(fs, "jit-in-loop")
+    assert len(msgs) == 1 and "EVERY call" in msgs[0].message
+
+
+def test_jit_in_loop_clean(tmp_path):
+    # module-scope construction (INCLUDING loops/comprehensions there —
+    # import runs once, and a bounded wrapper registry is this rule's
+    # own fix advice), cache-guarded per-signature slots (the executor
+    # pattern), *calling* a jitted fn in a loop, and the
+    # str.lower()/re.compile lookalikes must all stay silent
+    fs = lint(tmp_path, """
+        import re
+        import jax
+
+        jitted = jax.jit(lambda x: x * 2)
+        KERNELS = {name: jax.jit(fn)            # bind-once registry:
+                   for name, fn in [("a", abs)]}  # once per import
+        for _extra in (min, max):
+            KERNELS[_extra.__name__] = jax.jit(_extra)
+
+        class Executor:
+            def __init__(self):
+                self._jit_cache = {}
+
+            def run(self, key, fn, x):
+                if key not in self._jit_cache:
+                    self._jit_cache[key] = jax.jit(fn)
+                return self._jit_cache[key](x)
+
+        def warmup(server, samples):
+            for s in samples:
+                jitted(s)                   # executing, not constructing
+            for fn in samples:
+                if fn.lower().endswith(".jpg"):
+                    continue
+            else:
+                g = jax.jit(len)            # else: runs ONCE, after the loop
+            pats = [re.compile(p) for p in ("a", "b")]
+            return pats, g
+        """)
+    assert not fired(fs, "jit-in-loop"), \
+        [f.message for f in fired(fs, "jit-in-loop")]
+
+
+def test_jit_in_loop_suppression(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def census(apply, avals):
+            outs = []
+            for a in avals:
+                # mxlint: disable=jit-in-loop -- bounded bucket-grid
+                # enumeration; compiles are memoized downstream
+                outs.append(apply.lower(a).compile())
+            return outs
+        """)
+    assert not fired(fs, "jit-in-loop")
+    assert len(suppressed(fs, "jit-in-loop")) == 1
+
+
+def test_unbudgeted_entrypoint_bad(tmp_path):
+    fs = lint(tmp_path, """
+        from tools.costguard import entrypoint
+
+        @entrypoint("my_new_model_train")
+        def build_my_new_model_train():
+            pass
+        """)
+    msgs = fired(fs, "unbudgeted-entrypoint")
+    assert len(msgs) == 1
+    assert "my_new_model_train.json" in msgs[0].message
+
+
+def test_unbudgeted_entrypoint_clean_with_golden(tmp_path):
+    gdir = tmp_path / "tests" / "goldens" / "budgets"
+    gdir.mkdir(parents=True)
+    (gdir / "my_new_model_train.json").write_text("{}")
+    fs = lint(tmp_path, """
+        from tools.costguard import entrypoint
+
+        @entrypoint("my_new_model_train")
+        def build_my_new_model_train():
+            pass
+        """)
+    assert not fired(fs, "unbudgeted-entrypoint")
+
+
+def test_unbudgeted_entrypoint_suppression(tmp_path):
+    fs = lint(tmp_path, """
+        from tools.costguard import entrypoint
+
+        # mxlint: disable=unbudgeted-entrypoint -- golden lands in the
+        # follow-up PR that wires this model's serving path
+        @entrypoint("my_new_model_train")
+        def build_my_new_model_train():
+            pass
+        """)
+    assert not fired(fs, "unbudgeted-entrypoint")
+    assert len(suppressed(fs, "unbudgeted-entrypoint")) == 1
+
+
+# ---------------------------------------------------------------------------
 # registry + docs consistency
 # ---------------------------------------------------------------------------
 

@@ -274,3 +274,51 @@ def test_trainstep_cost_analysis():
     costs = step.cost_analysis()
     assert costs.get("flops", 0) > 0
     assert costs.get("bytes accessed", 0) > 0
+
+
+def test_trainstep_cost_analysis_lower_only():
+    """The ISSUE 6 budget path: cost_analysis/memory_analysis from a
+    sample batch, WITHOUT ever executing a step — and the audit must not
+    perturb training state (params, update counter, RNG stream)."""
+    mx.random.seed(11)
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    step = parallel.TrainStep(net, gluon.loss.L2Loss(),
+                              mx.optimizer.create("sgd", learning_rate=0.1),
+                              mesh=parallel.make_mesh(dp=-1))
+    x = np.zeros((8, 8), np.float32)
+    y = np.zeros((8, 4), np.float32)
+    key_before = mx.random.current_key_source().key
+    costs = step.cost_analysis(x, y)        # no step has run
+    assert costs.get("flops", 0) > 0
+    assert costs.get("bytes accessed", 0) > 0
+    memstats = step.memory_analysis(x, y)
+    assert memstats.argument_size_in_bytes > 0
+    # the audit consumed no RNG and advanced no update counter
+    assert memstats is not None
+    assert step._num_update == step.optimizer.begin_num_update
+    assert mx.random.current_key_source().key is key_before
+    # the program it costed is the one a real step then reuses: stepping
+    # afterwards must not recompile (same signature -> same executable)
+    step(mx.nd.array(np.random.randn(8, 8).astype(np.float32)),
+         mx.nd.array(np.random.randn(8, 4).astype(np.float32))).asnumpy()
+    assert step._jit._cache_size() == 1
+    # and the cached AOT costing survives the step (no second compile)
+    assert step.cost_analysis() is costs
+
+
+def test_trainstep_cost_analysis_tracks_signature_changes():
+    """A sample batch with a NEW signature must re-lower and re-cost —
+    never serve the previous signature's cached numbers."""
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    step = parallel.TrainStep(net, gluon.loss.L2Loss(),
+                              mx.optimizer.create("sgd", learning_rate=0.1),
+                              mesh=parallel.make_mesh(dp=-1))
+    small = step.cost_analysis(np.zeros((8, 8), np.float32),
+                               np.zeros((8, 4), np.float32))
+    big = step.cost_analysis(np.zeros((16, 8), np.float32),
+                             np.zeros((16, 4), np.float32))
+    assert big["bytes accessed"] > small["bytes accessed"]
+    mem = step.memory_analysis()       # follows the current signature
+    assert mem.argument_size_in_bytes > 0

@@ -234,6 +234,39 @@ def test_three_bucket_load_compiles_at_most_three_executables():
         assert apply.jitted._cache_size() <= 3
         assert len(srv.distinct_shapes) <= 3
         assert srv.stats["completed"] == 60
+        # the static executable census bounds the same number from the
+        # BucketSpec alone — no traffic needed to know the ceiling
+        from tools.costguard import executable_census
+        assert executable_census(srv.buckets) == 3
+        assert len(set(apply.traces)) <= executable_census(srv.buckets)
+    finally:
+        srv.drain()
+
+
+def test_executable_census_equals_runtime_jit_count():
+    """ISSUE 6 acceptance: the STATIC census over the bucket grid equals
+    the RUNTIME jit-compile count once warmup + full-grid traffic has
+    touched every signature — 'traffic can never trigger a recompile'
+    as an equality, not a comment, read through the same trace-counter
+    the ISSUE 4 load test trusts."""
+    from tools.costguard import executable_census, grid_signatures
+
+    apply = make_apply()
+    spec = BucketSpec(batch=(1, 2), length=(4, 8))
+    census = executable_census(spec)
+    assert census == 4 == len(grid_signatures(spec))
+    srv = InferenceServer(apply, buckets=spec, max_delay=0.001,
+                          sample=np.zeros((3, 2), np.float32))
+    srv.start()      # warmup compiles the whole grid
+    try:
+        assert len(set(apply.traces)) == census
+        assert apply.jitted._cache_size() == census
+        # drive real traffic across every length bucket: count must not
+        # move — the census is the ceiling AND the warmup floor
+        for n in (1, 3, 4, 5, 8):
+            srv(np.zeros((n, 2), np.float32))
+        assert len(set(apply.traces)) == census
+        assert apply.jitted._cache_size() == census
     finally:
         srv.drain()
 

@@ -1,0 +1,189 @@
+"""Compile-boundary rules: the jit-construction discipline costguard's
+budgets depend on.
+
+A budget golden pins how many executables a surface compiles
+(``tools/costguard``) — but only if compilation happens where the
+census can see it: at module scope, in a cached/bucketed slot, or in an
+explicit warmup.  Two shapes silently break that:
+
+``jit-in-loop``            ``jax.jit(...)`` (or the AOT
+                           ``.lower(...).compile(...)`` chain)
+                           constructed inside a loop, or the
+                           per-request form ``jax.jit(fn)(x)`` inside a
+                           function body.  The executable cache hangs
+                           off the *wrapper object*, so every fresh
+                           wrapper is a fresh trace+compile — tens of
+                           seconds of availability loss per request on
+                           a big model, the exact failure mode the
+                           serving bucket grid exists to kill.
+``unbudgeted-entrypoint``  a ``costguard.entrypoint("name")``
+                           registration with no committed budget golden
+                           under ``tests/goldens/budgets/`` — a surface
+                           declared budgetable but never actually
+                           budgeted regresses invisibly.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .core import Finding, ProjectRule, Rule, dotted_name, last_component
+from .dataflow import iter_scope_nodes
+
+
+def _jit_aliases(tree: ast.Module) -> Set[str]:
+    """Local names that ARE ``jax.jit`` (``from jax import jit [as j]``)."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for a in node.names:
+                if a.name == "jit":
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _is_jit_ctor(call: ast.Call, aliases: Set[str]) -> bool:
+    f = call.func
+    if dotted_name(f) == "jax.jit":
+        return True
+    if isinstance(f, ast.Name) and f.id in aliases:
+        return True
+    # functools.partial(jax.jit, static_argnums=...)
+    if last_component(f) == "partial" and call.args \
+            and dotted_name(call.args[0]) == "jax.jit":
+        return True
+    return False
+
+
+def _is_aot_chain(call: ast.Call) -> bool:
+    """``<expr>.lower(...).compile(...)`` — the AOT idiom.  Anchored on
+    the ``.compile`` whose receiver is a ``.lower(...)`` call, so
+    ``re.compile`` and ``str.lower`` alone never match."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "compile"
+            and isinstance(f.value, ast.Call)
+            and isinstance(f.value.func, ast.Attribute)
+            and f.value.func.attr == "lower")
+
+
+def _is_aot_lower(call: ast.Call) -> bool:
+    """A ``.lower(avals...)`` call WITH arguments: ``str.lower()`` never
+    takes any, jax's AOT ``Wrapped.lower(*args)`` always does."""
+    f = call.func
+    return (isinstance(f, ast.Attribute) and f.attr == "lower"
+            and bool(call.args or call.keywords))
+
+
+class JitInLoopRule(Rule):
+    id = "jit-in-loop"
+    default_severity = "error"
+    description = ("jax.jit / lower().compile() constructed inside a loop "
+                   "or per-request path (fresh XLA compile every pass)")
+
+    # ------------------------------------------------------------------
+    def check_module(self, mod) -> Iterable[Finding]:
+        """Only FUNCTION scopes are checked: module-scope loops and
+        comprehensions execute once per import, so building a bounded
+        registry of wrappers there (`{n: jax.jit(f) for ...}`) is the
+        bind-once pattern this rule's fix advice prescribes, not a
+        recompile hazard."""
+        aliases = _jit_aliases(mod.tree)
+        fns = [n for n in ast.walk(mod.tree)
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for fn in fns:
+            for node in iter_scope_nodes(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                    yield from self._check_loop(mod, node, aliases)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.DictComp, ast.GeneratorExp)):
+                    yield from self._check_comp(mod, node, aliases)
+                elif isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Call) \
+                        and _is_jit_ctor(node.func, aliases):
+                    yield self.finding(
+                        mod, node,
+                        "jax.jit(fn)(...) inside a function compiles "
+                        "fresh on EVERY call — the executable cache "
+                        "hangs off the wrapper object; bind the jitted "
+                        "callable once (module scope, or a cached "
+                        "attribute like executor's _jit_cache) and call "
+                        "that")
+
+    # ------------------------------------------------------------------
+    def _flag_ctors(self, mod, roots, aliases, where):
+        for root in roots:
+            for node in iter_scope_nodes(root):
+                if not isinstance(node, ast.Call):
+                    continue
+                if _is_jit_ctor(node, aliases):
+                    yield self.finding(
+                        mod, node,
+                        f"jax.jit constructed inside {where} — every "
+                        f"pass pays a fresh trace+compile (the cache is "
+                        f"per-wrapper); hoist the construction out, or "
+                        f"key a bounded cache the way the serving "
+                        f"bucket grid does")
+                elif _is_aot_chain(node) or _is_aot_lower(node):
+                    yield self.finding(
+                        mod, node,
+                        f"AOT lower/compile inside {where} — compile "
+                        f"once outside and reuse the executable (budget "
+                        f"audits go through tools/costguard's report "
+                        f"cache for exactly this reason)")
+
+    def _check_loop(self, mod, loop, aliases):
+        # body only: an `else:` clause runs at most once per loop
+        # statement, not per iteration — constructing there is fine
+        roots = list(loop.body)
+        if isinstance(loop, ast.While):
+            roots.append(loop.test)      # re-evaluated every iteration
+        yield from self._flag_ctors(mod, roots, aliases, "a loop")
+
+    def _check_comp(self, mod, comp, aliases):
+        roots = []
+        if isinstance(comp, ast.DictComp):
+            roots += [comp.key, comp.value]
+        else:
+            roots.append(comp.elt)
+        for gen in comp.generators:
+            roots.extend(gen.ifs)
+        yield from self._flag_ctors(mod, roots, aliases,
+                                    "a comprehension")
+
+
+class UnbudgetedEntrypointRule(ProjectRule):
+    id = "unbudgeted-entrypoint"
+    default_severity = "error"
+    description = ("costguard entry-point registration with no committed "
+                   "budget golden in tests/goldens/budgets/")
+
+    def facts(self, mod):
+        regs = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call) \
+                    and last_component(node.func) in ("entrypoint",
+                                                      "register_entrypoint") \
+                    and node.args \
+                    and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                regs.append([node.args[0].value, node.lineno])
+        return regs or None
+
+    def check_facts(self, facts, root, analyzed):
+        budgets_dir = root / "tests" / "goldens" / "budgets"
+        committed = {p.stem for p in budgets_dir.glob("*.json")} \
+            if budgets_dir.is_dir() else set()
+        for relpath, regs in facts:
+            if relpath not in analyzed:
+                continue
+            for name, line in regs or ():
+                if name in committed:
+                    continue
+                yield Finding(
+                    rule=self.id, path=relpath, line=line, col=1,
+                    message=f"entry point '{name}' is registered for "
+                            f"budgeting but tests/goldens/budgets/"
+                            f"{name}.json does not exist — commit a "
+                            f"golden (python tests/goldens/budgets/"
+                            f"regen_budgets.py {name}) or drop the "
+                            f"registration")

@@ -55,7 +55,7 @@ SEVERITIES = ("error", "warning", "info")
 
 #: bump when ANY rule's logic changes: it keys the incremental cache,
 #: and a stale record must never survive an analyzer upgrade
-ENGINE_VERSION = "2.0"
+ENGINE_VERSION = "2.1"
 
 # id of the meta-rule emitted for malformed disable comments; it cannot
 # itself be suppressed (suppressing the suppression-checker is turtles).
@@ -212,6 +212,7 @@ def default_rules() -> List[Rule]:
                               MutableGlobalRule, UnhashableStaticRule)
     from .thread_rules import UnlockedAttrRule
     from .donation_rules import DonatedReuseRule
+    from .compile_rules import JitInLoopRule, UnbudgetedEntrypointRule
     from .concurrency_rules import (BlockingUnderLockRule, LockOrderRule,
                                     SignalHandlerRule)
     from .lifecycle_rules import ResourceLeakRule
@@ -221,9 +222,9 @@ def default_rules() -> List[Rule]:
     return [HostSyncRule(), TracedBranchRule(), MutableGlobalRule(),
             UnhashableStaticRule(), UnlockedAttrRule(), DonatedReuseRule(),
             BlockingUnderLockRule(), LockOrderRule(), SignalHandlerRule(),
-            ResourceLeakRule(),
+            ResourceLeakRule(), JitInLoopRule(),
             DuplicateRegistrationRule(), MissingGradientRule(),
-            StaleDocSymbolRule()]
+            StaleDocSymbolRule(), UnbudgetedEntrypointRule()]
 
 
 def _collect_files(paths) -> List[Path]:

@@ -1,6 +1,7 @@
 #!/usr/bin/env python
 """Chaos smoke: kill-and-resume (train), inject-and-drain (serve),
-and the incremental-analyzer contract (lint).
+the incremental-analyzer contract (lint), and the budget-audit
+contract (cost).
 
 ``--mode train`` (default) runs a small training loop with periodic
 checkpoints, injects a crash mid-run via ``fault.inject``, rediscovers
@@ -21,6 +22,14 @@ acceptance contract of ISSUE 4::
 cache directory and asserts the second (fully cached) run is >= 5x
 faster AND byte-identical in findings — the incremental-mode contract
 of ISSUE 5 (a cache that changes answers is worse than no cache).
+
+``--mode cost`` runs the full costguard budget audit (every committed
+golden in tests/goldens/budgets/) twice against a fresh report cache:
+the cold run compiles every entry point, the warm run must hit the
+HLO-hash report cache (lowering still runs — that is what keys the
+cache), come back byte-identical in verdicts, pass the budget check
+both times, and land inside the wall-clock budgets — the ISSUE 6
+analogue of the lint contract.
 
 Exit code 0 on success, 1 on any mismatch.  Forces ``JAX_PLATFORMS=cpu``
 (and an 8-device virtual mesh) so it runs anywhere, TPU or not (lint
@@ -199,12 +208,72 @@ def lint_mode(args):
     return 0
 
 
+def cost_mode(args):
+    """Cold-vs-warm budget audit over every committed budget (ISSUE 6).
+
+    The costguard report cache is keyed by a hash of the LOWERED HLO
+    text, so the warm run still builds and lowers every entry point
+    (that work is what proves the cache key matches the code) but must
+    skip every XLA compile.  A cache that changes a verdict — or that
+    does not actually shortcut the compiles — fails here.
+    """
+    import shutil
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    from tools import costguard
+
+    cache_dir = tempfile.mkdtemp(prefix="chaos_cost_cache_")
+    try:
+        t0 = time.perf_counter()
+        cold = costguard.run_check(root=root, use_cache=True,
+                                   cache_dir=cache_dir)
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm = costguard.run_check(root=root, use_cache=True,
+                                   cache_dir=cache_dir)
+        warm_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    speedup = cold_s / warm_s if warm_s > 0 else float("inf")
+    n = len(cold.entries)
+    print(f"[chaos_check] cost: cold={cold_s:.2f}s warm={warm_s:.2f}s "
+          f"speedup={speedup:.1f}x entries={n} "
+          f"executables={sum(e.report['n_executables'] for e in cold.entries)}")
+    fails = []
+    if not cold.ok:
+        fails.append("cold budget audit FAILED:\n" + cold.render())
+    if not warm.ok:
+        fails.append("warm budget audit FAILED:\n" + warm.render())
+    if cold.to_json() != warm.to_json():
+        fails.append("cached re-run changed the audit verdicts "
+                     "(byte mismatch)")
+    if speedup < 1.5:
+        fails.append(f"cached re-run only {speedup:.1f}x faster (< 1.5x): "
+                     f"the report cache is not skipping compiles "
+                     f"(lower/build still run warm — by design — so the "
+                     f"bar is lower than lint's)")
+    if cold_s > 150.0:
+        fails.append(f"cold full audit took {cold_s:.1f}s (> 150s budget)")
+    if warm_s > 75.0:
+        fails.append(f"warm audit took {warm_s:.1f}s (> 75s budget)")
+    if fails:
+        for f in fails:
+            print(f"[chaos_check] FAIL: {f}")
+        return 1
+    print(f"[chaos_check] PASS: warm audit {speedup:.1f}x faster, "
+          f"byte-identical verdicts, all {n} budgets green")
+    return 0
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--mode", choices=("train", "serve", "lint"),
+    ap.add_argument("--mode", choices=("train", "serve", "lint", "cost"),
                     default="train",
                     help="train: kill-and-resume; serve: inject-and-"
-                         "drain; lint: incremental analyzer contract")
+                         "drain; lint: incremental analyzer contract; "
+                         "cost: cold-vs-warm budget audit")
     ap.add_argument("--steps", type=int, default=8,
                     help="total training steps in the reference run")
     ap.add_argument("--every", type=int, default=2,
@@ -218,6 +287,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.mode == "lint":
         return lint_mode(args)
+    if args.mode == "cost":
+        return cost_mode(args)
     if args.mode == "serve":
         return serve_mode(args)
     crash_after = (args.crash_after if args.crash_after is not None

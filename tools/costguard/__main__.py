@@ -1,0 +1,116 @@
+"""costguard CLI: ``python -m tools.costguard [target ...]``.
+
+Exit code 0 = every selected entry point within budget (and no stale
+goldens), 1 = regression / missing budget / census mismatch, 2 = usage.
+
+Targets are entry-point names, or paths — a path selects every
+registered entry point whose builder is defined under it, so the
+documented gate invocation ``python -m tools.costguard mxnet_tpu/``
+(the builders live in ``tools/costguard``, which models the mxnet_tpu
+zoo — path targets also match the models' own package) audits the whole
+registered surface.  No target = everything.
+
+Environment: forces ``JAX_PLATFORMS=cpu`` with an 8-device virtual mesh
+unless the caller already chose a platform — budgets are recorded
+against exactly this bring-up (same as tests/conftest.py), and goldens
+only *gate* in a matching backend/device-count environment.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+
+def _env_bringup():
+    """Same pre-jax-import bring-up as tests/conftest.py — must run
+    before anything imports jax."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if os.environ["JAX_PLATFORMS"] == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.costguard",
+        description="compiled-program cost budgets + recompile audit "
+                    "(docs/analysis.md \"Cost budgets\")")
+    parser.add_argument("targets", nargs="*", default=[],
+                        help="entry-point names and/or paths (a path "
+                             "selects the entries defined under it); "
+                             "default: every registered entry point")
+    parser.add_argument("--format", choices=("human", "json"),
+                        default="human", dest="fmt")
+    parser.add_argument("--list", action="store_true",
+                        help="list registered entry points and exit")
+    parser.add_argument("--root", default=None,
+                        help="repo root for goldens/cache (default: cwd)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the .costguard_cache/ report cache "
+                             "(always recompile)")
+    parser.add_argument("--cache-dir", default=None,
+                        help="cache directory (default: "
+                             "<root>/.costguard_cache)")
+    args = parser.parse_args(argv)
+
+    _env_bringup()
+    from . import entrypoints, run_check
+
+    if args.list:
+        for name in entrypoints.names():
+            doc = (entrypoints._REGISTRY[name].__doc__ or "").strip()
+            print(f"{name:24s} {doc.splitlines()[0] if doc else ''}")
+        return 0
+
+    root = Path(args.root).resolve() if args.root else Path.cwd()
+    selected = []
+    for t in args.targets:
+        if t in entrypoints.names():
+            selected.append(t)
+            continue
+        p = Path(t)
+        if p.exists():
+            rp = p.resolve()
+            hits = [n for n in entrypoints.names()
+                    if _selects_entry(n, rp, root)]
+            selected.extend(h for h in hits if h not in selected)
+            if not hits:
+                print(f"# note: no registered entry point under {t}",
+                      file=sys.stderr)
+            continue
+        parser.error(f"{t!r} is neither a registered entry point nor a "
+                     f"path (see --list)")
+    if args.targets and not selected:
+        # nothing to build, but the reverse check (orphaned goldens) is
+        # selection-independent and still part of the exit-0 contract
+        print("costguard: no registered entry points under the given "
+              "targets — auditing goldens only", file=sys.stderr)
+    result = run_check(entries=selected if args.targets else None,
+                       root=root, use_cache=not args.no_cache,
+                       cache_dir=args.cache_dir)
+    if args.fmt == "json":
+        print(result.to_json())
+    else:
+        print(result.render())
+    return 0 if result.ok else 1
+
+
+def _selects_entry(name: str, path: Path, root: Path) -> bool:
+    """Does a path target cover entry ``name``?  Either the entry's own
+    builder file is under the path, or the path contains the mxnet_tpu
+    package — ``python -m tools.costguard mxnet_tpu/`` must audit the
+    zoo entries even though the builder FILES live in tools/ (every
+    registered entry budgets that package's models)."""
+    from .entrypoints import source_of
+    if source_of(name).is_relative_to(path):
+        return True
+    pkg = (root / "mxnet_tpu").resolve()
+    return pkg == path or pkg.is_relative_to(path)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

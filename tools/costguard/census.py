@@ -1,0 +1,74 @@
+"""Static executable census: how many XLA programs a configuration can
+EVER compile.
+
+The serving stack's central availability invariant — "traffic can never
+trigger a recompile" (PR 4's signature pinning + warmup) — is only
+checkable if the jit-signature space is enumerable *statically*.  It
+is: a ``serving.BucketSpec`` admits exactly ``len(batch) × len(length)``
+padded signatures; a ``TrainStep``/``EvalStep`` pins one signature per
+(data, label) tree; ``module_apply`` traces once per padded signature,
+i.e. its server's grid.  This module does that enumeration, and the
+budget gate asserts ``census == n_executables`` in every committed
+golden — turning the comment into a checked invariant
+(``tests/test_serving.py`` additionally asserts census == the runtime
+jit-cache count under real bucket-grid traffic).
+"""
+from __future__ import annotations
+
+__all__ = ["grid_signatures", "executable_census"]
+
+
+def grid_signatures(spec):
+    """The full padded (batch_bucket, length_bucket) signature grid of a
+    ``serving.BucketSpec`` — ``length`` is ``None`` when the spec does
+    no length bucketing.  Every request an ``InferenceServer`` built on
+    ``spec`` can ever dispatch lands on exactly one of these."""
+    lengths = spec.length if spec.length is not None else (None,)
+    return [(b, L) for b in spec.batch for L in lengths]
+
+
+def _is_bucket_spec(c) -> bool:
+    try:
+        from mxnet_tpu.serving.batcher import BucketSpec
+    except ImportError:
+        return False
+    return isinstance(c, BucketSpec)
+
+
+def _is_step(c) -> bool:
+    try:
+        from mxnet_tpu.parallel.step import EvalStep, TrainStep
+    except ImportError:
+        return False
+    return isinstance(c, (TrainStep, EvalStep))
+
+
+def executable_census(*components) -> int:
+    """Count the distinct XLA executables a set of components can
+    compile:
+
+    - ``serving.BucketSpec`` → its full signature grid (also the census
+      of a ``module_apply``-backed server built on that spec);
+    - ``TrainStep`` / ``EvalStep`` → 1 (one pinned signature; feeding a
+      second signature is a *re*compile these budgets exist to catch);
+    - ``int`` → that many known-extra signatures (e.g. a warmup probe
+      shape outside the grid).
+    """
+    n = 0
+    for c in components:
+        if isinstance(c, bool):
+            raise TypeError("executable_census: bool is not a count")
+        if isinstance(c, int):
+            if c < 0:
+                raise ValueError("executable_census: negative count")
+            n += c
+        elif _is_bucket_spec(c):
+            n += len(grid_signatures(c))
+        elif _is_step(c):
+            n += 1
+        else:
+            raise TypeError(
+                f"executable_census: cannot enumerate signatures of "
+                f"{type(c).__name__!r} (expected BucketSpec, TrainStep, "
+                f"EvalStep, or int)")
+    return n

@@ -1,0 +1,202 @@
+"""Budgeted entry points: the named model/step/serving configurations
+whose compiled-program costs are committed as goldens.
+
+Each entry point is a builder that LOWERS its program(s) without ever
+executing a step (``TrainStep.lower(sample)`` / ``jax.jit(f).lower``),
+so budgets compute under ``JAX_PLATFORMS=cpu`` in tier-1.  Registration
+is the budget *contract*: mxlint's ``unbudgeted-entrypoint`` rule fails
+the gate when a registered name has no golden under
+``tests/goldens/budgets/``, and the costguard CLI fails on goldens whose
+registration disappeared — the two directions of "every audited surface
+stays audited".
+
+CPU-vs-TPU caveat (PERF.md): byte counts from the CPU backend are not
+comparable to TPU's.  Goldens record their backend + device count and
+are only *gated* in a matching environment; a TPU run of the same entry
+points is an audit, not a gate.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+from pathlib import Path
+from typing import Callable, Dict, List
+
+from .census import executable_census, grid_signatures
+from .report import Program
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+@dataclasses.dataclass
+class EntryBuild:
+    """What a builder returns: the lowered program units, the static
+    executable census, and the metadata the golden records."""
+    name: str
+    meta: dict
+    programs: List[Program]
+    census: int
+
+
+def entrypoint(name: str):
+    """Register a budgeted entry point (decorator).  The literal name is
+    what mxlint's ``unbudgeted-entrypoint`` facts extract — keep it a
+    string literal, and matching ``tests/goldens/budgets/<name>.json``."""
+    def deco(fn):
+        if name in _REGISTRY:
+            raise ValueError(f"entrypoint {name!r} registered twice")
+        _REGISTRY[name] = fn
+        fn.entrypoint_name = name
+        return fn
+    return deco
+
+
+def names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def build(name: str, **overrides) -> EntryBuild:
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown entry point {name!r} "
+                       f"(registered: {names()})")
+    return _REGISTRY[name](**overrides)
+
+
+def source_of(name: str) -> Path:
+    """The file defining an entry point's builder — what lets the CLI
+    map a path argument (``python -m tools.costguard mxnet_tpu/``) onto
+    the entry points whose models live under it."""
+    fn = _REGISTRY[name]
+    return Path(inspect.getsourcefile(fn)).resolve()
+
+
+def _mesh_and_opt(opt_name="sgd", **opt_kw):
+    import jax  # noqa: F401 — imported for side-effectful backend init
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel
+    mesh = parallel.make_mesh(dp=-1)
+    return mesh, mx.optimizer.create(opt_name, **opt_kw)
+
+
+def resnet50_train_step(batch=8, fused=False, layout="NHWC"):
+    """The headline ResNet-50 train step, AOT only — shared by the
+    ``resnet50_nhwc_train`` budget entry and ``benchmark/hlo_costs.py``
+    (which parameterizes batch/fused for the fused-conv A/B).  Returns
+    ``(step, x, y)`` with the sample batch as HOST arrays: nothing is
+    placed or executed until the caller decides."""
+    import ml_dtypes
+    import numpy as np
+
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    net = resnet50_v1(layout=layout, fused=fused)
+    net.initialize()
+    net.cast("bfloat16")
+    mesh, opt = _mesh_and_opt("sgd", learning_rate=0.1, momentum=0.9,
+                              wd=1e-4)
+    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              opt, mesh=mesh)
+    x = np.zeros((batch, 224, 224, 3), ml_dtypes.bfloat16)
+    y = np.zeros((batch,), np.int32)
+    return step, x, y
+
+
+def _train_step_build(name, step, x, y, meta) -> EntryBuild:
+    import jax
+
+    lowered = step.lower(x, y)
+    n_args = len(jax.tree.leaves(step._last_avals))
+    meta = dict(meta, backend_note=(
+        "CPU-backend byte counts are NOT comparable to TPU's (PERF.md); "
+        "this golden gates the compile boundary, not on-chip traffic"))
+    return EntryBuild(name=name, meta=meta, census=executable_census(step),
+                      programs=[Program(name, lowered, n_args)])
+
+
+@entrypoint("resnet50_nhwc_train")
+def build_resnet50_nhwc_train(batch=8):
+    """ResNet-50 v1 NHWC bf16 train step (fwd+bwd+SGD momentum, one XLA
+    program on the dp mesh) — the PERF.md headline workload."""
+    step, x, y = resnet50_train_step(batch=batch)
+    return _train_step_build(
+        "resnet50_nhwc_train", step, x, y,
+        {"model": "resnet50_v1", "layout": "NHWC", "dtype": "bfloat16",
+         "batch": batch, "optimizer": "sgd(momentum=0.9, wd=1e-4)"})
+
+
+@entrypoint("mnist_mlp_train")
+def build_mnist_mlp_train(batch=64, dtype="float32"):
+    """The examples/train_mnist_mlp.py recipe: 784-128-10 MLP train
+    step, f32, SGD momentum."""
+    import ml_dtypes
+    import numpy as np
+
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu", in_units=784),
+            nn.Dense(10, in_units=128))
+    net.initialize()
+    if dtype != "float32":
+        net.cast(dtype)
+    mesh, opt = _mesh_and_opt("sgd", learning_rate=0.1, momentum=0.9)
+    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              opt, mesh=mesh)
+    np_dtype = ml_dtypes.bfloat16 if dtype == "bfloat16" else np.float32
+    x = np.zeros((batch, 784), np_dtype)
+    y = np.zeros((batch,), np.int32)
+    return _train_step_build(
+        "mnist_mlp_train", step, x, y,
+        {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
+         "optimizer": "sgd(momentum=0.9)"})
+
+
+@entrypoint("serving_mlp_grid")
+def build_serving_mlp_grid(batch_buckets=(1, 2, 4), length_buckets=(8, 16),
+                           features=32, dtype="float32"):
+    """A serving bucket grid: one jitted MLP apply lowered at EVERY
+    padded (batch, length) signature a ``BucketSpec((1,2,4), (8,16))``
+    admits — the whole executable space an ``InferenceServer`` on this
+    spec can ever compile.  n_executables in the golden == the static
+    census == the runtime jit-cache count (tests/test_serving.py).
+    NB the dtype knob exists for on-TPU audits (bf16 serving, ROADMAP
+    item 2), but the committed golden is f32: on the CPU backend bf16
+    compute is EMULATED via converts and *costs* bytes rather than
+    saving them — the PERF.md caveat, visible in the numbers."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.serving import BucketSpec
+
+    spec = BucketSpec(batch=batch_buckets, length=length_buckets)
+    hidden, out = 64, 16
+    dt = jnp.dtype(dtype)
+    w1 = jnp.zeros((features, hidden), dt)
+    b1 = jnp.zeros((hidden,), dt)
+    w2 = jnp.zeros((hidden, out), dt)
+    b2 = jnp.zeros((out,), dt)
+
+    @jax.jit
+    def apply(x):                      # (batch, length, features)
+        h = jnp.tanh(x @ w1 + b1)
+        return h @ w2 + b2
+
+    programs = []
+    for b, L in grid_signatures(spec):
+        aval = jax.ShapeDtypeStruct((b, L, features), dt)
+        # mxlint: disable=jit-in-loop -- this loop IS the census: one
+        # lower per bucket signature, bounded by the static grid, and
+        # the expensive compile is memoized by the report cache
+        lowered = apply.lower(aval)
+        programs.append(Program(f"serving_mlp_grid/b{b}_l{L}",
+                                lowered, n_args=1))
+    return EntryBuild(
+        name="serving_mlp_grid",
+        meta={"model": f"mlp {features}-{hidden}-{out} apply",
+              "dtype": dtype,
+              "batch_buckets": list(spec.batch),
+              "length_buckets": list(spec.length)},
+        programs=programs, census=executable_census(spec))

@@ -1,0 +1,212 @@
+"""Normalized cost reports of compiled XLA programs.
+
+The only trustworthy performance instrument in this environment is
+static analysis of the compiled program (PERF.md: the axon tunnel
+memoizes executions and breaks profiler traces; ``lower().compile()``
+then ``cost_analysis()`` is the methodology behind the 51.4 → 44.2 GB
+traffic fix).  This module turns one compiled executable into a
+*normalized report* — FLOPs, bytes accessed, compiled-buffer memory,
+entry-computation instruction counts by category, donation coverage —
+and merges per-executable reports into one per-entry-point record that
+``budget.py`` diffs against committed goldens.
+
+Nothing here ever executes a step: the inputs are AOT ``Lowered`` /
+``Compiled`` objects (``TrainStep.lower()`` or ``jax.jit(f).lower``),
+so the whole pipeline runs under ``JAX_PLATFORMS=cpu`` in tier-1.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional
+
+#: bump when the report schema or extraction logic changes — it keys the
+#: report cache AND is recorded in budget goldens, so a stale cached
+#: report (or a golden from an older schema) can never pass silently
+REPORT_VERSION = "1.0"
+
+# entry-computation instruction line:  ``%name = SHAPE opcode(...)``.
+# SHAPE is either a bare token (f32[8,16]{1,0}) or a tuple type — which
+# contains spaces but no nested parens in optimized entry HLO.
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
+    r"(?:\([^()]*\)|\S+)\s+"
+    r"([a-z][a-z0-9\-]*)\(")
+
+# one input/output alias entry on the HloModule header line:
+# ``{0}: (5, {}, may-alias)`` — the parameter number is group 1
+_ALIAS_RE = re.compile(r"\((\d+), \{\}, (?:may|must)-alias\)")
+
+#: opcode → category.  Anything unlisted is "other"; the categories are
+#: the traffic-relevant families from PERF.md's entry-computation
+#: accounting table (convs, fusions, copies, collectives, ...).
+_CATEGORY = {
+    "convolution": "convolution",
+    "dot": "dot",
+    "fusion": "fusion",
+    "custom-call": "custom-call",
+    "all-reduce": "collective", "all-reduce-start": "collective",
+    "all-reduce-done": "collective", "all-gather": "collective",
+    "all-gather-start": "collective", "all-gather-done": "collective",
+    "reduce-scatter": "collective", "all-to-all": "collective",
+    "collective-permute": "collective",
+    "collective-broadcast": "collective",
+    "copy": "copy", "copy-start": "copy", "copy-done": "copy",
+    "reduce": "reduce", "reduce-window": "reduce",
+}
+CATEGORIES = ("convolution", "dot", "fusion", "custom-call", "collective",
+              "copy", "reduce", "other")
+
+
+@dataclasses.dataclass
+class Program:
+    """One AOT-lowered program unit of an entry point (a TrainStep has
+    one; a serving bucket grid has one per padded signature)."""
+    name: str
+    lowered: object          # jax ``Lowered``
+    n_args: int              # flattened input leaf count (donation denom.)
+    meta: Optional[dict] = None
+
+
+def _entry_lines(hlo_text: str):
+    """Lines of the ENTRY computation only — fusion subcomputations
+    repeat every fused elementwise op and would drown the categories
+    that matter (PERF.md counts the entry computation)."""
+    inside = False
+    for line in hlo_text.splitlines():
+        if line.startswith("ENTRY "):
+            inside = True
+            continue
+        if inside:
+            if line.startswith("}"):
+                return
+            yield line
+
+
+def instruction_counts(hlo_text: str) -> Dict[str, int]:
+    counts = {c: 0 for c in CATEGORIES}
+    total = 0
+    for line in _entry_lines(hlo_text):
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        total += 1
+        counts[_CATEGORY.get(m.group(1), "other")] += 1
+    counts["total"] = total
+    return counts
+
+
+def donation_counts(hlo_text: str, n_args: int) -> Dict[str, int]:
+    """Donated-parameter coverage from the ``input_output_alias`` header
+    attribute: which inputs XLA actually reuses as outputs.  This is the
+    *post-compile truth* — a donate_argnums entry the compiler could not
+    use does not count."""
+    donated = set()
+    for line in hlo_text.splitlines():
+        if line.startswith("HloModule"):
+            donated.update(int(p) for p in _ALIAS_RE.findall(line))
+            break
+    return {"donated_args": len(donated), "total_args": int(n_args)}
+
+
+def unit_report(compiled, n_args: int) -> dict:
+    """Normalized report of ONE compiled executable."""
+    costs = compiled.cost_analysis()
+    if isinstance(costs, list):
+        costs = costs[0] if costs else {}
+    text = compiled.as_text()
+    mem = {}
+    try:
+        ma = compiled.memory_analysis()
+        peak = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+                + ma.temp_size_in_bytes - ma.alias_size_in_bytes)
+        mem = {"argument_bytes": int(ma.argument_size_in_bytes),
+               "output_bytes": int(ma.output_size_in_bytes),
+               "temp_bytes": int(ma.temp_size_in_bytes),
+               "alias_bytes": int(ma.alias_size_in_bytes),
+               "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+               "peak_bytes": int(peak)}
+    except Exception:   # noqa: BLE001 — some backends can't account memory
+        mem = {}        # absent, not fabricated: the diff skips it
+    return {
+        "n_executables": 1,
+        "flops": float(costs.get("flops", 0.0)),
+        "bytes_accessed": float(costs.get("bytes accessed", 0.0)),
+        "transcendentals": float(costs.get("transcendentals", 0.0)),
+        "memory": mem,
+        "donation": donation_counts(text, n_args),
+        "instructions": instruction_counts(text),
+    }
+
+
+def merge_reports(units: List[dict]) -> dict:
+    """One entry-point report from its per-executable unit reports.
+
+    Additive metrics (flops, bytes, instruction counts, donation
+    counts, executable count) sum — the grid's total traffic budget.
+    Memory is the **max** over units: executables run one at a time, so
+    the budgetable figure is the worst single program, not a fictitious
+    sum."""
+    if not units:
+        raise ValueError("merge_reports: no unit reports")
+    out = {
+        "n_executables": sum(u["n_executables"] for u in units),
+        "flops": sum(u["flops"] for u in units),
+        "bytes_accessed": sum(u["bytes_accessed"] for u in units),
+        "transcendentals": sum(u["transcendentals"] for u in units),
+        "memory": {},
+        "donation": {
+            "donated_args": sum(u["donation"]["donated_args"]
+                                for u in units),
+            "total_args": sum(u["donation"]["total_args"] for u in units),
+        },
+        "instructions": {
+            k: sum(u["instructions"].get(k, 0) for u in units)
+            for k in CATEGORIES + ("total",)
+        },
+    }
+    mems = [u["memory"] for u in units if u["memory"]]
+    if mems:
+        out["memory"] = {k: max(m.get(k, 0) for m in mems)
+                         for k in mems[0]}
+    return out
+
+
+def report_for_programs(programs: List[Program], root=None,
+                        use_cache: bool = False, cache_dir=None) -> dict:
+    """Compile each program unit (or hit the report cache) and merge.
+
+    The cache key is a hash of the **lowered HLO text** — any change to
+    the model, the step plumbing, or jax itself changes the text, so a
+    cached report can never go stale against the code (the same
+    soundness argument as mxlint's content-hash cache, one level up the
+    stack: lowering is cheap and always runs; only the expensive
+    XLA compile + extraction is memoized).  ``.costguard_cache/`` under
+    ``root``; writes are atomic and best-effort."""
+    import jax
+
+    cache = None
+    if use_cache and root is not None:
+        from pathlib import Path
+
+        from tools.analysis.cache import FileCache
+        sig = (f"costguard-{REPORT_VERSION}-jax{jax.__version__}-"
+               f"{jax.default_backend()}-{jax.device_count()}d")
+        cache = FileCache(Path(root),
+                          cache_dir or Path(root) / ".costguard_cache",
+                          signature=sig)
+    units = []
+    for prog in programs:
+        text = prog.lowered.as_text()
+        key = rec = None
+        if cache is not None:
+            key = cache.key(prog.name, text.encode("utf-8"))
+            rec = cache.get(prog.name, key)
+        if rec is not None:
+            units.append(rec["report"])
+            continue
+        u = unit_report(prog.lowered.compile(), prog.n_args)
+        units.append(u)
+        if cache is not None:
+            cache.put(prog.name, key, {"relpath": prog.name, "report": u})
+    return merge_reports(units)
