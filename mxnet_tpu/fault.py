@@ -42,6 +42,14 @@ point                        location
                              the apply fn touches the device
 ``serving.drain``            InferenceServer.drain entry (before admission
                              stops)
+``fleet.route``              ServingFleet.submit entry (before any routing
+                             decision)
+``fleet.dispatch``           ServingFleet dispatch, before handing a request
+                             to the chosen replica
+``fleet.swap``               WeightUpdater, before a replica's param
+                             hot-swap sequence begins
+``fleet.probe``              fleet quarantine/update probe, before the probe
+                             request is submitted
 ===========================  ==============================================
 
 This module imports only the standard library (it is pulled in by
@@ -200,20 +208,35 @@ for _p, _w in (
     ("serving.batch", "DynamicBatcher dispatch, before padding a group"),
     ("serving.step", "InferenceServer batch/probe apply, before the device"),
     ("serving.drain", "InferenceServer.drain entry"),
+    ("fleet.route", "ServingFleet.submit entry, before routing"),
+    ("fleet.dispatch", "ServingFleet dispatch, before the chosen replica"),
+    ("fleet.swap", "WeightUpdater, before a replica's param hot-swap"),
+    ("fleet.probe", "fleet quarantine/update probe, before submitting"),
 ):
     register_point(_p, _w)
 del _p, _w
 
 
 # ------------------------------------------------------------------ retry --
-def backoff_delay(attempt, base_delay=0.5, max_delay=8.0, jitter=0.5):
+def backoff_delay(attempt, base_delay=0.5, max_delay=8.0, jitter=0.5,
+                  attempt_cap=32):
     """Backoff before retry ``attempt`` (1-based): ``base_delay *
     2**(attempt-1)`` capped at ``max_delay``, stretched by up to
     ``jitter`` fraction of itself.  The one exponential-backoff policy in
     the stack — ``retry_call`` consumes it as a blocking loop, the serving
-    circuit breaker as a state-machine probe schedule (a serving thread
-    must never sleep out a backoff)."""
-    delay = min(float(max_delay), float(base_delay) * 2 ** (int(attempt) - 1))
+    circuit breaker as a state-machine probe schedule, and the fleet
+    router as the quarantine re-probe schedule (a serving thread must
+    never sleep out a backoff).
+
+    ``attempt_cap`` clamps the EXPONENT, not the delay: open-ended
+    retry loops (a replica quarantined for hours keeps incrementing its
+    probe attempt) would otherwise push ``2**(attempt-1)`` past float
+    range and raise ``OverflowError`` on the very code path that exists
+    to survive failure.  Any attempt past the cap behaves exactly like
+    the cap (the delay saturated at ``max_delay`` long before); results
+    for attempts <= 32 are unchanged from the uncapped form."""
+    attempt = min(int(attempt), int(attempt_cap))
+    delay = min(float(max_delay), float(base_delay) * 2 ** (attempt - 1))
     return delay * (1.0 + float(jitter) * _random.random())
 
 

@@ -21,16 +21,17 @@ from .prefetch import DevicePrefetcher
 from .step import (EvalStep, TrainStep, add_transfer_hook,
                    remove_transfer_hook)
 from .checkpoint import (CheckpointManager, CheckpointMismatchError,
-                         list_checkpoints,
+                         list_checkpoints, load_snapshot_params,
                          load_train_step, load_train_step_sharded,
                          resume_latest,
-                         save_train_step, save_train_step_sharded)
+                         save_train_step, save_train_step_sharded,
+                         wait_for_new)
 
 __all__ = [
     "load_train_step", "save_train_step",
     "load_train_step_sharded", "save_train_step_sharded",
     "CheckpointManager", "CheckpointMismatchError", "list_checkpoints",
-    "resume_latest",
+    "resume_latest", "wait_for_new", "load_snapshot_params",
     "AXES", "MeshScope", "current_mesh", "default_mesh", "make_mesh",
     "named_sharding", "replicated",
     "ShardingRules", "batch_spec", "fsdp_rules", "param_sharding",

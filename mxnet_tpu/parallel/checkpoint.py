@@ -24,6 +24,7 @@ import json
 import logging
 import os
 import re as _re
+import time as _time
 
 import numpy as np
 import jax
@@ -33,7 +34,8 @@ from ..fault import fire as _fire
 __all__ = ["save_train_step", "load_train_step",
            "save_train_step_sharded", "load_train_step_sharded",
            "CheckpointManager", "CheckpointMismatchError",
-           "resume_latest", "list_checkpoints"]
+           "resume_latest", "list_checkpoints", "wait_for_new",
+           "load_snapshot_params"]
 
 _MANIFEST = "__manifest__"
 _logger = logging.getLogger(__name__)
@@ -408,6 +410,47 @@ def list_checkpoints(directory, prefix="ckpt"):
     return sorted(out)
 
 
+def wait_for_new(directory, last_seen=None, timeout=None, prefix="ckpt",
+                 poll=0.1):
+    """Block until ``directory`` holds a checkpoint NEWER than
+    ``last_seen`` (a ``num_update``; ``None`` accepts any); returns the
+    newest ``(num_update, path)`` pair, or ``None`` on timeout.
+
+    This is the serving side of the training→serving snapshot stream: a
+    ``WeightUpdater`` parks here between rolling updates.  Pure polling
+    over the committed-name namespace (``list_checkpoints``), so it only
+    ever sees atomically-committed snapshots — a mid-write ``.tmp`` is
+    invisible by construction, and the returned path is complete the
+    moment it is returned."""
+    t_end = None if timeout is None else _time.monotonic() + float(timeout)
+    while True:
+        cks = list_checkpoints(directory, prefix)
+        if cks:
+            num_update, path = cks[-1]
+            if last_seen is None or num_update > last_seen:
+                return num_update, path
+        if t_end is not None:
+            remaining = t_end - _time.monotonic()
+            if remaining <= 0:
+                return None
+            _time.sleep(min(float(poll), remaining))
+        else:
+            _time.sleep(float(poll))
+
+
+def load_snapshot_params(fname):
+    """Read ONLY the trainable params out of a v1 snapshot, without a
+    TrainStep: ``(params, names)`` where ``params`` is a list of host
+    arrays in saved (``p.<k>``) order and ``names`` the matching
+    manifest names.  This is the weight-update reader — a serving
+    process streams training snapshots into its replicas without ever
+    constructing the training step they came from."""
+    z = np.load(fname)
+    manifest = json.loads(bytes(z[_MANIFEST]).decode())
+    names = list(manifest["train_names"])
+    return [z[f"p.{k}"] for k in range(len(names))], names
+
+
 def resume_latest(step, directory, prefix="ckpt"):
     """Restore the newest loadable checkpoint in ``directory`` into a
     BUILT TrainStep; returns its ``num_update``, or None when the
@@ -416,23 +459,45 @@ def resume_latest(step, directory, prefix="ckpt"):
     A checkpoint that cannot be READ (truncated zip, corrupt json,
     truncated inner array — e.g. the process died while an external copy
     was happening) is skipped with a warning and the next-older one is
-    tried: preemption recovery must not be wedged by one bad file.  A
-    checkpoint that reads fine but does not MATCH the model raises
-    ``CheckpointMismatchError`` — that is a user error, and silently
-    resuming an older file would hide it."""
+    tried: preemption recovery must not be wedged by one bad file.  The
+    same damage-vs-user-error split applies to VALIDATION failures: a
+    committed file (the marker name exists) whose payload fails the
+    model match is only a user error when the mismatch is *systematic* —
+    if an older snapshot of the same run restores cleanly, the
+    mismatching file was damaged in place (partial overwrite, botched
+    external restore) and is skipped as damage, not reported as user
+    error.  Only when NO candidate matches does the newest file's
+    ``CheckpointMismatchError`` raise — a genuinely wrong model must
+    never silently resume."""
     if not step._built:
         raise ValueError("build the TrainStep (run one step) before "
                          "resume_latest")
+    mismatch = None
+    skipped = []
     for num_update, path in reversed(list_checkpoints(directory, prefix)):
         try:
             load_train_step(step, path)
-            return num_update
-        except CheckpointMismatchError:
-            raise
+        except CheckpointMismatchError as exc:
+            # deferred verdict: user error only if every candidate agrees
+            if mismatch is None:
+                mismatch = exc
+            skipped.append((path, exc))
+            continue
         except Exception as exc:   # truncated/corrupt in ANY layer (zip,
             # manifest json, inner .npy header): damage, not user error
             _logger.warning("resume_latest: skipping unreadable checkpoint "
                             "%s (%s)", path, exc)
+            continue
+        for bad_path, exc in skipped:    # an older file restored: the
+            # newer mismatches were per-file damage after all
+            _logger.warning(
+                "resume_latest: skipped damaged checkpoint %s — its "
+                "payload fails validation (%s) but %s restores cleanly, "
+                "so this is file damage, not a model mismatch",
+                bad_path, exc, path)
+        return num_update
+    if mismatch is not None:
+        raise mismatch
     return None
 
 
@@ -489,6 +554,12 @@ class CheckpointManager:
     def resume_latest(self):
         """``resume_latest(step, directory)`` with this manager's step."""
         return resume_latest(self.step, self.directory, self.prefix)
+
+    def wait_for_new(self, last_seen=None, timeout=None, poll=0.1):
+        """``wait_for_new`` against this manager's directory/prefix —
+        the polling hook a ``serving.WeightUpdater`` watches."""
+        return wait_for_new(self.directory, last_seen=last_seen,
+                            timeout=timeout, prefix=self.prefix, poll=poll)
 
     def _retain(self):
         if jax.process_index() != 0:

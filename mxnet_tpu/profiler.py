@@ -25,7 +25,8 @@ import time
 
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "reset", "Task", "Frame", "Event", "Counter",
-           "Marker", "scope", "counter_value", "counters"]
+           "Marker", "scope", "counter_value", "counters",
+           "counters_clear"]
 
 _lock = threading.Lock()
 
@@ -277,6 +278,22 @@ def counters(prefix=None):
         items = list(_COUNTERS.items())
     return {n: c._value for n, c in items
             if prefix is None or n.startswith(prefix)}
+
+
+def counters_clear(prefix=None):
+    """Drop Counter registrations (all, or names starting with
+    ``prefix``) from the ``counter_value``/``counters`` namespace.
+
+    A serving fleet creates one counter series per replica under its
+    own name prefix; a restarted fleet (or a test building several)
+    reuses those names, and without this the snapshot would keep
+    reporting the dead instance's values until the new one's first
+    write.  Live ``Counter`` objects are unaffected — only the
+    name→instance registry forgets them."""
+    with _lock:
+        for name in [n for n in _COUNTERS
+                     if prefix is None or n.startswith(prefix)]:
+            del _COUNTERS[name]
 
 
 class Counter:

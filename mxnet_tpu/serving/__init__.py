@@ -1,10 +1,14 @@
-"""mx.serving — fault-hardened inference serving runtime (ISSUE 4).
+"""mx.serving — fault-hardened inference serving runtime (ISSUE 4 + 7).
 
 The inference-side sibling of ``mx.fault``'s training runtime: admission
 control with load shedding, deadline-aware shape-bucketed dynamic
 batching (bounded jit cache — recompiles are the TPU availability
 killer), a circuit breaker with exponential half-open probing, health
-predicates, and SIGTERM graceful drain.  See ``docs/api.md`` "Serving".
+predicates, and SIGTERM graceful drain.  One tier up,
+``serving.ServingFleet`` replicates the server N ways behind a
+health-aware router with replica failover and zero-downtime rolling
+weight updates (``serving.WeightUpdater``).  See ``docs/api.md``
+"Serving" and "Serving fleet".
 
     from mxnet_tpu import serving
 
@@ -12,6 +16,11 @@ predicates, and SIGTERM graceful drain.  See ``docs/api.md`` "Serving".
                                   sample=example).start()
     out = srv(example, deadline=0.1)          # submit + blocking result
     srv.drain()                               # or serve_forever() + SIGTERM
+
+    fleet = serving.ServingFleet.replicated(fn, params, 3,
+                                            sample=example).start()
+    serving.WeightUpdater(fleet, ckpt_manager).start()   # live weights
+    fleet.serve_forever()
 """
 from .admission import (RejectedError, CircuitOpenError, ServerClosedError,
                         DeadlineExceededError, NonFiniteOutputError,
@@ -19,8 +28,14 @@ from .admission import (RejectedError, CircuitOpenError, ServerClosedError,
 from .batcher import BucketSpec, DynamicBatcher
 from .breaker import CircuitBreaker
 from .server import InferenceServer, module_apply
+from .fleet import (ServingFleet, HotSwapApply, WeightUpdater,
+                    SnapshotRejectedError, UpdateRolledBackError,
+                    validate_params)
 
 __all__ = ["InferenceServer", "module_apply", "BucketSpec",
            "DynamicBatcher", "CircuitBreaker", "TokenBucket", "Request",
            "RejectedError", "CircuitOpenError", "ServerClosedError",
-           "DeadlineExceededError", "NonFiniteOutputError"]
+           "DeadlineExceededError", "NonFiniteOutputError",
+           "ServingFleet", "HotSwapApply", "WeightUpdater",
+           "SnapshotRejectedError", "UpdateRolledBackError",
+           "validate_params"]

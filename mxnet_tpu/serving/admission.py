@@ -93,10 +93,15 @@ class Request:
     visible.  ``deadline`` is seconds from submission; an expired request
     is failed with ``DeadlineExceededError`` *in queue*, without touching
     the device.
+
+    ``add_done_callback`` is the non-blocking observation channel a
+    router needs: the fleet layer re-dispatches failed-over requests from
+    the resolving thread's callback instead of parking a watcher thread
+    per request in ``result()``.
     """
 
     __slots__ = ("data", "submitted_at", "deadline", "_event", "_result",
-                 "_error")
+                 "_error", "_callbacks", "_cb_lock")
 
     def __init__(self, data, deadline=None):
         self.data = data
@@ -106,6 +111,8 @@ class Request:
         self._event = threading.Event()
         self._result = None
         self._error = None
+        self._callbacks = []
+        self._cb_lock = threading.Lock()
 
     def expired(self, now=None):
         return self.deadline is not None and \
@@ -114,11 +121,38 @@ class Request:
     # ---- resolution (batch-thread side) ----
     def set_result(self, value):
         self._result = value
-        self._event.set()
+        self._finish()
 
     def set_error(self, exc):
         self._error = exc
-        self._event.set()
+        self._finish()
+
+    def _finish(self):
+        # the lock closes the add-after-resolve race: a callback is
+        # either in the list this drain snapshots, or added after the
+        # event is visibly set (and invoked by the adder) — exactly once
+        # either way.  Callbacks run OUTSIDE the lock (they are arbitrary
+        # router code).
+        with self._cb_lock:
+            self._event.set()
+            cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            try:
+                cb(self)
+            except Exception:    # noqa: BLE001 — a raising callback must
+                pass             # not strand the REST of a resolving batch
+
+    def add_done_callback(self, fn):
+        """Call ``fn(request)`` once the request is resolved — on the
+        resolving thread, or immediately on this one when it already is.
+        Callbacks must not block (the batch thread is the caller);
+        exceptions they raise are swallowed — resolution must never fail
+        halfway through a batch."""
+        with self._cb_lock:
+            if not self._event.is_set():
+                self._callbacks.append(fn)
+                return
+        fn(self)
 
     # ---- future protocol (client side) ----
     def done(self):

@@ -297,6 +297,20 @@ class DynamicBatcher:
         except Exception as exc:      # noqa: BLE001 — resolves, then state
             for r in group:
                 self._resolve_error(r, exc)
+        except BaseException:
+            # the batch THREAD is dying (SystemExit & co. — a killed
+            # replica).  The loop's finally sweeps the queue, but this
+            # group already left it: resolve it here or its clients hang
+            # forever — the one way to drop an accepted request.  A
+            # ServerClosedError is retry-safe, which is exactly right:
+            # the batch never completed, so a fleet router may re-dispatch
+            # it to a live replica.
+            err = ServerClosedError(
+                "batch thread died mid-batch — this request was not "
+                "served")
+            for r in group:
+                self._resolve_error(r, err)
+            raise
         for r in group:
             # a runner that forgot a request is a bug, but the client
             # must still get an answer — and an honest one: the batch DID

@@ -1,0 +1,989 @@
+"""ServingFleet: N ``InferenceServer`` replicas behind one front door.
+
+PR 4 made a single server fault-hardened; this module makes the SERVICE
+fault-hardened (ISSUE 7).  One process is one blast radius — a wedged
+batch loop, a tripped breaker, or a weight reload takes the whole
+endpoint down — so production TPU serving is a fleet of replicas behind
+a health-aware router (the deployment shape of the Gemma-on-TPU serving
+comparison and of TensorFlow Serving's worker fleets, PAPERS.md):
+
+- **health-aware routing** — ``submit()`` ranks replicas by their
+  ``healthz()`` snapshot (ready, breaker state, queue depth) plus the
+  fleet's own in-flight accounting, picks the least-loaded one, and
+  enforces a per-replica in-flight cap.  Replicas whose breaker is OPEN
+  or whose batch thread died are *quarantined*: no traffic until a probe
+  succeeds, re-probed on the ``fault.backoff_delay`` schedule.
+- **failover** — a request a replica ACCEPTED but then failed
+  (batch-thread death, breaker trip, post-acceptance shed) is
+  re-dispatched to a healthy replica within its original deadline.
+  Inference is idempotent, so re-dispatch is safe; admission-level
+  refusals (``RejectedError`` out of ``submit``) are never retried —
+  shedding is the client's verdict.  Killing a replica under traffic
+  drops zero accepted requests.
+- **rolling weight updates** — ``WeightUpdater`` watches a
+  ``CheckpointManager`` directory (``parallel.checkpoint.wait_for_new``)
+  and streams each new snapshot through the fleet one replica at a
+  time: quarantine → drain in-flight → hot-swap the param buffers
+  (same shapes/dtypes ⇒ the SAME executables — a weight update is a
+  pointer swap, never a recompile) → warmup probe → readmit, with
+  automatic rollback to the previous weights when the post-swap probe
+  fails.  A poisoned snapshot never serves a single client request.
+- **fleet lifecycle** — ``drain()`` resolves every accepted request
+  fleet-wide then drains replicas concurrently; ``serve_forever()``
+  latches SIGTERM via ``fault.GracefulExit``.
+
+Every fleet failure mode is deterministically injectable through the
+``fleet.route`` / ``fleet.dispatch`` / ``fleet.swap`` / ``fleet.probe``
+fault points.  See ``docs/api.md`` "Serving fleet".
+"""
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import threading
+import time
+
+import numpy as np
+
+from .. import fault as _fault
+from .. import profiler as _profiler
+from .admission import (DeadlineExceededError, NonFiniteOutputError,
+                        RejectedError, Request, ServerClosedError)
+from .batcher import BucketSpec
+from .breaker import OPEN
+from .server import InferenceServer
+
+__all__ = ["ServingFleet", "HotSwapApply", "WeightUpdater",
+           "SnapshotRejectedError", "UpdateRolledBackError",
+           "validate_params"]
+
+_logger = logging.getLogger(__name__)
+
+
+class SnapshotRejectedError(RuntimeError):
+    """A weight snapshot failed validation (leaf count / shape / dtype
+    drift against the served params, or non-finite values) and was NOT
+    applied to any replica.  The caller skips the snapshot — the fleet
+    keeps serving the previous weights at full capacity."""
+
+
+class UpdateRolledBackError(RuntimeError):
+    """A rolling update aborted: a post-swap probe failed, the affected
+    replica was rolled back to its previous weights, and any replicas
+    already updated were rolled back too — the fleet is back on the old
+    weights at full capacity.  The snapshot is poisoned in a way
+    validation could not see (finite params, non-finite outputs)."""
+
+
+class HotSwapApply:
+    """A serving apply fn with a hot-swappable parameter slot.
+
+    Wraps ``fn(params, *batch_leaves)`` — typically ONE ``jax.jit``
+    shared by every replica of a fleet — plus this replica's own
+    ``params`` pytree.  ``swap()`` replaces the whole pytree in a single
+    attribute store (atomic under the GIL; the update protocol drains
+    the replica first anyway) and returns the previous pytree for
+    rollback.  As long as the new leaves match the old leaf-for-leaf in
+    shape and dtype, the jitted fn keeps hitting the SAME executables:
+    a weight update is a pointer swap, never a recompile.
+    """
+
+    def __init__(self, fn, params):
+        self._fn = fn
+        self.params = params
+
+    def __call__(self, *leaves):
+        return self._fn(self.params, *leaves)
+
+    def swap(self, new_params):
+        """Install ``new_params``; returns the previous pytree."""
+        old, self.params = self.params, new_params
+        return old
+
+
+def _param_items(params):
+    """``(key, leaf)`` pairs of a params container — dict keys (sorted),
+    or positional indices for sequences.  The comparison space of
+    ``validate_params``."""
+    if isinstance(params, dict):
+        return [(k, params[k]) for k in sorted(params)]
+    return list(enumerate(params))
+
+
+def validate_params(new, current):
+    """Gate a snapshot BEFORE any replica touches it: same container
+    kind and keys as the served params, same shape and dtype
+    leaf-for-leaf (anything else would change the executable signature —
+    the recompile the whole serving stack exists to prevent), and every
+    new value finite (NaN/Inf weights poison every output they touch).
+    Raises ``SnapshotRejectedError``; on success returns ``new``
+    unchanged — the container shape the apply fn indexes by survives."""
+    if isinstance(new, dict) != isinstance(current, dict):
+        raise SnapshotRejectedError(
+            f"snapshot params are a {type(new).__name__}, the fleet "
+            f"serves a {type(current).__name__} — the apply fn's "
+            f"indexing would break")
+    new_items, cur_items = _param_items(new), _param_items(current)
+    if len(new_items) != len(cur_items):
+        raise SnapshotRejectedError(
+            f"snapshot has {len(new_items)} param leaves, the fleet "
+            f"serves {len(cur_items)} — structure drift would recompile")
+    for (nk, n), (ck, c) in zip(new_items, cur_items):
+        if nk != ck:
+            raise SnapshotRejectedError(
+                f"snapshot param key {nk!r} != served key {ck!r} — the "
+                f"apply fn would read the wrong leaf")
+        n_shape, c_shape = tuple(np.shape(n)), tuple(np.shape(c))
+        if n_shape != c_shape:
+            raise SnapshotRejectedError(
+                f"snapshot leaf {nk!r} shape {n_shape} != served "
+                f"{c_shape} — a shape change would recompile every "
+                f"bucket executable")
+        n_dt = np.asarray(n).dtype if not hasattr(n, "dtype") else n.dtype
+        c_dt = np.asarray(c).dtype if not hasattr(c, "dtype") else c.dtype
+        if n_dt != c_dt:
+            raise SnapshotRejectedError(
+                f"snapshot leaf {nk!r} dtype {n_dt} != served {c_dt} — a "
+                f"dtype change would recompile every bucket executable")
+        if not np.all(np.isfinite(np.asarray(n))):
+            raise SnapshotRejectedError(
+                f"snapshot leaf {nk!r} contains non-finite values — a "
+                f"poisoned snapshot must never reach a replica")
+    return new
+
+
+class _Replica:
+    """One fleet member.  Every mutable field is guarded by the FLEET's
+    lock — the replica's own server has its own synchronisation."""
+
+    __slots__ = ("index", "server", "apply", "in_flight", "quarantined",
+                 "manual", "probe_attempts", "next_probe_at", "probing")
+
+    def __init__(self, index, server, apply_fn):
+        self.index = index
+        self.server = server
+        self.apply = apply_fn
+        self.in_flight = 0          # fleet-dispatched, not yet resolved
+        self.quarantined = False
+        self.manual = False         # True: an updater owns readmission
+        self.probe_attempts = 0
+        self.next_probe_at = 0.0
+        self.probing = False
+
+
+class ServingFleet:
+    """N ``InferenceServer`` replicas behind one ``submit()`` front door.
+
+    ``applies`` is one serving apply fn per replica — for weight-updated
+    fleets, ``HotSwapApply`` instances sharing one jitted
+    ``fn(params, *leaves)`` (see ``ServingFleet.replicated``).  The fleet
+    builds its own replicas (``<name>-r<i>``) so each gets its own
+    breaker, queue, and counters; pass ``breaker=`` a FACTORY (callable)
+    when you want non-default breaker tuning — a shared instance would
+    couple the replicas' failure domains, which is the opposite of a
+    fleet.
+
+    Failure matrix (what a client sees):
+
+    - routed + served        → result
+    - no ready replica / all at the in-flight cap → ``RejectedError`` at
+      ``submit`` (admission-level; never retried — retry another cell)
+    - replica died / breaker tripped after acceptance → transparent
+      re-dispatch; an error surfaces only when every healthy replica has
+      been tried or the deadline passed
+    - deadline passed (queue, failover wait) → ``DeadlineExceededError``
+    - non-finite output row → ``NonFiniteOutputError`` (data fault —
+      deterministic, so never re-dispatched)
+
+    Thread contract (mxlint-gated): fleet state lives behind
+    ``self._lock`` (plain field reads/writes only — health reads,
+    ``fault.fire`` and replica calls happen OUTSIDE it); the router
+    thread and client threads share work through ``queue.Queue`` /
+    ``Event``s; per-replica state is fleet-lock-guarded fields on
+    ``_Replica``.
+    """
+
+    _TICK = 0.02             # router housekeeping cadence
+
+    def __init__(self, applies, *, buckets=(1, 2, 4, 8), sample=None,
+                 name="Fleet", default_deadline=None, max_inflight=None,
+                 max_redispatch=None, probe_base_delay=0.05,
+                 probe_max_delay=2.0, probe_jitter=0.25,
+                 probe_deadline=5.0, breaker=None, max_queue=128,
+                 **server_kw):
+        applies = list(applies)
+        if not applies:
+            raise ValueError("ServingFleet: need at least one replica")
+        self._name = name
+        self.buckets = buckets if isinstance(buckets, BucketSpec) \
+            else BucketSpec(buckets)
+        self._sample = sample
+        self._default_deadline = default_deadline
+        # cap = one replica's total capacity: its queue plus one full
+        # batch in flight.  Beyond that the replica would shed anyway —
+        # the fleet's cap just makes the verdict immediate and keeps the
+        # ranking honest.
+        self._max_inflight = int(max_inflight) if max_inflight is not None \
+            else int(max_queue) + self.buckets.max_batch
+        self._max_redispatch = int(max_redispatch) \
+            if max_redispatch is not None else 2 * len(applies) + 2
+        self._probe_base = float(probe_base_delay)
+        self._probe_max = float(probe_max_delay)
+        self._probe_jitter = float(probe_jitter)
+        self._probe_deadline = float(probe_deadline)
+        self.replicas = []
+        for i, apply_fn in enumerate(applies):
+            brk = breaker() if callable(breaker) else breaker
+            srv = InferenceServer(
+                apply_fn, buckets=self.buckets, sample=sample,
+                breaker=brk, max_queue=max_queue, name=f"{name}-r{i}",
+                **server_kw)
+            self.replicas.append(_Replica(i, srv, apply_fn))
+        self._lock = threading.Lock()
+        self._stats = {"admitted": 0, "completed": 0, "failed": 0,
+                       "expired": 0, "shed": 0, "rejected": 0,
+                       "redispatched": 0, "probes": 0, "swaps": 0,
+                       "rollbacks": 0}
+        self._outstanding = 0
+        self._retry_q = queue.Queue()
+        self._started = threading.Event()
+        self._draining = threading.Event()
+        self._stop = threading.Event()
+        self._router = threading.Thread(target=self._router_loop,
+                                        name=f"{name}-router", daemon=True)
+        self._c_ready = _profiler.Counter(None, f"{name}::ready_replicas")
+        self._c_quar = _profiler.Counter(None, f"{name}::quarantined")
+        self._c_redisp = _profiler.Counter(None, f"{name}::redispatched")
+        self._c_out = _profiler.Counter(None, f"{name}::outstanding")
+        self._c_swaps = _profiler.Counter(None, f"{name}::swaps")
+        self._c_rollbacks = _profiler.Counter(None, f"{name}::rollbacks")
+
+    @classmethod
+    def replicated(cls, fn, params, n, **kw):
+        """A fleet of ``n`` replicas of one jitted ``fn(params,
+        *batch_leaves)``, each with its own hot-swappable ``params``
+        slot (initially shared refs — a rolling update re-points them
+        one replica at a time).  One jit cache serves the whole fleet,
+        so the executable census of the bucket grid covers ALL replicas,
+        not each."""
+        return cls([HotSwapApply(fn, list(params)) for _ in range(n)], **kw)
+
+    # ------------------------------------------------------------ lifecycle --
+    def start(self, warmup=None):
+        """Start every replica (warmup per ``InferenceServer.start`` —
+        with a shared jitted fn only the first replica compiles; the
+        rest hit its cache), then the router thread."""
+        if self._draining.is_set():
+            raise ServerClosedError(f"{self._name}: already drained")
+        started = []
+        try:
+            for rep in self.replicas:
+                rep.server.start(warmup=warmup)
+                started.append(rep)
+        except Exception:
+            # a failed bring-up must not leak the replicas that DID
+            # start (their batch threads would outlive the fleet)
+            for rep in started:
+                rep.server.drain(timeout=5)
+            raise
+        if not self._started.is_set():
+            self._started.set()
+            self._router.start()
+        return self
+
+    def __enter__(self):
+        if not self._started.is_set():
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.drain()
+        return False
+
+    # ------------------------------------------------------------ admission --
+    def submit(self, data, deadline=None):
+        """Route one request to the best replica; returns its fleet-side
+        ``Request`` future (failover is transparent — the future resolves
+        exactly once, whichever replica ends up serving it).
+
+        Refusals are immediate: ``ServerClosedError`` while draining,
+        ``RejectedError`` when no ready replica has in-flight headroom.
+        An admission-level refusal never touched any replica's queue and
+        is never retried by the fleet."""
+        _fault.fire("fleet.route")
+        if self._draining.is_set():
+            self._count("rejected")
+            raise ServerClosedError(f"{self._name}: draining — "
+                                    f"not admitting")
+        if not self._started.is_set():
+            self._count("rejected")
+            raise RejectedError(f"{self._name}: not started")
+        if deadline is None:
+            deadline = self._default_deadline
+        freq = Request(data, deadline=deadline)
+        with self._lock:
+            self._stats["admitted"] += 1
+            self._outstanding += 1
+        try:
+            self._dispatch(freq, frozenset(), attempts=0, from_router=False)
+        except BaseException:
+            # refusal accounting lives in shed/rejected (outside the
+            # admitted == completed+failed+expired invariant) — the
+            # exception TYPE carries the deadline-vs-shed distinction
+            with self._lock:
+                self._stats["admitted"] -= 1
+                self._outstanding -= 1
+                self._stats["shed"] += 1
+            raise
+        self._c_out.set_value(self.outstanding)
+        return freq
+
+    def __call__(self, data, deadline=None, timeout=None):
+        """Blocking convenience: submit + ``result()``."""
+        return self.submit(data, deadline=deadline).result(timeout)
+
+    @property
+    def outstanding(self):
+        """Accepted fleet requests not yet resolved."""
+        with self._lock:
+            return self._outstanding
+
+    def _count(self, key, n=1):
+        with self._lock:
+            self._stats[key] += n
+
+    # -------------------------------------------------------------- routing --
+    def _remaining(self, freq):
+        """Seconds left on the request's ORIGINAL deadline (None =
+        unbounded); <= 0 means expired."""
+        if freq.deadline is None:
+            return None
+        return freq.deadline - time.monotonic()
+
+    def _ranked(self, excluded):
+        """Ready, unquarantined, under-cap replicas, least-loaded first:
+        ranked on (fleet in-flight, replica queue depth) — both read
+        from the replica's public ``healthz`` snapshot and the fleet's
+        own books, never from private server state."""
+        with self._lock:
+            snap = [(rep, rep.in_flight, rep.quarantined)
+                    for rep in self.replicas if rep.index not in excluded]
+        cands = []
+        for rep, in_flight, quarantined in snap:
+            if quarantined or in_flight >= self._max_inflight:
+                continue
+            h = rep.server.healthz()
+            if not h["ready"]:
+                continue
+            cands.append((in_flight, h["queue_depth"], rep.index, rep))
+        cands.sort(key=lambda c: c[:3])
+        return [c[3] for c in cands]
+
+    def _dispatch(self, freq, excluded, attempts, from_router):
+        """Hand ``freq`` to the best replica and register the completion
+        callback.  True when a replica accepted it.  When none can:
+        front-door callers get the admission verdict as a raise; the
+        router gets False and keeps the request pending."""
+        remaining = self._remaining(freq)
+        if remaining is not None and remaining <= 0:
+            # the deadline verdict, not an admission one: a client must
+            # never read "retry elsewhere" on a request whose GLOBAL
+            # deadline has passed
+            raise DeadlineExceededError(
+                f"{self._name}: deadline already passed at routing time")
+        last_refusal = None
+        for rep in self._ranked(excluded):
+            # reserve the slot under the lock BEFORE submitting — two
+            # client threads racing the same replica must not both slip
+            # under the cap
+            with self._lock:
+                if rep.quarantined or rep.in_flight >= self._max_inflight:
+                    continue
+                rep.in_flight += 1
+            try:
+                _fault.fire("fleet.dispatch")
+                rreq = rep.server.submit(freq.data, deadline=remaining)
+            except RejectedError as exc:
+                with self._lock:
+                    rep.in_flight -= 1
+                last_refusal = exc
+                continue
+            except BaseException:
+                with self._lock:
+                    rep.in_flight -= 1
+                raise
+            rreq.add_done_callback(
+                lambda r, _rep=rep, _ex=excluded, _at=attempts:
+                self._on_replica_done(freq, _rep, _ex, _at, r))
+            return True
+        if from_router:
+            return False
+        if last_refusal is not None:
+            raise RejectedError(
+                f"{self._name}: every ready replica refused "
+                f"({last_refusal}) — shedding")
+        raise RejectedError(
+            f"{self._name}: no ready replica with in-flight headroom — "
+            f"shedding")
+
+    def _on_replica_done(self, freq, rep, excluded, attempts, rreq):
+        """Replica-side resolution (runs on the replica's batch thread,
+        or on the refusing thread).  Success and terminal errors resolve
+        the fleet future; retryable failures go back to the router."""
+        with self._lock:
+            rep.in_flight -= 1
+        err = rreq.exception(timeout=0)          # already resolved
+        if err is None:
+            self._finish(freq, result=rreq.result(0))
+            return
+        if isinstance(err, (DeadlineExceededError, NonFiniteOutputError)) \
+                or self._stop.is_set():
+            # deadline is global; a NaN output is the INPUT's fault and
+            # will reproduce on any replica — never re-dispatch either
+            self._finish(freq, error=err)
+            return
+        self._retry_q.put((freq, frozenset(excluded) | {rep.index},
+                           attempts + 1, err))
+
+    def _finish(self, freq, result=None, error=None):
+        if error is None:
+            freq.set_result(result)
+            key = "completed"
+        else:
+            freq.set_error(error)
+            key = "expired" if isinstance(error, DeadlineExceededError) \
+                else "failed"
+        with self._lock:
+            self._stats[key] += 1
+            self._outstanding -= 1
+
+    # ---------------------------------------------------------- router thread --
+    def _router_loop(self):
+        """Failover + quarantine housekeeping: re-dispatches failed-over
+        requests, watches replica health, schedules quarantine probes.
+        Exits only when the fleet stops — and never with a pending
+        request unresolved."""
+        pending = []
+        try:
+            while True:
+                try:
+                    item = self._retry_q.get(timeout=self._TICK)
+                except queue.Empty:
+                    item = None
+                if item is not None:
+                    pending.append(item)
+                while True:          # drain whatever else arrived
+                    try:
+                        pending.append(self._retry_q.get_nowait())
+                    except queue.Empty:
+                        break
+                pending = self._service_pending(pending)
+                self._health_scan()
+                if self._stop.is_set() and not pending \
+                        and self._retry_q.empty():
+                    return
+        finally:
+            # crashed or stopping: strand nothing
+            leftovers = list(pending)
+            while True:
+                try:
+                    leftovers.append(self._retry_q.get_nowait())
+                except queue.Empty:
+                    break
+            for freq, _ex, _at, err in leftovers:
+                if not freq.done():
+                    self._finish(freq, error=ServerClosedError(
+                        f"{self._name}: fleet stopped before this request "
+                        f"could be re-dispatched (last replica error: "
+                        f"{err!r})"))
+
+    def _service_pending(self, pending):
+        """One pass over the failover backlog.  Returns what is still
+        waiting for a routable replica."""
+        still = []
+        for entry in pending:
+            freq, excluded, attempts, last_err = entry
+            if freq.done():
+                continue
+            if freq.expired():
+                self._finish(freq, error=DeadlineExceededError(
+                    f"deadline exceeded during fleet re-dispatch (last "
+                    f"replica error: {last_err!r})"))
+                continue
+            if attempts > self._max_redispatch:
+                self._finish(freq, error=last_err)
+                continue
+            try:
+                ok = self._dispatch(freq, excluded, attempts,
+                                    from_router=True)
+            except Exception as exc:    # injected fleet.dispatch fault —
+                self._finish(freq, error=exc)   # resolved, never dropped
+                continue
+            if ok:
+                self._count("redispatched")
+                self._c_redisp.increment()
+                continue
+            if self._draining.is_set() and not self._any_ready():
+                self._finish(freq, error=ServerClosedError(
+                    f"{self._name}: draining with no ready replica — "
+                    f"request not served (last replica error: "
+                    f"{last_err!r})"))
+                continue
+            if not self.alive():
+                # every batch thread is dead: nothing in-process can ever
+                # serve this again — a deadline-less request must resolve,
+                # not hang until someone thinks to call drain()
+                self._finish(freq, error=ServerClosedError(
+                    f"{self._name}: every replica batch thread is dead — "
+                    f"request not served (last replica error: "
+                    f"{last_err!r})"))
+                continue
+            if excluded:
+                # nothing OUTSIDE the excluded set can take it right now:
+                # open the set back up (an excluded replica may have
+                # healed) and bill one attempt for the failed pass, so a
+                # request that keeps failing everywhere stays bounded by
+                # max_redispatch instead of spinning forever
+                excluded, attempts = frozenset(), attempts + 1
+            still.append((freq, excluded, attempts, last_err))
+        return still
+
+    def _any_ready(self):
+        with self._lock:
+            quarantined = {rep.index for rep in self.replicas
+                           if rep.quarantined}
+        return any(rep.server.ready() for rep in self.replicas
+                   if rep.index not in quarantined)
+
+    # ------------------------------------------------------------ quarantine --
+    def _health_scan(self):
+        """Router-tick health pass: quarantine replicas that died or
+        tripped OPEN; schedule probes for auto-quarantined ones."""
+        now = time.monotonic()
+        n_ready, n_quar = 0, 0
+        for rep in self.replicas:
+            with self._lock:
+                quarantined = rep.quarantined
+                manual, probing = rep.manual, rep.probing
+                next_at = rep.next_probe_at
+            if not quarantined:
+                if rep.server.ready():
+                    n_ready += 1
+                if not self._draining.is_set():
+                    dead = not rep.server.alive()
+                    tripped = rep.server.breaker.state == OPEN
+                    if dead or tripped:
+                        self.quarantine(
+                            rep, manual=False,
+                            reason="batch thread dead" if dead
+                            else "breaker OPEN")
+                        n_quar += 1
+                continue
+            n_quar += 1
+            if manual or probing or now < next_at \
+                    or self._draining.is_set():
+                continue
+            self._probe(rep)
+        self._c_ready.set_value(n_ready)
+        self._c_quar.set_value(n_quar)
+
+    def quarantine(self, rep, manual=True, reason="manual"):
+        """Take one replica out of the routing set.  ``manual=True``
+        (the updater's mode) suppresses auto-readmission — the caller
+        owns the replica until ``readmit``; ``manual=False`` hands it to
+        the router's probe schedule."""
+        rep = self._resolve(rep)
+        with self._lock:
+            already = rep.quarantined
+            rep.quarantined = True
+            rep.manual = bool(manual)
+            if not already:
+                rep.probe_attempts = 0
+                rep.next_probe_at = time.monotonic() + _fault.backoff_delay(
+                    1, self._probe_base, self._probe_max,
+                    self._probe_jitter)
+        if not already:
+            _logger.warning("%s: replica r%d quarantined (%s)",
+                            self._name, rep.index, reason)
+        return rep
+
+    def readmit(self, rep):
+        """Put a quarantined replica back in the routing set."""
+        rep = self._resolve(rep)
+        with self._lock:
+            rep.quarantined = False
+            rep.manual = False
+            rep.probe_attempts = 0
+            rep.probing = False
+
+    def _resolve(self, rep):
+        return self.replicas[rep] if isinstance(rep, int) else rep
+
+    def wait_idle(self, rep, timeout=None, poll=0.01):
+        """Block until a replica has zero fleet-dispatched work in
+        flight (quarantine it first, or new work keeps arriving).  True
+        when idle within ``timeout``."""
+        rep = self._resolve(rep)
+        t_end = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                idle = rep.in_flight == 0
+            if idle:
+                return True
+            if t_end is not None and time.monotonic() >= t_end:
+                return False
+            time.sleep(poll)
+
+    def _probe(self, rep):
+        """One quarantine probe, non-blocking: push the warmup sample
+        through the replica's full serving path and judge it from the
+        completion callback.  Without a ``sample`` the fleet can only
+        watch ``ready()`` (the replica's own idle probe does the
+        healing)."""
+        with self._lock:
+            rep.probing = True
+            self._stats["probes"] += 1
+        ok_now = None
+        try:
+            _fault.fire("fleet.probe")
+            if self._sample is None:
+                ok_now = rep.server.ready()
+            else:
+                rreq = rep.server.submit(self._sample,
+                                         deadline=self._probe_deadline)
+        except Exception:        # refused (engaged breaker, dead server,
+            ok_now = False       # injected fleet.probe fault): not healed
+        if ok_now is not None:
+            self._probe_verdict(rep, ok_now)
+            return
+        rreq.add_done_callback(
+            lambda r: self._probe_verdict(
+                rep, r.exception(0) is None and rep.server.ready()))
+
+    def _probe_verdict(self, rep, ok):
+        with self._lock:
+            rep.probing = False
+            if ok and not rep.manual:
+                rep.quarantined = False
+                rep.probe_attempts = 0
+                readmitted = True
+            else:
+                rep.probe_attempts += 1
+                rep.next_probe_at = time.monotonic() + _fault.backoff_delay(
+                    rep.probe_attempts + 1, self._probe_base,
+                    self._probe_max, self._probe_jitter)
+                readmitted = False
+        if readmitted:
+            _logger.warning("%s: replica r%d readmitted after probe",
+                            self._name, rep.index)
+
+    # --------------------------------------------------------------- health --
+    def alive(self):
+        """Liveness: any replica's batch thread is running."""
+        return any(rep.server.alive() for rep in self.replicas)
+
+    def ready(self):
+        """Readiness: started, not draining, and at least one
+        unquarantined replica is ready."""
+        return (self._started.is_set() and not self._draining.is_set()
+                and self._any_ready())
+
+    def healthz(self):
+        """Fleet probe snapshot: fleet verdicts plus each replica's own
+        ``healthz`` extended with the fleet's view of it (``quarantined``,
+        fleet-tracked ``fleet_in_flight``)."""
+        with self._lock:
+            view = [(rep, rep.in_flight, rep.quarantined)
+                    for rep in self.replicas]
+            outstanding = self._outstanding
+        replicas = {}
+        for rep, in_flight, quarantined in view:
+            h = rep.server.healthz()
+            h["quarantined"] = quarantined
+            h["fleet_in_flight"] = in_flight
+            replicas[f"r{rep.index}"] = h
+        return {"alive": self.alive(), "ready": self.ready(),
+                "draining": self._draining.is_set(),
+                "outstanding": outstanding,
+                "ready_replicas": sum(
+                    1 for h in replicas.values()
+                    if h["ready"] and not h["quarantined"]),
+                "replicas": replicas}
+
+    @property
+    def stats(self):
+        """Fleet-level accounting.  ``admitted == completed + failed +
+        expired`` once drained — an accepted request always lands in
+        exactly one terminal bucket."""
+        with self._lock:
+            out = dict(self._stats)
+            out["outstanding"] = self._outstanding
+        out["replicas"] = {f"r{rep.index}": rep.server.stats
+                           for rep in self.replicas}
+        return out
+
+    # ---------------------------------------------------------------- drain --
+    def drain(self, timeout=None):
+        """Graceful fleet shutdown: stop admitting, let every accepted
+        request reach a terminal state (replicas keep serving their
+        queues; the router keeps failing work over while any replica is
+        ready), then drain all replicas CONCURRENTLY and stop the
+        router.  True when everything resolved and every thread exited
+        in time."""
+        self._draining.set()
+        t_end = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                n = self._outstanding
+            if n == 0:
+                break
+            if t_end is not None and time.monotonic() >= t_end:
+                break
+            time.sleep(self._TICK)
+        threads = [threading.Thread(target=rep.server.drain,
+                                    name=f"{self._name}-drain-r{rep.index}")
+                   for rep in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(None if t_end is None
+                   else max(0.1, t_end - time.monotonic()))
+        self._stop.set()
+        if self._started.is_set():
+            self._router.join(None if t_end is None
+                              else max(0.1, t_end - time.monotonic()))
+        self._c_out.set_value(self.outstanding)
+        ok = self.outstanding == 0 and not self._router.is_alive() \
+            and not any(rep.server.alive() for rep in self.replicas)
+        return ok
+
+    close = drain
+
+    def serve_forever(self, poll=0.05):
+        """Block until SIGTERM/SIGINT (``fault.GracefulExit``), then
+        drain the whole fleet — the preemption contract, one tier up."""
+        with _fault.GracefulExit() as g:
+            while not g.requested and self.alive():
+                time.sleep(poll)
+        return self.drain()
+
+
+class WeightUpdater:
+    """Streams training snapshots into a live fleet, zero downtime.
+
+    Watches a checkpoint directory (a ``parallel.CheckpointManager``
+    instance or a plain path written by one) through
+    ``checkpoint.wait_for_new``, validates each new snapshot against the
+    currently-served params (``validate_params`` — shape/dtype identity
+    so executables survive, all-finite so poison never ships), then
+    rolls it across the fleet one replica at a time::
+
+        quarantine → drain in-flight → hot-swap params → probe → readmit
+
+    The fleet never loses more than one replica of capacity, and a
+    request never sees a half-updated replica.  A probe failure rolls
+    the replica (and any replicas already updated) back to the previous
+    weights and raises ``UpdateRolledBackError`` — the fleet returns to
+    full ready capacity on the old weights.  A DEAD replica (batch
+    thread gone) is skipped, not fatal: it cannot serve, and a wedged
+    update would be a second outage on top of the replica loss.  Replica
+    apply fns must expose the ``HotSwapApply`` protocol (``params`` +
+    ``swap()``).
+    """
+
+    def __init__(self, fleet, source=None, *, prefix="ckpt", poll=0.25,
+                 last_seen=None, probe_deadline=10.0, drain_timeout=30.0):
+        self.fleet = fleet
+        directory = getattr(source, "directory", source)
+        self._directory = None if directory is None else str(directory)
+        self._prefix = getattr(source, "prefix", prefix)
+        self._poll = float(poll)
+        self._probe_deadline = float(probe_deadline)
+        self._drain_timeout = float(drain_timeout)
+        if last_seen is None and self._directory is not None:
+            # the fleet was (typically) just initialized from the newest
+            # snapshot — re-applying it would roll every replica through
+            # a quarantine/drain/probe cycle for a no-op.  Stream only
+            # snapshots committed AFTER this point; pass last_seen=0 (or
+            # any older step) to force-apply what is already there.
+            from ..parallel.checkpoint import list_checkpoints
+            cks = list_checkpoints(self._directory, self._prefix)
+            last_seen = cks[-1][0] if cks else None
+        self.last_seen = last_seen
+        self.applied = 0         # snapshots fully rolled out
+        self.skipped = 0         # snapshots refused or rolled back
+        self._stop = threading.Event()
+        self._thread = None
+        for rep in fleet.replicas:
+            if not hasattr(rep.apply, "swap"):
+                raise ValueError(
+                    "WeightUpdater: replica apply fns must expose the "
+                    "HotSwapApply protocol (.params + .swap) — build the "
+                    "fleet with ServingFleet.replicated or HotSwapApply")
+        if fleet._sample is None:
+            raise ValueError(
+                "WeightUpdater: the fleet needs a sample payload — the "
+                "post-swap probe is what stands between a bad snapshot "
+                "and live traffic")
+
+    # ------------------------------------------------------------- updates --
+    def update(self, snapshot):
+        """Apply one snapshot fleet-wide.  ``snapshot`` is a checkpoint
+        path (v1 ``save_train_step`` layout) or an already-loaded params
+        sequence.  Raises ``SnapshotRejectedError`` (nothing touched) or
+        ``UpdateRolledBackError`` (fleet restored to previous weights)."""
+        if isinstance(snapshot, (str, os.PathLike)):
+            from ..parallel.checkpoint import load_snapshot_params
+            params, _names = load_snapshot_params(str(snapshot))
+        else:
+            params = snapshot            # container kind is validated
+        try:
+            new_params = validate_params(
+                params, self.fleet.replicas[0].apply.params)
+        except SnapshotRejectedError:
+            self.skipped += 1
+            raise
+        done = []                      # [(replica, its previous params)]
+        try:
+            live = [rep for rep in self.fleet.replicas
+                    if rep.server.alive()]
+            if not live:
+                raise UpdateRolledBackError(
+                    "no live replica to update — the fleet is down")
+            for rep in self.fleet.replicas:
+                if rep not in live:
+                    # a dead replica cannot serve (it is quarantined and
+                    # its probes fail) — aborting the WHOLE update for it
+                    # would wedge weight streaming on the first replica
+                    # loss; it gets a fresh snapshot when it returns
+                    _logger.warning(
+                        "%s updater: skipping dead replica r%d",
+                        self.fleet._name, rep.index)
+                    continue
+                done.append((rep, self._swap_one(rep, new_params)))
+        except Exception as exc:
+            self.skipped += 1
+            self.fleet._count("rollbacks")
+            self.fleet._c_rollbacks.increment()
+            for rep, old in reversed(done):
+                try:
+                    self._swap_one(rep, old)
+                except Exception:      # noqa: BLE001 — the replica stays
+                    pass               # quarantined; the rollback goes on
+            if isinstance(exc, UpdateRolledBackError):
+                raise
+            raise UpdateRolledBackError(
+                f"rolling update aborted and rolled back: {exc}") from exc
+        self.applied += 1
+        self.fleet._count("swaps")
+        self.fleet._c_swaps.increment()
+        return len(done)
+
+    def _swap_one(self, rep, new_params):
+        """One replica through the full protocol; returns its previous
+        params.  On probe failure the replica is rolled back in place
+        (and re-probed — only a verified replica is readmitted)."""
+        _fault.fire("fleet.swap")
+        self.fleet.quarantine(rep, manual=True, reason="weight update")
+        swapped, old = False, None
+        try:
+            if not self.fleet.wait_idle(rep, timeout=self._drain_timeout):
+                raise UpdateRolledBackError(
+                    f"replica r{rep.index} still had in-flight work after "
+                    f"{self._drain_timeout}s — update aborted before any "
+                    f"swap")
+            old = rep.apply.swap(dict(new_params)
+                                 if isinstance(new_params, dict)
+                                 else list(new_params))
+            swapped = True
+            self._probe(rep)
+        except Exception as exc:
+            if swapped:
+                rep.apply.swap(old)
+                try:
+                    self._probe(rep)
+                except Exception:
+                    # even the OLD weights fail the probe: the replica
+                    # itself is sick — leave it quarantined and hand it
+                    # to the router's auto-probe schedule
+                    with self.fleet._lock:
+                        rep.manual = False
+                    raise UpdateRolledBackError(
+                        f"replica r{rep.index}: post-swap probe failed "
+                        f"AND the rollback probe failed — replica left "
+                        f"quarantined ({exc})") from exc
+            self.fleet.readmit(rep)
+            if isinstance(exc, UpdateRolledBackError):
+                raise
+            raise UpdateRolledBackError(
+                f"replica r{rep.index}: post-swap probe failed — rolled "
+                f"back to previous weights ({exc})") from exc
+        self.fleet.readmit(rep)
+        return old
+
+    def _probe(self, rep):
+        """Warmup probe through the replica's full serving path; raises
+        unless the replica returns an all-finite result in time."""
+        _fault.fire("fleet.probe")
+        self.fleet._count("probes")
+        rreq = rep.server.submit(self.fleet._sample,
+                                 deadline=self._probe_deadline)
+        out = rreq.result(self._probe_deadline + 1.0)
+        leaves = out if isinstance(out, (tuple, list)) else (out,)
+        for leaf in leaves:
+            if not np.all(np.isfinite(np.asarray(leaf))):
+                raise UpdateRolledBackError(
+                    f"replica r{rep.index}: probe output is non-finite")
+
+    # --------------------------------------------------------------- watch --
+    def poll_once(self, timeout=0.0):
+        """Check the directory once (blocking up to ``timeout`` for a
+        new snapshot); applies the newest unseen one.  Returns its
+        ``num_update`` or None.  A snapshot that fails (validation or
+        rollback) is marked seen — a poisoned file must not be retried
+        on every poll — and the error propagates."""
+        if self._directory is None:
+            raise ValueError("WeightUpdater: no watch directory — "
+                             "construct with source=")
+        from ..parallel.checkpoint import wait_for_new
+        found = wait_for_new(self._directory, last_seen=self.last_seen,
+                             timeout=timeout, prefix=self._prefix,
+                             poll=min(self._poll, 0.05))
+        if found is None:
+            return None
+        num_update, path = found
+        self.last_seen = num_update
+        self.update(path)
+        return num_update
+
+    def start(self):
+        """Watch the directory from a background thread; each new
+        snapshot rolls out as it commits.  Failed snapshots are logged
+        and skipped — the watcher never dies on a bad file."""
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._watch_loop,
+            name=f"{self.fleet._name}-updater", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=None):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        return self._thread is None or not self._thread.is_alive()
+
+    def _watch_loop(self):
+        while not self._stop.is_set():
+            try:
+                self.poll_once(timeout=self._poll)
+            except (SnapshotRejectedError, UpdateRolledBackError) as exc:
+                _logger.warning("%s updater: snapshot skipped: %s",
+                                self.fleet._name, exc)
+            except Exception as exc:   # noqa: BLE001 — the watcher must
+                _logger.warning(       # outlive transient I/O errors
+                    "%s updater: poll failed (%s) — retrying",
+                    self.fleet._name, exc)

@@ -105,6 +105,7 @@ class InferenceServer:
         self._stats = {"admitted": 0, "completed": 0, "failed": 0,
                        "shed": 0, "expired": 0, "rejected": 0,
                        "batches": 0, "probes": 0}
+        self._last_error = None       # (type name, monotonic stamp)
         self._shapes = set()          # distinct dispatched signatures
         self._ready = threading.Event()
         self._draining = threading.Event()
@@ -276,6 +277,14 @@ class InferenceServer:
         with self._lock:
             self._stats[key] += n
 
+    def _note_step_failure(self, exc):
+        """Remember the most recent step-level failure for ``healthz`` —
+        type name + monotonic stamp, never the exception object (holding
+        it would pin its traceback, and with it every frame's locals,
+        for the life of the server)."""
+        with self._lock:
+            self._last_error = (type(exc).__name__, time.monotonic())
+
     # ---------------------------------------------------------- batch thread --
     def _expire(self, req):
         """Deadline passed in queue: resolve WITHOUT device work."""
@@ -305,6 +314,7 @@ class InferenceServer:
                 out = self._apply(*padded)
         except Exception as exc:      # noqa: BLE001 — resolved per request
             self.breaker.record_failure()
+            self._note_step_failure(exc)
             self._c_breaker.set_value(self.breaker.state_code())
             err = _fault.with_context(
                 exc, f"{self._name} batch of {len(group)}")
@@ -326,6 +336,7 @@ class InferenceServer:
                 f"{self._name}: apply fn returned leading dim "
                 f"{bad_dim[0].shape[:1]} for a batch of {target} — serving "
                 f"apply fns must be batch-major")
+            self._note_step_failure(err)
             for r in group:
                 r.set_error(err)
             self._bump("failed", len(group))
@@ -346,6 +357,8 @@ class InferenceServer:
             batch_dead = False
         if batch_dead:
             self.breaker.record_failure()
+            self._note_step_failure(NonFiniteOutputError(
+                "entirely non-finite multi-request batch"))
         else:
             self.breaker.record_success()
         self._c_breaker.set_value(self.breaker.state_code())
@@ -380,8 +393,9 @@ class InferenceServer:
         try:
             _fault.fire("serving.step")
             self._apply(*self._padded(self._sample, self.buckets.batch[0]))
-        except Exception:                # noqa: BLE001 — probe verdicts
+        except Exception as exc:         # noqa: BLE001 — probe verdicts
             self.breaker.record_failure()
+            self._note_step_failure(exc)
         else:
             self.breaker.record_success()
         self._c_breaker.set_value(self.breaker.state_code())
@@ -399,11 +413,30 @@ class InferenceServer:
                 and not self.breaker.engaged())
 
     def healthz(self):
-        """The ``/healthz``-style snapshot a probe endpoint would serve."""
+        """The ``/healthz``-style snapshot a probe endpoint would serve.
+
+        Carries everything a fleet router needs to RANK replicas without
+        reaching into private state: ``breaker_state`` (0 closed /
+        1 half-open / 2 open — same coding as the profiler counter),
+        ``in_flight`` (accepted requests not yet resolved — queued plus
+        mid-batch), and ``last_error`` (``{"type", "age"}`` of the most
+        recent step-level failure, monotonic seconds; ``None`` when the
+        replica has never failed a step).  The snapshot is non-blocking:
+        one short stats copy under the server lock, every other field
+        read from its own primitive — no device work, no queue waits."""
+        with self._lock:
+            s = self._stats
+            in_flight = (s["admitted"] - s["completed"] - s["failed"]
+                         - s["expired"])
+            last = self._last_error
         return {"alive": self.alive(), "ready": self.ready(),
                 "draining": self._draining.is_set(),
                 "breaker": self.breaker.state,
-                "queue_depth": self._batcher.depth()}
+                "breaker_state": self.breaker.state_code(),
+                "queue_depth": self._batcher.depth(),
+                "in_flight": max(0, in_flight),
+                "last_error": None if last is None else
+                {"type": last[0], "age": time.monotonic() - last[1]}}
 
     @property
     def stats(self):

@@ -353,6 +353,58 @@ def test_resume_latest_model_mismatch_raises(tmp_path):
         resume_latest(s2, d)
 
 
+def _corrupt_payload_keep_marker(path):
+    """Rewrite one committed snapshot with a wrong-shaped p.0 payload:
+    the marker (name, container, manifest) stays valid, the payload no
+    longer matches the model — in-place damage, not a model change."""
+    import json
+    z = dict(np.load(path))
+    z["p.0"] = np.zeros((1, 1), np.float32)
+    with open(path, "wb") as f:
+        np.savez(f, **z)
+    # sanity: the manifest still reads fine
+    json.loads(bytes(np.load(path)["__manifest__"]).decode())
+
+
+def test_resume_latest_skips_validation_damage_when_older_loads(tmp_path):
+    """ISSUE 7 satellite: a snapshot whose marker exists but whose
+    payload fails validation is DAMAGE when an older sibling restores
+    cleanly — resume_latest must fall back, not raise it as user
+    error."""
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=3)
+    batches = _batches(3, seed=6)
+    for x, y in batches:
+        step(x, y)
+        mgr.maybe_save()
+    _corrupt_payload_keep_marker(mgr.checkpoints()[-1][1])
+
+    step2 = _step_for(_net(44))
+    step2(*batches[0])
+    assert resume_latest(step2, d) == 2      # fell back past the damage
+
+
+def test_resume_latest_systematic_mismatch_still_raises(tmp_path):
+    """When EVERY candidate fails validation the mismatch is the model's,
+    not the files' — the user error must still surface."""
+    from mxnet_tpu.parallel.checkpoint import CheckpointMismatchError
+
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=3)
+    for x, y in _batches(2, seed=6):
+        step(x, y)
+        mgr.maybe_save()
+    for _, path in mgr.checkpoints():
+        _corrupt_payload_keep_marker(path)
+
+    step2 = _step_for(_net(44))
+    step2(*_batches(1)[0])
+    with pytest.raises(CheckpointMismatchError):
+        resume_latest(step2, d)
+
+
 @chaos
 def test_kill_and_resume_via_inject_bit_exact(tmp_path):
     """The acceptance contract: crash mid-run via fault.inject, rediscover

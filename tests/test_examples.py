@@ -40,6 +40,13 @@ def test_serve_mnist_example():
     assert "distinct_shapes=4" in out      # bucket grid bounded the compiles
 
 
+def test_serve_fleet_mnist_example():
+    out = _run("serve_fleet_mnist.py", "--requests", "120",
+               "--more-batches", "24")
+    assert "rolling update applied=1" in out    # live weight stream landed
+    assert "drained=True dropped=0" in out      # fleet-wide zero-drop drain
+
+
 def test_bucketing_lstm_example():
     out = _run("bucketing_lstm.py", "--epochs", "2", "--batch-size", "16")
     assert "over buckets [4, 8, 12]" in out

@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """Chaos smoke: kill-and-resume (train), inject-and-drain (serve),
-the incremental-analyzer contract (lint), and the budget-audit
-contract (cost).
+replica-kill + rolling-update (fleet), the incremental-analyzer
+contract (lint), and the budget-audit contract (cost).
 
 ``--mode train`` (default) runs a small training loop with periodic
 checkpoints, injects a crash mid-run via ``fault.inject``, rediscovers
@@ -17,6 +17,15 @@ dropped) and the breaker must have tripped and fast-failed — the
 acceptance contract of ISSUE 4::
 
     python tools/chaos_check.py [--mode train|serve|lint] [--steps 8] ...
+
+``--mode fleet`` runs the ISSUE 7 acceptance end to end: a 3-replica
+``mx.serving.ServingFleet`` under continuous client traffic has one
+replica hard-killed mid-flight, two training snapshots (written by a
+real ``TrainStep`` + ``CheckpointManager``) streamed through a rolling
+weight update, and finally a SIGTERM drain.  The contract: **zero
+dropped accepted requests** end to end (every fleet-accepted request
+resolves with a result) and **zero recompiles** (the runtime jit-cache
+count equals the static bucket census before and after both swaps).
 
 ``--mode lint`` runs the full mxlint analyzer twice against a fresh
 cache directory and asserts the second (fully cached) run is >= 5x
@@ -150,6 +159,168 @@ def serve_mode(args):
     return 0
 
 
+def fleet_mode(args):
+    """Replica-kill + rolling-update + SIGTERM smoke (ISSUE 7)."""
+    import signal
+    import tempfile as _tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault, gluon, parallel, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.checkpoint import (CheckpointManager,
+                                               load_snapshot_params)
+    from tools.costguard import executable_census
+
+    # -- a real training job feeding the snapshot stream -------------------
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              mx.optimizer.create("adam"), mesh=mesh)
+    rng = np.random.RandomState(0)
+    batches = [(rng.randn(16, 8).astype(np.float32),
+                rng.randint(0, 4, (16,))) for _ in range(6)]
+    d = _tempfile.mkdtemp(prefix="chaos_fleet_")
+    mgr = CheckpointManager(step, d, keep_last=5)
+    for x, y in batches[:2]:
+        step(x, y)
+    mgr.save()
+    params, names = load_snapshot_params(mgr.checkpoints()[-1][1])
+    first_seen = mgr.checkpoints()[-1][0]
+
+    # -- the serving side: one shared jitted forward, 3 hot-swap replicas --
+    shapes = [tuple(p.shape) for p in params]
+    iw1, ib1 = shapes.index((16, 8)), shapes.index((16,))
+    iw2, ib2 = shapes.index((4, 16)), shapes.index((4,))
+    traces = []
+
+    @jax.jit
+    def fwd(p, x):
+        traces.append(x.shape)
+        h = jnp.maximum(x @ p[iw1].T + p[ib1], 0.0)
+        return h @ p[iw2].T + p[ib2]
+
+    class KillableApply(serving.HotSwapApply):
+        def __init__(self, params):
+            super().__init__(lambda p, x: np.asarray(fwd(p, x)), params)
+            self.dead = False
+
+        def __call__(self, *leaves):
+            if self.dead:
+                raise SystemExit("replica killed")
+            time.sleep(0.003)          # keep work in flight at kill time
+            return super().__call__(*leaves)
+
+    applies = [KillableApply(list(params)) for _ in range(3)]
+    fleet = serving.ServingFleet(
+        applies, buckets=(1, 2, 4), max_delay=0.002,
+        sample=np.ones((8,), np.float32), name="ChaosFleet")
+    fleet.start()
+    census = executable_census(fleet.buckets)
+    warm = len(set(traces))
+    print(f"[chaos_check] fleet: 3 replicas warm, census={census} "
+          f"compiled={warm} jit_cache={fwd._cache_size()} "
+          f"ready={fleet.ready()}")
+
+    updater = serving.WeightUpdater(fleet, mgr, last_seen=first_seen,
+                                    poll=0.02)
+    updater.start()
+
+    accepted, sheds = [], [0]
+    count_lock = threading.Lock()
+    stop_submitting = threading.Event()
+
+    def client(k):
+        r = np.random.RandomState(k).randn(8).astype(np.float32)
+        while not stop_submitting.is_set():
+            try:
+                req = fleet.submit(r)
+                with count_lock:
+                    accepted.append(req)
+            except serving.RejectedError:
+                with count_lock:
+                    sheds[0] += 1
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    fails = []
+    try:
+        time.sleep(0.15)
+        applies[1].dead = True         # hard-kill replica 1 under traffic
+        time.sleep(0.15)
+        for round_no in (1, 2):        # stream two snapshots through
+            for x, y in batches[2 * round_no:2 * round_no + 2]:
+                step(x, y)
+            mgr.save()
+            t0 = time.time()
+            while updater.applied < round_no and time.time() - t0 < 30:
+                time.sleep(0.01)
+            if updater.applied < round_no:
+                fails.append(f"rolling update {round_no} did not apply "
+                             f"within 30s (applied={updater.applied}, "
+                             f"skipped={updater.skipped})")
+        # SIGTERM lands while clients are still submitting
+        threading.Timer(0.1, os.kill, (os.getpid(), signal.SIGTERM)).start()
+        drained = fleet.serve_forever(poll=0.01)
+    finally:
+        stop_submitting.set()
+        for t in threads:
+            t.join()
+        updater.stop(timeout=10)
+
+    resolved = sum(1 for r in accepted if r.done())
+    errs = [r.exception(0) for r in accepted
+            if r.done() and r.exception(0) is not None]
+    st = fleet.stats
+    print(f"[chaos_check] fleet: accepted={len(accepted)} "
+          f"resolved={resolved} errored={len(errs)} shed={sheds[0]} "
+          f"redispatched={st['redispatched']} swaps={st['swaps']} "
+          f"probes={st['probes']} compiled={len(set(traces))} "
+          f"jit_cache={fwd._cache_size()}")
+    if not drained:
+        fails.append("fleet drain did not complete")
+    if resolved != len(accepted):
+        fails.append(f"{len(accepted) - resolved} accepted requests were "
+                     f"silently dropped")
+    if errs:
+        fails.append(f"{len(errs)} accepted requests errored — failover "
+                     f"should have served them (first: {errs[0]!r})")
+    if st["redispatched"] < 1:
+        fails.append("the replica kill never exercised failover")
+    if updater.applied != 2:
+        fails.append(f"expected 2 applied rolling updates, got "
+                     f"{updater.applied}")
+    if len(set(traces)) > census or fwd._cache_size() > census:
+        fails.append(f"recompile leak: {len(set(traces))} traced / "
+                     f"{fwd._cache_size()} cached > census {census}")
+    if fleet.alive():
+        fails.append("a replica batch thread survived the drain")
+    # the survivors must actually serve the LAST snapshot's weights
+    want = np.asarray(fwd([jnp.asarray(p) for p in
+                           load_snapshot_params(mgr.checkpoints()[-1][1])[0]],
+                          np.ones((1, 8), np.float32)))[0]
+    got = np.asarray(applies[0](np.ones((1, 8), np.float32)))[0]
+    if not np.allclose(got, want):
+        fails.append("replica 0 does not serve the final snapshot weights")
+    if fails:
+        for f in fails:
+            print(f"[chaos_check] FAIL: {f}")
+        return 1
+    print(f"[chaos_check] PASS: replica kill + 2 rolling updates + SIGTERM "
+          f"with 0 dropped accepted requests, 0 recompiles "
+          f"({len(set(traces))}/{census} executables), "
+          f"{st['redispatched']} failovers")
+    return 0
+
+
 def lint_mode(args):
     """Incremental-analyzer smoke: cold run, warm run, compare (ISSUE 5).
 
@@ -269,11 +440,13 @@ def cost_mode(args):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--mode", choices=("train", "serve", "lint", "cost"),
+    ap.add_argument("--mode",
+                    choices=("train", "serve", "fleet", "lint", "cost"),
                     default="train",
                     help="train: kill-and-resume; serve: inject-and-"
-                         "drain; lint: incremental analyzer contract; "
-                         "cost: cold-vs-warm budget audit")
+                         "drain; fleet: replica-kill + rolling weight "
+                         "updates + SIGTERM; lint: incremental analyzer "
+                         "contract; cost: cold-vs-warm budget audit")
     ap.add_argument("--steps", type=int, default=8,
                     help="total training steps in the reference run")
     ap.add_argument("--every", type=int, default=2,
@@ -291,6 +464,8 @@ def main(argv=None):
         return cost_mode(args)
     if args.mode == "serve":
         return serve_mode(args)
+    if args.mode == "fleet":
+        return fleet_mode(args)
     crash_after = (args.crash_after if args.crash_after is not None
                    else args.steps // 2 + 1)
 
