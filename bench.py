@@ -35,6 +35,21 @@ _METRIC_NAMES = {"resnet": "resnet50_train_throughput",
                  "ssd": "ssd512_train_throughput"}
 
 
+def _quant_mode():
+    """MXTPU_BENCH_QUANT={off,bf16,int8}: the ``grad_reduce`` wire
+    format for every bench TrainStep (ISSUE 8 A/B knob).  The chosen
+    mode rides in the BENCH JSON line next to the cost fields, so the
+    perf trajectory records what was measured."""
+    v = os.environ.get("MXTPU_BENCH_QUANT", "off").lower()
+    if v in ("", "off", "0", "f32"):
+        return "f32"
+    if v not in ("bf16", "int8"):
+        print(f"MXTPU_BENCH_QUANT={v!r} (expected off|bf16|int8)",
+              file=sys.stderr)
+        sys.exit(1)
+    return v
+
+
 def _cost_fields(step):
     """costguard report fields for a bench's JSON line: the static
     accounting (tools/costguard; PERF.md methodology) rides next to the
@@ -45,15 +60,17 @@ def _cost_fields(step):
     column.  MXTPU_BENCH_COSTS=0 disables."""
     if os.environ.get("MXTPU_BENCH_COSTS", "1").lower() in ("0", "false"):
         return {}
+    fields = {"grad_reduce": getattr(step, "_grad_reduce", "f32")}
     try:
         costs = step.cost_analysis()
-        return {
+        fields.update({
             "flops_T": round(costs.get("flops", 0.0) / 1e12, 3),
             "bytes_GB": round(costs.get("bytes accessed", 0.0) / 1e9, 2),
             "n_executables": int(step._jit._cache_size()),
-        }
-    except Exception:       # noqa: BLE001 — wedged backend mid-AOT
-        return {}
+        })
+    except Exception:       # noqa: BLE001 — wedged backend mid-AOT;
+        pass                # the mode column still ships
+    return fields
 
 
 def _setup():
@@ -106,7 +123,8 @@ def bench_resnet():
     mesh = parallel.make_mesh(dp=len(jax.devices()))
     opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9, wd=1e-4)
     step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(), opt,
-                              mesh=mesh, donate_batch=(feed == "prefetch"))
+                              mesh=mesh, donate_batch=(feed == "prefetch"),
+                              grad_reduce=_quant_mode())
 
     rng = np.random.RandomState(0)
     xh = rng.randn(batch, 224, 224, 3).astype(np.float32)
@@ -186,7 +204,8 @@ def bench_bert():
 
     mesh = parallel.make_mesh(dp=len(jax.devices()))
     opt = mx.optimizer.create("lamb", learning_rate=1e-3, wd=0.01)
-    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh)
+    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh,
+                              grad_reduce=_quant_mode())
 
     rng = np.random.RandomState(0)
     tok = mx.nd.array(rng.randint(0, vocab, (batch, seq_len)).astype(np.int32))
@@ -247,7 +266,8 @@ def bench_lstm():
     mesh = parallel.make_mesh(dp=len(jax.devices()))
     opt = mx.optimizer.create("sgd", learning_rate=20.0 / batch)
     step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh,
-                              data_spec=PartitionSpec(None, "dp"))
+                              data_spec=PartitionSpec(None, "dp"),
+                              grad_reduce=_quant_mode())
 
     rng = np.random.RandomState(0)
     x = mx.nd.array(rng.randint(0, vocab, (bptt, batch)).astype(np.int32))
@@ -304,7 +324,8 @@ def bench_ssd():
     mesh = parallel.make_mesh(dp=len(jax.devices()))
     opt = mx.optimizer.create("sgd", learning_rate=1e-3, momentum=0.9,
                               wd=5e-4)
-    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh)
+    step = parallel.TrainStep(net, loss_fn, opt, mesh=mesh,
+                              grad_reduce=_quant_mode())
 
     rng = np.random.RandomState(0)
     x = mx.nd.array(rng.randn(batch, 3, size, size)

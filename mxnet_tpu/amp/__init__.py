@@ -26,9 +26,11 @@ import jax.numpy as jnp
 from ..ndarray.ndarray import NDArray
 from ..ndarray import ndarray as _nd_mod
 from . import lists
+from .quantize import Int8Quantizer, dequantize_weight, quantize_weight
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale",
-           "convert_model", "LossScaler"]
+           "convert_model", "LossScaler",
+           "Int8Quantizer", "quantize_weight", "dequantize_weight"]
 
 _FLOATS = (jnp.float16, jnp.bfloat16, jnp.float32)
 

@@ -1,0 +1,168 @@
+"""Post-training int8 weight quantization for serving.
+
+ref: the reference ships ``mxnet.contrib.quantization`` (calibrated
+int8 *op* rewriting for MKLDNN/TensorRT); here the serving bottleneck
+is different — PERF.md measures the hot paths HBM-bandwidth-bound, so
+the lever is the **weight buffer**: int8 payloads + per-channel f32
+scales quarter the bytes a compiled serving program holds and streams
+per step, which multiplies serving capacity per chip (the
+Gemma-on-TPU serving comparison, arXiv:2605.25645; ROADMAP item 2).
+
+The scheme is symmetric per-channel PTQ, deterministic round-to-nearest
+(stochastic rounding is for *gradients* — ``parallel.quantize`` — where
+bias accumulates over steps; a weight is quantized once):
+
+- ``quantize_weight`` / ``dequantize_weight``: one f32 scale per output
+  channel (``amax / 127`` along ``axis``), int8 payload.
+- ``Int8Quantizer``: the serving-container form.  ``quantize()`` maps a
+  params pytree (list or dict, the ``fleet.HotSwapApply`` currency)
+  into its int8 representation — every float leaf with
+  ``ndim >= min_ndim`` becomes a payload/scale *pair* of leaves
+  (``k`` + ``k::scale`` for dicts, adjacent entries for sequences);
+  1-D leaves (bias, norm stats) stay full precision, where they are
+  numerically load-bearing and byte-wise irrelevant.  ``wrap()`` turns
+  an ``fn(params, *batch_leaves)`` into the int8-consuming form with
+  the **dequant folded inside** — jit ``wrap(fn)`` and the compiled
+  program's weight arguments are int8 (the committed
+  ``serving_mlp_grid_int8`` budget golden measures exactly this).
+
+Because ``quantize()`` is deterministic and shape/dtype-stable, it is
+also the fleet's snapshot-ingest transform: ``WeightUpdater`` runs an
+f32 training snapshot through the fleet's quantizer before
+``validate_params``, so rolling updates from an f32 training job stream
+into an int8 fleet without a recompile or a dtype-drift rejection.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["quantize_weight", "dequantize_weight", "Int8Quantizer"]
+
+#: dict-container key suffix pairing a scale leaf with its payload
+SCALE_SUFFIX = "::scale"
+
+
+def quantize_weight(w, axis=0):
+    """Symmetric per-channel int8 quantization of one weight.
+
+    Returns ``(q, scales)``: ``q`` int8 with ``w``'s shape, ``scales``
+    f32 of shape ``(w.shape[axis],)`` (``amax / 127`` per channel; an
+    all-zero channel gets scale 1 so dequantization is exact).
+    Deterministic round-to-nearest."""
+    x = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis % x.ndim)
+    amax = jnp.max(jnp.abs(x), axis=reduce_axes)
+    # != 0, not > 0: a NaN channel must keep its NaN scale so the
+    # quantized leaf dequantizes non-finite and the fleet's
+    # validate_params all-finite gate rejects the snapshot — `> 0`
+    # would launder the NaN into a finite zeroed weight
+    scales = jnp.where(amax != 0, amax / 127.0, 1.0)
+    q = jnp.round(x / _channel_view(scales, x.ndim, axis))
+    return jnp.clip(q, -127.0, 127.0).astype(jnp.int8), scales
+
+
+def dequantize_weight(q, scales, axis=0, dtype=jnp.float32):
+    """Inverse of ``quantize_weight`` (jit-safe: this is the fold-in
+    the compiled serving apply runs per step)."""
+    return (q.astype(jnp.float32)
+            * _channel_view(scales, q.ndim, axis)).astype(dtype)
+
+
+def _channel_view(scales, ndim, axis):
+    shape = [1] * ndim
+    shape[axis % ndim] = -1
+    return jnp.reshape(scales, shape)
+
+
+def _is_quantized_payload(leaf):
+    return getattr(leaf, "dtype", None) == jnp.int8
+
+
+class Int8Quantizer:
+    """Container-level int8 PTQ for serving params (see module doc).
+
+    ``axis`` is the per-channel scale axis of the quantized weights —
+    0 for MXNet-layout ``(units, in_units)`` Dense kernels, the last
+    axis for ``x @ w`` math-layout kernels.  Leaves with fewer than
+    ``min_ndim`` dims (or non-float dtypes) pass through unquantized.
+    """
+
+    def __init__(self, axis=0, min_ndim=2):
+        self.axis = int(axis)
+        self.min_ndim = int(min_ndim)
+
+    def _quantizes(self, leaf):
+        arr = np.asarray(leaf) if not hasattr(leaf, "dtype") else leaf
+        return (np.issubdtype(np.dtype(str(arr.dtype)), np.floating)
+                and arr.ndim >= self.min_ndim)
+
+    def quantize(self, params):
+        """f32 container → int8 container (payload/scale leaf pairs).
+
+        Deterministic, so re-quantizing the same snapshot always yields
+        the same leaves — the property ``validate_params`` relies on
+        when a rolling update re-ingests f32 training snapshots."""
+        if isinstance(params, dict):
+            out = {}
+            for k, v in params.items():
+                if str(k).endswith(SCALE_SUFFIX) or _is_quantized_payload(v):
+                    raise ValueError(
+                        f"Int8Quantizer.quantize: leaf {k!r} already "
+                        f"looks quantized — quantize() ingests "
+                        f"full-precision containers only")
+                if self._quantizes(v):
+                    q, s = quantize_weight(v, self.axis)
+                    out[k] = q
+                    out[f"{k}{SCALE_SUFFIX}"] = s
+                else:
+                    out[k] = jnp.asarray(v)
+            return out
+        out = []
+        for v in params:
+            if _is_quantized_payload(v):
+                raise ValueError(
+                    "Int8Quantizer.quantize: int8 leaf in input — "
+                    "quantize() ingests full-precision containers only")
+            if self._quantizes(v):
+                q, s = quantize_weight(v, self.axis)
+                out.extend((q, s))
+            else:
+                out.append(jnp.asarray(v))
+        return out
+
+    def dequantize(self, qparams, dtype=jnp.float32):
+        """int8 container → full-precision container in the ORIGINAL
+        layout (payload/scale pairs collapse back to one leaf).
+        jit-safe — ``wrap`` runs it inside the compiled apply."""
+        if isinstance(qparams, dict):
+            out = {}
+            for k, v in qparams.items():
+                if str(k).endswith(SCALE_SUFFIX):
+                    continue
+                if _is_quantized_payload(v):
+                    out[k] = dequantize_weight(
+                        v, qparams[f"{k}{SCALE_SUFFIX}"], self.axis, dtype)
+                else:
+                    out[k] = v
+            return out
+        out, i = [], 0
+        while i < len(qparams):
+            v = qparams[i]
+            if _is_quantized_payload(v):
+                out.append(dequantize_weight(v, qparams[i + 1], self.axis,
+                                             dtype))
+                i += 2
+            else:
+                out.append(v)
+                i += 1
+        return out
+
+    def wrap(self, fn, dtype=jnp.float32):
+        """``fn(params, *leaves)`` → ``qfn(qparams, *leaves)`` with the
+        dequant folded in.  jit the result and the compiled program's
+        weight arguments are the int8 payloads + f32 scales — the
+        quartered weight buffer the serving budget golden commits."""
+        def qfn(qparams, *leaves):
+            return fn(self.dequantize(qparams, dtype), *leaves)
+        return qfn

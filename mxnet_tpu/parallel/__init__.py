@@ -20,6 +20,8 @@ from .sequence import ring_attention, sp_attention, ulysses_attention
 from .prefetch import DevicePrefetcher
 from .step import (EvalStep, TrainStep, add_transfer_hook,
                    remove_transfer_hook)
+from .quantize import (GRAD_REDUCE_MODES, cast_bf16, dequantize_chunked,
+                       quantize_chunked, reduce_gradients)
 from .checkpoint import (CheckpointManager, CheckpointMismatchError,
                          list_checkpoints, load_snapshot_params,
                          load_train_step, load_train_step_sharded,
@@ -42,4 +44,6 @@ __all__ = [
     "MoEFFN", "moe_dispatch",
     "EvalStep", "TrainStep", "DevicePrefetcher",
     "add_transfer_hook", "remove_transfer_hook",
+    "GRAD_REDUCE_MODES", "quantize_chunked", "dequantize_chunked",
+    "cast_bf16", "reduce_gradients",
 ]

@@ -35,6 +35,8 @@ from .sharding import ShardingRules, batch_spec, param_sharding
 from .functional import (FunctionalState, functional_call,
                          param_names_and_values, trainable_split)
 from .functional_opt import pure_update, state_template
+from . import quantize as _quantize
+from .mesh import shard_map as _shard_map
 
 __all__ = ["TrainStep", "EvalStep", "all_finite_rows", "add_transfer_hook",
            "remove_transfer_hook"]
@@ -88,6 +90,11 @@ def _leaves(args):
     return [a._data for a in nds], tree
 
 
+# decorrelates the gradient-quantizer rounding stream from the forward
+# pass's dropout stream (both fold from the step's one PRNG key)
+_GRADQ_SALT = 0x6A5D
+
+
 def _coerce_arrays(v):
     """Accept raw numpy / jax arrays as batch leaves (wrap into NDArray so
     they flatten as data, not as static tree structure).  numpy stays in
@@ -133,7 +140,8 @@ class TrainStep:
 
     def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
                  data_spec=None, loss_reduce="mean", donate_batch=False,
-                 skip_nonfinite=False, nonfinite_budget=10):
+                 skip_nonfinite=False, nonfinite_budget=10,
+                 grad_reduce="f32"):
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -158,6 +166,34 @@ class TrainStep:
         # diverges; ``nonfinite_budget=None`` disables the abort.
         self._skip_nonfinite = bool(skip_nonfinite)
         self._nonfinite_budget = nonfinite_budget
+        # grad_reduce selects the cross-device gradient wire format:
+        # "f32" keeps the implicit sharding-inserted full-precision
+        # collective; "bf16"/"int8" route the backward pass through an
+        # explicit shard_map reduction stage over the dp axis
+        # (parallel.quantize.reduce_gradients) — same jitted program,
+        # compressed collective payloads, stochastic rounding driven by
+        # the step's PRNG key.  Quantized modes need a pure
+        # data-parallel mesh: the explicit stage replicates params per
+        # device, which a tp/fsdp-sharded layout would contradict.
+        if grad_reduce not in _quantize.GRAD_REDUCE_MODES:
+            raise ValueError(
+                f"TrainStep: grad_reduce={grad_reduce!r} not in "
+                f"{_quantize.GRAD_REDUCE_MODES}")
+        if grad_reduce != "f32":
+            if "dp" not in self.mesh.shape:
+                raise ValueError(
+                    f"TrainStep: grad_reduce={grad_reduce!r} needs a "
+                    f"'dp' mesh axis to reduce over (mesh axes: "
+                    f"{dict(self.mesh.shape)})")
+            extra = {a: s for a, s in self.mesh.shape.items()
+                     if a != "dp" and s > 1}
+            if extra:
+                raise ValueError(
+                    f"TrainStep: grad_reduce={grad_reduce!r} supports "
+                    f"pure data-parallel meshes only; model-parallel "
+                    f"axes {extra} shard the params the explicit "
+                    f"reduction stage would replicate")
+        self._grad_reduce = grad_reduce
         self.skipped_steps = 0
         self.consecutive_skips = 0
         self._skip_counter = _profiler.Counter(
@@ -239,31 +275,75 @@ class TrainStep:
             data_leaves = list(batch[:n_data])
             label_leaves = list(batch[n_data:])
 
-            def loss_of(ta):
-                pa = [None] * len(plist)
-                for i, a in zip(train_idx, ta):
-                    pa[i] = a
-                for i, a in zip(aux_idx, aux_arrays):
-                    pa[i] = a
-                # mesh visible to mesh-aware ops (ring/ulysses attention)
-                with MeshScope(self.mesh):
-                    outs = functional_call(net, plist, pa, data_tree,
-                                           data_leaves, key, True,
-                                           state_holder)
-                out_nd = _unflatten_nd(state_holder.out_tree,
-                                       tuple(NDArray(o) for o in outs))
-                lab_nd = _unflatten_nd(label_tree,
-                                       tuple(NDArray(l) for l in label_leaves))
-                if isinstance(lab_nd, tuple) and len(lab_nd) == 1:
-                    lab_nd = lab_nd[0]
-                loss = loss_fn(out_nd, lab_nd)
-                lv = loss._data if isinstance(loss, NDArray) else loss
-                lv = jnp.mean(lv) if reduce == "mean" else jnp.sum(lv)
-                mut = [m for _, m in state_holder.mutated]
-                return lv.astype(jnp.float32), mut
+            def value_grad(ta_in, aux_in, key_in, dl, ll):
+                def loss_of(ta):
+                    pa = [None] * len(plist)
+                    for i, a in zip(train_idx, ta):
+                        pa[i] = a
+                    for i, a in zip(aux_idx, aux_in):
+                        pa[i] = a
+                    # mesh visible to mesh-aware ops (ring/ulysses attn)
+                    with MeshScope(self.mesh):
+                        outs = functional_call(net, plist, pa, data_tree,
+                                               dl, key_in, True,
+                                               state_holder)
+                    out_nd = _unflatten_nd(state_holder.out_tree,
+                                           tuple(NDArray(o) for o in outs))
+                    lab_nd = _unflatten_nd(label_tree,
+                                           tuple(NDArray(l) for l in ll))
+                    if isinstance(lab_nd, tuple) and len(lab_nd) == 1:
+                        lab_nd = lab_nd[0]
+                    loss = loss_fn(out_nd, lab_nd)
+                    lv = loss._data if isinstance(loss, NDArray) else loss
+                    lv = jnp.mean(lv) if reduce == "mean" else jnp.sum(lv)
+                    mut = [m for _, m in state_holder.mutated]
+                    return lv.astype(jnp.float32), mut
 
-            (loss, mut), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(train_arrays)
+                return jax.value_and_grad(loss_of, has_aux=True)(ta_in)
+
+            if self._grad_reduce == "f32":
+                # implicit path: grads of the sharded-batch loss — the
+                # SPMD partitioner inserts the full-precision all-reduce
+                (loss, mut), grads = value_grad(
+                    train_arrays, aux_arrays, key, data_leaves,
+                    label_leaves)
+            else:
+                # explicit path: per-device local grads inside shard_map,
+                # reduced by parallel.quantize with a compressed wire
+                # format.  The local loss is the mean/sum over the LOCAL
+                # shard; pmean/psum restores the global reduction (equal
+                # shard sizes — sharding already guarantees that).
+                dp = self.mesh.shape["dp"]
+                mode = self._grad_reduce
+
+                def local_step(ta, aux, k, *leaves):
+                    # per-device key: forward RNG (dropout) and the
+                    # rounding streams decorrelate across replicas
+                    dk = jax.random.fold_in(k, jax.lax.axis_index("dp"))
+                    (lv, mu), gr = value_grad(ta, aux, dk,
+                                              list(leaves[:n_data]),
+                                              list(leaves[n_data:]))
+                    gr = _quantize.reduce_gradients(
+                        gr, "dp", dp, mode=mode,
+                        key=jax.random.fold_in(dk, _GRADQ_SALT),
+                        reduce=reduce)
+                    lv = (jax.lax.pmean if reduce == "mean"
+                          else jax.lax.psum)(lv, "dp")
+                    # aux updates (BN running stats) are per-shard here:
+                    # average the float ones; anything non-float is
+                    # assumed replica-identical
+                    mu = [jax.lax.pmean(m, "dp")
+                          if jnp.issubdtype(m.dtype, jnp.floating) else m
+                          for m in mu]
+                    return lv, mu, gr
+
+                repl = PartitionSpec()
+                loss, mut, grads = _shard_map(
+                    local_step, mesh=self.mesh,
+                    in_specs=(repl, repl, repl)
+                    + tuple([self._data_pspec] * len(batch)),
+                    out_specs=(repl, repl, repl),
+                    check_vma=False)(train_arrays, aux_arrays, key, *batch)
             t1 = t + 1
             new_train, new_states = [], []
             for k, (w, g, s) in enumerate(zip(train_arrays, grads, states)):

@@ -87,11 +87,21 @@ class HotSwapApply:
     rollback.  As long as the new leaves match the old leaf-for-leaf in
     shape and dtype, the jitted fn keeps hitting the SAME executables:
     a weight update is a pointer swap, never a recompile.
+
+    ``quantizer`` (optional) is the ingest transform for a
+    reduced-precision fleet — typically ``amp.Int8Quantizer().quantize``
+    with ``fn`` built via ``Int8Quantizer.wrap``.  It maps a
+    full-precision training snapshot into this fleet's served
+    representation; ``WeightUpdater`` runs incoming snapshots through
+    it BEFORE ``validate_params``, so an f32 training job streams
+    rolling updates into an int8 fleet instead of tripping the
+    dtype-drift rejection.
     """
 
-    def __init__(self, fn, params):
+    def __init__(self, fn, params, quantizer=None):
         self._fn = fn
         self.params = params
+        self.quantizer = quantizer
 
     def __call__(self, *leaves):
         return self._fn(self.params, *leaves)
@@ -260,14 +270,16 @@ class ServingFleet:
         self._c_rollbacks = _profiler.Counter(None, f"{name}::rollbacks")
 
     @classmethod
-    def replicated(cls, fn, params, n, **kw):
+    def replicated(cls, fn, params, n, quantizer=None, **kw):
         """A fleet of ``n`` replicas of one jitted ``fn(params,
         *batch_leaves)``, each with its own hot-swappable ``params``
         slot (initially shared refs — a rolling update re-points them
         one replica at a time).  One jit cache serves the whole fleet,
         so the executable census of the bucket grid covers ALL replicas,
-        not each."""
-        return cls([HotSwapApply(fn, list(params)) for _ in range(n)], **kw)
+        not each.  ``quantizer`` (see ``HotSwapApply``) makes this an
+        int8 fleet that keeps accepting f32 training snapshots."""
+        return cls([HotSwapApply(fn, list(params), quantizer=quantizer)
+                    for _ in range(n)], **kw)
 
     # ------------------------------------------------------------ lifecycle --
     def start(self, warmup=None):
@@ -777,7 +789,11 @@ class WeightUpdater:
     ``checkpoint.wait_for_new``, validates each new snapshot against the
     currently-served params (``validate_params`` — shape/dtype identity
     so executables survive, all-finite so poison never ships), then
-    rolls it across the fleet one replica at a time::
+    rolls it across the fleet one replica at a time.  A fleet whose
+    applies carry a ``quantizer`` (int8 serving via
+    ``amp.Int8Quantizer``) re-quantizes each full-precision snapshot
+    into the served representation BEFORE validation — an f32 training
+    job streams into a reduced-precision fleet without recompiles::
 
         quarantine → drain in-flight → hot-swap params → probe → readmit
 
@@ -838,6 +854,20 @@ class WeightUpdater:
             params, _names = load_snapshot_params(str(snapshot))
         else:
             params = snapshot            # container kind is validated
+        quantizer = getattr(self.fleet.replicas[0].apply, "quantizer", None)
+        if quantizer is not None:
+            # reduced-precision fleet: snapshots arrive full-precision
+            # from the training job — re-quantize into the served
+            # representation BEFORE validation, so validate_params
+            # compares like for like and an f32 rolling update into an
+            # int8 fleet is routine, not a dtype-drift rejection
+            try:
+                params = quantizer(params)
+            except Exception as exc:
+                self.skipped += 1
+                raise SnapshotRejectedError(
+                    f"snapshot failed the fleet's quantizer ({exc}) — "
+                    f"not applied to any replica") from exc
         try:
             new_params = validate_params(
                 params, self.fleet.replicas[0].apply.params)

@@ -25,10 +25,10 @@ if str(REPO) not in sys.path:
     sys.path.insert(0, str(REPO))
 
 from tools import costguard  # noqa: E402
-from tools.costguard import (Program, diff_report,  # noqa: E402
-                             executable_census, grid_signatures,
-                             instruction_counts, load_golden,
-                             report_for_programs, run_check)
+from tools.costguard import (Program, collective_payload_bytes,  # noqa: E402
+                             diff_report, executable_census,
+                             grid_signatures, instruction_counts,
+                             load_golden, report_for_programs, run_check)
 from tools.costguard import entrypoints  # noqa: E402
 from tools.costguard.report import donation_counts  # noqa: E402
 
@@ -83,6 +83,60 @@ def test_serving_grid_report_counts_every_signature():
     assert rep["n_executables"] == built.census == 6
     # 2 matmuls per executable, summed across the grid
     assert rep["instructions"]["dot"] == 12
+
+
+def test_collective_payload_bytes_parser():
+    """Result-shape byte accounting of entry collectives: async pairs
+    count once (-start skipped), tuple shapes (the CPU all-to-all form)
+    sum per-peer buffers, non-collectives are ignored."""
+    hlo = textwrap.dedent("""\
+        HloModule jit_f
+
+        ENTRY %main (x: f32[8]) -> f32[32] {
+          %x = f32[8]{0} parameter(0)
+          %ar = f32[8]{0} all-reduce(%x), replica_groups={}
+          %a2a = (s8[1,4]{1,0}, s8[1,4]{1,0}) all-to-all(s8[1,4]{1,0} %q, s8[1,4]{1,0} %q2), dimensions={0}
+          %ags = (f32[4]{0}, f32[32]{0}) all-gather-start(f32[4]{0} %p), dimensions={0}
+          ROOT %agd = f32[32]{0} all-gather-done(%ags)
+        }
+        """)
+    # 8*4 (all-reduce) + 2*4 (s8 tuple) + 32*4 (the -done; -start skipped)
+    assert collective_payload_bytes(hlo) == 32 + 8 + 128
+    assert instruction_counts(hlo)["collective"] == 4
+
+
+# --------------------------------------- ISSUE 8: committed byte budgets --
+def test_gradq_int8_collective_byte_budget():
+    """The tentpole's headline, pinned: the committed int8
+    gradient-collective golden moves >= 25% fewer collective payload
+    bytes than its f32 sibling.  This diffs the TWO COMMITTED goldens —
+    the win regresses in tier-1 if either side drifts, independently of
+    each golden's own tolerance gate."""
+    f32 = load_golden("mnist_mlp_train", REPO)["report"]
+    q8 = load_golden("mnist_mlp_train_gradq_int8", REPO)["report"]
+    assert f32["collective_bytes"] > 0
+    assert q8["collective_bytes"] <= 0.75 * f32["collective_bytes"], (
+        f"int8 grad collectives moved {q8['collective_bytes']} bytes vs "
+        f"f32's {f32['collective_bytes']} — the committed >=25% "
+        f"reduction no longer holds")
+    # same model, same pinned-executable contract
+    assert q8["n_executables"] == f32["n_executables"] == 1
+
+
+def test_serving_int8_weight_buffer_budget():
+    """The serving-side headline, pinned the same way: the int8 grid's
+    compiled weight buffer (argument bytes — weights are jit ARGUMENTS
+    in the HotSwapApply serving shape) is >= 25% smaller than the f32
+    grid's, over the identical bucket census."""
+    f32 = load_golden("serving_mlp_grid", REPO)["report"]
+    q8 = load_golden("serving_mlp_grid_int8", REPO)["report"]
+    assert f32["memory"]["argument_bytes"] > 0
+    assert q8["memory"]["argument_bytes"] <= \
+        0.75 * f32["memory"]["argument_bytes"], (
+            f"int8 serving weight buffer {q8['memory']['argument_bytes']}"
+            f" vs f32 {f32['memory']['argument_bytes']} — the committed "
+            f">=25% reduction no longer holds")
+    assert q8["n_executables"] == f32["n_executables"] == 6
 
 
 # ----------------------------------------------------------------- census --
@@ -314,7 +368,32 @@ def test_bench_cost_fields(monkeypatch):
                               mesh=parallel.make_mesh(dp=-1))
     step(np.zeros((8, 8), np.float32), np.zeros((8, 4), np.float32))
     fields = bench._cost_fields(step)
-    assert set(fields) == {"flops_T", "bytes_GB", "n_executables"}
+    assert set(fields) == {"flops_T", "bytes_GB", "n_executables",
+                           "grad_reduce"}
     assert fields["n_executables"] == 1
+    assert fields["grad_reduce"] == "f32"
     monkeypatch.setenv("MXTPU_BENCH_COSTS", "0")
     assert bench._cost_fields(step) == {}
+
+
+def test_bench_quant_knob(monkeypatch):
+    """MXTPU_BENCH_QUANT selects the bench grad_reduce mode and the
+    JSON line records what was measured."""
+    import bench
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    monkeypatch.delenv("MXTPU_BENCH_QUANT", raising=False)
+    assert bench._quant_mode() == "f32"
+    monkeypatch.setenv("MXTPU_BENCH_QUANT", "int8")
+    assert bench._quant_mode() == "int8"
+    net = gluon.nn.Dense(4, in_units=8)
+    net.initialize()
+    step = parallel.TrainStep(net, gluon.loss.L2Loss(),
+                              mx.optimizer.create("sgd", learning_rate=0.1),
+                              mesh=parallel.make_mesh(dp=-1),
+                              grad_reduce=bench._quant_mode())
+    step(np.zeros((8, 8), np.float32), np.zeros((8, 4), np.float32))
+    assert bench._cost_fields(step)["grad_reduce"] == "int8"
+    monkeypatch.setenv("MXTPU_BENCH_QUANT", "int4")
+    with pytest.raises(SystemExit):
+        bench._quant_mode()

@@ -26,6 +26,11 @@ weight update, and finally a SIGTERM drain.  The contract: **zero
 dropped accepted requests** end to end (every fleet-accepted request
 resolves with a result) and **zero recompiles** (the runtime jit-cache
 count equals the static bucket census before and after both swaps).
+A second leg (ISSUE 8) then boots an **int8 fleet** (per-channel PTQ
+weights via ``amp.Int8Quantizer``, dequant folded into the compiled
+apply) and streams a fresh **f32** training snapshot through a rolling
+update under traffic — re-quantized on ingest by the fleet's
+quantizer, 0 drops, census unchanged.
 
 ``--mode lint`` runs the full mxlint analyzer twice against a fresh
 cache directory and asserts the second (fully cached) run is >= 5x
@@ -157,6 +162,117 @@ def serve_mode(args):
           f"request resolved ({oks} served, {errs} explicitly errored, "
           f"0 dropped)")
     return 0
+
+
+def _fleet_int8_leg(step, mgr):
+    """ISSUE 8 leg: an int8 fleet (per-channel PTQ weights, dequant
+    folded into the compiled apply) ingests an f32 training snapshot
+    through a rolling update under live traffic — 0 dropped accepted
+    requests, executable census unchanged.  Returns failure strings."""
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu import amp, serving
+    from mxnet_tpu.parallel.checkpoint import load_snapshot_params
+    from tools.costguard import executable_census
+
+    params, _names = load_snapshot_params(mgr.checkpoints()[-1][1])
+    shapes = [tuple(p.shape) for p in params]
+    iw1, ib1 = shapes.index((16, 8)), shapes.index((16,))
+    iw2, ib2 = shapes.index((4, 16)), shapes.index((4,))
+    quant = amp.Int8Quantizer(axis=0)      # (units, in_units) kernels
+
+    def fwd(p, x):
+        h = jnp.maximum(x @ p[iw1].T + p[ib1], 0.0)
+        return h @ p[iw2].T + p[ib2]
+
+    qfn = jax.jit(quant.wrap(fwd))
+    fleet = serving.ServingFleet.replicated(
+        qfn, quant.quantize([jnp.asarray(p) for p in params]), 3,
+        quantizer=quant.quantize, buckets=(1, 2, 4), max_delay=0.002,
+        sample=np.ones((8,), np.float32), name="ChaosInt8Fleet")
+    fleet.start()
+    census = executable_census(fleet.buckets)
+    updater = serving.WeightUpdater(fleet, mgr, poll=0.02).start()
+    n_int8 = sum(1 for p in fleet.replicas[0].apply.params
+                 if p.dtype == jnp.int8)
+    print(f"[chaos_check] int8 fleet: 3 replicas up, census={census}, "
+          f"{n_int8} int8 weight payload(s) served")
+
+    accepted, sheds = [], [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(k):
+        r = np.random.RandomState(100 + k).randn(8).astype(np.float32)
+        while not stop.is_set():
+            try:
+                req = fleet.submit(r)
+                with lock:
+                    accepted.append(req)
+            except serving.RejectedError:
+                with lock:
+                    sheds[0] += 1
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(2)]
+    for t in threads:
+        t.start()
+    fails = []
+    try:
+        time.sleep(0.1)
+        # one more f32 training step -> a fresh f32 snapshot the int8
+        # fleet must re-quantize on ingest
+        rng = np.random.RandomState(42)
+        step(rng.randn(16, 8).astype(np.float32),
+             rng.randint(0, 4, (16,)))
+        mgr.save()
+        t0 = time.time()
+        while updater.applied < 1 and time.time() - t0 < 30:
+            time.sleep(0.01)
+        if updater.applied < 1:
+            fails.append(f"int8 fleet: f32 snapshot did not roll out "
+                         f"within 30s (applied={updater.applied}, "
+                         f"skipped={updater.skipped})")
+        time.sleep(0.1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        updater.stop(timeout=10)
+        drained = fleet.drain(timeout=30)
+    resolved = sum(1 for r in accepted if r.done())
+    errs = [r.exception(0) for r in accepted
+            if r.done() and r.exception(0) is not None]
+    print(f"[chaos_check] int8 fleet: accepted={len(accepted)} "
+          f"resolved={resolved} errored={len(errs)} shed={sheds[0]} "
+          f"swaps={fleet.stats['swaps']} jit_cache={qfn._cache_size()}")
+    if not drained:
+        fails.append("int8 fleet: drain did not complete")
+    if resolved != len(accepted):
+        fails.append(f"int8 fleet: {len(accepted) - resolved} accepted "
+                     f"requests dropped")
+    if errs:
+        fails.append(f"int8 fleet: {len(errs)} accepted requests errored "
+                     f"(first: {errs[0]!r})")
+    if qfn._cache_size() > census:
+        fails.append(f"int8 fleet: recompile leak — jit cache "
+                     f"{qfn._cache_size()} > census {census}")
+    if n_int8 != 2:        # both Dense kernels; biases stay f32
+        fails.append(f"int8 fleet: expected 2 int8 weight payloads, "
+                     f"served {n_int8}")
+    # the rolled-out weights are the NEW snapshot's, re-quantized
+    new_params, _ = load_snapshot_params(mgr.checkpoints()[-1][1])
+    ref = quant.dequantize(quant.quantize(
+        [jnp.asarray(p) for p in new_params]))
+    x1 = np.ones((1, 8), np.float32)
+    want = np.asarray(fwd([np.asarray(r) for r in ref], x1))[0]
+    got = np.asarray(fleet.replicas[0].apply(x1))[0]
+    if not np.allclose(got, want, atol=1e-5):
+        fails.append("int8 fleet: replica 0 does not serve the "
+                     "re-quantized final snapshot")
+    return fails
 
 
 def fleet_mode(args):
@@ -310,6 +426,8 @@ def fleet_mode(args):
     got = np.asarray(applies[0](np.ones((1, 8), np.float32)))[0]
     if not np.allclose(got, want):
         fails.append("replica 0 does not serve the final snapshot weights")
+    # ISSUE 8 leg: f32 snapshot -> int8 fleet rolling update
+    fails += _fleet_int8_leg(step, mgr)
     if fails:
         for f in fails:
             print(f"[chaos_check] FAIL: {f}")
@@ -317,7 +435,8 @@ def fleet_mode(args):
     print(f"[chaos_check] PASS: replica kill + 2 rolling updates + SIGTERM "
           f"with 0 dropped accepted requests, 0 recompiles "
           f"({len(set(traces))}/{census} executables), "
-          f"{st['redispatched']} failovers")
+          f"{st['redispatched']} failovers; int8-fleet f32-snapshot "
+          f"rolling update clean")
     return 0
 
 

@@ -32,8 +32,9 @@ from .budget import (DEFAULT_TOLERANCES, CheckResult, EntryResult,
                      golden_path, load_golden, run_check)
 from .census import executable_census, grid_signatures
 from .entrypoints import EntryBuild, build, entrypoint, names, source_of
-from .report import (REPORT_VERSION, Program, instruction_counts,
-                     merge_reports, report_for_programs, unit_report)
+from .report import (REPORT_VERSION, Program, collective_payload_bytes,
+                     instruction_counts, merge_reports,
+                     report_for_programs, unit_report)
 
 __all__ = [
     "DEFAULT_TOLERANCES", "CheckResult", "EntryResult", "MetricRow",
@@ -41,6 +42,7 @@ __all__ = [
     "load_golden", "run_check",
     "executable_census", "grid_signatures",
     "EntryBuild", "build", "entrypoint", "names", "source_of",
-    "REPORT_VERSION", "Program", "instruction_counts", "merge_reports",
-    "report_for_programs", "unit_report",
+    "REPORT_VERSION", "Program", "collective_payload_bytes",
+    "instruction_counts", "merge_reports", "report_for_programs",
+    "unit_report",
 ]

@@ -37,6 +37,7 @@ DEFAULT_TOLERANCES: Dict[str, float] = {
     "flops": 0.01,
     "bytes_accessed": 0.02,
     "transcendentals": 0.05,
+    "collective_bytes": 0.02,
     "n_executables": 0.0,
     "memory.peak_bytes": 0.25,
     "memory.argument_bytes": 0.02,

@@ -79,7 +79,8 @@ def _mesh_and_opt(opt_name="sgd", **opt_kw):
     return mesh, mx.optimizer.create(opt_name, **opt_kw)
 
 
-def resnet50_train_step(batch=8, fused=False, layout="NHWC"):
+def resnet50_train_step(batch=8, fused=False, layout="NHWC",
+                        grad_reduce="f32"):
     """The headline ResNet-50 train step, AOT only — shared by the
     ``resnet50_nhwc_train`` budget entry and ``benchmark/hlo_costs.py``
     (which parameterizes batch/fused for the fused-conv A/B).  Returns
@@ -97,7 +98,7 @@ def resnet50_train_step(batch=8, fused=False, layout="NHWC"):
     mesh, opt = _mesh_and_opt("sgd", learning_rate=0.1, momentum=0.9,
                               wd=1e-4)
     step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
-                              opt, mesh=mesh)
+                              opt, mesh=mesh, grad_reduce=grad_reduce)
     x = np.zeros((batch, 224, 224, 3), ml_dtypes.bfloat16)
     y = np.zeros((batch,), np.int32)
     return step, x, y
@@ -126,10 +127,11 @@ def build_resnet50_nhwc_train(batch=8):
          "batch": batch, "optimizer": "sgd(momentum=0.9, wd=1e-4)"})
 
 
-@entrypoint("mnist_mlp_train")
-def build_mnist_mlp_train(batch=64, dtype="float32"):
+def _mnist_mlp_step(batch=64, dtype="float32", grad_reduce="f32"):
     """The examples/train_mnist_mlp.py recipe: 784-128-10 MLP train
-    step, f32, SGD momentum."""
+    step, f32, SGD momentum — shared by the f32 entry and its
+    ``grad_reduce="int8"`` sibling (same model, same sample batch, so
+    the two goldens diff leaf-for-leaf)."""
     import ml_dtypes
     import numpy as np
 
@@ -144,28 +146,54 @@ def build_mnist_mlp_train(batch=64, dtype="float32"):
         net.cast(dtype)
     mesh, opt = _mesh_and_opt("sgd", learning_rate=0.1, momentum=0.9)
     step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
-                              opt, mesh=mesh)
+                              opt, mesh=mesh, grad_reduce=grad_reduce)
     np_dtype = ml_dtypes.bfloat16 if dtype == "bfloat16" else np.float32
     x = np.zeros((batch, 784), np_dtype)
     y = np.zeros((batch,), np.int32)
+    return step, x, y
+
+
+@entrypoint("mnist_mlp_train")
+def build_mnist_mlp_train(batch=64, dtype="float32"):
+    step, x, y = _mnist_mlp_step(batch=batch, dtype=dtype)
     return _train_step_build(
         "mnist_mlp_train", step, x, y,
         {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
          "optimizer": "sgd(momentum=0.9)"})
 
 
-@entrypoint("serving_mlp_grid")
-def build_serving_mlp_grid(batch_buckets=(1, 2, 4), length_buckets=(8, 16),
-                           features=32, dtype="float32"):
-    """A serving bucket grid: one jitted MLP apply lowered at EVERY
-    padded (batch, length) signature a ``BucketSpec((1,2,4), (8,16))``
-    admits — the whole executable space an ``InferenceServer`` on this
-    spec can ever compile.  n_executables in the golden == the static
-    census == the runtime jit-cache count (tests/test_serving.py).
-    NB the dtype knob exists for on-TPU audits (bf16 serving, ROADMAP
-    item 2), but the committed golden is f32: on the CPU backend bf16
-    compute is EMULATED via converts and *costs* bytes rather than
-    saving them — the PERF.md caveat, visible in the numbers."""
+@entrypoint("mnist_mlp_train_gradq_int8")
+def build_mnist_mlp_train_gradq_int8(batch=64, dtype="float32"):
+    """``mnist_mlp_train`` with ``grad_reduce="int8"``: the explicit
+    shard_map gradient-reduction stage (quantize → all_to_all /
+    all_gather of int8 payloads → dequantize) replacing the implicit
+    f32 all-reduce.  The committed contract vs the f32 golden —
+    asserted by tests/test_costguard.py::test_gradq_int8_collective_
+    byte_budget — is >= 25% fewer ``collective_bytes``.  NB on the CPU
+    backend ``bytes_accessed``/``flops`` go UP (int8 + stochastic
+    rounding are emulated); the wire payload is what this entry
+    budgets.  (ResNet-50 was measured too: its master grads are
+    already bf16, so the int8 modeled-payload win there is marginal —
+    the f32-gradient MLP is the honest A/B.)"""
+    step, x, y = _mnist_mlp_step(batch=batch, dtype=dtype,
+                                 grad_reduce="int8")
+    return _train_step_build(
+        "mnist_mlp_train_gradq_int8", step, x, y,
+        {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
+         "optimizer": "sgd(momentum=0.9)", "grad_reduce": "int8"})
+
+
+def _serving_mlp_grid_build(name, batch_buckets, length_buckets, features,
+                            dtype, quantize):
+    """One jitted MLP apply lowered at EVERY padded (batch, length)
+    signature the ``BucketSpec`` admits — the whole executable space an
+    ``InferenceServer`` on this spec can ever compile.  The params are
+    ARGUMENTS of the jitted fn (the ``fleet.HotSwapApply`` serving
+    shape: a weight update is a pointer swap), so the compiled weight
+    buffer is visible in ``memory.argument_bytes`` — the metric the
+    int8 variant commits a >= 25% reduction on.  n_executables in the
+    golden == the static census == the runtime jit-cache count
+    (tests/test_serving.py, tests/test_quantize.py)."""
     import jax
     import jax.numpy as jnp
 
@@ -174,29 +202,64 @@ def build_serving_mlp_grid(batch_buckets=(1, 2, 4), length_buckets=(8, 16),
     spec = BucketSpec(batch=batch_buckets, length=length_buckets)
     hidden, out = 64, 16
     dt = jnp.dtype(dtype)
-    w1 = jnp.zeros((features, hidden), dt)
-    b1 = jnp.zeros((hidden,), dt)
-    w2 = jnp.zeros((hidden, out), dt)
-    b2 = jnp.zeros((out,), dt)
+    params = [jnp.zeros((features, hidden), dt), jnp.zeros((hidden,), dt),
+              jnp.zeros((hidden, out), dt), jnp.zeros((out,), dt)]
 
-    @jax.jit
-    def apply(x):                      # (batch, length, features)
-        h = jnp.tanh(x @ w1 + b1)
-        return h @ w2 + b2
+    def fwd(p, x):                     # x: (batch, length, features)
+        h = jnp.tanh(x @ p[0] + p[1])
+        return h @ p[2] + p[3]
 
+    meta = {"model": f"mlp {features}-{hidden}-{out} apply",
+            "dtype": dtype, "batch_buckets": list(spec.batch),
+            "length_buckets": list(spec.length)}
+    if quantize:
+        # the int8 serving shape: per-channel PTQ payload/scale pairs as
+        # the compiled program's weight arguments, dequant folded inside
+        from mxnet_tpu.amp import Int8Quantizer
+        quantizer = Int8Quantizer(axis=1)      # x @ w: out-features last
+        params = quantizer.quantize(params)
+        apply = jax.jit(quantizer.wrap(fwd))
+        meta["weights"] = "int8 per-channel PTQ (amp.Int8Quantizer)"
+    else:
+        apply = jax.jit(fwd)
+    p_avals = [jax.ShapeDtypeStruct(p.shape, p.dtype) for p in params]
     programs = []
     for b, L in grid_signatures(spec):
         aval = jax.ShapeDtypeStruct((b, L, features), dt)
         # mxlint: disable=jit-in-loop -- this loop IS the census: one
         # lower per bucket signature, bounded by the static grid, and
         # the expensive compile is memoized by the report cache
-        lowered = apply.lower(aval)
-        programs.append(Program(f"serving_mlp_grid/b{b}_l{L}",
-                                lowered, n_args=1))
-    return EntryBuild(
-        name="serving_mlp_grid",
-        meta={"model": f"mlp {features}-{hidden}-{out} apply",
-              "dtype": dtype,
-              "batch_buckets": list(spec.batch),
-              "length_buckets": list(spec.length)},
-        programs=programs, census=executable_census(spec))
+        lowered = apply.lower(p_avals, aval)
+        programs.append(Program(f"{name}/b{b}_l{L}", lowered,
+                                n_args=len(params) + 1))
+    return EntryBuild(name=name, meta=meta, programs=programs,
+                      census=executable_census(spec))
+
+
+@entrypoint("serving_mlp_grid")
+def build_serving_mlp_grid(batch_buckets=(1, 2, 4), length_buckets=(8, 16),
+                           features=32, dtype="float32"):
+    """The f32 serving bucket grid (see ``_serving_mlp_grid_build``).
+    NB the dtype knob exists for on-TPU audits (bf16 serving, ROADMAP
+    item 2), but the committed golden is f32: on the CPU backend bf16
+    compute is EMULATED via converts and *costs* bytes rather than
+    saving them — the PERF.md caveat, visible in the numbers."""
+    return _serving_mlp_grid_build("serving_mlp_grid", batch_buckets,
+                                   length_buckets, features, dtype,
+                                   quantize=False)
+
+
+@entrypoint("serving_mlp_grid_int8")
+def build_serving_mlp_grid_int8(batch_buckets=(1, 2, 4),
+                                length_buckets=(8, 16), features=32,
+                                dtype="float32"):
+    """``serving_mlp_grid`` with int8 post-training weight quantization:
+    same model, same bucket grid, but the compiled programs take int8
+    payloads + f32 per-channel scales as their weight arguments (the
+    ``amp.Int8Quantizer.wrap`` fold).  The committed contract vs the
+    f32 golden — asserted by tests/test_costguard.py::test_serving_
+    int8_weight_buffer_budget — is >= 25% less compiled weight-buffer
+    memory (``memory.argument_bytes``)."""
+    return _serving_mlp_grid_build("serving_mlp_grid_int8", batch_buckets,
+                                   length_buckets, features, dtype,
+                                   quantize=True)

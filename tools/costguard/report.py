@@ -23,15 +23,41 @@ from typing import Dict, List, Optional
 #: bump when the report schema or extraction logic changes — it keys the
 #: report cache AND is recorded in budget goldens, so a stale cached
 #: report (or a golden from an older schema) can never pass silently
-REPORT_VERSION = "1.0"
+REPORT_VERSION = "1.1"
 
 # entry-computation instruction line:  ``%name = SHAPE opcode(...)``.
 # SHAPE is either a bare token (f32[8,16]{1,0}) or a tuple type — which
-# contains spaces but no nested parens in optimized entry HLO.
+# contains spaces but no nested parens in optimized entry HLO.  Group 1
+# is the result shape (the collective-payload accounting reads it),
+# group 2 the opcode.
 _INSTR_RE = re.compile(
     r"^\s*(?:ROOT\s+)?%?[\w.\-]+\s*=\s*"
-    r"(?:\([^()]*\)|\S+)\s+"
+    r"(\([^()]*\)|\S+)\s+"
     r"([a-z][a-z0-9\-]*)\(")
+
+# one typed buffer inside a (possibly tuple) shape: ``f32[8,16]{1,0}``
+_SHAPE_TOK = re.compile(r"\b(pred|[a-z]+\d+)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s4": 1, "u4": 1, "s8": 1, "u8": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+
+def _shape_bytes(shape_text: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE_TOK.findall(shape_text):
+        unit = _DTYPE_BYTES.get(dt)
+        if unit is None:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d.strip():
+                n *= int(d)
+        total += unit * n
+    return total
 
 # one input/output alias entry on the HloModule header line:
 # ``{0}: (5, {}, may-alias)`` — the parameter number is group 1
@@ -91,9 +117,28 @@ def instruction_counts(hlo_text: str) -> Dict[str, int]:
         if not m:
             continue
         total += 1
-        counts[_CATEGORY.get(m.group(1), "other")] += 1
+        counts[_CATEGORY.get(m.group(2), "other")] += 1
     counts["total"] = total
     return counts
+
+
+def collective_payload_bytes(hlo_text: str) -> int:
+    """Summed result-shape bytes of the ENTRY computation's collective
+    instructions — the gradient/weight *wire* traffic of the program,
+    the number ISSUE 8's quantized collectives exist to shrink.  Async
+    pairs count once (the ``*-start`` half is skipped; its ``-done``
+    carries the payload), and a tuple-shaped result (the CPU backend's
+    all-to-all form) sums its per-peer buffers."""
+    total = 0
+    for line in _entry_lines(hlo_text):
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        op = m.group(2)
+        if _CATEGORY.get(op) != "collective" or op.endswith("-start"):
+            continue
+        total += _shape_bytes(m.group(1))
+    return total
 
 
 def donation_counts(hlo_text: str, n_args: int) -> Dict[str, int]:
@@ -133,6 +178,7 @@ def unit_report(compiled, n_args: int) -> dict:
         "flops": float(costs.get("flops", 0.0)),
         "bytes_accessed": float(costs.get("bytes accessed", 0.0)),
         "transcendentals": float(costs.get("transcendentals", 0.0)),
+        "collective_bytes": float(collective_payload_bytes(text)),
         "memory": mem,
         "donation": donation_counts(text, n_args),
         "instructions": instruction_counts(text),
@@ -154,6 +200,8 @@ def merge_reports(units: List[dict]) -> dict:
         "flops": sum(u["flops"] for u in units),
         "bytes_accessed": sum(u["bytes_accessed"] for u in units),
         "transcendentals": sum(u["transcendentals"] for u in units),
+        "collective_bytes": sum(u.get("collective_bytes", 0.0)
+                                for u in units),
         "memory": {},
         "donation": {
             "donated_args": sum(u["donation"]["donated_args"]
