@@ -30,6 +30,7 @@ from .context import (Context, cpu, tpu, gpu, cpu_pinned,
                       current_context, num_tpus, num_gpus, gpu_memory_info)
 from . import engine
 from . import fault             # mx.fault — injection harness, retry, signals
+from . import elastic           # mx.elastic — heartbeats, supervisor contract
 from . import storage
 from . import random
 from . import autograd

@@ -6,7 +6,7 @@ import logging
 import time
 
 __all__ = ["Speedometer", "do_checkpoint", "do_step_checkpoint",
-           "log_train_metric", "ProgressBar"]
+           "do_heartbeat", "log_train_metric", "ProgressBar"]
 
 
 class BatchEndParam:
@@ -93,6 +93,21 @@ def do_step_checkpoint(manager):
 
     def _callback(param):
         manager.maybe_save()
+
+    return _callback
+
+
+def do_heartbeat(heartbeat):
+    """Batch-end callback driving an ``elastic.Heartbeat`` — the liveness
+    twin of ``do_step_checkpoint``: every batch boundary stamps this
+    rank's heartbeat file so an elastic supervisor's watchdog can tell a
+    slow step from a hung worker (docs/api.md "Elastic training").
+    ``Module.fit`` arms this automatically when launched supervised
+    (``MXTPU_HEARTBEAT_DIR`` set); the explicit form is for custom
+    loops."""
+
+    def _callback(param):
+        heartbeat.beat(phase="train")
 
     return _callback
 

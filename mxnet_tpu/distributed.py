@@ -109,10 +109,19 @@ def init(coordinator=None, num_processes=None, process_id=None,
 
 
 def shutdown():
+    """Tear the coordination service down so a later ``init()`` can
+    rebuild it — the shutdown→re-init round-trip a restarted elastic
+    attempt relies on.  Idempotent; the connected flag (and the barrier
+    sequence counters) reset even when the underlying shutdown raises,
+    so a retrying re-init never wedges on half-torn state."""
     global _initialized
-    if _initialized:
+    if not _initialized:
+        return
+    try:
         jax.distributed.shutdown()
+    finally:
         _initialized = False
+        _barrier_seq.clear()
 
 
 def rank():
@@ -125,11 +134,61 @@ def num_workers():
     return jax.process_count()
 
 
-def barrier(name="barrier"):
-    """ref: KVStore::Barrier (ps-lite Postoffice::Barrier)."""
-    if jax.process_count() > 1:
-        from jax.experimental import multihost_utils
-        multihost_utils.sync_global_devices(name)
+_barrier_seq = {}     # name -> calls so far (same order on every rank)
+
+
+def barrier(name="barrier", timeout=None):
+    """ref: KVStore::Barrier (ps-lite Postoffice::Barrier).
+
+    With ``timeout`` (seconds) the wait is BOUNDED: a barrier against a
+    peer that already died otherwise blocks forever — the exact wedge
+    the elastic watchdog exists to catch from outside.  On expiry a
+    ``TimeoutError`` naming the barrier raises, so a supervised worker
+    fails fast into the gang-restart path instead of hanging until the
+    watchdog fires.  The bounded form rides the coordination-service
+    key-value barrier (no backend collective), with a per-name sequence
+    number so repeated barriers never collide; like every collective,
+    all ranks must reach the same barriers in the same order.
+    ``timeout=None`` keeps the classic unbounded device sync.
+
+    The bounded form deliberately never touches the jax BACKEND (no
+    ``jax.process_count()``): it works between ``init()`` and first
+    compute, which is what lets a shutdown→re-``init()`` round-trip be
+    probed before backends come up (``jax.distributed.initialize`` must
+    precede any computation)."""
+    if timeout is None:
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(name)
+        return
+    from jax._src import distributed as _jax_dist
+    client = getattr(_jax_dist.global_state, "client", None)
+    if client is None:
+        # no coordination service: a single-process run is a no-op, but
+        # a configured gang without a client (barrier between shutdown()
+        # and the next init()) must NOT silently "succeed" — every rank
+        # would believe the gang synchronized when nobody did
+        if int(os.environ.get("DMLC_NUM_WORKER", "0") or 0) > 1:
+            raise RuntimeError(
+                f"barrier {name!r}: no coordination service is connected "
+                f"in a {os.environ['DMLC_NUM_WORKER']}-worker gang — "
+                f"called between shutdown() and init()?")
+        return
+    seq = _barrier_seq.get(name, 0)
+    _barrier_seq[name] = seq + 1
+    try:
+        client.wait_at_barrier(f"mxtpu:{name}:{seq}",
+                               timeout_in_ms=int(float(timeout) * 1000))
+    except Exception as exc:
+        msg = str(exc)
+        if "DEADLINE_EXCEEDED" in msg or "deadline" in msg.lower() \
+                or "timed out" in msg.lower():
+            raise TimeoutError(
+                f"barrier {name!r} timed out after {timeout}s: a peer "
+                f"never arrived (dead or hung worker) — failing fast so "
+                f"the supervisor can tear the gang down and restart "
+                f"from the last snapshot") from exc
+        raise
 
 
 def all_sum(array):

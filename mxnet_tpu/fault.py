@@ -50,6 +50,14 @@ point                        location
                              hot-swap sequence begins
 ``fleet.probe``              fleet quarantine/update probe, before the probe
                              request is submitted
+``supervisor.spawn``         elastic.Supervisor, before spawning a gang
+                             attempt
+``supervisor.heartbeat``     elastic.Supervisor watchdog, before each
+                             heartbeat scan
+``supervisor.watchdog``      elastic.Supervisor watchdog, on declaring a
+                             worker hung
+``supervisor.restart``       elastic.Supervisor, before relaunching the gang
+                             after backoff
 ===========================  ==============================================
 
 This module imports only the standard library (it is pulled in by
@@ -212,6 +220,10 @@ for _p, _w in (
     ("fleet.dispatch", "ServingFleet dispatch, before the chosen replica"),
     ("fleet.swap", "WeightUpdater, before a replica's param hot-swap"),
     ("fleet.probe", "fleet quarantine/update probe, before submitting"),
+    ("supervisor.spawn", "elastic.Supervisor, before spawning a gang"),
+    ("supervisor.heartbeat", "elastic.Supervisor watchdog, per scan"),
+    ("supervisor.watchdog", "elastic.Supervisor, on declaring a hang"),
+    ("supervisor.restart", "elastic.Supervisor, before the relaunch"),
 ):
     register_point(_p, _w)
 del _p, _w

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from . import callback as _callback
+from . import elastic as _elastic
 from . import fault as _fault
 from . import initializer as _init
 from . import metric as _metric
@@ -390,6 +391,12 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
         nx = getattr(src, "next", None)
         return nx if callable(nx) else iter(src).__next__
 
+    # supervised runs (tools/launch.py exports MXTPU_HEARTBEAT_DIR) stamp
+    # a per-rank heartbeat every batch so the supervisor's watchdog can
+    # tell a slow step from a hung one; unsupervised runs get None and
+    # pay nothing
+    heartbeat = _elastic.Heartbeat.from_env()
+
     start_epoch, skip_batches = 0, 0
     if resume:
         if not checkpoint_prefix:
@@ -464,7 +471,18 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
                             epoch=epoch, nbatch=nbatch,
                             eval_metric=eval_metric))
                     nbatch += 1
+                    if heartbeat is not None:
+                        # stamp the OPTIMIZER's update count (restored by
+                        # resume), not a from-zero batch counter: a
+                        # resumed attempt must report its real position
+                        # or the post-mortem progress reads near-zero
+                        # while the checkpoint says step 10000
+                        heartbeat.beat(
+                            int(_opt_owner(mod)._optimizer.num_update),
+                            phase="train")
                     if gexit.requested:
+                        if heartbeat is not None:
+                            heartbeat.beat(phase="snapshot")
                         _save_fit_snapshot(mod, symbol, checkpoint_prefix,
                                            epoch, nbatch)
                         logger.info(
@@ -477,7 +495,12 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
                 logger.info("Epoch[%d] Train-%s=%f  time=%.1fs",
                             epoch, name, val, time.time() - t0)
                 if eval_data is not None:
-                    for name, val in mod.score(eval_data, eval_metric):
+                    # the eval pass beats too (phase "eval"): a long
+                    # validation sweep with no stamps would look exactly
+                    # like a hang to the supervisor's watchdog
+                    for name, val in _score_loop(mod, eval_data,
+                                                 eval_metric,
+                                                 heartbeat=heartbeat):
                         logger.info("Epoch[%d] Validation-%s=%f",
                                     epoch, name, val)
                 if epoch_end_callback:
@@ -662,7 +685,7 @@ def _redeliver_unclaimed(gexit):
         _signal.raise_signal(gexit.signum)
 
 
-def _infer_loop(mod, eval_data, num_batch, on_batch):
+def _infer_loop(mod, eval_data, num_batch, on_batch, heartbeat=None):
     """The interrupt/cleanup scaffold score and predict share.  Both
     honor ``fault.GracefulExit`` (inside an armed latch — fit's, or a
     caller's — a SIGTERM/SIGINT stops at the next batch boundary with
@@ -680,6 +703,8 @@ def _infer_loop(mod, eval_data, num_batch, on_batch):
                     break
                 mod.forward(batch, is_train=False)
                 on_batch(batch)
+                if heartbeat is not None:
+                    heartbeat.beat(phase="eval")
                 if gexit.requested:
                     _close_feed(eval_data)
                     break
@@ -689,12 +714,14 @@ def _infer_loop(mod, eval_data, num_batch, on_batch):
     _redeliver_unclaimed(gexit)
 
 
-def _score_loop(mod, eval_data, eval_metric, num_batch=None):
+def _score_loop(mod, eval_data, eval_metric, num_batch=None,
+                heartbeat=None):
     if isinstance(eval_metric, str):
         eval_metric = _metric.create(eval_metric)
     eval_metric.reset()
     _infer_loop(mod, eval_data, num_batch,
-                lambda batch: mod.update_metric(eval_metric, batch.label))
+                lambda batch: mod.update_metric(eval_metric, batch.label),
+                heartbeat=heartbeat)
     return [eval_metric.get()]
 
 

@@ -23,19 +23,19 @@ from __future__ import annotations
 import json
 import logging
 import os
-import re as _re
 import time as _time
 
 import numpy as np
 import jax
 
 from ..fault import fire as _fire
+from .. import elastic as _elastic
 
 __all__ = ["save_train_step", "load_train_step",
            "save_train_step_sharded", "load_train_step_sharded",
            "CheckpointManager", "CheckpointMismatchError",
-           "resume_latest", "list_checkpoints", "wait_for_new",
-           "load_snapshot_params"]
+           "resume_latest", "list_checkpoints", "latest_checkpoint",
+           "latest_step", "wait_for_new", "load_snapshot_params"]
 
 _MANIFEST = "__manifest__"
 _logger = logging.getLogger(__name__)
@@ -399,15 +399,23 @@ def load_train_step_sharded(step, directory):
 def list_checkpoints(directory, prefix="ckpt"):
     """``(num_update, path)`` pairs for every ``<prefix>-<n>.npz`` in
     ``directory``, ascending by step.  Orphan ``.tmp`` files (a crash
-    mid-write) are ignored — they were never committed."""
-    pat = _re.compile(_re.escape(prefix) + r"-(\d+)\.npz$")
-    out = []
-    if os.path.isdir(directory):
-        for name in os.listdir(directory):
-            m = pat.fullmatch(name)
-            if m:
-                out.append((int(m.group(1)), os.path.join(directory, name)))
-    return sorted(out)
+    mid-write) are ignored — they were never committed.  Delegates to
+    ``elastic.scan_checkpoints`` — the one committed-name parser, shared
+    with the (jax-free) supervisor's progress accounting."""
+    return _elastic.scan_checkpoints(directory, prefix)
+
+
+def latest_checkpoint(directory, prefix="ckpt"):
+    """Newest committed ``(num_update, path)``, or None when empty."""
+    return _elastic.latest_checkpoint(directory, prefix)
+
+
+def latest_step(directory, prefix="ckpt"):
+    """The newest committed snapshot's step count, or None when the
+    directory holds none — the progress probe the elastic supervisor's
+    restart-budget accounting reads (``elastic.latest_committed_step``
+    is the stdlib spelling the supervisor process itself uses)."""
+    return _elastic.latest_committed_step(directory, prefix)
 
 
 def wait_for_new(directory, last_seen=None, timeout=None, prefix="ckpt",
@@ -424,9 +432,9 @@ def wait_for_new(directory, last_seen=None, timeout=None, prefix="ckpt",
     moment it is returned."""
     t_end = None if timeout is None else _time.monotonic() + float(timeout)
     while True:
-        cks = list_checkpoints(directory, prefix)
-        if cks:
-            num_update, path = cks[-1]
+        ck = latest_checkpoint(directory, prefix)
+        if ck is not None:
+            num_update, path = ck
             if last_seen is None or num_update > last_seen:
                 return num_update, path
         if t_end is not None:
@@ -550,6 +558,12 @@ class CheckpointManager:
 
     def checkpoints(self):
         return list_checkpoints(self.directory, self.prefix)
+
+    def latest_step(self):
+        """Newest committed snapshot's step, or None when empty — the
+        one-call progress probe (the supervisor-side twin is
+        ``elastic.latest_committed_step`` on the same directory)."""
+        return latest_step(self.directory, self.prefix)
 
     def resume_latest(self):
         """``resume_latest(step, directory)`` with this manager's step."""

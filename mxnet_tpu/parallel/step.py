@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 from .. import random as _random
 from .. import autograd as _autograd
 from ..fault import fire as _fire
+from ..elastic import NonFiniteAbortError
 from .. import profiler as _profiler
 from ..profiler import scope as _pscope
 from ..ndarray import NDArray
@@ -141,7 +142,7 @@ class TrainStep:
     def __init__(self, net, loss_fn, optimizer, mesh=None, rules=None,
                  data_spec=None, loss_reduce="mean", donate_batch=False,
                  skip_nonfinite=False, nonfinite_budget=10,
-                 grad_reduce="f32"):
+                 grad_reduce="f32", heartbeat=None):
         self.net = net
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -194,6 +195,10 @@ class TrainStep:
                     f"axes {extra} shard the params the explicit "
                     f"reduction stage would replicate")
         self._grad_reduce = grad_reduce
+        # heartbeat: an elastic.Heartbeat stamped after every completed
+        # step (host side, post-dispatch) — the supervised-training
+        # liveness wire (docs/api.md "Elastic training")
+        self._heartbeat = heartbeat
         self.skipped_steps = 0
         self.consecutive_skips = 0
         self._skip_counter = _profiler.Counter(
@@ -475,7 +480,7 @@ class TrainStep:
                         lv = float(np.asarray(loss))
                     except Exception:
                         lv = float("nan")
-                    raise RuntimeError(
+                    raise NonFiniteAbortError(
                         f"TrainStep: {self.consecutive_skips} consecutive "
                         f"non-finite updates (budget {budget}) at "
                         f"num_update={self._num_update}; last loss={lv}. "
@@ -489,6 +494,8 @@ class TrainStep:
              loss) = out
             self._num_update += 1
         self.optimizer.num_update = self._num_update
+        if self._heartbeat is not None:
+            self._heartbeat.beat(self._num_update)
         return NDArray(loss)
 
     # ------------------------------------------------------------- costing --

@@ -19,9 +19,17 @@ _tls = threading.local()
 
 class _EagerState:
     def __init__(self, seed_val: int = 0):
-        self.key = jax.random.key(seed_val)
+        # the key materializes on FIRST DRAW, not at construction:
+        # jax.random.key() initializes the jax backend, and package
+        # import must stay backend-free — jax.distributed.initialize
+        # (and so the elastic shutdown→re-init round-trip) is only legal
+        # before any computation runs
+        self._seed = int(seed_val)
+        self.key = None
 
     def next_key(self):
+        if self.key is None:
+            self.key = jax.random.key(self._seed)
         self.key, sub = jax.random.split(self.key)
         return sub
 

@@ -1,0 +1,136 @@
+"""Elastic-supervised training worker (the ISSUE 9 rehearsal shape; ref:
+tests/dist_worker.py — real multi-process assertions, no mocks).
+
+Spawned by ``elastic.Supervisor`` (``tools/launch.py`` or
+``tools/chaos_check.py --mode elastic``): trains a small dense net with a
+multi-process ``TrainStep`` to a target global step with periodic
+``CheckpointManager`` snapshots, resumes from the newest committed
+snapshot on every attempt, stamps per-rank heartbeats every step, and
+exits with the classified statuses the supervisor reads from outside —
+``EXIT_PREEMPTED`` after the collective snapshot-then-exit on SIGTERM,
+``EXIT_NONFINITE`` on a non-finite abort, nonzero on crash.
+
+Env knobs: ``MXTPU_TARGET_STEP`` (default 12), ``MXTPU_CKPT_DIR``
+(required), ``MXTPU_STEP_SLEEP`` (default 0.05 — keeps work in flight so
+a chaos harness can land kills mid-epoch), ``MXTPU_ROUNDTRIP=1`` adds a
+``distributed.shutdown()`` → re-``init()`` round-trip plus a
+bounded-barrier-against-a-dead-peer probe before training.
+"""
+import os
+import sys
+import time
+
+
+def _roundtrip_probe():
+    """shutdown() → init() must rebuild the coordination service, and a
+    barrier against a dead peer must TimeoutError instead of hanging.
+
+    Runs BEFORE any jax backend touch (rank from env, bounded barriers
+    only): ``jax.distributed.initialize`` must precede computation, so
+    the round-trip contract is a coordination-service property — exactly
+    what a restarted attempt (a fresh process) exercises for real."""
+    from mxnet_tpu import distributed
+
+    r = int(os.environ.get("DMLC_WORKER_ID", "0"))
+    distributed.barrier("rt-before", timeout=60)
+    distributed.shutdown()
+    distributed.init()
+    distributed.barrier("rt-after", timeout=60)
+    print(f"[worker] rank {r} coordination round-trip OK", flush=True)
+    # dead-peer probe: every rank but 0 skips the barrier; rank 0 must
+    # fail fast with a TimeoutError naming the barrier, not hang
+    if r == 0:
+        try:
+            distributed.barrier("dead-peer", timeout=2)
+        except TimeoutError as exc:
+            assert "dead-peer" in str(exc)
+            print("[worker] barrier-timeout OK", flush=True)
+        else:
+            print("[worker] FAIL: dead-peer barrier did not time out",
+                  flush=True)
+            sys.exit(1)
+
+
+def main():
+    target = int(os.environ.get("MXTPU_TARGET_STEP", "12"))
+    step_sleep = float(os.environ.get("MXTPU_STEP_SLEEP", "0.05"))
+    ckpt_dir = os.environ["MXTPU_CKPT_DIR"]
+
+    import numpy as np
+
+    import mxnet_tpu as mx            # DMLC_* env connects the gang
+    from mxnet_tpu import distributed, elastic, fault, gluon, parallel
+    from mxnet_tpu.gluon import nn
+    import jax
+
+    if os.environ.get("MXTPU_ROUNDTRIP"):
+        _roundtrip_probe()
+
+    r = distributed.rank()
+    attempt = int(os.environ.get("DMLC_ATTEMPT", "0"))
+    hb = elastic.Heartbeat.from_env()
+
+    mx.random.seed(42)                # identical init on every rank
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              mx.optimizer.create("sgd", learning_rate=0.05),
+                              mesh=mesh, heartbeat=hb)
+    local_b = 4 * len(jax.local_devices())
+
+    def batch(n):
+        # deterministic per (step index, rank): every attempt replays the
+        # same data stream, so resumed progress is real progress
+        rng = np.random.RandomState(1000 * (r + 1) + n)
+        return (rng.randn(local_b, 8).astype(np.float32),
+                rng.randint(0, 4, (local_b,)))
+
+    mgr = parallel.CheckpointManager(step, ckpt_dir, keep_last=4)
+    step(*batch(0))                   # build/compile so resume can land
+    resumed = mgr.resume_latest()
+    start = int(step._num_update)
+    print(f"[worker] rank {r} attempt {attempt} resumed_at "
+          f"{resumed if resumed is not None else 0} start {start}",
+          flush=True)
+
+    with fault.GracefulExit() as gexit:
+        try:
+            while int(step._num_update) < target:
+                n = int(step._num_update)
+                step(*batch(n))
+                if int(step._num_update) % 2 == 0:
+                    mgr.save()
+                # collective stop verdict: a latch on ANY rank stops ALL
+                # ranks at the same boundary (a lone early exit would
+                # wedge the peers' next collective)
+                flag = 1.0 if gexit.requested else 0.0
+                stop = float(np.asarray(distributed.all_sum(
+                    np.full((1,), flag, np.float32)))[0])
+                if stop > 0:
+                    if hb is not None:
+                        hb.beat(int(step._num_update), phase="snapshot")
+                    mgr.save()
+                    print(f"[worker] rank {r} preempted at step "
+                          f"{int(step._num_update)}, snapshot committed",
+                          flush=True)
+                    distributed.shutdown()
+                    sys.exit(elastic.EXIT_PREEMPTED)
+                time.sleep(step_sleep)
+        except elastic.NonFiniteAbortError as exc:
+            print(f"[worker] rank {r} non-finite abort: {exc}", flush=True)
+            distributed.shutdown()
+            sys.exit(elastic.EXIT_NONFINITE)
+
+    mgr.save()
+    if hb is not None:
+        hb.beat(int(step._num_update), phase="exit")
+    print(f"[worker] rank {r} reached target {target}", flush=True)
+    distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

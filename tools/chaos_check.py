@@ -45,6 +45,19 @@ cache), come back byte-identical in verdicts, pass the budget check
 both times, and land inside the wall-clock budgets — the ISSUE 6
 analogue of the lint contract.
 
+``--mode elastic`` runs the ISSUE 9 acceptance end to end: an
+``elastic.Supervisor`` drives a real 2-worker CPU training gang
+(``tests/elastic_worker.py``) to a target step while the harness
+SIGKILLs one worker mid-epoch, SIGSTOPs the other to force a watchdog
+trip, and finally (fresh gang) SIGTERMs the supervisor itself.  The
+contract: the job reaches the target step, restarts stay within the
+progress-aware budget, every restarted attempt resumes from a strictly
+increasing committed step (never step 0), the supervisor SIGTERM ends
+with every worker exiting ``EXIT_PREEMPTED`` after its snapshot, the
+event log parses as JSONL, and zero worker processes leak.
+
+``--list-modes`` prints the mode registry and exits.
+
 Exit code 0 on success, 1 on any mismatch.  Forces ``JAX_PLATFORMS=cpu``
 (and an 8-device virtual mesh) so it runs anywhere, TPU or not (lint
 mode never imports jax at all — mxlint is pure ast).
@@ -557,15 +570,206 @@ def cost_mode(args):
     return 0
 
 
+def elastic_mode(args):
+    """Supervised-gang chaos (ISSUE 9): SIGKILL + SIGSTOP-hang +
+    supervisor-SIGTERM legs over a real 2-worker CPU training gang."""
+    import json
+    import signal
+    import threading
+
+    from mxnet_tpu import elastic
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(root, "tests", "elastic_worker.py")
+    fails = []
+
+    def wait_for(pred, timeout, what):
+        t0 = time.time()
+        while time.time() - t0 < timeout:
+            v = pred()
+            if v:
+                return v
+            time.sleep(0.05)
+        fails.append(f"timed out after {timeout}s waiting for {what}")
+        return None
+
+    def spawn_pids(sup, attempt):
+        for rec in sup.log.records:
+            if rec["event"] == "spawn" and rec["attempt"] == attempt:
+                return rec["pids"]
+        return None
+
+    def hb_step(sup, rank, attempt):
+        rec = elastic.read_heartbeats(sup.heartbeat_dir).get(rank)
+        if rec and int(rec.get("attempt", -1)) == attempt:
+            return int(rec["global_step"])
+        return 0
+
+    def assert_reaped(sup):
+        pids = {p for r in sup.log.records if r["event"] == "spawn"
+                for p in r["pids"]}
+        for pid in sorted(pids):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                continue
+            fails.append(f"worker pid {pid} leaked past supervisor exit")
+
+    def build(td, target, max_restarts, env):
+        return elastic.Supervisor(
+            [sys.executable, worker], 2, platform="cpu",
+            devices_per_worker=1, max_restarts=max_restarts,
+            watchdog_secs=5.0, startup_grace_secs=180.0,
+            graceful_secs=30.0, backoff_base=0.2,
+            heartbeat_dir=os.path.join(td, "hb"),
+            event_log=os.path.join(td, "events.jsonl"),
+            progress_dir=os.path.join(td, "ckpt"),
+            extra_env=dict(env, MXTPU_TARGET_STEP=str(target),
+                           MXTPU_CKPT_DIR=os.path.join(td, "ckpt"),
+                           PYTHONPATH=root + os.pathsep +
+                           os.environ.get("PYTHONPATH", "")))
+
+    # ---- leg A: SIGKILL one worker mid-epoch, SIGSTOP the other ----------
+    target = 14
+    td = tempfile.mkdtemp(prefix="chaos_elastic_")
+    sup = build(td, target, max_restarts=2,
+                env={"MXTPU_STEP_SLEEP": "0.15", "MXTPU_ROUNDTRIP": "1"})
+    stopped = []
+
+    def chaos_script():
+        # SIGKILL rank 1 once attempt 0 committed real progress
+        if wait_for(lambda: hb_step(sup, 1, 0) >= 5, 300,
+                    "attempt 0 rank 1 to pass step 5") is None:
+            sup.request_stop()
+            return
+        os.kill(spawn_pids(sup, 0)[1], signal.SIGKILL)
+        print("[chaos_check] elastic: SIGKILLed rank 1 mid-epoch",
+              flush=True)
+        # SIGSTOP rank 0 of attempt 1 once it advanced further
+        if wait_for(lambda: hb_step(sup, 0, 1) >= 9, 300,
+                    "attempt 1 rank 0 to pass step 9") is None:
+            sup.request_stop()
+            return
+        pid = spawn_pids(sup, 1)[0]
+        os.kill(pid, signal.SIGSTOP)
+        stopped.append(pid)
+        print("[chaos_check] elastic: SIGSTOPed rank 0 (watchdog bait)",
+              flush=True)
+
+    t = threading.Thread(target=chaos_script)
+    t.start()
+    try:
+        rc = sup.run()
+    finally:
+        t.join()
+        for pid in stopped:        # belt+braces: never leave one stopped
+            try:
+                os.kill(pid, signal.SIGCONT)
+            except ProcessLookupError:
+                pass
+    evs = [r["event"] for r in sup.log.records]
+    final = elastic.latest_committed_step(sup.progress_dir)
+    restarts = evs.count("restart")
+    starts = [r["progress"] for r in sup.log.records
+              if r["event"] == "spawn"]
+    print(f"[chaos_check] elastic: rc={rc} final_step={final} "
+          f"restarts={restarts} spawn_progress={starts} events={evs}")
+    if rc != 0:
+        fails.append(f"leg A: supervisor exited rc={rc}, wanted 0")
+    if final is None or final < target:
+        fails.append(f"leg A: committed step {final} < target {target}")
+    if restarts != 2:
+        fails.append(f"leg A: expected exactly 2 restarts "
+                     f"(SIGKILL + watchdog), saw {restarts}")
+    if "heartbeat-stale" not in evs:
+        fails.append("leg A: the SIGSTOP never tripped the watchdog")
+    if "giveup" in evs:
+        fails.append("leg A: supervisor gave up inside budget")
+    resumes = [s for s in starts[1:]]
+    if any(s in (None, 0) for s in resumes):
+        fails.append(f"leg A: a restart resumed from step 0: {starts}")
+    if resumes != sorted(resumes) or len(set(resumes)) != len(resumes):
+        fails.append(f"leg A: per-attempt resume steps not strictly "
+                     f"increasing: {starts}")
+    with open(sup.event_log) as f:
+        for line in f:
+            json.loads(line)       # every event line is valid JSON
+    assert_reaped(sup)
+
+    # ---- leg B: SIGTERM the supervisor itself ----------------------------
+    td2 = tempfile.mkdtemp(prefix="chaos_elastic_term_")
+    sup2 = build(td2, target=10_000, max_restarts=1,
+                 env={"MXTPU_STEP_SLEEP": "0.15"})
+
+    def term_script():
+        if wait_for(lambda: hb_step(sup2, 0, 0) >= 4 and
+                    hb_step(sup2, 1, 0) >= 4, 300,
+                    "leg B workers to pass step 4") is None:
+            sup2.request_stop()
+            return
+        os.kill(os.getpid(), signal.SIGTERM)
+        print("[chaos_check] elastic: SIGTERMed the supervisor",
+              flush=True)
+
+    t2 = threading.Thread(target=term_script)
+    t2.start()
+    try:
+        rc2 = sup2.run()
+    finally:
+        t2.join()
+    evs2 = [r["event"] for r in sup2.log.records]
+    statuses = [r["status"] for r in sup2.log.records
+                if r["event"] == "worker-exit"]
+    final2 = elastic.latest_committed_step(sup2.progress_dir)
+    print(f"[chaos_check] elastic: SIGTERM leg rc={rc2} "
+          f"statuses={statuses} snapshot_step={final2} events={evs2}")
+    if rc2 != 0:
+        fails.append(f"leg B: supervisor SIGTERM exit rc={rc2}, wanted 0")
+    if "preempted" not in evs2 or "forward-sigterm" not in evs2:
+        fails.append(f"leg B: missing forward-sigterm/preempted events: "
+                     f"{evs2}")
+    if statuses != ["preempted", "preempted"]:
+        fails.append(f"leg B: workers did not snapshot-then-exit: "
+                     f"{statuses}")
+    if not final2:
+        fails.append("leg B: no snapshot committed before exit")
+    assert_reaped(sup2)
+
+    if fails:
+        for f in fails:
+            print(f"[chaos_check] FAIL: {f}")
+        return 1
+    print(f"[chaos_check] PASS: SIGKILL + SIGSTOP-hang recovered within "
+          f"budget ({restarts} restarts, resumes {resumes}, reached step "
+          f"{final}); supervisor SIGTERM drained to {statuses} with "
+          f"snapshot at step {final2}; 0 leaked workers")
+    return 0
+
+
+MODES = {
+    "train": ("kill-and-resume training smoke (ISSUE 2)", None),
+    "serve": ("inject-and-drain serving smoke (ISSUE 4)", serve_mode),
+    "fleet": ("replica-kill + rolling weight updates + SIGTERM "
+              "(ISSUES 7/8)", fleet_mode),
+    "lint": ("incremental-analyzer cold-vs-warm contract (ISSUE 5)",
+             lint_mode),
+    "cost": ("cold-vs-warm compiled-cost budget audit (ISSUE 6)",
+             cost_mode),
+    "elastic": ("supervised-gang SIGKILL + SIGSTOP-hang + supervisor "
+                "SIGTERM (ISSUE 9)", elastic_mode),
+}
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--mode",
-                    choices=("train", "serve", "fleet", "lint", "cost"),
-                    default="train",
+    ap.add_argument("--mode", choices=tuple(MODES), default="train",
                     help="train: kill-and-resume; serve: inject-and-"
                          "drain; fleet: replica-kill + rolling weight "
                          "updates + SIGTERM; lint: incremental analyzer "
-                         "contract; cost: cold-vs-warm budget audit")
+                         "contract; cost: cold-vs-warm budget audit; "
+                         "elastic: supervised-gang chaos")
+    ap.add_argument("--list-modes", action="store_true",
+                    help="print the mode registry and exit")
     ap.add_argument("--steps", type=int, default=8,
                     help="total training steps in the reference run")
     ap.add_argument("--every", type=int, default=2,
@@ -577,14 +781,13 @@ def main(argv=None):
     ap.add_argument("--requests", type=int, default=25,
                     help="serve mode: requests per client thread")
     args = ap.parse_args(argv)
-    if args.mode == "lint":
-        return lint_mode(args)
-    if args.mode == "cost":
-        return cost_mode(args)
-    if args.mode == "serve":
-        return serve_mode(args)
-    if args.mode == "fleet":
-        return fleet_mode(args)
+    if args.list_modes:
+        for name, (desc, _) in MODES.items():
+            print(f"{name:<10} {desc}")
+        return 0
+    mode_fn = MODES[args.mode][1]
+    if mode_fn is not None:
+        return mode_fn(args)
     crash_after = (args.crash_after if args.crash_after is not None
                    else args.steps // 2 + 1)
 

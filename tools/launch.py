@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Multi-process launcher.
+"""Elastic multi-process launcher.
 
 ref: tools/launch.py + the dmlc-core tracker's local launcher
 (3rdparty/dmlc-core/tracker/dmlc_tracker/local.py): export the DMLC_* env
@@ -9,26 +9,42 @@ talking to the jax.distributed coordination service — SURVEY.md §5.8), and
 ``--platform cpu`` rehearses a cluster on one machine with virtual devices
 (SURVEY.md §4 "distributed-without-a-cluster").
 
+Since ISSUE 9 this is a thin CLI over ``mxnet_tpu.elastic.Supervisor``:
+per-rank heartbeats + a hang watchdog (``--watchdog-secs``), fail-fast
+gang teardown with a snapshot-friendly SIGTERM→SIGKILL escalation,
+progress-aware restarts (``--max-restarts`` refills whenever an attempt
+advanced the committed checkpoint step under ``--progress-dir``), a JSONL
+event log (``--event-log``), and ``[r<rank>]``-prefixed worker output
+(or per-rank files under ``--log-dir``).
+
     python tools/launch.py -n 4 python train.py ...
     python tools/launch.py -n 2 --platform cpu --devices-per-worker 2 \
         python tests/dist_worker.py
+    python tools/launch.py -n 2 --platform cpu --watchdog-secs 60 \
+        --max-restarts 3 --progress-dir /ckpts --event-log events.jsonl \
+        python train.py ...
 """
 import argparse
+import importlib.util
 import os
-import socket
-import subprocess
 import sys
-import time
 
 
-def _free_port():
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+def _load_elastic():
+    """Load mxnet_tpu/elastic.py WITHOUT importing the package: the
+    supervisor process must stay jax-free (the package import would pull
+    the backend into the launcher — on a TPU host that can wedge device
+    ownership away from the very workers it launches)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "mxnet_tpu", "elastic.py")
+    spec = importlib.util.spec_from_file_location("_mxtpu_elastic", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 
 def main(argv=None):
-    p = argparse.ArgumentParser(description=__doc__)
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("-n", "--num-workers", type=int, required=True)
     p.add_argument("--launcher", choices=["local"], default="local",
                    help="only the local launcher is built in; multi-host "
@@ -39,79 +55,70 @@ def main(argv=None):
     p.add_argument("--devices-per-worker", type=int, default=0,
                    help="with --platform cpu: virtual CPU devices per worker")
     p.add_argument("--max-restarts", type=int, default=0,
-                   help="elastic mode: if any worker dies, tear the job "
-                        "down and relaunch the whole gang up to N times "
-                        "(pair with TrainStep checkpoints to resume; the "
-                        "reference has no equivalent — SURVEY §5.3 names "
-                        "failure recovery as a gap to exceed)")
+                   help="elastic mode: on any failure tear the gang down "
+                        "and relaunch up to N times; with --progress-dir "
+                        "the budget REFILLS whenever an attempt advanced "
+                        "the committed checkpoint step, so long jobs "
+                        "survive many spread-out faults while a pinned "
+                        "crash-loop exhausts it fast (SURVEY §5.3 names "
+                        "failure recovery as the gap to exceed)")
+    p.add_argument("--watchdog-secs", type=float, default=0.0,
+                   help="declare a worker hung when its heartbeat goes "
+                        "stale this long (0 = no watchdog); workers "
+                        "stamp heartbeats via Module.fit / "
+                        "TrainStep(heartbeat=...) under the exported "
+                        "MXTPU_HEARTBEAT_DIR contract")
+    p.add_argument("--startup-grace-secs", type=float, default=None,
+                   help="also declare a hang when a worker produced NO "
+                        "heartbeat this long after spawn (covers a wedge "
+                        "during bring-up, before step 1 exists); default "
+                        "with a watchdog armed: 10x --watchdog-secs, "
+                        "min 60s")
+    p.add_argument("--graceful-secs", type=float, default=10.0,
+                   help="SIGTERM→SIGKILL escalation window on teardown "
+                        "(size it to cover one step + one snapshot)")
+    p.add_argument("--backoff-base", type=float, default=0.5,
+                   help="base delay of the exponential restart backoff")
+    p.add_argument("--heartbeat-dir", default=None,
+                   help="where workers stamp heartbeats (default: a "
+                        "fresh temp dir, exported as MXTPU_HEARTBEAT_DIR)")
+    p.add_argument("--progress-dir", default=None,
+                   help="CheckpointManager directory to read committed "
+                        "progress from (enables the budget refill and "
+                        "per-attempt progress in the event log)")
+    p.add_argument("--progress-prefix", default="ckpt",
+                   help="checkpoint filename prefix under --progress-dir")
+    p.add_argument("--log-dir", default=None,
+                   help="tee each worker's output to r<rank>.log here "
+                        "instead of prefixing the supervisor's streams")
+    p.add_argument("--event-log", default=None,
+                   help="append supervision events (spawn/heartbeat-stale/"
+                        "teardown/restart/giveup) as JSONL here")
+    p.add_argument("--no-prefix", action="store_true",
+                   help="pass worker output through untagged (the "
+                        "pre-ISSUE-9 behavior)")
     p.add_argument("command", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
     if not args.command:
         p.error("no command given")
 
-    attempt = 0
-    while True:
-        rc = _run_gang(args, attempt)
-        if rc == 0 or attempt >= args.max_restarts:
-            return rc
-        attempt += 1
-        print(f"[launch] job failed (rc={rc}); restart "
-              f"{attempt}/{args.max_restarts}", file=sys.stderr)
-
-
-def _run_gang(args, attempt):
-    """One gang launch: all workers, fresh coordinator port; kill the gang
-    when any worker dies (partial gangs deadlock in collectives)."""
-    port = _free_port()
-    procs = []
-    for i in range(args.num_workers):
-        env = dict(os.environ)
-        env.update({
-            "DMLC_ROLE": "worker",
-            "DMLC_PS_ROOT_URI": "127.0.0.1",
-            "DMLC_PS_ROOT_PORT": str(port),
-            "DMLC_NUM_WORKER": str(args.num_workers),
-            "DMLC_WORKER_ID": str(i),
-            "DMLC_ATTEMPT": str(attempt),
-        })
-        if args.platform:
-            env["JAX_PLATFORMS"] = args.platform
-            if args.platform == "cpu":
-                # keep the axon/TPU plugin out of CPU rehearsal workers:
-                # sitecustomize registers it at interpreter startup
-                env.pop("PALLAS_AXON_POOL_IPS", None)
-        if args.devices_per_worker:
-            flags = env.get("XLA_FLAGS", "")
-            env["XLA_FLAGS"] = (
-                f"{flags} --xla_force_host_platform_device_count="
-                f"{args.devices_per_worker}").strip()
-        procs.append(subprocess.Popen(args.command, env=env))
-
-    rc = 0
-    alive = set(range(len(procs)))
-    while alive and rc == 0:
-        for i in sorted(alive):
-            r = procs[i].poll()
-            if r is None:
-                continue
-            alive.discard(i)
-            if r != 0:
-                print(f"worker {i} exited with {r}", file=sys.stderr)
-                rc = r
-                break
-        else:
-            time.sleep(0.05)
-    if rc:
-        # fail-fast gang teardown (a dead peer hangs the others' collectives)
-        for proc in procs:
-            if proc.poll() is None:
-                proc.terminate()
-        for proc in procs:
-            try:
-                proc.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-    return rc
+    elastic = _load_elastic()
+    sup = elastic.Supervisor(
+        args.command, args.num_workers,
+        platform=args.platform,
+        devices_per_worker=args.devices_per_worker,
+        max_restarts=args.max_restarts,
+        watchdog_secs=args.watchdog_secs,
+        startup_grace_secs=args.startup_grace_secs,
+        graceful_secs=args.graceful_secs,
+        backoff_base=args.backoff_base,
+        heartbeat_dir=args.heartbeat_dir,
+        log_dir=args.log_dir,
+        event_log=args.event_log,
+        progress_dir=args.progress_dir,
+        progress_prefix=args.progress_prefix,
+        prefix_output=not args.no_prefix)
+    return sup.run()
 
 
 if __name__ == "__main__":
