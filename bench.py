@@ -32,7 +32,8 @@ BASELINE_SSD_IMG_S = 40.0  # BASELINE.md config 5: >=40 img/s/chip train bar
 _METRIC_NAMES = {"resnet": "resnet50_train_throughput",
                  "bert": "bert_base_pretrain_throughput",
                  "lstm": "lstm_lm_train_throughput",
-                 "ssd": "ssd512_train_throughput"}
+                 "ssd": "ssd512_train_throughput",
+                 "llm": "llm_decode_throughput"}
 
 
 def _quant_mode():
@@ -291,6 +292,120 @@ def bench_lstm():
     }))
 
 
+BASELINE_LLM_TOK_S = 1000.0   # decode tokens/s/chip order for a tiny LM;
+                              # the interesting columns are occupancy + the
+                              # paged-vs-dense cost fields, not this bar
+
+
+def bench_llm():
+    """Continuous-batching decode throughput (ISSUE 10): a
+    ``GenerationServer`` over the paged KV cache under saturating
+    mixed-length traffic.  Emits decode tokens/s/chip, mean in-flight
+    slot occupancy, and the costguard fields of THE decode executable
+    (one program serves every traffic mix — ``n_executables`` in the
+    line is the full serving census: prefill grid + 1).  Selected by
+    ``python bench.py llm`` or ``MXTPU_BENCH_LLM=1`` (which also adds
+    it to ``all``)."""
+    jax = _setup()
+
+    from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
+                                                     init_causal_lm)
+    from mxnet_tpu.serving import BucketSpec, GenerationServer
+    from mxnet_tpu.serving.generate import build_decode_step
+
+    on_accel = jax.devices()[0].platform != "cpu"
+    cfg = CausalLMConfig(vocab_size=4096 if on_accel else 256,
+                         n_layers=4 if on_accel else 2,
+                         n_heads=8 if on_accel else 2,
+                         head_dim=64 if on_accel else 16,
+                         d_ff=2048 if on_accel else 64)
+    n_slots = 64 if on_accel else 8
+    n_pages, page_size = (512, 64) if on_accel else (64, 16)
+    max_new = 64 if on_accel else 8
+    n_requests = 256 if on_accel else 32
+    params = init_causal_lm(cfg, seed=0)
+    srv = GenerationServer(
+        params, cfg, buckets=BucketSpec(batch=(1, 2, 4), length=(32, 64)),
+        n_slots=n_slots, n_pages=n_pages, page_size=page_size,
+        max_new_tokens=max_new, max_queue=n_requests, seed=0,
+        name="BenchGen")
+    srv.start()                       # warmup compiles the whole census
+
+    rng = np.random.RandomState(0)
+    occupancy = []
+    stop = [False]
+
+    def sampler():
+        while not stop[0]:
+            # active_slots = sequences actually SEATED in the decode
+            # grid (in_flight would also count the queue and read ~100%
+            # whenever one exists — useless for slot-packing)
+            occupancy.append(srv.healthz()["active_slots"])
+            time.sleep(0.01)
+
+    import threading
+    t = threading.Thread(target=sampler, daemon=True)
+    t.start()
+    try:
+        try:
+            t0 = time.perf_counter()
+            reqs = [srv.submit(rng.randint(0, cfg.vocab_size,
+                                           size=int(rng.randint(4, 60)))
+                               .astype(np.int32))
+                    for _ in range(n_requests)]
+            for r in reqs:
+                r.result(timeout=600)
+            dt = time.perf_counter() - t0
+        finally:
+            stop[0] = True     # sampler exit condition, then join below
+    finally:
+        t.join()
+    st = srv.stats
+    census, jit_count = srv.census(), srv.jit_cache_count()
+    srv.drain()
+
+    fields = {}
+    if os.environ.get("MXTPU_BENCH_COSTS", "1").lower() not in ("0",
+                                                                "false"):
+        try:       # AOT cost analysis of THE decode program (lower-only)
+            import jax.numpy as jnp
+            sds = jax.ShapeDtypeStruct
+            pool = sds((cfg.n_layers, n_pages, page_size, cfg.n_heads,
+                        cfg.head_dim), jnp.float32)
+            p_avals = jax.eval_shape(lambda: init_causal_lm(cfg, 0))
+            lowered = jax.jit(
+                build_decode_step(cfg, page_size, "jnp")).lower(
+                p_avals, pool, pool, sds((n_slots,), jnp.int32),
+                sds((n_slots,), jnp.int32), sds((n_slots,), jnp.bool_),
+                sds((n_slots, srv.pages_per_seq), jnp.int32),
+                sds((2,), jnp.uint32), sds((n_slots,), jnp.float32),
+                sds((n_slots,), jnp.int32))
+            costs = lowered.compile().cost_analysis()
+            if isinstance(costs, list):
+                costs = costs[0] if costs else {}
+            fields = {
+                "flops_T": round(costs.get("flops", 0.0) / 1e12, 6),
+                "bytes_GB": round(costs.get("bytes accessed", 0.0) / 1e9,
+                                  4),
+            }
+        except Exception:   # noqa: BLE001 — wedged backend mid-AOT;
+            pass            # the throughput line still ships
+    tok_s = st["tokens_out"] / dt / len(jax.devices())
+    print(json.dumps({
+        "metric": _METRIC_NAMES["llm"],
+        "value": round(tok_s, 2),
+        "unit": "decode tokens/s/chip",
+        "vs_baseline": round(tok_s / BASELINE_LLM_TOK_S, 4),
+        "occupancy_pct": round(100 * float(np.mean(occupancy))
+                               / n_slots, 1) if occupancy else None,
+        "sequences": st["completed"],
+        "preempted": st["preempted"],
+        "n_executables": jit_count,
+        "census": census,
+        **fields,
+    }))
+
+
 def bench_ssd():
     """SSD-512 ResNet-50 train step: forward + MultiBoxTarget matching +
     cls/loc loss + backward + SGD, one XLA program (ref: GluonCV
@@ -359,7 +474,7 @@ def bench_ssd():
 
 
 BENCHES = {"resnet": bench_resnet, "bert": bench_bert,
-           "lstm": bench_lstm, "ssd": bench_ssd}
+           "lstm": bench_lstm, "ssd": bench_ssd, "llm": bench_llm}
 assert set(BENCHES) == set(_METRIC_NAMES)
 
 # The axon PJRT tunnel can wedge so hard that even `jax.devices()` hangs
@@ -422,6 +537,13 @@ def main():
               f"(expected {'|'.join(BENCHES)}|all)", file=sys.stderr)
         sys.exit(1)
     names = list(BENCHES) if which == "all" else [which]
+    if which == "all" and os.environ.get("MXTPU_BENCH_LLM",
+                                         "0").lower() in ("", "0",
+                                                          "false"):
+        # the driver contract predates the LLM bench: `all` stays the
+        # four training configs unless MXTPU_BENCH_LLM=1 opts in
+        # (`python bench.py llm` always runs it)
+        names.remove("llm")
 
     if os.environ.get("MXTPU_BENCH_INNER"):
         # inner mode: actually run (we are already inside the watchdog)

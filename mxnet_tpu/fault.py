@@ -42,6 +42,12 @@ point                        location
                              the apply fn touches the device
 ``serving.drain``            InferenceServer.drain entry (before admission
                              stops)
+``generate.prefill``         GenerationServer, before a prompt group's
+                             prefill executable runs
+``generate.decode``          GenerationServer, before each decode step over
+                             the slot grid
+``generate.evict``           GenerationServer, before preempting a
+                             sequence's pages back to the pool
 ``fleet.route``              ServingFleet.submit entry (before any routing
                              decision)
 ``fleet.dispatch``           ServingFleet dispatch, before handing a request
@@ -216,6 +222,12 @@ for _p, _w in (
     ("serving.batch", "DynamicBatcher dispatch, before padding a group"),
     ("serving.step", "InferenceServer batch/probe apply, before the device"),
     ("serving.drain", "InferenceServer.drain entry"),
+    ("generate.prefill", "GenerationServer, before a prompt group's "
+                         "prefill executable runs"),
+    ("generate.decode", "GenerationServer, before each decode step over "
+                        "the slot grid"),
+    ("generate.evict", "GenerationServer, before preempting a sequence's "
+                       "pages back to the pool"),
     ("fleet.route", "ServingFleet.submit entry, before routing"),
     ("fleet.dispatch", "ServingFleet dispatch, before the chosen replica"),
     ("fleet.swap", "WeightUpdater, before a replica's param hot-swap"),

@@ -4,4 +4,5 @@ from . import vision
 from . import bert
 from . import ssd
 from . import language_model
+from . import causal_lm
 from .vision import get_model

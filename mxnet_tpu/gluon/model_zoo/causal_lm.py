@@ -1,0 +1,169 @@
+"""Small causal (decoder-only) transformer LM for the serving decode loop.
+
+The gluon blocks (``bert.py``, ``language_model.py``) drive training-time
+whole-sequence forwards through the NDArray frontend; autoregressive
+*serving* needs something those forwards cannot express: an incremental
+apply that threads an explicit KV cache through every layer so one new
+token costs one token of compute (``serving/generate.py`` builds its
+paged prefill/decode executables from the pieces here).  The model is
+therefore **functional** — params are a flat dict of jnp arrays,
+applies are pure — while the architecture mirrors ``BERTLayer``
+(pre-LN here, fused QKV projection, GELU FFN) with a causal mask and a
+weight-tied LM head (``RNNModel(tie_weights=True)``'s trick).
+
+Layer params are stacked on a leading ``[n_layers, ...]`` axis so the
+serving decode loop can index or scan them inside one compiled program.
+Full-sequence attention reuses ``ops.multi_head_attention`` (the BERT
+hot path); single-token decode attention is
+``ops.paged_decode_attention`` over the serving page pool.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import OPS
+
+__all__ = ["CausalLMConfig", "init_causal_lm", "prefill_forward",
+           "sequence_logits", "decode_hidden", "lm_logits"]
+
+_mha = OPS["multi_head_attention"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CausalLMConfig:
+    """Static architecture hyperparameters (hashable, so builders can
+    close over an instance and stay jit-cache-friendly)."""
+    vocab_size: int = 256
+    n_layers: int = 2
+    n_heads: int = 2
+    head_dim: int = 16
+    d_ff: int = 64
+
+    @property
+    def d_model(self) -> int:
+        return self.n_heads * self.head_dim
+
+
+def init_causal_lm(config: CausalLMConfig, seed: int = 0) -> dict:
+    """Random-init params: a flat dict of jnp arrays, per-layer weights
+    stacked on axis 0 (``[n_layers, ...]``)."""
+    c = config
+    d, ff, L = c.d_model, c.d_ff, c.n_layers
+    keys = jax.random.split(jax.random.PRNGKey(seed), 5)
+    s = 0.02
+
+    def norm(key, shape):
+        return (s * jax.random.normal(key, shape)).astype(jnp.float32)
+
+    return {
+        "embed": norm(keys[0], (c.vocab_size, d)),
+        "wqkv": norm(keys[1], (L, d, 3 * d)),
+        "bqkv": jnp.zeros((L, 3 * d), jnp.float32),
+        "wo": norm(keys[2], (L, d, d)),
+        "bo": jnp.zeros((L, d), jnp.float32),
+        "ln1_s": jnp.ones((L, d), jnp.float32),
+        "ln1_b": jnp.zeros((L, d), jnp.float32),
+        "ln2_s": jnp.ones((L, d), jnp.float32),
+        "ln2_b": jnp.zeros((L, d), jnp.float32),
+        "w1": norm(keys[3], (L, d, ff)),
+        "b1": jnp.zeros((L, ff), jnp.float32),
+        "w2": norm(keys[4], (L, ff, d)),
+        "b2": jnp.zeros((L, d), jnp.float32),
+        "lnf_s": jnp.ones((d,), jnp.float32),
+        "lnf_b": jnp.zeros((d,), jnp.float32),
+    }
+
+
+def _ln(x, scale, bias, eps=1e-6):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _ffn(x, w1, b1, w2, b2):
+    return jax.nn.gelu(x @ w1 + b1) @ w2 + b2
+
+
+def lm_logits(params, h):
+    """Weight-tied LM head: hidden → vocab logits through the embedding
+    matrix (``RNNModel(tie_weights=True)``)."""
+    return _ln(h, params["lnf_s"], params["lnf_b"]) @ params["embed"].T
+
+
+def decode_hidden(params, layer, h, attend):
+    """One pre-LN transformer layer for a SINGLE token position.
+
+    ``h`` is ``[slots, d_model]``; ``attend(k, v) -> ctx`` is the
+    caller's cache hook: it receives this layer's new per-slot K/V
+    (``[slots, heads, head_dim]``), owns writing them into its cache
+    (paged pool or dense stripe), and returns the attention context over
+    that cache.  Splitting here keeps the model free of any cache
+    layout while the serving layer stays free of the architecture."""
+    d = params["wo"].shape[1]
+    x = _ln(h, params["ln1_s"][layer], params["ln1_b"][layer])
+    qkv = x @ params["wqkv"][layer] + params["bqkv"][layer]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    slots = h.shape[0]
+    ctx = attend(q, k, v)                         # [slots, H, D] resolved
+    h = h + ctx.reshape(slots, d) @ params["wo"][layer] + params["bo"][layer]
+    h = h + _ffn(_ln(h, params["ln2_s"][layer], params["ln2_b"][layer]),
+                 params["w1"][layer], params["b1"][layer],
+                 params["w2"][layer], params["b2"][layer])
+    return h
+
+
+def _stack_forward(params, config: CausalLMConfig, tokens, lengths):
+    """The shared whole-sequence transformer stack: causal
+    ``ops.multi_head_attention`` with positions beyond a row's
+    ``lengths`` masked as keys (``lengths=None`` = every position
+    valid).  Returns ``(h [b, L, d], k_all, v_all)`` with K/V stacked
+    ``[n_layers, b, L, heads, head_dim]``."""
+    c = config
+    b, L = tokens.shape
+    h = params["embed"][tokens]                   # [b, L, d]
+    if lengths is None:
+        mask = jnp.ones((b, 1, 1, L), jnp.float32)
+    else:
+        mask = (jnp.arange(L)[None, :]
+                < lengths[:, None]).astype(jnp.float32)[:, None, None, :]
+    ks, vs = [], []
+    for layer in range(c.n_layers):
+        x = _ln(h, params["ln1_s"][layer], params["ln1_b"][layer])
+        qkv = x @ params["wqkv"][layer] + params["bqkv"][layer]
+        q, k, v = jnp.split(qkv, 3, axis=-1)      # each [b, L, d]
+        ks.append(k.reshape(b, L, c.n_heads, c.head_dim))
+        vs.append(v.reshape(b, L, c.n_heads, c.head_dim))
+        ctx = _mha(q, k, v, mask=mask, heads=c.n_heads, causal=True,
+                   dropout=0.0, training=False)
+        h = h + ctx @ params["wo"][layer] + params["bo"][layer]
+        h = h + _ffn(_ln(h, params["ln2_s"][layer],
+                         params["ln2_b"][layer]),
+                     params["w1"][layer], params["b1"][layer],
+                     params["w2"][layer], params["b2"][layer])
+    return h, jnp.stack(ks), jnp.stack(vs)
+
+
+def prefill_forward(params, config: CausalLMConfig, tokens, lengths):
+    """Whole-prompt forward: ``tokens [b, L]`` int32, ``lengths [b]``.
+
+    Returns ``(logits_last [b, vocab], k_all, v_all)`` with K/V stacked
+    ``[n_layers, b, L, heads, head_dim]`` — everything the serving
+    layer needs to seed its cache and sample the first new token.  The
+    "last" hidden state is gathered at ``lengths - 1``."""
+    b, L = tokens.shape
+    h, ks, vs = _stack_forward(params, config, tokens, lengths)
+    last = jnp.clip(lengths - 1, 0, L - 1)
+    h_last = h[jnp.arange(b), last]               # [b, d]
+    return lm_logits(params, h_last), ks, vs
+
+
+def sequence_logits(params, config: CausalLMConfig, tokens,
+                    lengths=None):
+    """Next-token logits for EVERY position, ``[b, L, vocab]`` — the
+    training-side apply (differentiate a cross-entropy over this with
+    plain ``jax.grad``; examples/serve_llm.py does exactly that)."""
+    h, _, _ = _stack_forward(params, config, tokens, lengths)
+    return lm_logits(params, h)

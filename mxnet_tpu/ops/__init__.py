@@ -19,6 +19,7 @@ from . import sequence  # noqa: F401
 from . import loss  # noqa: F401
 from . import rnn  # noqa: F401
 from . import attention  # noqa: F401
+from . import paged_attention  # noqa: F401
 from . import image  # noqa: F401
 from . import multibox  # noqa: F401
 from . import quantization  # noqa: F401

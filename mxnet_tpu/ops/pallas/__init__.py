@@ -1,4 +1,5 @@
 """Pallas TPU kernels for ops XLA won't fuse well (SURVEY.md §7.0.2)."""
 from .flash_attention import flash_attention
+from .paged_attention import paged_decode_attention_pallas
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "paged_decode_attention_pallas"]

@@ -31,6 +31,8 @@ from .server import InferenceServer, module_apply
 from .fleet import (ServingFleet, HotSwapApply, WeightUpdater,
                     SnapshotRejectedError, UpdateRolledBackError,
                     validate_params)
+from .generate import (GenerationServer, PageAllocator,
+                       PoolExhaustedError)
 
 __all__ = ["InferenceServer", "module_apply", "BucketSpec",
            "DynamicBatcher", "CircuitBreaker", "TokenBucket", "Request",
@@ -38,4 +40,5 @@ __all__ = ["InferenceServer", "module_apply", "BucketSpec",
            "DeadlineExceededError", "NonFiniteOutputError",
            "ServingFleet", "HotSwapApply", "WeightUpdater",
            "SnapshotRejectedError", "UpdateRolledBackError",
-           "validate_params"]
+           "validate_params", "GenerationServer", "PageAllocator",
+           "PoolExhaustedError"]

@@ -1,0 +1,1006 @@
+"""Continuous-batching LLM serving: paged KV cache + one-executable decode.
+
+Every other serving surface in the stack batches *requests*; an
+autoregressive LM needs token-level batching — sequences join and leave
+the in-flight batch at every decode step.  Done naively (one jitted call
+per sequence, a dense ``[max_len]`` cache per sequence) that is the worst
+possible shape for a bandwidth-bound chip: recompiles keyed on traffic,
+and HBM reserved for contexts that mostly aren't there.  This module is
+the PAPERS.md *Ragged Paged Attention* / Gemma-serving design
+(arXiv:2604.15464, 2605.25645) on top of the PR 4 serving substrate:
+
+- **Paged KV cache** — one fixed pool ``[n_layers, n_pages, page_size,
+  heads, head_dim]`` per K and V; sequences hold *pages* through a page
+  table and a host-side free list (``PageAllocator``).  HBM cost is the
+  pool, a configuration constant sized for expected concurrency — not
+  ``n_slots × max_len`` dense stripes (the costguard
+  ``llm_decode_step`` vs ``llm_decode_step_dense`` golden pair commits
+  the ≥ 40% argument-bytes win in tier-1).
+- **One pinned decode executable** — every decode step, whatever the
+  in-flight mix of sequence lengths/ages/sampling modes, runs the SAME
+  jitted program over a fixed slot grid: slot-mask + page-table + length
+  arrays are the arguments, shapes are constants.  Traffic can never
+  recompile; the executable census is ``len(batch buckets) ×
+  len(length buckets) + 1`` (prefill grid + decode), asserted against
+  the runtime jit-cache count in tests.
+- **Continuous-batching scheduler** (``GenerationServer``) — prompts
+  prefill through the existing ``BucketSpec`` length buckets (each
+  bucket warmup-compiled before readiness), sequences are admitted into
+  fixed decode slots, retire per-step on EOS/max-tokens/deadline (pages
+  freed and queued sequences admitted the *same* step), and pool
+  exhaustion preempts the youngest sequence back onto the queue instead
+  of deadlocking.  Admission control (bounded queue, token bucket,
+  deadlines, ``Request`` futures), the circuit breaker, ``healthz`` and
+  ``drain()``/SIGTERM semantics are all the PR 4 pieces reused: an
+  accepted sequence ALWAYS resolves to tokens or an explicit error.
+
+Sampling is greedy or temperature/top-k per request, drawn from the
+per-step PRNG key inside the compiled program (deterministic under a
+fixed server seed and traffic order).
+
+Failure paths are deterministic tests via the ``generate.prefill`` /
+``generate.decode`` / ``generate.evict`` fault points
+(``tools/chaos_check.py --mode llm`` drives all of them plus SIGTERM).
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from .. import fault as _fault
+from .. import profiler as _profiler
+from .admission import (CircuitOpenError, DeadlineExceededError,
+                        RejectedError, Request, ServerClosedError,
+                        TokenBucket)
+from .batcher import BucketSpec
+from .breaker import CircuitBreaker
+
+__all__ = ["PageAllocator", "PoolExhaustedError", "GenerationServer",
+           "build_decode_step", "build_prefill_step",
+           "build_dense_decode_step"]
+
+
+class PoolExhaustedError(RuntimeError):
+    """The page pool has no free page.  Internal scheduler signal — the
+    decode loop preempts a sequence and retries; it never reaches a
+    client, who instead sees either admission-time ``RejectedError``
+    (a request whose worst case could never fit) or a later result."""
+
+
+class PageAllocator:
+    """Host-side free list over the fixed page pool.
+
+    Page 0 is reserved as the *write sink*: masked/inactive lanes of the
+    compiled programs scatter their K/V there, so the executables never
+    branch on occupancy.  Pages ``1..n_pages-1`` are allocatable.  All
+    methods are thread-safe (one lock, no blocking under it); the free
+    list is LIFO, so a freed sequence's pages are immediately reused —
+    fragmentation cannot accrete by construction (any free page serves
+    any sequence; there is nothing contiguous to fragment)."""
+
+    def __init__(self, n_pages, page_size):
+        if n_pages < 2:
+            raise ValueError("PageAllocator: need >= 2 pages (page 0 is "
+                             "the reserved write sink)")
+        if page_size < 1:
+            raise ValueError("PageAllocator: page_size must be >= 1")
+        self.n_pages = int(n_pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        self._free = list(range(1, self.n_pages))   # LIFO tail = next out
+
+    @property
+    def allocatable(self):
+        """Pages a sequence can ever hold (pool minus the sink)."""
+        return self.n_pages - 1
+
+    def free_count(self):
+        with self._lock:
+            return len(self._free)
+
+    def pages_for(self, n_tokens):
+        """Pages needed to hold ``n_tokens`` cache entries."""
+        return -(-int(n_tokens) // self.page_size)
+
+    def alloc(self, n_pages):
+        """Take ``n_pages`` pages or raise ``PoolExhaustedError`` (taking
+        nothing — allocation is all-or-nothing so a half-admitted
+        sequence can never strand pages)."""
+        n = int(n_pages)
+        with self._lock:
+            if n > len(self._free):
+                raise PoolExhaustedError(
+                    f"need {n} pages, {len(self._free)} free "
+                    f"(pool {self.allocatable})")
+            taken, self._free[-n:] = self._free[-n:], []
+            return taken if n else []
+
+    def free(self, pages):
+        """Return pages to the pool (idempotence is the caller's job —
+        the scheduler frees a sequence's pages exactly once, at
+        retirement or eviction)."""
+        with self._lock:
+            self._free.extend(pages)
+
+
+# --------------------------------------------------------------- samplers --
+def _sample_tokens(logits, key, temps, topks):
+    """Per-slot next-token choice inside the compiled program: greedy
+    where ``temps == 0``, temperature softmax-sampling elsewhere, with
+    an optional top-k cut (``topks > 0``).  Both arms always compute —
+    that is what keeps a mixed greedy/sampling batch ONE executable —
+    and each slot draws from ``fold_in(step_key, slot)``."""
+    import jax
+    import jax.numpy as jnp
+
+    slots, vocab = logits.shape
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.sort(scaled, axis=-1)[:, ::-1]          # descending
+    kidx = jnp.clip(topks - 1, 0, vocab - 1)
+    thr = jnp.take_along_axis(order, kidx[:, None], axis=1)
+    cut = (topks[:, None] > 0) & (scaled < thr)
+    masked = jnp.where(cut, jnp.asarray(-1e30, scaled.dtype), scaled)
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+        jnp.arange(slots, dtype=jnp.uint32))
+    drawn = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
+    return jnp.where(temps > 0.0, drawn, greedy)
+
+
+# -------------------------------------------------------- program builders --
+def build_decode_step(config, page_size, attention_impl=None):
+    """The ONE decode executable: every in-flight mix of sequences runs
+    this program over the fixed slot grid.
+
+    Signature (all shapes configuration constants):
+      ``(params, k_pool, v_pool, tokens[S], lengths[S], active[S],
+      tables[S, P], key, temps[S], topks[S])`` →
+      ``(next_tokens[S], k_pool, v_pool)``.
+
+    ``lengths[s]`` is the slot's cache occupancy BEFORE this step; the
+    input token's K/V is written at position ``lengths[s]`` (page
+    ``tables[s, lengths[s] // page_size]``), inactive slots sink to
+    page 0, and attention covers ``lengths[s] + 1`` positions.  Pools
+    are donated by the caller, so the update is in-place on device."""
+    import jax.numpy as jnp
+
+    from ..gluon.model_zoo.causal_lm import decode_hidden, lm_logits
+    from ..ops.paged_attention import paged_decode_attention
+
+    n_layers = config.n_layers
+    heads, head_dim = config.n_heads, config.head_dim
+
+    def decode_step(params, k_pool, v_pool, tokens, lengths, active,
+                    tables, key, temps, topks):
+        slots = tokens.shape[0]
+        h = params["embed"][tokens]                     # [S, d]
+        pos = lengths
+        page = jnp.take_along_axis(tables, (pos // page_size)[:, None],
+                                   axis=1)[:, 0]
+        page = jnp.where(active, page, 0)               # sink inactive
+        off = pos % page_size
+        att_len = jnp.where(active, lengths + 1, 0)
+
+        for layer in range(n_layers):
+            def attend(q, k, v, _l=layer):
+                nonlocal k_pool, v_pool
+                k = k.reshape(slots, heads, head_dim)
+                v = v.reshape(slots, heads, head_dim)
+                q = q.reshape(slots, heads, head_dim)
+                k_pool = k_pool.at[_l, page, off].set(k)
+                v_pool = v_pool.at[_l, page, off].set(v)
+                return paged_decode_attention(q, k_pool[_l], v_pool[_l],
+                                              tables, att_len,
+                                              impl=attention_impl)
+            h = decode_hidden(params, layer, h, attend)
+        nxt = _sample_tokens(lm_logits(params, h), key, temps, topks)
+        return nxt, k_pool, v_pool
+
+    return decode_step
+
+
+def build_prefill_step(config, page_size, attention_impl=None):
+    """One prefill executable per ``(batch, length)`` bucket: the whole
+    prompt forward (``causal_lm.prefill_forward``), K/V scattered into
+    the paged pools by page table, and the FIRST new token sampled —
+    so a prefilled sequence enters the decode grid already one token
+    ahead.  Padded rows/positions sink their writes to page 0."""
+    import jax.numpy as jnp
+
+    from ..gluon.model_zoo.causal_lm import prefill_forward
+
+    del attention_impl      # prefill is dense-causal (ops.multi_head_attention)
+
+    def prefill_step(params, k_pool, v_pool, tokens, lengths, active,
+                     tables, key, temps, topks):
+        b, L = tokens.shape
+        logits, k_all, v_all = prefill_forward(params, config, tokens,
+                                               lengths)
+        pos = jnp.arange(L)
+        valid = (pos[None, :] < lengths[:, None]) & active[:, None]
+        page = jnp.where(valid, tables[:, pos // page_size], 0)  # [b, L]
+        off = jnp.broadcast_to((pos % page_size)[None, :], (b, L))
+        for layer in range(config.n_layers):
+            k_pool = k_pool.at[layer, page, off].set(k_all[layer])
+            v_pool = v_pool.at[layer, page, off].set(v_all[layer])
+        first = _sample_tokens(logits, key, temps, topks)
+        return first, k_pool, v_pool
+
+    return prefill_step
+
+
+def build_dense_decode_step(config, max_ctx, attention_impl=None):
+    """The dense max-length-cache decode variant: identical model and
+    sampling, but every slot owns a ``[max_ctx, H, D]`` stripe of
+    ``[n_layers, slots, max_ctx, H, D]`` caches — the per-sequence HBM
+    reservation the paged pool replaces.  Exists for the parity tests
+    and as the costguard ``llm_decode_step_dense`` golden the paged
+    win is committed against; the serving loop never runs it."""
+    import jax.numpy as jnp
+
+    from ..gluon.model_zoo.causal_lm import decode_hidden, lm_logits
+    from ..ops.paged_attention import dense_decode_attention
+
+    del attention_impl
+    n_layers = config.n_layers
+    heads, head_dim = config.n_heads, config.head_dim
+
+    def dense_step(params, k_cache, v_cache, tokens, lengths, active,
+                   key, temps, topks):
+        slots = tokens.shape[0]
+        h = params["embed"][tokens]
+        row = jnp.arange(slots)
+        pos = jnp.clip(lengths, 0, max_ctx - 1)
+        att_len = jnp.where(active, lengths + 1, 0)
+
+        for layer in range(n_layers):
+            def attend(q, k, v, _l=layer):
+                nonlocal k_cache, v_cache
+                k = k.reshape(slots, heads, head_dim)
+                v = v.reshape(slots, heads, head_dim)
+                q = q.reshape(slots, heads, head_dim)
+                k_cache = k_cache.at[_l, row, pos].set(k)
+                v_cache = v_cache.at[_l, row, pos].set(v)
+                return dense_decode_attention(q, k_cache[_l], v_cache[_l],
+                                              att_len)
+            h = decode_hidden(params, layer, h, attend)
+        nxt = _sample_tokens(lm_logits(params, h), key, temps, topks)
+        return nxt, k_cache, v_cache
+
+    return dense_step
+
+
+# ---------------------------------------------------------------- scheduler --
+class _Seq:
+    """Decode-loop-private state of one admitted sequence."""
+
+    __slots__ = ("req", "prompt", "max_new", "temp", "top_k", "slot",
+                 "pages", "cached", "out", "stamp", "ran")
+
+    def __init__(self, req, prompt, max_new, temp, top_k):
+        self.req = req
+        self.prompt = prompt
+        self.max_new = max_new
+        self.temp = temp
+        self.top_k = top_k
+        self.slot = None
+        self.pages = []
+        self.cached = 0          # tokens whose K/V is in the pool
+        self.out = []            # generated token ids (EOS excluded)
+        self.stamp = 0.0         # admission order — eviction picks youngest
+        self.ran = False         # ever prefilled (survives preemption)
+
+
+class GenerationServer:
+    """Continuous-batching autoregressive generation server.
+
+    Lifecycle mirrors ``InferenceServer``: construct → ``start()``
+    (warmup-compiles the full prefill bucket grid AND the single decode
+    executable before readiness flips) → ``submit()``/``__call__`` →
+    ``drain()`` or ``serve_forever()``.  ``submit`` returns a
+    ``Request`` future resolving to the generated token ids
+    (``np.int32``, EOS excluded) or an explicit error.
+
+    One decode loop thread owns all device state (pools, slot arrays,
+    allocator traffic); client threads touch only the admission deque,
+    the lock-guarded stats, and ``Request`` futures.
+
+    Profiler series: ``<name>::tokens_out``, ``<name>::page_occupancy``
+    (percent of allocatable pages held), ``<name>::preempted``,
+    ``<name>::retired`` (sequences leaving a slot for any terminal
+    reason: completed, failed, or expired).
+    """
+
+    _IDLE_TICK = 0.005
+
+    def __init__(self, params, config, *, buckets=None, n_slots=8,
+                 n_pages=64, page_size=16, max_context=None,
+                 max_queue=128, rate=None, burst=None, breaker=None,
+                 default_deadline=None, max_new_tokens=32, eos_id=None,
+                 seed=0, attention_impl=None, name="GenerationServer"):
+        import jax
+        import jax.numpy as jnp
+
+        self.config = config
+        if buckets is None:
+            buckets = BucketSpec(batch=(1, 2), length=(16, 32))
+        # a bare batch tuple wraps like InferenceServer's — and then
+        # fails the length-bucket requirement below LOUDLY, instead of
+        # silently serving the default grid
+        self.buckets = buckets if isinstance(buckets, BucketSpec) \
+            else BucketSpec(buckets)
+        if self.buckets.length is None:
+            raise ValueError(f"{name}: buckets must define length "
+                             f"buckets — prompts are sequences")
+        self.n_slots = int(n_slots)
+        self.alloc = PageAllocator(n_pages, page_size)
+        # per-sequence page-table width: enough for the longest prompt
+        # bucket plus the default generation budget (the table is a
+        # configuration constant — it shapes the compiled programs)
+        if max_context is None:
+            max_context = max(self.buckets.length) + int(max_new_tokens)
+        if max_context < max(self.buckets.length) + 1:
+            raise ValueError(
+                f"{name}: max_context {max_context} cannot hold the "
+                f"largest length bucket {max(self.buckets.length)} plus "
+                f"one generated token")
+        self.pages_per_seq = self.alloc.pages_for(max_context)
+        self.max_context = self.pages_per_seq * self.alloc.page_size
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._limiter = None if rate is None else TokenBucket(rate, burst)
+        self._default_deadline = default_deadline
+        self._max_new = int(max_new_tokens)
+        self._eos = None if eos_id is None else int(eos_id)
+        self._name = name
+        self._max_queue = int(max_queue)
+
+        self._params = jax.tree.map(jnp.asarray, params)
+        self._decode = jax.jit(
+            build_decode_step(config, self.alloc.page_size,
+                              attention_impl), donate_argnums=(1, 2))
+        self._prefill = jax.jit(
+            build_prefill_step(config, self.alloc.page_size,
+                               attention_impl), donate_argnums=(1, 2))
+        self._key_base = jax.random.PRNGKey(int(seed))
+        self._steps = 0          # device-call counter → per-step PRNG key
+
+        # decode-loop-private device + slot state (created in start())
+        self._k_pool = self._v_pool = None
+        self._seqs = {}                                  # slot -> _Seq
+        self._tokens = np.zeros((self.n_slots,), np.int32)
+        self._lengths = np.zeros((self.n_slots,), np.int32)
+        self._active = np.zeros((self.n_slots,), bool)
+        self._tables = np.zeros((self.n_slots, self.pages_per_seq),
+                                np.int32)
+        self._temps = np.zeros((self.n_slots,), np.float32)
+        self._topks = np.zeros((self.n_slots,), np.int32)
+
+        self._pending = collections.deque()
+        self._admit_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._stats = {"admitted": 0, "completed": 0, "failed": 0,
+                       "expired": 0, "rejected": 0, "retired": 0,
+                       "preempted": 0, "tokens_out": 0, "prefills": 0,
+                       "decode_steps": 0, "active_slots": 0}
+        self._last_error = None
+        self._ready = threading.Event()
+        self._draining = threading.Event()
+        self._stop = threading.Event()
+        self._started = threading.Event()
+        self._thread = threading.Thread(target=self._loop, name=name,
+                                        daemon=True)
+        self._c_tokens = _profiler.Counter(None, f"{name}::tokens_out")
+        self._c_pages = _profiler.Counter(None, f"{name}::page_occupancy")
+        self._c_preempted = _profiler.Counter(None, f"{name}::preempted")
+        self._c_retired = _profiler.Counter(None, f"{name}::retired")
+
+    # ------------------------------------------------------------ lifecycle --
+    def start(self, warmup=True):
+        """Allocate the pools and (by default) compile the WHOLE
+        executable space — every prefill bucket signature plus the one
+        decode program — with inert all-inactive arguments (writes sink
+        to page 0, the allocator is untouched) before readiness flips.
+        After warmup the jit caches hold exactly ``census()`` entries
+        and live traffic can never add one."""
+        import jax.numpy as jnp
+
+        if self._draining.is_set():
+            raise ServerClosedError(f"{self._name}: already drained")
+        c, npg, psz = self.config, self.alloc.n_pages, self.alloc.page_size
+        shape = (c.n_layers, npg, psz, c.n_heads, c.head_dim)
+        # the decode thread owns the pools once it starts (two lines
+        # down); the lock here is for the thread-contract checker —
+        # nothing races a thread that does not exist yet
+        with self._admit_lock:
+            self._k_pool = jnp.zeros(shape, jnp.float32)
+            self._v_pool = jnp.zeros(shape, jnp.float32)
+        if warmup:
+            for b in self.buckets.batch:
+                for L in self.buckets.length:
+                    self._run_prefill(
+                        np.zeros((b, L), np.int32), np.zeros((b,), np.int32),
+                        np.zeros((b,), bool),
+                        np.zeros((b, self.pages_per_seq), np.int32),
+                        np.zeros((b,), np.float32), np.zeros((b,), np.int32))
+            self._run_decode()
+        self._started.set()
+        self._thread.start()
+        self._ready.set()
+        return self
+
+    def __enter__(self):
+        if not self._started.is_set():
+            self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.drain()
+        return False
+
+    def census(self):
+        """The static executable count: one prefill program per (batch,
+        length) bucket plus THE decode program.  ``jit_cache_count()``
+        must equal this after warmup, forever."""
+        return len(self.buckets.batch) * len(self.buckets.length) + 1
+
+    def jit_cache_count(self):
+        """Runtime executables actually compiled (both jit caches)."""
+        return self._prefill._cache_size() + self._decode._cache_size()
+
+    # ------------------------------------------------------------ admission --
+    def submit(self, tokens, *, max_new_tokens=None, temperature=0.0,
+               top_k=0, deadline=None):
+        """Admit one prompt; returns a ``Request`` future resolving to
+        the generated ``np.int32`` token ids (EOS excluded).
+
+        Refusals are immediate and explicit (PR 4 contract):
+        ``ServerClosedError`` draining, ``CircuitOpenError`` fast-fail,
+        ``RejectedError`` for rate limit / full queue / a prompt no
+        length bucket holds / a worst case that could never fit the
+        page pool.  None of them touched the device."""
+        if self._draining.is_set():
+            self._bump("rejected")
+            raise ServerClosedError(f"{self._name}: draining — "
+                                    f"not admitting")
+        if not self._ready.is_set():
+            self._bump("rejected")
+            raise RejectedError(f"{self._name}: not started")
+        if not self._thread.is_alive():
+            self._bump("rejected")
+            raise ServerClosedError(f"{self._name}: decode loop is not "
+                                    f"running — not admitting")
+        if self.breaker.engaged():
+            self._bump("rejected")
+            raise CircuitOpenError(
+                f"{self._name}: circuit open after repeated step failures "
+                f"— fast-failing until a probe succeeds")
+        raw = np.asarray(tokens)
+        if not np.issubdtype(raw.dtype, np.integer):
+            raise ValueError(
+                f"{self._name}: prompt dtype {raw.dtype} is not an "
+                f"integer token array — casting would silently "
+                f"truncate; tokenize first")
+        prompt = raw.astype(np.int32)
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            raise ValueError(f"{self._name}: prompt must be a 1-D, "
+                             f"non-empty int sequence")
+        max_new = self._max_new if max_new_tokens is None \
+            else int(max_new_tokens)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if float(temperature) < 0.0 or int(top_k) < 0:
+            raise ValueError("temperature must be >= 0 and top_k >= 0")
+        n = prompt.shape[0]
+        try:
+            if n > max(self.buckets.length):
+                raise RejectedError(
+                    f"prompt length {n} exceeds the largest length bucket "
+                    f"{max(self.buckets.length)} — no prefill executable "
+                    f"exists for this shape")
+            if n + max_new > self.max_context:
+                raise RejectedError(
+                    f"prompt {n} + max_new_tokens {max_new} exceeds the "
+                    f"page capacity {self.max_context} per sequence")
+            if self.alloc.pages_for(n + max_new) > self.alloc.allocatable:
+                raise RejectedError(
+                    f"worst case needs {self.alloc.pages_for(n + max_new)} "
+                    f"pages, pool holds {self.alloc.allocatable} — this "
+                    f"request could never be served")
+        except RejectedError:
+            self._bump("rejected")
+            raise
+        if self._limiter is not None and not self._limiter.try_acquire():
+            self._bump("rejected")
+            raise RejectedError(f"{self._name}: rate limit exceeded — "
+                                f"shedding")
+        req = Request((prompt,), deadline=deadline if deadline is not None
+                      else self._default_deadline)
+        seq = _Seq(req, prompt, max_new, float(temperature), int(top_k))
+        seq.stamp = time.monotonic()
+        with self._admit_lock:
+            if self._stop.is_set():
+                if self._limiter is not None:
+                    self._limiter.refund()
+                self._bump("rejected")
+                raise ServerClosedError(f"{self._name}: draining — "
+                                        f"not admitting")
+            if len(self._pending) >= self._max_queue:
+                if self._limiter is not None:
+                    self._limiter.refund()
+                self._bump("rejected")
+                raise RejectedError(
+                    f"{self._name}: request queue full "
+                    f"({self._max_queue}) — shedding")
+            self._pending.append(seq)
+        self._bump("admitted")
+        return req
+
+    def __call__(self, tokens, timeout=None, **kw):
+        """Blocking convenience: submit + ``result()``."""
+        return self.submit(tokens, **kw).result(timeout)
+
+    def _bump(self, key, n=1):
+        with self._lock:
+            self._stats[key] += n
+
+    def _note_step_failure(self, exc):
+        with self._lock:
+            self._last_error = (type(exc).__name__, time.monotonic())
+
+    # ----------------------------------------------------------- decode loop --
+    def _next_key(self):
+        import jax
+        self._steps += 1
+        return jax.random.fold_in(self._key_base, self._steps)
+
+    def _run_prefill(self, tokens, lengths, active, tables, temps, topks):
+        """One prefill program invocation (pools donated/reassigned)."""
+        first, self._k_pool, self._v_pool = self._prefill(
+            self._params, self._k_pool, self._v_pool, tokens, lengths,
+            active, tables, self._next_key(), temps, topks)
+        return np.asarray(first)
+
+    def _recover_pools(self):
+        """A device call that failed MID-EXECUTION already consumed the
+        donated pools — every in-flight sequence's cache is gone with
+        them.  Re-zero the pools and fail the sequences explicitly (the
+        error path that got here resolves its own group; this sweeps the
+        bystanders whose state was collateral).  A host-side failure
+        (e.g. an armed fault point) never reaches this: the pools are
+        intact and bystanders keep decoding."""
+        import jax.numpy as jnp
+
+        if self._k_pool is not None and not self._k_pool.is_deleted() \
+                and not self._v_pool.is_deleted():
+            return
+        c, npg, psz = self.config, self.alloc.n_pages, self.alloc.page_size
+        shape = (c.n_layers, npg, psz, c.n_heads, c.head_dim)
+        self._k_pool = jnp.zeros(shape, jnp.float32)
+        self._v_pool = jnp.zeros(shape, jnp.float32)
+        for seq in list(self._seqs.values()):
+            self._retire(seq, ServerClosedError(
+                "KV pool lost to a failed device step — sequence cannot "
+                "continue"), stat="failed")
+
+    def _run_decode(self):
+        """One decode program invocation over the full slot grid."""
+        nxt, self._k_pool, self._v_pool = self._decode(
+            self._params, self._k_pool, self._v_pool, self._tokens,
+            self._lengths, self._active, self._tables, self._next_key(),
+            self._temps, self._topks)
+        return np.asarray(nxt)
+
+    def _loop(self):
+        try:
+            while True:
+                if self._stop.is_set() and not self._seqs \
+                        and not self._pending:
+                    return
+                worked = self._retire_expired()
+                if self._draining.is_set() and self.breaker.engaged():
+                    # drain must terminate: an open breaker during drain
+                    # cannot half-open through traffic it refuses, so
+                    # everything still accepted resolves explicitly now
+                    self._fail_everything(CircuitOpenError(
+                        f"{self._name}: circuit open during drain — "
+                        f"fast-failing accepted work"))
+                    return
+                worked = self._admit() or worked
+                if self._seqs:
+                    self._decode_once()
+                    worked = True
+                if not worked and not self._seqs:
+                    time.sleep(self._IDLE_TICK)
+        finally:
+            with self._admit_lock:
+                self._stop.set()
+            self._fail_residue()
+
+    # ---- retirement ----
+    def _vacate(self, seq):
+        """Release a sequence's slot + pages (no request resolution)."""
+        if seq.slot is not None:
+            s = seq.slot
+            self._bump("active_slots", -1)
+            self._active[s] = False
+            self._lengths[s] = 0
+            self._tokens[s] = 0
+            self._tables[s, :] = 0
+            self._temps[s] = 0.0
+            self._topks[s] = 0
+            self._seqs.pop(s, None)
+            seq.slot = None
+        if seq.pages:
+            self.alloc.free(seq.pages)
+            seq.pages = []
+        self._note_occupancy()
+
+    def _note_occupancy(self):
+        total = self.alloc.allocatable
+        held = total - self.alloc.free_count()
+        self._c_pages.set_value(int(100 * held / total))
+
+    def _retire(self, seq, error=None, stat="completed"):
+        """Terminal retirement: vacate, resolve the future, account."""
+        self._vacate(seq)
+        if error is None:
+            seq.req.set_result(np.asarray(seq.out, np.int32))
+        else:
+            seq.req.set_error(error)
+        self._bump(stat)
+        self._bump("retired")
+        self._c_retired.increment()
+
+    def _retire_expired(self):
+        """Deadline sweep: queued sequences expire without device work,
+        in-flight ones mid-generation (pages freed either way)."""
+        worked = False
+        now = time.monotonic()
+        for seq in [s for s in self._seqs.values()
+                    if s.req.expired(now)]:
+            self._retire(seq, DeadlineExceededError(
+                f"deadline exceeded mid-generation after "
+                f"{len(seq.out)} of {seq.max_new} tokens — pages freed, "
+                f"partial output discarded"), stat="expired")
+            worked = True
+        with self._admit_lock:
+            queued = [s for s in self._pending if s.req.expired(now)]
+            for s in queued:
+                self._pending.remove(s)
+        for seq in queued:
+            self._retire(seq, DeadlineExceededError(
+                "deadline exceeded in queue after preemption — partial "
+                "work discarded" if seq.ran else
+                "deadline exceeded in queue — the request never touched "
+                "the device"), stat="expired")
+            worked = True
+        return worked
+
+    # ---- admission into slots ----
+    def _free_slots(self):
+        return [s for s in range(self.n_slots) if s not in self._seqs]
+
+    def _bucket_len(self, n):
+        return next(L for L in self.buckets.length if L >= n)
+
+    def _take_prefill_group(self):
+        """Pop one same-length-bucket group of queued sequences that
+        fits the free slots and the free pages, preserving FIFO order
+        for the group's bucket.  Returns [] when nothing can start."""
+        free_slots = len(self._free_slots())
+        if free_slots == 0:
+            return []
+        with self._admit_lock:
+            if not self._pending:
+                return []
+            head = self._pending[0]
+            bucket = self._bucket_len(head.prompt.shape[0])
+            group, budget = [], self.alloc.free_count()
+            limit = min(free_slots, self.buckets.max_batch)
+            for seq in list(self._pending):
+                if len(group) >= limit:
+                    break
+                if self._bucket_len(seq.prompt.shape[0]) != bucket:
+                    continue
+                need = self.alloc.pages_for(seq.prompt.shape[0])
+                if need > budget:
+                    break       # keep FIFO: don't starve the big one
+                budget -= need
+                group.append(seq)
+            for seq in group:
+                self._pending.remove(seq)
+        return group
+
+    def _admit(self):
+        """Admit queued sequences into free decode slots (prefill).
+        While the breaker fast-fails nothing is admitted; once its probe
+        timer expires a SINGLE group goes through as the trial — its
+        verdict closes or re-opens the circuit (the
+        ``InferenceServer`` admission stance, at group granularity)."""
+        if self.breaker.engaged():
+            return False
+        cautious = self.breaker.state_code() != 0
+        worked = False
+        while True:
+            group = self._take_prefill_group()
+            if not group:
+                return worked
+            worked = True
+            self._prefill_group(group)
+            if cautious:
+                return worked
+
+    def _prefill_group(self, group):
+        """Prefill one bucket-aligned group and seat it in decode slots."""
+        k = len(group)
+        bucket = self._bucket_len(max(s.prompt.shape[0] for s in group))
+        b = self.buckets.batch_bucket(k)
+        slots = self._free_slots()[:k]
+        try:
+            for seq in group:
+                seq.pages = self.alloc.alloc(
+                    self.alloc.pages_for(seq.prompt.shape[0]))
+        except PoolExhaustedError:
+            # _take_prefill_group budgeted against the free count, so
+            # only a racing... nothing else allocates; defensive re-queue
+            for seq in group:
+                self._vacate(seq)
+            with self._admit_lock:
+                self._pending.extendleft(reversed(group))
+            return
+        tokens = np.zeros((b, bucket), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        active = np.zeros((b,), bool)
+        tables = np.zeros((b, self.pages_per_seq), np.int32)
+        temps = np.zeros((b,), np.float32)
+        topks = np.zeros((b,), np.int32)
+        for i, seq in enumerate(group):
+            n = seq.prompt.shape[0]
+            tokens[i, :n] = seq.prompt
+            lengths[i] = n
+            active[i] = True
+            tables[i, :len(seq.pages)] = seq.pages
+            temps[i] = seq.temp
+            topks[i] = seq.top_k
+        try:
+            _fault.fire("generate.prefill")
+            with _profiler.scope(f"{self._name}.prefill", cat="serving"):
+                first = self._run_prefill(tokens, lengths, active, tables,
+                                          temps, topks)
+        except Exception as exc:    # noqa: BLE001 — resolved per sequence
+            self.breaker.record_failure()
+            self._note_step_failure(exc)
+            err = _fault.with_context(exc, f"{self._name} prefill of {k}")
+            for seq in group:
+                self._retire(seq, err, stat="failed")
+            self._recover_pools()
+            return
+        self.breaker.record_success()
+        self._bump("prefills")
+        for i, seq in enumerate(group):
+            seq.cached = seq.prompt.shape[0]
+            seq.ran = True
+            tok = int(first[i])
+            s = seq.slot = slots[i]
+            self._seqs[s] = seq
+            self._bump("active_slots")
+            # seat-time slot init — the per-token path only advances
+            # _tokens/_lengths; _ensure_capacity appends table entries
+            self._tables[s, :] = 0
+            self._tables[s, :len(seq.pages)] = seq.pages
+            self._temps[s] = seq.temp
+            self._topks[s] = seq.top_k
+            self._active[s] = True
+            self._finish_token(seq, tok)
+        self._note_occupancy()
+
+    def _finish_token(self, seq, tok):
+        """Account one newly generated token; True if the sequence
+        retired (EOS or max-tokens).  A continuing sequence's per-token
+        slot state advances so the next decode step consumes ``tok``
+        (the page-table row is owned by seat-time init +
+        ``_ensure_capacity`` — never rewritten here)."""
+        if self._eos is not None and tok == self._eos:
+            self._retire(seq)
+            return True
+        seq.out.append(tok)
+        self._bump("tokens_out")
+        self._c_tokens.increment()
+        if len(seq.out) >= seq.max_new:
+            self._retire(seq)
+            return True
+        s = seq.slot
+        self._tokens[s] = tok
+        self._lengths[s] = seq.cached
+        return False
+
+    # ---- decode ----
+    def _ensure_capacity(self, seq):
+        """Guarantee a page exists for this step's write position.  When
+        the pool is dry, eviction is strictly seniority-ordered: a
+        sequence may only preempt YOUNGER neighbours (later admission
+        stamp — preserved across preemptions, so a restarted sequence
+        keeps its place in line); with no younger neighbour it yields
+        ITSELF back to the queue.  The oldest in-flight sequence is
+        therefore never evicted — combined with admission's
+        worst-case-fit check (its full need fits the pool alone) that
+        is the global progress guarantee: symmetric mutual eviction, the
+        livelock where two sequences endlessly restart each other, is
+        impossible by construction.  Returns False when ``seq`` yielded
+        (the caller must skip it this step)."""
+        while self.alloc.pages_for(seq.cached + 1) > len(seq.pages):
+            try:
+                seq.pages.extend(self.alloc.alloc(1))
+                self._tables[seq.slot, len(seq.pages) - 1] = seq.pages[-1]
+            except PoolExhaustedError:
+                victims = [s for s in self._seqs.values()
+                           if s is not seq and s.stamp > seq.stamp]
+                if victims:
+                    self._preempt(max(victims, key=lambda s: s.stamp))
+                elif len(self._seqs) > 1:
+                    self._preempt(seq)     # we are the youngest: yield
+                    return False
+                else:
+                    raise     # alone and dry: admission math was violated
+        return True
+
+    def _preempt(self, victim):
+        """Evict a sequence: free its pages and requeue it at the FRONT
+        for a from-scratch restart (generated-so-far is discarded — the
+        cache that backed it is gone).  The request future is untouched:
+        preemption is invisible to the client beyond latency."""
+        _fault.fire("generate.evict")
+        self._vacate(victim)
+        victim.cached = 0
+        victim.out = []
+        self._bump("preempted")
+        self._c_preempted.increment()
+        with self._admit_lock:
+            self._pending.appendleft(victim)
+
+    def _decode_once(self):
+        """One token for every in-flight sequence: capacity, the pinned
+        decode executable, then per-slot retirement/advance."""
+        try:
+            # oldest first: seniors claim pages (evicting juniors if the
+            # pool is dry) before juniors decide whether to yield
+            for seq in sorted(self._seqs.values(), key=lambda s: s.stamp):
+                if seq.slot is None:
+                    continue     # preempted by an earlier neighbour
+                self._ensure_capacity(seq)
+        except PoolExhaustedError as exc:
+            # unreachable via admission's worst-case check; resolve
+            # rather than wedge if it ever happens
+            self._fail_everything(_fault.with_context(
+                exc, f"{self._name} page pool wedged"))
+            return
+        if not self._seqs:
+            return
+        if not self.breaker.allow():
+            self._fail_everything(CircuitOpenError(
+                f"{self._name}: circuit open — fast-failing in-flight "
+                f"generation"), queued=False)
+            return
+        try:
+            _fault.fire("generate.decode")
+            with _profiler.scope(f"{self._name}.decode", cat="serving"):
+                nxt = self._run_decode()
+        except Exception as exc:    # noqa: BLE001 — resolved per sequence
+            self.breaker.record_failure()
+            self._note_step_failure(exc)
+            err = _fault.with_context(
+                exc, f"{self._name} decode step over "
+                f"{len(self._seqs)} sequences")
+            for seq in list(self._seqs.values()):
+                self._retire(seq, err, stat="failed")
+            self._recover_pools()
+            return
+        self.breaker.record_success()
+        self._bump("decode_steps")
+        for seq in list(self._seqs.values()):
+            seq.cached += 1          # this step wrote the input token
+            self._finish_token(seq, int(nxt[seq.slot]))
+
+    def _fail_everything(self, err, queued=True):
+        """Explicitly resolve every in-flight (and optionally queued)
+        sequence — the terminal sweep for breaker-open-during-drain and
+        never-happens pool wedges.  Nothing is silently dropped."""
+        for seq in list(self._seqs.values()):
+            self._retire(seq, err, stat="failed")
+        if not queued:
+            return
+        with self._admit_lock:
+            residue = list(self._pending)
+            self._pending.clear()
+        for seq in residue:
+            self._retire(seq, err, stat="failed")
+
+    def _fail_residue(self):
+        """Loop-exit sweep (a clean drain leaves nothing; a crashed loop
+        may): every accepted-but-unresolved sequence gets an explicit
+        terminal error."""
+        residue = list(self._seqs.values())
+        self._seqs = {}
+        with self._admit_lock:
+            residue += list(self._pending)
+            self._pending.clear()
+        for seq in residue:
+            if seq.slot is not None:
+                seq.slot = None
+                self._bump("active_slots", -1)
+            if seq.req.done():
+                continue
+            if seq.pages:
+                self.alloc.free(seq.pages)
+                seq.pages = []
+            seq.req.set_error(ServerClosedError(
+                "server stopped before this sequence finished"))
+            self._bump("failed")
+            self._bump("retired")
+
+    # ---------------------------------------------------------------- health --
+    def alive(self):
+        return self._thread.is_alive()
+
+    def ready(self):
+        return (self._ready.is_set() and self.alive()
+                and not self._draining.is_set()
+                and not self.breaker.engaged())
+
+    def healthz(self):
+        """Router-rankable snapshot (same fields as
+        ``InferenceServer.healthz`` plus the paging gauges)."""
+        with self._lock:
+            s = self._stats
+            in_flight = (s["admitted"] - s["completed"] - s["failed"]
+                         - s["expired"])
+            active = s["active_slots"]
+            last = self._last_error
+        return {"alive": self.alive(), "ready": self.ready(),
+                "draining": self._draining.is_set(),
+                "breaker": self.breaker.state,
+                "breaker_state": self.breaker.state_code(),
+                "in_flight": max(0, in_flight),
+                "active_slots": active,
+                "free_pages": self.alloc.free_count(),
+                "total_pages": self.alloc.allocatable,
+                "last_error": None if last is None else
+                {"type": last[0], "age": time.monotonic() - last[1]}}
+
+    @property
+    def stats(self):
+        with self._lock:
+            out = dict(self._stats)
+        out["free_pages"] = self.alloc.free_count()
+        out["breaker"] = self.breaker.state
+        return out
+
+    # ----------------------------------------------------------------- drain --
+    def drain(self, timeout=None):
+        """Graceful shutdown: stop admitting (submits raise
+        ``ServerClosedError``), finish EVERY accepted sequence — queued
+        ones included; generation is bounded by per-request max-tokens —
+        then stop the loop.  After ``drain()`` every ``Request`` ever
+        returned is ``done()``.  True when the loop exited in time."""
+        self._draining.set()
+        self._ready.clear()
+        with self._admit_lock:
+            self._stop.set()
+        if self._started.is_set():
+            self._thread.join(timeout)
+        if not self._thread.is_alive():
+            self._fail_residue()
+        return not self._thread.is_alive()
+
+    close = drain
+
+    def serve_forever(self, poll=0.05):
+        """Block until SIGTERM/SIGINT (``fault.GracefulExit``), then
+        drain — accepted sequences resolve, mid-decode work finishes."""
+        with _fault.GracefulExit() as g:
+            while not g.requested and self.alive():
+                time.sleep(poll)
+        return self.drain()

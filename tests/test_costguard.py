@@ -139,6 +139,40 @@ def test_serving_int8_weight_buffer_budget():
     assert q8["n_executables"] == f32["n_executables"] == 6
 
 
+# ------------------------------------- ISSUE 10: paged-KV byte commitment --
+def test_llm_paged_kv_byte_budget():
+    """The continuous-batching tentpole's structural-HBM win, pinned as
+    a committed golden PAIR (PR 8 pattern): the paged decode step's
+    ``memory.argument_bytes`` — the resident pool + weights + slot
+    state the ONE decode executable touches — is >= 40% below the
+    dense max-length-cache variant's, over the identical model, slot
+    grid, and sampling program.  Both sides are committed goldens, so
+    the WIN regresses in tier-1 if either drifts."""
+    paged = load_golden("llm_decode_step", REPO)["report"]
+    dense = load_golden("llm_decode_step_dense", REPO)["report"]
+    assert dense["memory"]["argument_bytes"] > 0
+    assert paged["memory"]["argument_bytes"] <= \
+        0.60 * dense["memory"]["argument_bytes"], (
+            f"paged decode-step argument bytes "
+            f"{paged['memory']['argument_bytes']} vs dense "
+            f"{dense['memory']['argument_bytes']} — the committed "
+            f">=40% paged-KV reduction no longer holds")
+    # both are the SAME one-executable contract: any in-flight mix of
+    # sequence lengths/ages runs the single compiled decode program
+    assert paged["n_executables"] == dense["n_executables"] == 1
+
+
+def test_llm_serving_census_is_prefill_grid_plus_one():
+    """The LLM serving executable space is exactly the prefill bucket
+    grid plus THE decode program — committed across the two goldens."""
+    prefill = load_golden("llm_prefill_grid", REPO)
+    decode = load_golden("llm_decode_step", REPO)
+    grid = (len(prefill["meta"]["batch_buckets"])
+            * len(prefill["meta"]["length_buckets"]))
+    assert prefill["report"]["n_executables"] == prefill["census"] == grid
+    assert decode["report"]["n_executables"] == decode["census"] == 1
+
+
 # ----------------------------------------------------------------- census --
 def test_executable_census_components():
     from mxnet_tpu.serving import BucketSpec

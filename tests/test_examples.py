@@ -47,6 +47,13 @@ def test_serve_fleet_mnist_example():
     assert "drained=True dropped=0" in out      # fleet-wide zero-drop drain
 
 
+def test_serve_llm_example():
+    out = _run("serve_llm.py", "--requests", "12", "--train-steps", "250")
+    assert "drained=True" in out
+    assert "0 traffic recompiles" in out      # census bounded the jit cache
+    assert "pages reclaimed 32/32" in out     # paged pool fully returned
+
+
 def test_bucketing_lstm_example():
     out = _run("bucketing_lstm.py", "--epochs", "2", "--batch-size", "16")
     assert "over buckets [4, 8, 12]" in out

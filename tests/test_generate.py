@@ -1,0 +1,459 @@
+"""Continuous-batching LLM serving (ISSUE 10): paged KV allocator,
+paged-vs-dense attention parity, the one-executable decode contract
+(census == runtime jit cache under mixed-length traffic), scheduler
+admit/retire/EOS/preemption, deadline expiry mid-generation,
+drain/SIGTERM, and sampling determinism.
+
+All tier-1 (JAX_PLATFORMS=cpu, conftest's virtual mesh).  The
+``generate`` marker selects this suite; signal tests also carry
+``chaos``.
+"""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu import fault, profiler
+from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
+                                                 init_causal_lm,
+                                                 prefill_forward)
+from mxnet_tpu.ops.paged_attention import (dense_decode_attention,
+                                           paged_decode_attention)
+from mxnet_tpu.ops.pallas.paged_attention import \
+    paged_decode_attention_pallas
+from mxnet_tpu.serving import (BucketSpec, CircuitBreaker,
+                               CircuitOpenError, DeadlineExceededError,
+                               GenerationServer, PageAllocator,
+                               PoolExhaustedError, RejectedError,
+                               ServerClosedError)
+
+pytestmark = pytest.mark.generate
+chaos = pytest.mark.chaos
+
+CFG = CausalLMConfig(vocab_size=48, n_layers=2, n_heads=2, head_dim=8,
+                     d_ff=32)
+PARAMS = init_causal_lm(CFG, seed=3)
+# amplified weights give varied (non-degenerate) greedy continuations,
+# so parity/EOS tests exercise real token diversity
+LOUD = {k: v * 8.0 if k in ("embed", "wqkv", "wo", "w1", "w2") else v
+        for k, v in PARAMS.items()}
+
+
+def make_server(params=LOUD, *, buckets=None, name=None, **kw):
+    buckets = buckets or BucketSpec(batch=(1,), length=(8,))
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("n_pages", 17)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_new_tokens", 6)
+    kw.setdefault("seed", 0)
+    name = name or f"GenSrv-{time.monotonic_ns()}"
+    return GenerationServer(params, CFG, buckets=buckets, name=name, **kw)
+
+
+def oracle_greedy(params, prompt, steps, pad_to=32):
+    """Reference continuation: re-run the FULL forward for every token."""
+    seq = list(int(t) for t in prompt)
+    out = []
+    for _ in range(steps):
+        toks = np.zeros((1, pad_to), np.int32)
+        toks[0, :len(seq)] = seq
+        logits, _, _ = prefill_forward(
+            params, CFG, jnp.asarray(toks),
+            jnp.asarray([len(seq)], np.int32))
+        t = int(np.argmax(np.asarray(logits)[0]))
+        out.append(t)
+        seq.append(t)
+    return np.asarray(out, np.int32)
+
+
+# -------------------------------------------------------------- allocator --
+def test_allocator_alloc_extend_free():
+    a = PageAllocator(9, 4)
+    assert a.allocatable == 8 and a.free_count() == 8
+    assert a.pages_for(1) == 1 and a.pages_for(4) == 1
+    assert a.pages_for(5) == 2 and a.pages_for(0) == 0
+    p1 = a.alloc(3)
+    assert len(p1) == 3 and 0 not in p1       # page 0 is the sink
+    p2 = a.alloc(5)
+    assert a.free_count() == 0
+    assert set(p1) | set(p2) == set(range(1, 9))
+    a.free(p2)
+    assert a.free_count() == 5
+
+
+def test_allocator_exhaustion_is_all_or_nothing():
+    a = PageAllocator(5, 2)
+    a.alloc(2)
+    before = a.free_count()
+    with pytest.raises(PoolExhaustedError):
+        a.alloc(3)
+    assert a.free_count() == before           # nothing was taken
+
+
+def test_allocator_fragmentation_reuse():
+    """Freed pages are immediately reusable whatever the free/hold
+    interleaving — any page serves any sequence, so there is no
+    fragmentation regime at all."""
+    a = PageAllocator(9, 4)
+    held = [a.alloc(2) for _ in range(4)]     # pool exhausted
+    assert a.free_count() == 0
+    a.free(held[0])                            # free a non-contiguous pair
+    a.free(held[2])
+    again = a.alloc(4)                         # one alloc spans both holes
+    assert sorted(again) == sorted(held[0] + held[2])
+
+
+def test_allocator_validation():
+    with pytest.raises(ValueError):
+        PageAllocator(1, 4)                    # sink needs a sibling
+    with pytest.raises(ValueError):
+        PageAllocator(4, 0)
+
+
+# ----------------------------------------------------- attention parity --
+def _paged_fixture(seed=0, slots=3, pages_per_seq=3, page=4, heads=2, d=8,
+                   n_pages=12):
+    rng = np.random.RandomState(seed)
+    q = rng.randn(slots, heads, d).astype(np.float32)
+    kp = rng.randn(n_pages, page, heads, d).astype(np.float32)
+    vp = rng.randn(n_pages, page, heads, d).astype(np.float32)
+    tables = np.zeros((slots, pages_per_seq), np.int32)
+    used = iter(range(1, n_pages))
+    lengths = np.asarray([11, 5, 0], np.int32)[:slots]
+    for s in range(slots):
+        for j in range(-(-int(lengths[s]) // page)):
+            tables[s, j] = next(used)
+    return q, kp, vp, tables, lengths
+
+
+def test_paged_vs_dense_attention_parity():
+    q, kp, vp, tables, lengths = _paged_fixture()
+    out = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths), impl="jnp"))
+    slots, P = tables.shape
+    page = kp.shape[1]
+    ctx = P * page
+    kc = kp[tables].reshape(slots, ctx, *kp.shape[2:])
+    vc = vp[tables].reshape(slots, ctx, *vp.shape[2:])
+    ref = np.asarray(dense_decode_attention(
+        jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+        jnp.asarray(lengths)))
+    np.testing.assert_allclose(out[:2], ref[:2], rtol=1e-5, atol=1e-5)
+    assert np.all(np.isfinite(out))            # inactive row: garbage, not NaN
+
+
+def test_paged_attention_pallas_interpret_parity():
+    """The TPU ragged kernel against the jnp path (Pallas interpreter
+    off-TPU), including the inactive-slot zero-output contract."""
+    q, kp, vp, tables, lengths = _paged_fixture()
+    ref = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths), impl="jnp"))
+    out = np.asarray(paged_decode_attention_pallas(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lengths)))
+    np.testing.assert_allclose(out[:2], ref[:2], rtol=1e-5, atol=1e-5)
+    assert np.all(out[2] == 0.0)               # length-0 slot never ran a page
+
+
+def test_incremental_decode_matches_full_forward():
+    """The strong contract: greedy generation through the paged
+    incremental decode loop is token-exact against re-running the whole
+    forward per token."""
+    srv = make_server(buckets=BucketSpec(batch=(1,), length=(8,)),
+                      n_pages=33, max_new_tokens=10).start()
+    prompt = np.asarray([5, 9, 2, 7, 1], np.int32)
+    try:
+        out = srv.submit(prompt, max_new_tokens=10).result(timeout=60)
+    finally:
+        assert srv.drain(30)
+    np.testing.assert_array_equal(out, oracle_greedy(LOUD, prompt, 10))
+
+
+# ---------------------------------------------------- census / recompiles --
+def test_census_equals_runtime_jit_cache_under_mixed_traffic():
+    """ISSUE 10 acceptance: one compiled decode executable serves ANY
+    in-flight mix.  A mixed-length, mixed-sampling traffic replay over
+    the full bucket grid compiles exactly ``prefill buckets + 1``
+    executables — the static census — and not one more."""
+    spec = BucketSpec(batch=(1, 2), length=(8, 16))
+    srv = make_server(buckets=spec, n_slots=4, n_pages=33,
+                      max_new_tokens=4).start()
+    census = srv.census()
+    assert census == 2 * 2 + 1
+    assert srv.jit_cache_count() == census     # warmup compiled the space
+    try:
+        rng = np.random.RandomState(0)
+        reqs = []
+        for i in range(12):                    # ragged lengths, mixed modes
+            n = int(rng.randint(1, 15))
+            reqs.append(srv.submit(
+                rng.randint(0, CFG.vocab_size, size=n).astype(np.int32),
+                max_new_tokens=int(rng.randint(1, 5)),
+                temperature=float(i % 2),      # greedy and sampled mixed
+                top_k=int(3 * (i % 2))))
+        for r in reqs:
+            r.result(timeout=60)
+        assert srv.jit_cache_count() == census, \
+            "traffic triggered a recompile — the pinned-signature " \
+            "contract is broken"
+        assert srv.stats["decode_steps"] > 0
+    finally:
+        assert srv.drain(30)
+    assert srv.jit_cache_count() == census
+
+
+# ------------------------------------------------------------- scheduler --
+def test_admit_retire_eos():
+    """A sequence retires the step its EOS appears, the token stream
+    excludes EOS, and its slot+pages free for queued work."""
+    free = oracle_greedy(LOUD, np.asarray([7, 11, 13], np.int32), 6)
+    assert free[3] != free[0]                  # diversity sanity
+    eos = int(free[3])
+    srv = make_server(eos_id=eos, n_pages=33, max_new_tokens=6).start()
+    try:
+        out = srv.submit(np.asarray([7, 11, 13], np.int32),
+                         max_new_tokens=6).result(timeout=60)
+        np.testing.assert_array_equal(out, free[:3])
+        st = srv.stats
+        assert st["completed"] == 1 and st["retired"] == 1
+        assert srv.alloc.free_count() == srv.alloc.allocatable
+    finally:
+        assert srv.drain(30)
+
+
+def test_queued_sequences_admitted_as_slots_free():
+    """More accepted sequences than decode slots: retirement admits the
+    queue the same loop, everyone resolves, pages fully reclaimed."""
+    srv = make_server(n_slots=2, n_pages=17, max_new_tokens=3).start()
+    try:
+        reqs = [srv.submit(np.asarray([i + 1, i + 2], np.int32))
+                for i in range(6)]
+        outs = [r.result(timeout=60) for r in reqs]
+        assert all(len(o) == 3 for o in outs)
+        assert srv.stats["completed"] == 6
+    finally:
+        assert srv.drain(30)
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+def test_pool_exhaustion_preempts_youngest_and_recovers():
+    """Two sequences that each fit the pool alone but not together: the
+    younger is evicted back to the queue (generate.evict fires, the
+    ``preempted`` stat moves) and BOTH still resolve."""
+    name = f"GenSrv-preempt-{time.monotonic_ns()}"
+    srv = make_server(buckets=BucketSpec(batch=(1,), length=(4,)),
+                      n_slots=2, n_pages=8, page_size=4,
+                      max_new_tokens=24, name=name).start()
+    try:
+        with fault.inject("generate.evict", RuntimeError("probe"),
+                          after_n=10 ** 9) as h:   # count, never raise
+            r1 = srv.submit(np.asarray([1, 2, 3, 4], np.int32),
+                            max_new_tokens=24)
+            r2 = srv.submit(np.asarray([5, 6, 7, 8], np.int32),
+                            max_new_tokens=24)
+            o1, o2 = r1.result(timeout=120), r2.result(timeout=120)
+        assert len(o1) == 24 and len(o2) == 24
+        st = srv.stats
+        assert st["preempted"] >= 1
+        assert h.calls >= 1                     # evict point actually fired
+        assert profiler.counter_value(f"{name}::preempted") >= 1
+    finally:
+        assert srv.drain(60)
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+def test_admission_rejections():
+    srv = make_server(n_pages=9, max_new_tokens=4).start()
+    try:
+        with pytest.raises(RejectedError):     # no bucket holds length 9
+            srv.submit(np.arange(9, dtype=np.int32))
+        with pytest.raises(RejectedError):     # worst case > pool
+            srv.submit(np.asarray([1, 2], np.int32), max_new_tokens=31)
+        with pytest.raises(ValueError):
+            srv.submit(np.asarray([1], np.int32), max_new_tokens=0)
+        with pytest.raises(ValueError):
+            srv.submit(np.asarray([[1, 2]], np.int32))   # not 1-D
+        assert srv.stats["rejected"] == 2      # ValueErrors are not sheds
+    finally:
+        assert srv.drain(30)
+    with pytest.raises(ServerClosedError):
+        srv.submit(np.asarray([1], np.int32))
+
+
+def test_deadline_expiry_mid_generation_frees_pages():
+    """A deadline that lands mid-decode resolves the request with an
+    explicit mid-generation DeadlineExceededError and reclaims its
+    pages; a queued-only expiry reports it never touched the device."""
+    srv = make_server(buckets=BucketSpec(batch=(1,), length=(4,)),
+                      n_slots=1, n_pages=129, page_size=4,
+                      max_new_tokens=500, max_context=512).start()
+    orig = srv._run_decode          # pace decode so the deadline lands
+    srv._run_decode = lambda: (time.sleep(0.02), orig())[1]
+    try:
+        req = srv.submit(np.asarray([1, 2], np.int32),
+                         max_new_tokens=500, deadline=0.25)
+        # a second sequence queued behind the only slot expires unserved
+        q = srv.submit(np.asarray([3, 4], np.int32),
+                       max_new_tokens=500, deadline=0.05)
+        err = req.exception(timeout=120)
+        assert isinstance(err, DeadlineExceededError)
+        assert "mid-generation" in str(err)
+        qerr = q.exception(timeout=120)
+        assert isinstance(qerr, DeadlineExceededError)
+        assert srv.stats["expired"] == 2
+    finally:
+        assert srv.drain(30)
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+# ------------------------------------------------------- sampling modes --
+def test_sampling_determinism_fixed_seed():
+    """Same seed + same traffic order → identical sampled streams, on
+    fresh servers; a different seed diverges (vocab is big enough that
+    a 6-token collision is ~impossible)."""
+    def run(seed):
+        srv = make_server(n_pages=33, seed=seed).start()
+        try:
+            return srv.submit(np.asarray([3, 1, 4], np.int32),
+                              max_new_tokens=6, temperature=1.0,
+                              top_k=8).result(timeout=60)
+        finally:
+            assert srv.drain(30)
+    a, b, c = run(7), run(7), run(8)
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_greedy_and_sampled_share_one_executable():
+    """temperature=0 (greedy) and temperature>0 (top-k sampled) rows
+    coexist in one decode batch — no per-mode executable exists."""
+    srv = make_server(n_slots=2, n_pages=33).start()
+    try:
+        g = srv.submit(np.asarray([3, 1, 4], np.int32), temperature=0.0)
+        s = srv.submit(np.asarray([3, 1, 4], np.int32), temperature=1.5,
+                       top_k=4)
+        g.result(timeout=60), s.result(timeout=60)
+        assert srv.jit_cache_count() == srv.census()
+    finally:
+        assert srv.drain(30)
+
+
+# ------------------------------------------------------ failure lifecycle --
+def test_decode_fault_fails_inflight_explicitly_and_recovers():
+    """An armed generate.decode fault errors every in-flight sequence
+    EXPLICITLY (nothing dropped, pages freed) and later traffic is
+    served again once the breaker's probe succeeds."""
+    srv = make_server(n_pages=33,
+                      breaker=CircuitBreaker(threshold=1, base_delay=0.01,
+                                             max_delay=0.02)).start()
+    try:
+        with fault.inject("generate.decode", RuntimeError("injected"),
+                          times=1) as h:
+            req = srv.submit(np.asarray([1, 2], np.int32))
+            err = req.exception(timeout=60)
+        assert h.fired == 1
+        assert err is not None and "injected" in str(err)
+        assert srv.alloc.free_count() == srv.alloc.allocatable
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:     # breaker re-closes via probe
+            try:
+                out = srv.submit(np.asarray([1, 2], np.int32)) \
+                    .result(timeout=60)
+                break
+            except (CircuitOpenError, RejectedError):
+                time.sleep(0.01)
+        else:
+            pytest.fail("breaker never recovered")
+        assert len(out) == 6
+        st = srv.stats
+        assert st["failed"] == 1 and st["completed"] == 1
+    finally:
+        assert srv.drain(30)
+
+
+def test_prefill_fault_fails_only_its_group():
+    """An armed generate.prefill fault errors the admitted group while a
+    sequence already decoding is untouched (host-side fault: the pools
+    were never consumed)."""
+    srv = make_server(n_slots=2, n_pages=33, max_new_tokens=30,
+                      breaker=CircuitBreaker(threshold=3)).start()
+    try:
+        first = srv.submit(np.asarray([1, 2], np.int32),
+                           max_new_tokens=30)
+        deadline = time.monotonic() + 20
+        while srv.stats["prefills"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.002)
+        with fault.inject("generate.prefill", RuntimeError("boom"),
+                          times=1) as h:
+            second = srv.submit(np.asarray([3, 4], np.int32),
+                                max_new_tokens=2)
+            err = second.exception(timeout=60)
+        assert h.fired == 1 and err is not None and "boom" in str(err)
+        out = first.result(timeout=120)
+        assert len(out) == 30                  # bystander fully served
+    finally:
+        assert srv.drain(60)
+
+
+# --------------------------------------------------------- drain / SIGTERM --
+def test_drain_resolves_everything_accepted():
+    srv = make_server(n_slots=2, n_pages=17, max_new_tokens=4).start()
+    reqs = [srv.submit(np.asarray([i + 1], np.int32)) for i in range(5)]
+    assert srv.drain(60)
+    assert all(r.done() for r in reqs)
+    outs = [r.result(timeout=0) for r in reqs]
+    assert all(len(o) == 4 for o in outs)      # drain SERVES queued work
+    assert not srv.alive()
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+    with pytest.raises(ServerClosedError):
+        srv.submit(np.asarray([1], np.int32))
+
+
+@chaos
+def test_sigterm_serve_forever_drains():
+    srv = make_server(n_slots=2, n_pages=17, max_new_tokens=4).start()
+    reqs = [srv.submit(np.asarray([i + 1, i + 2], np.int32))
+            for i in range(4)]
+    threading.Timer(0.05, os.kill,
+                    (os.getpid(), signal.SIGTERM)).start()
+    assert srv.serve_forever(poll=0.01)
+    assert all(r.done() for r in reqs)
+    assert all(r.exception(timeout=0) is None for r in reqs)
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+# ------------------------------------------------------- plumbing details --
+def test_generate_fault_points_registered():
+    pts = fault.points()
+    for p in ("generate.prefill", "generate.decode", "generate.evict"):
+        assert p in pts
+    with pytest.raises(ValueError):
+        fault.inject("generate.decoed", RuntimeError("typo")).__enter__()
+
+
+def test_profiler_counters_and_healthz():
+    name = f"GenSrv-counters-{time.monotonic_ns()}"
+    srv = make_server(name=name, n_pages=33).start()
+    try:
+        srv.submit(np.asarray([1, 2], np.int32),
+                   max_new_tokens=3).result(timeout=60)
+        assert profiler.counter_value(f"{name}::tokens_out") >= 3
+        assert profiler.counter_value(f"{name}::retired") == 1
+        assert profiler.counter_value(f"{name}::page_occupancy") == 0
+        h = srv.healthz()
+        assert h["alive"] and h["ready"] and not h["draining"]
+        assert h["free_pages"] == h["total_pages"]
+        assert h["in_flight"] == 0 and h["last_error"] is None
+        st = srv.stats
+        assert st["admitted"] == st["completed"] + st["failed"] \
+            + st["expired"]
+    finally:
+        assert srv.drain(30)
+        assert not srv.healthz()["alive"]

@@ -933,6 +933,8 @@ COVERED_ELSEWHERE = {
     "ulysses_attention": "tests/test_sequence_parallel.py",
     "moe_ffn": "tests/test_moe.py",
     "flash_attention": "tests/test_flash_attention.py",
+    "paged_decode_attention": "tests/test_generate.py",
+    "dense_decode_attention": "tests/test_generate.py",
     "quantized_conv": "tests/test_misc_subsystems.py",
     "FusedNormReluConv": "tests/test_fused_conv.py",
     # the symbolic frontend's ops (tests/test_symbol.py, test_module.py)

@@ -1,0 +1,13 @@
+"""Re-run the continuous-batching LLM serving suite on TPU: the paged
+decode attention auto-selects the NATIVE Pallas ragged kernel there
+(the CPU suite runs the pure-jnp gather path, plus the kernel in
+interpreter mode), so allocator/scheduler/census/parity all re-verify
+against the real kernel."""
+import jax
+import pytest
+
+if jax.default_backend() == "cpu":
+    pytest.skip("TPU re-run suite needs an accelerator backend",
+                allow_module_level=True)
+
+from test_generate import *   # noqa: F401,F403,E402

@@ -32,6 +32,16 @@ apply) and streams a fresh **f32** training snapshot through a rolling
 update under traffic — re-quantized on ingest by the fleet's
 quantizer, 0 drops, census unchanged.
 
+``--mode llm`` runs the ISSUE 10 acceptance end to end: a
+``mx.serving.GenerationServer`` (paged KV cache, one pinned decode
+executable) streams generations from client threads while a
+``generate.decode`` failure burst fires, then lands a SIGTERM
+mid-decode.  The contract: **zero dropped accepted sequences** (every
+accepted ``Request`` resolves to tokens or an explicit error),
+**zero recompiles** (runtime jit-cache count == the prefill-grid + 1
+census before and after the chaos), and **pages fully reclaimed**
+after the drain (free list == allocatable pool size).
+
 ``--mode lint`` runs the full mxlint analyzer twice against a fresh
 cache directory and asserts the second (fully cached) run is >= 5x
 faster AND byte-identical in findings — the incremental-mode contract
@@ -174,6 +184,109 @@ def serve_mode(args):
     print(f"[chaos_check] PASS: drain completed with every accepted "
           f"request resolved ({oks} served, {errs} explicitly errored, "
           f"0 dropped)")
+    return 0
+
+
+def llm_mode(args):
+    """Continuous-batching LLM serving chaos (ISSUE 10): stream
+    generations under a decode-fault burst + SIGTERM mid-decode."""
+    import signal
+    import threading
+
+    from mxnet_tpu import fault, serving
+    from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
+                                                     init_causal_lm)
+
+    cfg = CausalLMConfig(vocab_size=64, n_layers=2, n_heads=2,
+                         head_dim=8, d_ff=32)
+    srv = serving.GenerationServer(
+        init_causal_lm(cfg, seed=0), cfg,
+        buckets=serving.BucketSpec(batch=(1, 2), length=(8, 16)),
+        n_slots=4, n_pages=33, page_size=8, max_new_tokens=6,
+        max_queue=256, seed=0,
+        breaker=serving.CircuitBreaker(threshold=3, base_delay=0.02,
+                                       max_delay=0.1),
+        name="ChaosGen")
+    srv.start()
+    census = srv.census()
+    warm = srv.jit_cache_count()
+    print(f"[chaos_check] llm: warmed {warm} executables "
+          f"(census {census}: prefill grid + 1 decode), "
+          f"ready={srv.ready()}")
+
+    accepted, sheds = [], [0]
+    count_lock = threading.Lock()
+    stop_submitting = threading.Event()
+
+    def client(k):
+        rng = np.random.RandomState(k)
+        for i in range(args.requests):
+            if stop_submitting.is_set():
+                return
+            prompt = rng.randint(0, 64, size=int(rng.randint(1, 15)))
+            try:
+                req = srv.submit(prompt.astype(np.int32),
+                                 max_new_tokens=int(rng.randint(1, 7)),
+                                 temperature=float(i % 2), top_k=4)
+                with count_lock:
+                    accepted.append(req)
+            except serving.RejectedError:
+                with count_lock:
+                    sheds[0] += 1
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(4)]
+    with fault.inject("generate.decode",
+                      RuntimeError("injected decode fault"),
+                      after_n=5, times=3) as h:
+        for t in threads:
+            t.start()
+        # SIGTERM lands while sequences are mid-decode and clients are
+        # still submitting — serve_forever must drain, not drop
+        threading.Timer(0.3, os.kill, (os.getpid(), signal.SIGTERM)).start()
+        drained = srv.serve_forever(poll=0.01)
+    stop_submitting.set()
+    for t in threads:
+        t.join()
+
+    resolved = sum(1 for r in accepted if r.done())
+    oks = sum(1 for r in accepted
+              if r.done() and r.exception(timeout=0) is None)
+    errs = resolved - oks
+    st = srv.stats
+    print(f"[chaos_check] llm: accepted={len(accepted)} ok={oks} "
+          f"errored={errs} shed={sheds[0]} injected_fired={h.fired} "
+          f"tokens_out={st['tokens_out']} preempted={st['preempted']} "
+          f"stats={st}")
+    fails = []
+    if not drained:
+        fails.append("drain did not complete")
+    if resolved != len(accepted):
+        fails.append(f"{len(accepted) - resolved} accepted sequences "
+                     f"were silently dropped")
+    if h.fired == 0:
+        fails.append("injected decode faults never fired")
+    if errs == 0:
+        fails.append("no sequence surfaced the injected failure")
+    if oks == 0:
+        fails.append("no sequence was actually served")
+    if srv.jit_cache_count() != warm or warm != census:
+        fails.append(f"recompile: jit cache {srv.jit_cache_count()} vs "
+                     f"warmup {warm} vs census {census}")
+    if srv.alloc.free_count() != srv.alloc.allocatable:
+        fails.append(f"page leak: {srv.alloc.free_count()} free of "
+                     f"{srv.alloc.allocatable} after drain")
+    if srv.alive():
+        fails.append("decode loop survived the drain")
+    if fails:
+        for f in fails:
+            print(f"[chaos_check] FAIL: {f}")
+        return 1
+    print(f"[chaos_check] PASS: drain completed with every accepted "
+          f"sequence resolved ({oks} served, {errs} explicitly errored, "
+          f"0 dropped), 0 recompiles ({warm} executables == census), "
+          f"pages fully reclaimed")
     return 0
 
 
@@ -751,6 +864,8 @@ MODES = {
     "serve": ("inject-and-drain serving smoke (ISSUE 4)", serve_mode),
     "fleet": ("replica-kill + rolling weight updates + SIGTERM "
               "(ISSUES 7/8)", fleet_mode),
+    "llm": ("decode-fault burst + SIGTERM mid-decode on the "
+            "continuous-batching LLM server (ISSUE 10)", llm_mode),
     "lint": ("incremental-analyzer cold-vs-warm contract (ISSUE 5)",
              lint_mode),
     "cost": ("cold-vs-warm compiled-cost budget audit (ISSUE 6)",

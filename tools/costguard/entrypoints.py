@@ -236,6 +236,149 @@ def _serving_mlp_grid_build(name, batch_buckets, length_buckets, features,
                       census=executable_census(spec))
 
 
+def _llm_parts(vocab=256, n_layers=2, n_heads=2, head_dim=16, d_ff=64,
+               n_slots=8, n_pages=64, page_size=16, pages_per_seq=16):
+    """Shared pieces of the LLM serving entry points: the tiny causal
+    LM's param avals (``jax.eval_shape`` — zero device work) and the
+    fixed decode-grid geometry.  ``n_pages * page_size`` (1024 cache
+    tokens) is HALF of ``n_slots * pages_per_seq * page_size`` (2048) —
+    the pool is deliberately oversubscribed 2:1 against the worst case,
+    which is exactly the HBM the paged design reclaims and the
+    ``llm_decode_step`` vs ``llm_decode_step_dense`` golden pair
+    commits (>= 40% fewer decode-step argument bytes, gated by
+    tests/test_costguard.py::test_llm_paged_kv_byte_budget)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
+                                                     init_causal_lm)
+
+    cfg = CausalLMConfig(vocab_size=vocab, n_layers=n_layers,
+                         n_heads=n_heads, head_dim=head_dim, d_ff=d_ff)
+    p_avals = jax.eval_shape(lambda: init_causal_lm(cfg, 0))
+    geom = {"n_slots": n_slots, "n_pages": n_pages,
+            "page_size": page_size, "pages_per_seq": pages_per_seq,
+            "max_context": pages_per_seq * page_size}
+    sds = jax.ShapeDtypeStruct
+    slot_avals = {
+        "tokens": sds((n_slots,), jnp.int32),
+        "lengths": sds((n_slots,), jnp.int32),
+        "active": sds((n_slots,), jnp.bool_),
+        "tables": sds((n_slots, pages_per_seq), jnp.int32),
+        "key": sds((2,), jnp.uint32),
+        "temps": sds((n_slots,), jnp.float32),
+        "topks": sds((n_slots,), jnp.int32),
+    }
+    return cfg, p_avals, geom, slot_avals
+
+
+def _n_leaves(*trees):
+    import jax
+    return sum(len(jax.tree.leaves(t)) for t in trees)
+
+
+@entrypoint("llm_decode_step")
+def build_llm_decode_step():
+    """THE continuous-batching decode executable (serving/generate.py):
+    one token for every in-flight sequence over the fixed slot grid,
+    K/V held in the shared paged pool addressed by page tables.  Its
+    census is 1 by construction — every traffic mix runs this program —
+    and its ``memory.argument_bytes`` is the paged-KV headline the
+    golden pair below commits."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.serving.generate import build_decode_step
+
+    cfg, p_avals, g, s = _llm_parts()
+    pool = jax.ShapeDtypeStruct(
+        (cfg.n_layers, g["n_pages"], g["page_size"], cfg.n_heads,
+         cfg.head_dim), jnp.float32)
+    step = jax.jit(build_decode_step(cfg, g["page_size"], "jnp"),
+                   donate_argnums=(1, 2))
+    lowered = step.lower(p_avals, pool, pool, s["tokens"], s["lengths"],
+                         s["active"], s["tables"], s["key"], s["temps"],
+                         s["topks"])
+    n_args = _n_leaves(p_avals) + 2 + 7
+    meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
+                     f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged", **g}
+    return EntryBuild(name="llm_decode_step", meta=meta, census=1,
+                      programs=[Program("llm_decode_step", lowered,
+                                        n_args)])
+
+
+@entrypoint("llm_decode_step_dense")
+def build_llm_decode_step_dense():
+    """The dense max-length-cache decode variant: identical model, slot
+    grid, and sampling, but every slot owns a full ``max_context``
+    cache stripe.  Committed as the golden the paged entry is diffed
+    against — the pair IS the structural-HBM-win regression floor
+    (PR 8 pattern: the win itself is gated, not just each side)."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.serving.generate import build_dense_decode_step
+
+    cfg, p_avals, g, s = _llm_parts()
+    cache = jax.ShapeDtypeStruct(
+        (cfg.n_layers, g["n_slots"], g["max_context"], cfg.n_heads,
+         cfg.head_dim), jnp.float32)
+    step = jax.jit(build_dense_decode_step(cfg, g["max_context"]),
+                   donate_argnums=(1, 2))
+    lowered = step.lower(p_avals, cache, cache, s["tokens"], s["lengths"],
+                         s["active"], s["key"], s["temps"], s["topks"])
+    n_args = _n_leaves(p_avals) + 2 + 6
+    meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
+                     f"{cfg.n_heads}h{cfg.head_dim}",
+            "kv": "dense max-length", **g}
+    return EntryBuild(name="llm_decode_step_dense", meta=meta, census=1,
+                      programs=[Program("llm_decode_step_dense", lowered,
+                                        n_args)])
+
+
+@entrypoint("llm_prefill_grid")
+def build_llm_prefill_grid(batch_buckets=(1, 2), length_buckets=(32, 64)):
+    """The prompt-prefill side of the LLM serving census: ONE jitted
+    prefill program lowered at every padded (batch, length) bucket the
+    ``GenerationServer``'s BucketSpec admits.  Together with
+    ``llm_decode_step`` this is the ENTIRE executable space of the
+    serving loop — runtime jit caches are asserted equal to this census
+    under mixed-length traffic in tests/test_generate.py."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.serving import BucketSpec
+    from mxnet_tpu.serving.generate import build_prefill_step
+
+    cfg, p_avals, g, s = _llm_parts()
+    spec = BucketSpec(batch=batch_buckets, length=length_buckets)
+    pool = jax.ShapeDtypeStruct(
+        (cfg.n_layers, g["n_pages"], g["page_size"], cfg.n_heads,
+         cfg.head_dim), jnp.float32)
+    step = jax.jit(build_prefill_step(cfg, g["page_size"]),
+                   donate_argnums=(1, 2))
+    sds = jax.ShapeDtypeStruct
+    programs = []
+    for b, L in grid_signatures(spec):
+        # mxlint: disable=jit-in-loop -- this loop IS the census: one
+        # lower per bucket signature, bounded by the static grid, and
+        # the expensive compile is memoized by the report cache
+        lowered = step.lower(
+            p_avals, pool, pool, sds((b, L), jnp.int32),
+            sds((b,), jnp.int32), sds((b,), jnp.bool_),
+            sds((b, g["pages_per_seq"]), jnp.int32), s["key"],
+            sds((b,), jnp.float32), sds((b,), jnp.int32))
+        programs.append(Program(f"llm_prefill_grid/b{b}_l{L}", lowered,
+                                n_args=_n_leaves(p_avals) + 2 + 7))
+    meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
+                     f"{cfg.n_heads}h{cfg.head_dim}",
+            "batch_buckets": list(spec.batch),
+            "length_buckets": list(spec.length), **g}
+    return EntryBuild(name="llm_prefill_grid", meta=meta,
+                      programs=programs,
+                      census=executable_census(spec))
+
+
 @entrypoint("serving_mlp_grid")
 def build_serving_mlp_grid(batch_buckets=(1, 2, 4), length_buckets=(8, 16),
                            features=32, dtype="float32"):
