@@ -10,7 +10,7 @@ pieces:
 - functional_call            pure-function view of any Gluon block
 """
 from .mesh import (AXES, MeshScope, current_mesh, default_mesh, make_mesh,
-                   named_sharding, replicated)
+                   named_sharding, replicated, shard_map, validate_specs)
 from .sharding import (ShardingRules, batch_spec, fsdp_rules, param_sharding,
                        tp_dense_rules)
 from .functional import functional_call, param_names_and_values

@@ -25,7 +25,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 __all__ = ["AXES", "make_mesh", "current_mesh", "default_mesh", "MeshScope",
-           "replicated", "named_sharding", "shard_map"]
+           "replicated", "named_sharding", "shard_map", "validate_specs"]
 
 
 def _compat_shard_map():
@@ -49,7 +49,62 @@ def _compat_shard_map():
         return sm
 
 
-shard_map = _compat_shard_map()
+_jax_shard_map = _compat_shard_map()
+
+
+def _spec_axis_names(specs):
+    """Every axis name appearing in a specs pytree (PartitionSpec
+    leaves; entries may be names or tuples of names)."""
+    out = []
+    for spec in jax.tree_util.tree_leaves(
+            specs, is_leaf=lambda s: isinstance(s, PartitionSpec)):
+        if not isinstance(spec, PartitionSpec):
+            continue
+        for entry in spec:
+            names = entry if isinstance(entry, tuple) else (entry,)
+            for name in names:
+                if isinstance(name, str):
+                    out.append(name)
+    return out
+
+
+def validate_specs(mesh, in_specs=None, out_specs=None):
+    """Raise ``ValueError`` naming the axis when an in/out spec names a
+    mesh axis that does not exist — the runtime twin of mxlint's
+    ``spmd-axis-unknown``.  Without this a typo'd axis surfaces as a
+    deep jax internal error far from the call site."""
+    axes = set(getattr(mesh, "axis_names", ()) or ())
+    if not axes:
+        return
+    for role, specs in (("in_specs", in_specs), ("out_specs", out_specs)):
+        for name in _spec_axis_names(specs):
+            if name not in axes:
+                raise ValueError(
+                    f"shard_map {role} names axis {name!r}, which is "
+                    f"not one of the mesh axes {tuple(sorted(axes))} — "
+                    f"a typo'd axis would otherwise fail deep inside "
+                    f"jax (or silently change the partitioning)")
+
+
+def shard_map(f=None, *, mesh=None, in_specs=None, out_specs=None, **kw):
+    """``jax.shard_map`` with call-time axis validation: every axis
+    named in ``in_specs``/``out_specs`` must exist in
+    ``mesh.axis_names`` (``validate_specs``).  Currying (``f=None``)
+    and the ``check_vma``/``check_rep`` compat of older jax are
+    preserved."""
+    if mesh is not None:
+        validate_specs(mesh, in_specs, out_specs)
+    inner = {}
+    if mesh is not None:
+        inner["mesh"] = mesh
+    if in_specs is not None:
+        inner["in_specs"] = in_specs
+    if out_specs is not None:
+        inner["out_specs"] = out_specs
+    inner.update(kw)
+    if f is None:
+        return lambda g: _jax_shard_map(g, **inner)
+    return _jax_shard_map(f, **inner)
 
 # Canonical axis order: collectives that ride adjacent devices (tp, sp) go
 # last so they land on the fastest ICI neighbours in the device enumeration.

@@ -176,10 +176,17 @@ def reduce_gradients(grads, axis_name, n_dev, mode="int8", key=None,
     for i, g in enumerate(grads):
         lkey = None if key is None else jax.random.fold_in(key, i)
         if mode == "f32":
+            # mxlint: disable=spmd-collective-in-loop -- deliberate
+            # per-leaf loop: gradient leaves have heterogeneous
+            # shapes/dtypes, flattening them into one collective would
+            # defeat the per-chunk scales (and XLA overlaps the
+            # unrolled per-leaf collectives on ICI anyway)
             r = lax.psum(g, axis_name)
             r = (r / n_dev).astype(g.dtype) if mean else r
         elif mode == "bf16":
             h = cast_bf16(g.astype(jnp.float32) / n_dev if mean else g, lkey)
+            # mxlint: disable=spmd-collective-in-loop -- same deliberate
+            # per-leaf loop as the f32 branch (heterogeneous leaves)
             r = lax.psum(h, axis_name).astype(g.dtype)
         else:
             r = _reduce_leaf_int8(g, axis_name, n_dev, lkey, chunk, mean)

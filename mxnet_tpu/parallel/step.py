@@ -337,6 +337,10 @@ class TrainStep:
                     # aux updates (BN running stats) are per-shard here:
                     # average the float ones; anything non-float is
                     # assumed replica-identical
+                    # mxlint: disable=spmd-collective-in-loop -- deliberate
+                    # per-leaf comprehension over the short aux-state
+                    # list (BN running stats): leaves have heterogeneous
+                    # shapes and only float ones reduce
                     mu = [jax.lax.pmean(m, "dp")
                           if jnp.issubdtype(m.dtype, jnp.floating) else m
                           for m in mu]

@@ -39,6 +39,19 @@ def main(argv=None):
     names = (argv if argv else sys.argv[1:]) or entrypoints.names()
     out_dir = REPO / budget.GOLDEN_SUBDIR
     out_dir.mkdir(parents=True, exist_ok=True)
+    env = environment()
+    # census guard, device-count leg, checked for EVERY requested name
+    # BEFORE anything is written: a sharded golden regenerated from a
+    # shell whose visible device count differs from the committed one
+    # would silently gate nothing — refuse, and refuse before the loop
+    # half-rewrites the directory
+    for name in names:
+        path = out_dir / f"{name}.json"
+        if path.exists():
+            old = json.loads(path.read_text(encoding="utf-8"))
+            msg = budget.device_count_guard(old, env["n_devices"], name)
+            if msg:
+                raise SystemExit(msg)
     for name in names:
         built = entrypoints.build(name)
         report = report_for_programs(built.programs)   # no cache: fresh
@@ -47,7 +60,7 @@ def main(argv=None):
                 f"{name}: lowered {report['n_executables']} executables "
                 f"but the static census says {built.census} — fix the "
                 f"entry point before committing a golden")
-        golden = dict(environment())
+        golden = dict(env)
         golden.update({"entry": name, "meta": built.meta,
                        "census": built.census, "report": report})
         path = out_dir / f"{name}.json"

@@ -173,6 +173,134 @@ def test_llm_serving_census_is_prefill_grid_plus_one():
     assert decode["report"]["n_executables"] == decode["census"] == 1
 
 
+# ------------------------- ISSUE 11: sharded per-device cost budgets --
+def test_program_num_partitions_parser():
+    from tools.costguard.report import program_num_partitions
+    sharded = ("HloModule jit_f, is_scheduled=true, num_partitions=8, "
+               "entry_computation_layout={()->f32[1]{0}}\n")
+    single = "HloModule jit_f, is_scheduled=true\n"
+    assert program_num_partitions(sharded) == 8
+    assert program_num_partitions(single) == 1
+    assert program_num_partitions("") == 1
+
+
+def test_per_device_merge_takes_worst_single_program():
+    from tools.costguard.report import merge_reports
+    base = {"n_executables": 1, "flops": 1.0, "bytes_accessed": 1.0,
+            "transcendentals": 0.0, "collective_bytes": 0.0,
+            "memory": {}, "donation": {"donated_args": 0,
+                                       "total_args": 1},
+            "instructions": {"total": 1}}
+    u1 = dict(base, per_device={"n_devices": 8, "argument_bytes": 100,
+                                "peak_bytes": 200,
+                                "collective_bytes": 32.0})
+    u2 = dict(base, per_device={"n_devices": 8, "argument_bytes": 300,
+                                "peak_bytes": 150,
+                                "collective_bytes": 8.0})
+    merged = merge_reports([u1, u2])
+    # executables run one at a time: the budgetable per-device figure
+    # is the worst single program, not a fictitious sum
+    assert merged["per_device"] == {"n_devices": 8,
+                                    "argument_bytes": 300,
+                                    "peak_bytes": 200,
+                                    "collective_bytes": 32.0}
+    # key UNION: a unit whose memory extraction failed (per_device
+    # missing the byte keys) must not drop the metrics others report
+    u3 = dict(base, per_device={"n_devices": 8,
+                                "collective_bytes": 4.0})
+    merged = merge_reports([u3, u1])
+    assert merged["per_device"]["argument_bytes"] == 100
+    assert merged["per_device"]["peak_bytes"] == 200
+    assert merged["per_device"]["collective_bytes"] == 32.0
+
+
+def test_dp_sharded_per_device_byte_budget():
+    """The dp golden pair, diffed: on a pure-dp mesh the params are
+    replicated and ONLY the batch shards, so the dp=8 entry's
+    per-device argument bytes must sit exactly 7/8 of the batch bytes
+    below the committed dp=1 control — per-device bytes ∝ 1/shards for
+    the sharded tensors, as a diff of two COMMITTED goldens."""
+    dp8 = load_golden("mnist_mlp_train", REPO)["report"]
+    dp1 = load_golden("mnist_mlp_train_dp1", REPO)["report"]
+    assert dp8["per_device"]["n_devices"] == 8
+    assert dp1["per_device"]["n_devices"] == 1
+    batch_bytes = 64 * 784 * 4 + 64 * 4          # x f32 + y i32
+    saved = dp1["per_device"]["argument_bytes"] \
+        - dp8["per_device"]["argument_bytes"]
+    expect = batch_bytes * 7 // 8
+    assert abs(saved - expect) <= 0.02 * expect, (
+        f"dp=8 per-device argument bytes save {saved} vs the expected "
+        f"7/8 of the batch ({expect}) — the batch is no longer "
+        f"dp-sharded (or something else leaked into the signature)")
+    # the sharded side pays its gradient collectives; the control is
+    # collective-free
+    assert dp8["per_device"]["collective_bytes"] > 0
+    assert dp1["per_device"]["collective_bytes"] == 0
+    assert dp8["n_executables"] == dp1["n_executables"] == 1
+
+
+def test_tp_sharded_per_device_byte_budget():
+    """The TP golden pair, diffed: column/row-sharded weights put
+    1/shards of the weight bytes on each device, so the tp=8 apply's
+    per-device argument bytes must be >= 70% below the tp=1 control
+    (committed: ~87% — weights dominate this entry by construction),
+    with the output all-reduce visible in the collective columns.  This
+    is THE gate ROADMAP item 1's tensor-parallel decode lands on."""
+    tp8 = load_golden("mlp_apply_tp8", REPO)["report"]
+    tp1 = load_golden("mlp_apply_tp1", REPO)["report"]
+    assert tp8["per_device"]["n_devices"] == 8
+    assert tp1["per_device"]["n_devices"] == 1
+    assert tp1["per_device"]["argument_bytes"] > 0
+    assert tp8["per_device"]["argument_bytes"] <= \
+        0.30 * tp1["per_device"]["argument_bytes"], (
+            f"tp=8 per-device argument bytes "
+            f"{tp8['per_device']['argument_bytes']} vs tp=1 "
+            f"{tp1['per_device']['argument_bytes']} — the committed "
+            f">=70% per-device weight reduction no longer holds")
+    # the two Megatron collectives collapse to ONE all-reduce here
+    # (activations replicated); the control has none
+    assert tp8["instructions"]["collective"] >= 1
+    assert tp8["per_device"]["collective_bytes"] > 0
+    assert tp1["instructions"]["collective"] == 0
+    assert tp8["n_executables"] == tp1["n_executables"] == 1
+
+
+def test_tp_sharded_census_matches_runtime_jit_cache():
+    """Census == runtime jit-cache count, preserved on the SHARDED
+    entry: executing the tp=8 apply with real mesh-sharded arrays (two
+    distinct batches) compiles exactly the one executable the golden
+    budgets."""
+    import jax
+    import jax.numpy as jnp
+
+    from tools.costguard.entrypoints import tp_mlp_apply
+
+    apply, avals, mesh = tp_mlp_apply(8)
+    args = [jnp.ones(a.shape, a.dtype) for a in avals]
+    out1 = apply(*args)
+    args[-1] = jnp.full(avals[-1].shape, 2.0, avals[-1].dtype)
+    out2 = apply(*args)
+    assert out1.shape == out2.shape == avals[-1].shape
+    assert apply._cache_size() == 1 == \
+        load_golden("mlp_apply_tp8", REPO)["report"]["n_executables"]
+
+
+def test_regen_device_count_guard():
+    """The census guard's device-count leg: a SHARDED golden refuses
+    regeneration when the visible device count differs from the one it
+    embeds (prevents committing a 1-device 'sharded' budget by
+    accident); unsharded goldens and matching environments pass."""
+    from tools.costguard.budget import device_count_guard
+
+    sharded = {"n_devices": 8, "meta": {"sharded": True}}
+    assert device_count_guard(sharded, 8, "e") is None
+    msg = device_count_guard(sharded, 1, "e")
+    assert msg is not None and "refusing" in msg and "8" in msg
+    unsharded = {"n_devices": 8, "meta": {"sharded": False}}
+    assert device_count_guard(unsharded, 1, "e") is None
+    assert device_count_guard({"n_devices": 8, "meta": {}}, 1, "e") is None
+
+
 # ----------------------------------------------------------------- census --
 def test_executable_census_components():
     from mxnet_tpu.serving import BucketSpec

@@ -24,6 +24,37 @@ def test_make_mesh_axes():
         parallel.make_mesh(dp=3, tp=4)
 
 
+def test_shard_map_validates_spec_axes():
+    """mesh.shard_map's call-time axis validation (the runtime twin of
+    mxlint's spmd-axis-unknown): a typo'd axis in in_specs/out_specs
+    raises a ValueError NAMING the axis at the wrapping site, instead
+    of a deep jax internal error at trace time."""
+    mesh = parallel.make_mesh(dp=8)
+    with pytest.raises(ValueError, match="'pd'"):
+        parallel.shard_map(lambda x: x, mesh=mesh,
+                           in_specs=(PartitionSpec("pd"),),
+                           out_specs=PartitionSpec())
+    with pytest.raises(ValueError, match="out_specs.*'tp'"):
+        parallel.shard_map(lambda x: x, mesh=mesh,
+                           in_specs=(PartitionSpec("dp"),),
+                           out_specs=PartitionSpec("tp"))
+    # tuple-of-names spec entries are validated too
+    with pytest.raises(ValueError, match="'sp'"):
+        parallel.validate_specs(
+            mesh, in_specs=(PartitionSpec(("dp", "sp")),))
+    # a valid wrapper still runs (curried decorator form included)
+    run = parallel.shard_map(lambda x: x * 2, mesh=mesh,
+                             in_specs=(PartitionSpec("dp"),),
+                             out_specs=PartitionSpec("dp"),
+                             check_vma=False)
+    out = run(jnp.ones((8, 4)))
+    assert out.shape == (8, 4) and float(out[0, 0]) == 2.0
+    deco = parallel.shard_map(mesh=mesh,
+                              in_specs=(PartitionSpec("dp"),),
+                              out_specs=PartitionSpec("dp"))
+    assert deco(lambda x: x + 1)(jnp.zeros((8, 2))).shape == (8, 2)
+
+
 def test_sharding_rules_tp():
     mesh = parallel.make_mesh(dp=2, tp=4)
     rules = parallel.tp_dense_rules()

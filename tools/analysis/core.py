@@ -55,7 +55,7 @@ SEVERITIES = ("error", "warning", "info")
 
 #: bump when ANY rule's logic changes: it keys the incremental cache,
 #: and a stale record must never survive an analyzer upgrade
-ENGINE_VERSION = "2.1"
+ENGINE_VERSION = "3.0"
 
 # id of the meta-rule emitted for malformed disable comments; it cannot
 # itself be suppressed (suppressing the suppression-checker is turtles).
@@ -218,11 +218,16 @@ def default_rules() -> List[Rule]:
     from .lifecycle_rules import ResourceLeakRule
     from .registry_rules import (DuplicateRegistrationRule,
                                  MissingGradientRule, StaleDocSymbolRule)
+    from .spmd_rules import (SpmdAxisUnknownRule, SpmdSpecArityRule,
+                             SpmdReplicationClaimRule,
+                             SpmdCollectiveInLoopRule)
 
     return [HostSyncRule(), TracedBranchRule(), MutableGlobalRule(),
             UnhashableStaticRule(), UnlockedAttrRule(), DonatedReuseRule(),
             BlockingUnderLockRule(), LockOrderRule(), SignalHandlerRule(),
             ResourceLeakRule(), JitInLoopRule(),
+            SpmdAxisUnknownRule(), SpmdSpecArityRule(),
+            SpmdReplicationClaimRule(), SpmdCollectiveInLoopRule(),
             DuplicateRegistrationRule(), MissingGradientRule(),
             StaleDocSymbolRule(), UnbudgetedEntrypointRule()]
 

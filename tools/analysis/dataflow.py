@@ -35,7 +35,7 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .cfg import (BRANCH, CFG, LOOP, STMT, WITH_ENTER, WITH_EXIT, Node,
                   build_cfg, forward, node_exprs)
-from .core import last_component
+from .core import assigned_names, last_component
 
 #: how many call levels interprocedural walks descend (the ISSUE's
 #: "bounded, two-level inlining is enough for this tree")
@@ -367,6 +367,209 @@ def _calls_of_stmt(stmt) -> List[ast.Call]:
                          ast.ClassDef)):
         return []
     return list(iter_calls(stmt))
+
+
+# --------------------------------------------------------------------------
+# SPMD axis-binding facts (spmd_rules)
+# --------------------------------------------------------------------------
+#
+# A ``shard_map``-wrapped body runs one program per device, and its
+# collectives (``lax.psum(x, "dp")``) are only meaningful for axes the
+# enclosing mesh defines.  These helpers answer, statically, "which axis
+# names does this shard_map call bind, and do we know ALL of them?" —
+# the *closed* bit is what keeps the spmd rules sound: when any spec or
+# the mesh is not literal-resolvable the binding is OPEN and the rules
+# must not claim an axis is unbound.
+
+_PSPEC_NAMES = {"PartitionSpec", "P"}
+
+
+def scope_assignments(scope, module_tree=None) -> Dict[str, ast.AST]:
+    """``name -> value expr`` for SINGLE simple assignments lexically in
+    ``scope`` (module-level assignments as fallback).  A name assigned
+    twice is dropped — two bindings means we cannot know which one a
+    later read sees without flow analysis, and these facts feed
+    soundness-critical "is the axis set closed" decisions."""
+    out: Dict[str, ast.AST] = {}
+    dead: Set[str] = set()
+
+    def scan(root):
+        for node in iter_scope_nodes(root):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                if name in out or name in dead:
+                    dead.add(name)
+                    out.pop(name, None)
+                else:
+                    out[name] = node.value
+
+    if module_tree is not None:
+        scan(module_tree)
+    if scope is not None and scope is not module_tree:
+        # any name the function binds OTHER than via a recorded simple
+        # assignment shadows a same-named module-level literal: its
+        # value is a runtime fact, so the module entry must die — a
+        # parameter named ``mesh`` must not resolve to the module's
+        # ``mesh = make_mesh(...)``
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            a = scope.args
+            for p in (a.posonlyargs + a.args + a.kwonlyargs
+                      + ([a.vararg] if a.vararg else [])
+                      + ([a.kwarg] if a.kwarg else [])):
+                dead.add(p.arg)
+                out.pop(p.arg, None)
+        for node in iter_scope_nodes(scope):
+            bound = None
+            if isinstance(node, ast.Assign):
+                # the single-Name form is scan()'s own (recorded)
+                # territory; every OTHER shape — tuple unpacking
+                # (``mesh, opt = ...``), multi-target — still binds
+                if not (len(node.targets) == 1
+                        and isinstance(node.targets[0], ast.Name)):
+                    bound = set()
+                    for t in node.targets:
+                        bound |= assigned_names(t)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                                   ast.NamedExpr)):
+                bound = assigned_names(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor,
+                                   ast.comprehension)):
+                bound = assigned_names(node.target)
+            elif isinstance(node, ast.withitem) \
+                    and node.optional_vars is not None:
+                bound = assigned_names(node.optional_vars)
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                bound = {a.asname or a.name.split(".")[0]
+                         for a in node.names}
+            elif isinstance(node, ast.ExceptHandler) \
+                    and node.name is not None:
+                bound = {node.name}
+            elif isinstance(node, ast.Delete):
+                bound = set()
+                for t in node.targets:
+                    bound |= assigned_names(t)
+            if bound:
+                for name in bound:
+                    dead.add(name)
+                    out.pop(name, None)
+        # nested def/class statements bind their NAME in this scope but
+        # iter_scope_nodes prunes the nodes themselves — a dedicated
+        # shallow walk (bodies not expanded) catches the shadow
+        stack = [scope]
+        while stack:
+            node = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.ClassDef)):
+                    dead.add(child.name)
+                    out.pop(child.name, None)
+                    continue
+                if isinstance(child, ast.Lambda):
+                    continue
+                stack.append(child)
+        scan(scope)
+    return out
+
+
+def resolve_spec_axes(expr, assigns: Dict[str, ast.AST],
+                      depth: int = 3) -> Tuple[Set[str], bool]:
+    """``(axis names, closed)`` for one in_specs/out_specs expression.
+    ``closed=True`` means every axis the spec could name is in the set
+    (all literals resolved); any unresolvable subexpression — a
+    computed spec, a parameter, ``tree_map(...)`` — makes it open."""
+    if depth <= 0:
+        return set(), False
+    if isinstance(expr, ast.Constant):
+        if expr.value is None:
+            return set(), True
+        if isinstance(expr.value, str):
+            return {expr.value}, True
+        return set(), True
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        axes: Set[str] = set()
+        closed = True
+        for el in expr.elts:
+            el = el.value if isinstance(el, ast.Starred) else el
+            a, c = resolve_spec_axes(el, assigns, depth)
+            axes |= a
+            closed &= c
+        return axes, closed
+    if isinstance(expr, ast.Call) and \
+            last_component(expr.func) in _PSPEC_NAMES:
+        axes, closed = set(), True
+        for a in list(expr.args) + [k.value for k in expr.keywords]:
+            sub_axes, sub_closed = resolve_spec_axes(a, assigns, depth)
+            axes |= sub_axes
+            closed &= sub_closed
+        return axes, closed
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        la, lc = resolve_spec_axes(expr.left, assigns, depth)
+        ra, rc = resolve_spec_axes(expr.right, assigns, depth)
+        return la | ra, lc and rc
+    if isinstance(expr, ast.Name) and expr.id in assigns:
+        return resolve_spec_axes(assigns[expr.id], assigns, depth - 1)
+    return set(), False
+
+
+def resolve_mesh_axes(expr, assigns: Dict[str, ast.AST],
+                      depth: int = 3) -> Tuple[Set[str], bool]:
+    """``(axis names, closed)`` for a ``mesh=`` expression: literal
+    ``make_mesh(dp=2, tp=-1)`` kwargs or ``Mesh(devs, ("dp", "tp"))``
+    axis-name literals.  A mesh arriving through a variable/attribute
+    (``mesh=self.mesh``) is open — its axes are a runtime fact the
+    ``parallel.mesh.shard_map`` wrapper validates instead."""
+    if depth <= 0:
+        return set(), False
+    if isinstance(expr, ast.Name) and expr.id in assigns:
+        return resolve_mesh_axes(assigns[expr.id], assigns, depth - 1)
+    if not isinstance(expr, ast.Call):
+        return set(), False
+    callee = last_component(expr.func)
+    if callee == "make_mesh":
+        if expr.args:         # axes dict / devices positionally: give up
+            return set(), False
+        if any(k.arg is None for k in expr.keywords):   # **kwargs splat
+            return set(), False
+        axes = {k.arg for k in expr.keywords
+                if k.arg not in ("devices", "axes")}
+        for k in expr.keywords:
+            if k.arg != "axes":
+                continue
+            # axes= dict form: literal str keys resolve, anything else
+            # (a variable, computed keys) makes the binding OPEN
+            if isinstance(k.value, ast.Constant) and k.value.value is None:
+                continue
+            if isinstance(k.value, ast.Dict) and all(
+                    isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                    for key in k.value.keys):
+                axes |= {key.value for key in k.value.keys}
+            else:
+                return set(), False
+        if not axes:
+            # the documented no-axis default: every device on one 'dp'
+            return {"dp"}, True
+        return axes, True
+    if callee in ("Mesh", "AbstractMesh"):
+        names_expr = None
+        if len(expr.args) >= 2:
+            names_expr = expr.args[1]
+        for k in expr.keywords:
+            if k.arg == "axis_names":
+                names_expr = k.value
+        if names_expr is None:
+            return set(), False
+        if isinstance(names_expr, ast.Constant) \
+                and isinstance(names_expr.value, str):
+            return {names_expr.value}, True
+        if isinstance(names_expr, (ast.Tuple, ast.List)) \
+                and all(isinstance(e, ast.Constant)
+                        and isinstance(e.value, str)
+                        for e in names_expr.elts):
+            return {e.value for e in names_expr.elts}, True
+    return set(), False
 
 
 def traced_closure(funcs: ModuleFunctions, fn, taint0: Set[str],

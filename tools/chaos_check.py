@@ -654,8 +654,12 @@ def cost_mode(args):
 
     speedup = cold_s / warm_s if warm_s > 0 else float("inf")
     n = len(cold.entries)
+    sharded = [e for e in cold.entries
+               if (e.report.get("per_device") or {}).get("n_devices",
+                                                         1) > 1]
     print(f"[chaos_check] cost: cold={cold_s:.2f}s warm={warm_s:.2f}s "
           f"speedup={speedup:.1f}x entries={n} "
+          f"(sharded={len(sharded)}) "
           f"executables={sum(e.report['n_executables'] for e in cold.entries)}")
     fails = []
     if not cold.ok:
@@ -665,6 +669,20 @@ def cost_mode(args):
     if cold.to_json() != warm.to_json():
         fails.append("cached re-run changed the audit verdicts "
                      "(byte mismatch)")
+    # ISSUE 11: the cold-vs-warm byte-identity must cover SHARDED
+    # goldens too — per-device numbers ride the same report cache, and
+    # a cache that dropped (or fabricated) a per_device section would
+    # silently un-gate the ∝ 1/shards contracts
+    if not sharded:
+        fails.append("no sharded entry (per_device.n_devices > 1) in "
+                     "the audited set — the per-device budget surface "
+                     "is not covered")
+    for e in sharded:
+        pd = e.report["per_device"]
+        if not (pd.get("argument_bytes", 0) > 0
+                and pd.get("peak_bytes", 0) > 0):
+            fails.append(f"sharded entry {e.name}: per_device bytes "
+                         f"missing/zero ({pd}) — extraction went dark")
     if speedup < 1.5:
         fails.append(f"cached re-run only {speedup:.1f}x faster (< 1.5x): "
                      f"the report cache is not skipping compiles "

@@ -50,6 +50,18 @@ DEFAULT_TOLERANCES: Dict[str, float] = {
     "instructions.fusion": 0.25,
     "instructions.custom-call": 0.25,
     "instructions.copy": 0.50,
+    # per-device view of the worst single executable: how many devices
+    # one program spans is structural (exact), its shard-local bytes
+    # follow the memory tolerances — the ∝ 1/shards contract of a
+    # sharded entry lives here.  The byte rows deliberately MIRROR the
+    # memory.* rows (same values, same tolerances — keep them in sync):
+    # per_device is the committed semantic unit of the sharded pairs,
+    # memory the raw extraction; only n_devices and the max-vs-sum
+    # collective_bytes carry new information today
+    "per_device.n_devices": 0.0,
+    "per_device.argument_bytes": 0.02,
+    "per_device.peak_bytes": 0.25,
+    "per_device.collective_bytes": 0.02,
 }
 
 
@@ -118,6 +130,32 @@ def _json_num(v):
 
 def golden_path(name: str, root) -> Path:
     return Path(root) / GOLDEN_SUBDIR / f"{name}.json"
+
+
+def device_count_guard(golden: dict, n_devices: int,
+                       name: str) -> Optional[str]:
+    """Why a SHARDED golden must not be regenerated right now, or None.
+
+    A sharded entry's contract IS its per-device scaling — regenerating
+    it from an environment whose visible device count differs from the
+    committed golden's (a shell without the
+    ``--xla_force_host_platform_device_count`` bring-up, a 1-chip TPU
+    VM) would silently commit a 1-device "sharded" budget that gates
+    nothing.  ``regen_budgets.py`` refuses; delete the golden first if
+    the device-count change is intentional."""
+    if not (golden.get("meta") or {}).get("sharded"):
+        return None
+    old = golden.get("n_devices")
+    if old is not None and int(old) != int(n_devices):
+        return (f"{name}: refusing to regenerate a SHARDED golden "
+                f"recorded with {old} visible device(s) from an "
+                f"environment with {n_devices} — its per-device byte "
+                f"contract depends on the shard count.  Re-run under "
+                f"the recorded bring-up (XLA_FLAGS=--xla_force_host_"
+                f"platform_device_count={old}, the tests/conftest.py "
+                f"environment), or delete the golden first if the "
+                f"device-count change is intentional")
+    return None
 
 
 def load_golden(name: str, root) -> Optional[dict]:

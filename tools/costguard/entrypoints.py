@@ -70,12 +70,18 @@ def source_of(name: str) -> Path:
     return Path(inspect.getsourcefile(fn)).resolve()
 
 
-def _mesh_and_opt(opt_name="sgd", **opt_kw):
-    import jax  # noqa: F401 — imported for side-effectful backend init
+def _mesh_and_opt(opt_name="sgd", dp=None, **opt_kw):
+    """Default: every visible device on one ``dp`` axis.  ``dp=N`` pins
+    the mesh to the first N devices — the 1-device CONTROL of the
+    per-device-scaling golden pairs uses ``dp=1``."""
+    import jax
 
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
-    mesh = parallel.make_mesh(dp=-1)
+    if dp is None:
+        mesh = parallel.make_mesh(dp=-1)
+    else:
+        mesh = parallel.make_mesh(dp=dp, devices=jax.devices()[:dp])
     return mesh, mx.optimizer.create(opt_name, **opt_kw)
 
 
@@ -124,14 +130,17 @@ def build_resnet50_nhwc_train(batch=8):
     return _train_step_build(
         "resnet50_nhwc_train", step, x, y,
         {"model": "resnet50_v1", "layout": "NHWC", "dtype": "bfloat16",
-         "batch": batch, "optimizer": "sgd(momentum=0.9, wd=1e-4)"})
+         "batch": batch, "optimizer": "sgd(momentum=0.9, wd=1e-4)",
+         "sharded": True})
 
 
-def _mnist_mlp_step(batch=64, dtype="float32", grad_reduce="f32"):
+def _mnist_mlp_step(batch=64, dtype="float32", grad_reduce="f32",
+                    dp=None):
     """The examples/train_mnist_mlp.py recipe: 784-128-10 MLP train
-    step, f32, SGD momentum — shared by the f32 entry and its
+    step, f32, SGD momentum — shared by the f32 entry, its
     ``grad_reduce="int8"`` sibling (same model, same sample batch, so
-    the two goldens diff leaf-for-leaf)."""
+    the two goldens diff leaf-for-leaf), and the ``dp=1`` unsharded
+    control of the per-device-scaling pair."""
     import ml_dtypes
     import numpy as np
 
@@ -144,7 +153,8 @@ def _mnist_mlp_step(batch=64, dtype="float32", grad_reduce="f32"):
     net.initialize()
     if dtype != "float32":
         net.cast(dtype)
-    mesh, opt = _mesh_and_opt("sgd", learning_rate=0.1, momentum=0.9)
+    mesh, opt = _mesh_and_opt("sgd", dp=dp, learning_rate=0.1,
+                              momentum=0.9)
     step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
                               opt, mesh=mesh, grad_reduce=grad_reduce)
     np_dtype = ml_dtypes.bfloat16 if dtype == "bfloat16" else np.float32
@@ -159,7 +169,26 @@ def build_mnist_mlp_train(batch=64, dtype="float32"):
     return _train_step_build(
         "mnist_mlp_train", step, x, y,
         {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
-         "optimizer": "sgd(momentum=0.9)"})
+         "optimizer": "sgd(momentum=0.9)", "sharded": True,
+         "dp_shards": int(step.mesh.devices.size)})
+
+
+@entrypoint("mnist_mlp_train_dp1")
+def build_mnist_mlp_train_dp1(batch=64, dtype="float32"):
+    """``mnist_mlp_train`` pinned to a 1-device ``dp`` mesh: the
+    UNSHARDED control of the dp per-device-scaling pair.  The committed
+    contract — asserted by tests/test_costguard.py::
+    test_dp_sharded_per_device_byte_budget — is that the dp=8 entry's
+    per-device ``argument_bytes`` drop by ~7/8 of the batch bytes vs
+    this control (params are replicated on a pure-dp mesh, so ONLY the
+    batch shard scales — exactly what "per-device bytes ∝ 1/shards for
+    the sharded tensors" means here)."""
+    step, x, y = _mnist_mlp_step(batch=batch, dtype=dtype, dp=1)
+    return _train_step_build(
+        "mnist_mlp_train_dp1", step, x, y,
+        {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
+         "optimizer": "sgd(momentum=0.9)", "sharded": False,
+         "dp_shards": 1})
 
 
 @entrypoint("mnist_mlp_train_gradq_int8")
@@ -180,7 +209,8 @@ def build_mnist_mlp_train_gradq_int8(batch=64, dtype="float32"):
     return _train_step_build(
         "mnist_mlp_train_gradq_int8", step, x, y,
         {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
-         "optimizer": "sgd(momentum=0.9)", "grad_reduce": "int8"})
+         "optimizer": "sgd(momentum=0.9)", "grad_reduce": "int8",
+         "sharded": True})
 
 
 def _serving_mlp_grid_build(name, batch_buckets, length_buckets, features,
@@ -390,6 +420,78 @@ def build_serving_mlp_grid(batch_buckets=(1, 2, 4), length_buckets=(8, 16),
     return _serving_mlp_grid_build("serving_mlp_grid", batch_buckets,
                                    length_buckets, features, dtype,
                                    quantize=False)
+
+
+def tp_mlp_apply(shards, features=256, hidden=1024, batch=8):
+    """The tensor-parallel MLP apply the TP golden pair budgets — and
+    the exact collective shape ROADMAP item 1's sharded FFN uses:
+    ``w1`` column-sharded over ``tp`` (hidden split), ``w2``
+    row-sharded (the partial products), one all-reduce restoring the
+    replicated output — the standard two-collective-per-layer Megatron
+    layout collapsed to its one-layer core.  Returns ``(apply, avals,
+    mesh)`` with the jitted apply carrying the shardings, so tests can
+    EXECUTE it (census == runtime jit-cache proof) while the entry
+    points only lower it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from mxnet_tpu import parallel
+
+    mesh = parallel.make_mesh(tp=shards, devices=jax.devices()[:shards])
+
+    def fwd(w1, b1, w2, b2, x):
+        h = jax.nn.gelu(x @ w1 + b1)
+        return h @ w2 + b2
+
+    def sh(*spec):
+        return NamedSharding(mesh, PartitionSpec(*spec))
+
+    apply = jax.jit(fwd,
+                    in_shardings=(sh(None, "tp"), sh("tp"),
+                                  sh("tp", None), sh(), sh()),
+                    out_shardings=sh())
+    avals = [jax.ShapeDtypeStruct(s, jnp.float32)
+             for s in ((features, hidden), (hidden,),
+                       (hidden, features), (features,),
+                       (batch, features))]
+    return apply, avals, mesh
+
+
+def _tp_mlp_build(name, shards, features=256, hidden=1024, batch=8):
+    apply, avals, _mesh = tp_mlp_apply(shards, features=features,
+                                       hidden=hidden, batch=batch)
+    lowered = apply.lower(*avals)
+    meta = {"model": f"mlp {features}-{hidden}-{features} apply",
+            "dtype": "float32", "batch": batch, "tp_shards": shards,
+            "sharded": shards > 1,
+            "layout": "w1 column-sharded / w2 row-sharded over tp; "
+                      "activations replicated; one all-reduce on the "
+                      "output"}
+    return EntryBuild(name=name, meta=meta, census=1,
+                      programs=[Program(name, lowered, n_args=5)])
+
+
+@entrypoint("mlp_apply_tp8")
+def build_mlp_apply_tp8(shards=8):
+    """Tensor-parallel (tp=8) MLP apply: weights sharded column/row over
+    the mesh, output restored by ONE all-reduce.  The committed
+    contract vs ``mlp_apply_tp1`` — asserted by tests/test_costguard.py
+    ::test_tp_sharded_per_device_byte_budget — is per-device
+    ``argument_bytes`` ∝ 1/shards for the sharded weights (>= 70% below
+    the unsharded control at tp=8), with the all-reduce visible in
+    ``per_device.collective_bytes`` — the literal gate ROADMAP item 1
+    (tensor-parallel decode) lands on top of."""
+    return _tp_mlp_build("mlp_apply_tp8", shards)
+
+
+@entrypoint("mlp_apply_tp1")
+def build_mlp_apply_tp1():
+    """The tp=1 control of the TP golden pair: identical model and
+    batch on a 1-device mesh — full weight bytes per device, zero
+    collectives.  Exists so the TP win is a diff of two COMMITTED
+    goldens (the PR 8 pattern), not a number recomputed at test time."""
+    return _tp_mlp_build("mlp_apply_tp1", 1)
 
 
 @entrypoint("serving_mlp_grid_int8")

@@ -23,7 +23,12 @@ from typing import Dict, List, Optional
 #: bump when the report schema or extraction logic changes — it keys the
 #: report cache AND is recorded in budget goldens, so a stale cached
 #: report (or a golden from an older schema) can never pass silently
-REPORT_VERSION = "1.1"
+REPORT_VERSION = "1.2"
+
+# HloModule header attribute stamped by the SPMD partitioner: how many
+# devices one copy of this program spans (1 when absent — a
+# single-device or replicated program)
+_NUM_PARTITIONS_RE = re.compile(r"\bnum_partitions=(\d+)")
 
 # entry-computation instruction line:  ``%name = SHAPE opcode(...)``.
 # SHAPE is either a bare token (f32[8,16]{1,0}) or a tuple type — which
@@ -154,8 +159,25 @@ def donation_counts(hlo_text: str, n_args: int) -> Dict[str, int]:
     return {"donated_args": len(donated), "total_args": int(n_args)}
 
 
+def program_num_partitions(hlo_text: str) -> int:
+    """How many devices one copy of this program spans — the SPMD
+    partitioner stamps ``num_partitions=N`` on the HloModule header.
+    1 when absent: a single-device (or trivially replicated) program."""
+    for line in hlo_text.splitlines():
+        if line.startswith("HloModule"):
+            m = _NUM_PARTITIONS_RE.search(line)
+            return int(m.group(1)) if m else 1
+    return 1
+
+
 def unit_report(compiled, n_args: int) -> dict:
-    """Normalized report of ONE compiled executable."""
+    """Normalized report of ONE compiled executable.
+
+    Post-SPMD HLO is the PER-DEVICE program: shapes are shard shapes,
+    ``memory_analysis`` accounts one device's buffers.  The
+    ``per_device`` section makes that semantic explicit (and budgetable
+    — a sharded entry commits that these numbers scale as 1/shards),
+    alongside the device count the partitioner stamped."""
     costs = compiled.cost_analysis()
     if isinstance(costs, list):
         costs = costs[0] if costs else {}
@@ -173,13 +195,20 @@ def unit_report(compiled, n_args: int) -> dict:
                "peak_bytes": int(peak)}
     except Exception:   # noqa: BLE001 — some backends can't account memory
         mem = {}        # absent, not fabricated: the diff skips it
+    wire = float(collective_payload_bytes(text))
+    per_device = {"n_devices": program_num_partitions(text),
+                  "collective_bytes": wire}
+    if mem:
+        per_device["argument_bytes"] = mem["argument_bytes"]
+        per_device["peak_bytes"] = mem["peak_bytes"]
     return {
         "n_executables": 1,
         "flops": float(costs.get("flops", 0.0)),
         "bytes_accessed": float(costs.get("bytes accessed", 0.0)),
         "transcendentals": float(costs.get("transcendentals", 0.0)),
-        "collective_bytes": float(collective_payload_bytes(text)),
+        "collective_bytes": wire,
         "memory": mem,
+        "per_device": per_device,
         "donation": donation_counts(text, n_args),
         "instructions": instruction_counts(text),
     }
@@ -217,6 +246,18 @@ def merge_reports(units: List[dict]) -> dict:
     if mems:
         out["memory"] = {k: max(m.get(k, 0) for m in mems)
                          for k in mems[0]}
+    # per-device numbers merge like memory: executables run one at a
+    # time, so the budgetable per-device figure is the worst single
+    # program on one device, not a sum across the grid
+    pds = [u.get("per_device") for u in units]
+    pds = [p for p in pds if p]
+    if pds:
+        # key UNION, not pds[0]'s keys: one unit whose memory_analysis
+        # failed (its per_device carries only n_devices+collective)
+        # must not silently un-gate the byte metrics the others report
+        keys = set().union(*(p.keys() for p in pds))
+        out["per_device"] = {k: max(p.get(k, 0) for p in pds)
+                             for k in sorted(keys)}
     return out
 
 
