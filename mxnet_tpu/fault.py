@@ -56,6 +56,15 @@ point                        location
                              hot-swap sequence begins
 ``fleet.probe``              fleet quarantine/update probe, before the probe
                              request is submitted
+``fleet.scale_up``           ServingFleet.add_replica entry, before the new
+                             replica is built or warmed
+``fleet.retire``             ServingFleet.retire_replica entry, before the
+                             quarantine/drain sequence begins
+``fleet.handoff``            GenerationServer, before a prefilled group's
+                             KV pages + first token are scattered into the
+                             decode group's pool
+``admission.classify``       TenantQoS.classify, before the tenant/class
+                             admission verdict
 ``supervisor.spawn``         elastic.Supervisor, before spawning a gang
                              attempt
 ``supervisor.heartbeat``     elastic.Supervisor watchdog, before each
@@ -232,6 +241,13 @@ for _p, _w in (
     ("fleet.dispatch", "ServingFleet dispatch, before the chosen replica"),
     ("fleet.swap", "WeightUpdater, before a replica's param hot-swap"),
     ("fleet.probe", "fleet quarantine/update probe, before submitting"),
+    ("fleet.scale_up", "ServingFleet.add_replica entry, before the spawn"),
+    ("fleet.retire", "ServingFleet.retire_replica entry, before the "
+                     "quarantine/drain sequence"),
+    ("fleet.handoff", "GenerationServer, before a prefilled group's KV "
+                      "pages + first token reach a decode slot"),
+    ("admission.classify", "TenantQoS.classify, before the tenant/class "
+                           "admission verdict"),
     ("supervisor.spawn", "elastic.Supervisor, before spawning a gang"),
     ("supervisor.heartbeat", "elastic.Supervisor watchdog, per scan"),
     ("supervisor.watchdog", "elastic.Supervisor, on declaring a hang"),

@@ -24,21 +24,24 @@ weight updates (``serving.WeightUpdater``).  See ``docs/api.md``
 """
 from .admission import (RejectedError, CircuitOpenError, ServerClosedError,
                         DeadlineExceededError, NonFiniteOutputError,
-                        TokenBucket, Request)
+                        TenantThrottledError, TokenBucket, Request,
+                        QoSClass, ClassStats, TenantQoS)
 from .batcher import BucketSpec, DynamicBatcher
 from .breaker import CircuitBreaker
 from .server import InferenceServer, module_apply
-from .fleet import (ServingFleet, HotSwapApply, WeightUpdater,
-                    SnapshotRejectedError, UpdateRolledBackError,
-                    validate_params)
+from .fleet import (ServingFleet, ReplicaGroup, HotSwapApply,
+                    WeightUpdater, SnapshotRejectedError,
+                    UpdateRolledBackError, validate_params)
 from .generate import (GenerationServer, PageAllocator,
                        PoolExhaustedError)
+from .autoscale import FleetAutoscaler, ScalingPolicy
 
 __all__ = ["InferenceServer", "module_apply", "BucketSpec",
            "DynamicBatcher", "CircuitBreaker", "TokenBucket", "Request",
            "RejectedError", "CircuitOpenError", "ServerClosedError",
            "DeadlineExceededError", "NonFiniteOutputError",
-           "ServingFleet", "HotSwapApply", "WeightUpdater",
+           "TenantThrottledError", "QoSClass", "ClassStats", "TenantQoS",
+           "ServingFleet", "ReplicaGroup", "HotSwapApply", "WeightUpdater",
            "SnapshotRejectedError", "UpdateRolledBackError",
            "validate_params", "GenerationServer", "PageAllocator",
-           "PoolExhaustedError"]
+           "PoolExhaustedError", "FleetAutoscaler", "ScalingPolicy"]

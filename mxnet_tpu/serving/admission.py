@@ -1,4 +1,5 @@
-"""Admission-control primitives: errors, rate limiting, request futures.
+"""Admission-control primitives: errors, rate limiting, request futures,
+and per-tenant QoS (priority classes + tenant token buckets).
 
 ref: the reference stack has no serving layer at all (Module.predict is a
 bare loop); the design here follows the TPU-serving literature's stance
@@ -7,17 +8,31 @@ that overload is a *normal* lifecycle event: a server that cannot keep up
 must say so immediately (bounded queue, explicit ``RejectedError``) rather
 than buffer without bound and melt every request into a timeout.
 
+The QoS layer (ISSUE 12) extends the same stance to mixed-tenant traffic:
+a single shared ``TokenBucket`` lets one noisy tenant starve everyone, so
+``TenantQoS`` gives every tenant its OWN bucket (the abusive tenant sheds
+with ``TenantThrottledError``; its neighbours never notice) and sorts
+requests into **priority classes** (``QoSClass``) that carry a default
+deadline, a routing-group pin, and an admission headroom fraction.  Each
+class tracks deadline misses and a sliding-window latency distribution
+(``ClassStats`` — p50/p99) that servers surface through ``healthz()`` so
+routers and operators see SLO state per class.
+
 Everything here is stdlib-only; the device-facing pieces live in
 ``serving.server``.
 """
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
+from .. import fault as _fault
+
 __all__ = ["RejectedError", "CircuitOpenError", "ServerClosedError",
-           "DeadlineExceededError", "NonFiniteOutputError", "TokenBucket",
-           "Request"]
+           "DeadlineExceededError", "NonFiniteOutputError",
+           "TenantThrottledError", "TokenBucket", "Request", "QoSClass",
+           "ClassStats", "TenantQoS"]
 
 
 class RejectedError(RuntimeError):
@@ -45,6 +60,13 @@ class NonFiniteOutputError(RuntimeError):
     """This request's rows of the batched output contained NaN/Inf — the
     request fails alone; batch neighbours and the server are unaffected
     (the serving counterpart of ``TrainStep(skip_nonfinite=True)``)."""
+
+
+class TenantThrottledError(RejectedError):
+    """THIS tenant's token bucket is empty — the request is shed for the
+    tenant alone.  Other tenants' admission is untouched (per-tenant
+    buckets are the isolation boundary; a shared limiter would let one
+    abusive client starve everyone at zero served throughput)."""
 
 
 class TokenBucket:
@@ -84,6 +106,211 @@ class TokenBucket:
             self._tokens = min(self._capacity, self._tokens + n)
 
 
+# --------------------------------------------------------------------- QoS --
+class QoSClass:
+    """One priority class of a ``TenantQoS`` policy.
+
+    ``priority`` orders classes (higher = more important — schedulers
+    serve it first, eviction spares it longest).  ``deadline`` is the
+    class's default request deadline AND its SLO latency target: a
+    request of this class that resolves later than ``deadline`` seconds
+    after submission counts as a deadline miss even when it succeeded.
+    ``admit_frac`` is an admission threshold on the server's TOTAL
+    load: requests of this class are admitted only while overall
+    utilization (all classes combined) is below the fraction, so the
+    top ``1 - admit_frac`` of capacity is reserved exclusively for
+    higher classes (a class with ``admit_frac=0.5`` sheds whenever the
+    server is more than half full — under a sustained high-priority
+    storm that saturates the threshold, the class yields entirely;
+    this is strict priority admission, not a per-class occupancy
+    quota).  ``group`` optionally pins the class to a named
+    ``ServingFleet`` replica group.
+    """
+
+    __slots__ = ("name", "priority", "deadline", "admit_frac", "group")
+
+    def __init__(self, name, priority=0, deadline=None, admit_frac=1.0,
+                 group=None):
+        self.name = str(name)
+        self.priority = int(priority)
+        self.deadline = None if deadline is None else float(deadline)
+        self.admit_frac = float(admit_frac)
+        if not 0.0 < self.admit_frac <= 1.0:
+            raise ValueError(f"QoSClass {name!r}: admit_frac must be in "
+                             f"(0, 1], got {admit_frac}")
+        self.group = None if group is None else str(group)
+
+
+class ClassStats:
+    """Sliding-window SLO accounting for one priority class.
+
+    Counters (monotonic): ``admitted`` / ``throttled`` / ``shed`` /
+    ``completed`` / ``failed`` / ``expired`` / ``deadline_miss``.
+    Latencies of the last ``window`` resolutions feed the p50/p99 the
+    snapshot reports.  ``snapshot()`` is non-blocking in the healthz
+    sense: one short lock over host counters and a bounded sort — no
+    device work, no queue waits."""
+
+    def __init__(self, window=256):
+        self._lock = threading.Lock()
+        self._lat = collections.deque(maxlen=int(window))
+        self._counts = {"admitted": 0, "throttled": 0, "shed": 0,
+                        "completed": 0, "failed": 0, "expired": 0,
+                        "deadline_miss": 0}
+
+    def count(self, key, n=1):
+        with self._lock:
+            self._counts[key] += n
+
+    def observe(self, latency, outcome, missed):
+        """One resolved request: ``latency`` seconds, ``outcome`` in
+        ``completed``/``failed``/``expired``, ``missed`` = SLO verdict."""
+        with self._lock:
+            self._counts[outcome] += 1
+            if missed:
+                self._counts["deadline_miss"] += 1
+            self._lat.append(float(latency))
+
+    def snapshot(self):
+        with self._lock:
+            out = dict(self._counts)
+            lat = sorted(self._lat)
+        n = len(lat)
+        out["p50_ms"] = round(lat[n // 2] * 1e3, 3) if n else None
+        out["p99_ms"] = round(lat[min(n - 1, (99 * n) // 100)] * 1e3,
+                              3) if n else None
+        return out
+
+
+class TenantQoS:
+    """Per-tenant token buckets + priority classes at admission.
+
+    ``classes`` is an iterable of ``QoSClass`` (default: one class named
+    ``"default"``).  ``tenant_rate``/``tenant_burst`` configure the
+    per-tenant ``TokenBucket`` (``None`` rate = no tenant limiting);
+    buckets are created lazily per tenant id and capped at
+    ``max_tenants`` live buckets, evicting the least-recently-seen — a
+    tenant-id cardinality attack must not grow host memory without
+    bound.  ``classify()`` is the admission verdict (it fires the
+    ``admission.classify`` fault point); ``track()`` arms SLO
+    accounting on an accepted request; ``snapshot()`` is the per-class
+    healthz payload.
+
+    Thread contract: ``classify`` runs on client threads; the policy
+    lock guards only the bucket/LRU dict — ``TokenBucket`` calls happen
+    OUTSIDE it (the bucket has its own lock), and ``ClassStats`` guards
+    itself.
+    """
+
+    def __init__(self, classes=None, default_class=None, tenant_rate=None,
+                 tenant_burst=None, max_tenants=1024, window=256):
+        if classes is None:
+            classes = (QoSClass("default"),)
+        self.classes = {}
+        for qc in classes:
+            if qc.name in self.classes:
+                raise ValueError(f"TenantQoS: duplicate class {qc.name!r}")
+            self.classes[qc.name] = qc
+        if default_class is None:
+            default_class = next(iter(self.classes))
+        if default_class not in self.classes:
+            raise ValueError(f"TenantQoS: default_class {default_class!r} "
+                             f"is not one of {sorted(self.classes)}")
+        self.default_class = default_class
+        self._rate = None if tenant_rate is None else float(tenant_rate)
+        self._burst = tenant_burst
+        self._max_tenants = int(max_tenants)
+        self._lock = threading.Lock()
+        self._buckets = collections.OrderedDict()    # tenant -> TokenBucket
+        self._stats = {name: ClassStats(window=window)
+                       for name in self.classes}
+
+    def klass(self, name=None):
+        """Resolve a class name (``None`` = the default class); raises
+        ``RejectedError`` for an unknown name — an unconfigured class is
+        a client bug, not a new SLO tier."""
+        if name is None:
+            name = self.default_class
+        qc = self.classes.get(name)
+        if qc is None:
+            raise RejectedError(
+                f"unknown priority class {name!r} — configured classes: "
+                f"{sorted(self.classes)}")
+        return qc
+
+    def _bucket(self, tenant):
+        """This tenant's bucket (created on first sight, LRU-capped)."""
+        with self._lock:
+            b = self._buckets.get(tenant)
+            if b is not None:
+                self._buckets.move_to_end(tenant)
+                return b
+            b = TokenBucket(self._rate, self._burst)
+            self._buckets[tenant] = b
+            while len(self._buckets) > self._max_tenants:
+                self._buckets.popitem(last=False)
+            return b
+
+    def classify(self, tenant=None, klass=None):
+        """The admission verdict for one request: resolve its class,
+        charge the tenant's bucket.  Returns the ``QoSClass``; raises
+        ``RejectedError`` (unknown class) or ``TenantThrottledError``
+        (this tenant is out of tokens — nobody else is affected)."""
+        _fault.fire("admission.classify")
+        qc = self.klass(klass)
+        stats = self._stats[qc.name]
+        if tenant is not None and self._rate is not None:
+            bucket = self._bucket(tenant)
+            if not bucket.try_acquire():
+                stats.count("throttled")
+                raise TenantThrottledError(
+                    f"tenant {tenant!r} exceeded its rate — shedding this "
+                    f"tenant only")
+        stats.count("admitted")
+        return qc
+
+    def refund(self, tenant, qc):
+        """A classified request was refused downstream (queue full,
+        headroom): give the tenant its token back and move the admission
+        to the ``shed`` column so the books stay honest."""
+        if tenant is not None and self._rate is not None:
+            self._bucket(tenant).refund()
+        stats = self._stats[qc.name]
+        stats.count("admitted", -1)
+        stats.count("shed")
+
+    def track(self, qc, req):
+        """Arm SLO accounting on an accepted request: when it resolves,
+        its latency/outcome/deadline-miss land in the class stats (from
+        the resolving thread's done callback — no watcher thread)."""
+        req.add_done_callback(lambda r: self._observe(qc, r))
+        return req
+
+    def _observe(self, qc, req):
+        err = req.exception(timeout=0)            # resolved by now
+        latency = time.monotonic() - req.submitted_at
+        if err is None:
+            outcome = "completed"
+        elif isinstance(err, DeadlineExceededError):
+            outcome = "expired"
+        else:
+            outcome = "failed"
+        missed = outcome == "expired" \
+            or (qc.deadline is not None and latency > qc.deadline)
+        self._stats[qc.name].observe(latency, outcome, missed)
+
+    def snapshot(self):
+        """``{class: ClassStats.snapshot()}`` plus the class's static
+        config — the ``healthz()["classes"]`` payload."""
+        out = {}
+        for name, qc in self.classes.items():
+            s = self._stats[name].snapshot()
+            s["priority"] = qc.priority
+            s["deadline"] = qc.deadline
+            out[name] = s
+        return out
+
+
 class Request:
     """One accepted inference request: payload + deadline + a future.
 
@@ -98,13 +325,20 @@ class Request:
     router needs: the fleet layer re-dispatches failed-over requests from
     the resolving thread's callback instead of parking a watcher thread
     per request in ``result()``.
+
+    ``tenant``/``klass`` are the QoS labels admission stamped on the
+    request (``None`` when the server runs without tenant attribution) —
+    carried here so schedulers can order work and SLO accounting can
+    attribute the resolution without a side table.
     """
 
-    __slots__ = ("data", "submitted_at", "deadline", "_event", "_result",
-                 "_error", "_callbacks", "_cb_lock")
+    __slots__ = ("data", "submitted_at", "deadline", "tenant", "klass",
+                 "_event", "_result", "_error", "_callbacks", "_cb_lock")
 
-    def __init__(self, data, deadline=None):
+    def __init__(self, data, deadline=None, tenant=None, klass=None):
         self.data = data
+        self.tenant = tenant
+        self.klass = klass
         self.submitted_at = time.monotonic()
         self.deadline = None if deadline is None \
             else self.submitted_at + float(deadline)

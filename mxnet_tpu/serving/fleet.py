@@ -54,7 +54,7 @@ from .batcher import BucketSpec
 from .breaker import OPEN
 from .server import InferenceServer
 
-__all__ = ["ServingFleet", "HotSwapApply", "WeightUpdater",
+__all__ = ["ServingFleet", "ReplicaGroup", "HotSwapApply", "WeightUpdater",
            "SnapshotRejectedError", "UpdateRolledBackError",
            "validate_params"]
 
@@ -167,13 +167,15 @@ class _Replica:
     """One fleet member.  Every mutable field is guarded by the FLEET's
     lock — the replica's own server has its own synchronisation."""
 
-    __slots__ = ("index", "server", "apply", "in_flight", "quarantined",
-                 "manual", "probe_attempts", "next_probe_at", "probing")
+    __slots__ = ("index", "server", "apply", "group", "in_flight",
+                 "quarantined", "manual", "probe_attempts", "next_probe_at",
+                 "probing")
 
-    def __init__(self, index, server, apply_fn):
+    def __init__(self, index, server, apply_fn, group="default"):
         self.index = index
         self.server = server
         self.apply = apply_fn
+        self.group = group          # ReplicaGroup name, fixed for life
         self.in_flight = 0          # fleet-dispatched, not yet resolved
         self.quarantined = False
         self.manual = False         # True: an updater owns readmission
@@ -182,17 +184,59 @@ class _Replica:
         self.probing = False
 
 
+class ReplicaGroup:
+    """A named partition of a fleet's replicas with its own routing set.
+
+    Groups are how the fleet disaggregates WORKLOADS, not just load:
+    requests routed to group "prefill" can never queue behind (or stall)
+    group "decode" — the structural interference fix the LLM serving
+    exemplars (PAPERS.md, Ragged Paged Attention / Gemma-on-TPU) call
+    prefill/decode disaggregation.  A ``QoSClass(group=...)`` pins a
+    priority class to a group; ``submit(group=...)`` pins one request.
+    Each group carries its own census expectation (the bucket-grid
+    executable count a member must have warmed before it may serve) and
+    its own capacity arithmetic for the autoscaler.
+
+    Constructed through ``ServingFleet`` (pass ``applies`` as a dict of
+    ``{group_name: [apply_fns]}``); this object is the fleet's
+    per-group view, exposed via ``ServingFleet.groups``."""
+
+    __slots__ = ("name", "replicas")
+
+    def __init__(self, name):
+        self.name = str(name)
+        self.replicas = []          # mutated only under the FLEET lock
+
+
 class ServingFleet:
     """N ``InferenceServer`` replicas behind one ``submit()`` front door.
 
     ``applies`` is one serving apply fn per replica — for weight-updated
     fleets, ``HotSwapApply`` instances sharing one jitted
-    ``fn(params, *leaves)`` (see ``ServingFleet.replicated``).  The fleet
-    builds its own replicas (``<name>-r<i>``) so each gets its own
-    breaker, queue, and counters; pass ``breaker=`` a FACTORY (callable)
-    when you want non-default breaker tuning — a shared instance would
-    couple the replicas' failure domains, which is the opposite of a
-    fleet.
+    ``fn(params, *leaves)`` (see ``ServingFleet.replicated``) — or a
+    dict ``{group_name: [apply_fns]}`` to partition the fleet into named
+    ``ReplicaGroup``s with disjoint routing sets.  The fleet builds its
+    own replicas (``<name>-r<i>``) so each gets its own breaker, queue,
+    and counters; pass ``breaker=`` a FACTORY (callable) when you want
+    non-default breaker tuning — a shared instance would couple the
+    replicas' failure domains, which is the opposite of a fleet.
+
+    **Dynamic membership (ISSUE 12).**  ``add_replica()`` grows a group
+    (spawn → warmup until the bucket-grid census is complete → only then
+    join the routing set) and ``retire_replica()`` shrinks it
+    (manual-quarantine → drain outstanding fleet work → remove, with the
+    retired member's counter series cleared) — both under live traffic
+    with zero dropped accepted requests.  ``FleetAutoscaler``
+    (``serving.autoscale``) drives them from queue-depth/occupancy/
+    deadline-miss signals.
+
+    **Per-tenant QoS.**  Pass ``qos=TenantQoS(...)`` to put priority
+    classes and per-tenant token buckets at the front door: an abusive
+    tenant sheds with ``TenantThrottledError`` while its neighbours are
+    untouched, a class's ``admit_frac`` reserves headroom for the
+    classes above it, ``QoSClass(group=...)`` pins a class to a replica
+    group, and ``healthz()["classes"]`` reports per-class deadline-miss
+    and p50/p99 latency.
 
     Failure matrix (what a client sees):
 
@@ -221,15 +265,26 @@ class ServingFleet:
                  max_redispatch=None, probe_base_delay=0.05,
                  probe_max_delay=2.0, probe_jitter=0.25,
                  probe_deadline=5.0, breaker=None, max_queue=128,
-                 **server_kw):
-        applies = list(applies)
-        if not applies:
+                 qos=None, **server_kw):
+        if isinstance(applies, dict):
+            group_map = {str(g): list(fns) for g, fns in applies.items()}
+        else:
+            group_map = {"default": list(applies)}
+        n_total = sum(len(fns) for fns in group_map.values())
+        if n_total == 0:
             raise ValueError("ServingFleet: need at least one replica")
         self._name = name
         self.buckets = buckets if isinstance(buckets, BucketSpec) \
             else BucketSpec(buckets)
         self._sample = sample
         self._default_deadline = default_deadline
+        self._qos = qos
+        if qos is not None:
+            for qc in qos.classes.values():
+                if qc.group is not None and qc.group not in group_map:
+                    raise ValueError(
+                        f"ServingFleet: QoS class {qc.name!r} pins group "
+                        f"{qc.group!r}, fleet has {sorted(group_map)}")
         # cap = one replica's total capacity: its queue plus one full
         # batch in flight.  Beyond that the replica would shed anyway —
         # the fleet's cap just makes the verdict immediate and keeps the
@@ -237,24 +292,29 @@ class ServingFleet:
         self._max_inflight = int(max_inflight) if max_inflight is not None \
             else int(max_queue) + self.buckets.max_batch
         self._max_redispatch = int(max_redispatch) \
-            if max_redispatch is not None else 2 * len(applies) + 2
+            if max_redispatch is not None else 2 * n_total + 2
         self._probe_base = float(probe_base_delay)
         self._probe_max = float(probe_max_delay)
         self._probe_jitter = float(probe_jitter)
         self._probe_deadline = float(probe_deadline)
+        self._breaker = breaker          # factory/instance, reused by scale-up
+        self._max_queue = int(max_queue)
+        self._server_kw = dict(server_kw)
         self.replicas = []
-        for i, apply_fn in enumerate(applies):
-            brk = breaker() if callable(breaker) else breaker
-            srv = InferenceServer(
-                apply_fn, buckets=self.buckets, sample=sample,
-                breaker=brk, max_queue=max_queue, name=f"{name}-r{i}",
-                **server_kw)
-            self.replicas.append(_Replica(i, srv, apply_fn))
+        self.groups = {g: ReplicaGroup(g) for g in group_map}
+        self._next_index = 0
+        for gname, fns in group_map.items():
+            for apply_fn in fns:
+                rep = self._build_replica(apply_fn, gname,
+                                          self._next_index)
+                self._next_index += 1
+                self.replicas.append(rep)
+                self.groups[gname].replicas.append(rep)
         self._lock = threading.Lock()
         self._stats = {"admitted": 0, "completed": 0, "failed": 0,
                        "expired": 0, "shed": 0, "rejected": 0,
                        "redispatched": 0, "probes": 0, "swaps": 0,
-                       "rollbacks": 0}
+                       "rollbacks": 0, "scale_ups": 0, "retired": 0}
         self._outstanding = 0
         self._retry_q = queue.Queue()
         self._started = threading.Event()
@@ -268,6 +328,28 @@ class ServingFleet:
         self._c_out = _profiler.Counter(None, f"{name}::outstanding")
         self._c_swaps = _profiler.Counter(None, f"{name}::swaps")
         self._c_rollbacks = _profiler.Counter(None, f"{name}::rollbacks")
+
+    def _build_replica(self, apply_fn, group, idx):
+        """One new ``_Replica`` (server + breaker + counters) under the
+        given fleet-unique index.  Does NOT insert it into the routing
+        set — construction-time callers append directly, ``add_replica``
+        appends only after warmup completes."""
+        brk = self._breaker() if callable(self._breaker) else self._breaker
+        srv = InferenceServer(
+            apply_fn, buckets=self.buckets, sample=self._sample,
+            breaker=brk, max_queue=self._max_queue,
+            name=f"{self._name}-r{idx}", **self._server_kw)
+        return _Replica(idx, srv, apply_fn, group=group)
+
+    @property
+    def grid_census(self):
+        """Executables the bucket grid allows — the per-group warmup
+        completeness bar: a scale-up replica joins the routing set only
+        once its server has this many distinct warmed signatures (with a
+        shared jitted fn they are jit-cache HITS, so growing the fleet
+        never grows the fleet-wide executable census)."""
+        n_len = 1 if self.buckets.length is None else len(self.buckets.length)
+        return len(self.buckets.batch) * n_len
 
     @classmethod
     def replicated(cls, fn, params, n, quantizer=None, **kw):
@@ -290,7 +372,7 @@ class ServingFleet:
             raise ServerClosedError(f"{self._name}: already drained")
         started = []
         try:
-            for rep in self.replicas:
+            for rep in self._members():
                 rep.server.start(warmup=warmup)
                 started.append(rep)
         except Exception:
@@ -314,12 +396,41 @@ class ServingFleet:
         return False
 
     # ------------------------------------------------------------ admission --
-    def submit(self, data, deadline=None):
+    def _headroom_check(self, qc, group):
+        """The class's ``admit_frac`` reservation: the class admits only
+        while TOTAL in-flight load (all classes) on the (group's) live
+        capacity is under its fraction — the top ``1 - admit_frac`` is
+        reserved exclusively for higher classes.  Raises
+        ``RejectedError`` when the threshold is already met."""
+        if qc.admit_frac >= 1.0:
+            return
+        with self._lock:
+            reps = self.replicas if group is None \
+                else self.groups[group].replicas
+            live = [rep for rep in reps if not rep.quarantined]
+            used = sum(rep.in_flight for rep in live)
+        capacity = max(1, len(live)) * self._max_inflight
+        if used >= qc.admit_frac * capacity:
+            raise RejectedError(
+                f"{self._name}: class {qc.name!r} is at its admit_frac "
+                f"({qc.admit_frac:.2f}) share of capacity "
+                f"({used}/{capacity} in flight) — shedding to preserve "
+                f"headroom for higher classes")
+
+    def submit(self, data, deadline=None, tenant=None, klass=None,
+               group=None):
         """Route one request to the best replica; returns its fleet-side
         ``Request`` future (failover is transparent — the future resolves
         exactly once, whichever replica ends up serving it).
 
+        ``tenant``/``klass`` are the QoS labels (active when the fleet
+        was built with ``qos=``): the class supplies the default
+        deadline, may pin a ``ReplicaGroup``, and its ``admit_frac``
+        headroom reservation is enforced here.  ``group`` pins this one
+        request to a named group (routing and failover stay inside it).
+
         Refusals are immediate: ``ServerClosedError`` while draining,
+        ``TenantThrottledError`` for an over-rate tenant,
         ``RejectedError`` when no ready replica has in-flight headroom.
         An admission-level refusal never touched any replica's queue and
         is never retried by the fleet."""
@@ -331,14 +442,40 @@ class ServingFleet:
         if not self._started.is_set():
             self._count("rejected")
             raise RejectedError(f"{self._name}: not started")
+        qc = None
+        if self._qos is not None:
+            try:
+                qc = self._qos.classify(tenant=tenant, klass=klass)
+            except RejectedError:
+                self._count("shed")
+                raise
+            if group is None:
+                group = qc.group
+            if deadline is None:
+                deadline = qc.deadline
+        if group is not None and group not in self.groups:
+            if qc is not None:
+                self._qos.refund(tenant, qc)
+            self._count("rejected")
+            raise RejectedError(f"{self._name}: unknown replica group "
+                               f"{group!r} — have {sorted(self.groups)}")
         if deadline is None:
             deadline = self._default_deadline
-        freq = Request(data, deadline=deadline)
+        freq = Request(data, deadline=deadline, tenant=tenant,
+                       klass=None if qc is None else qc.name)
+        try:
+            if qc is not None:
+                self._headroom_check(qc, group)
+        except RejectedError:
+            self._qos.refund(tenant, qc)
+            self._count("shed")
+            raise
         with self._lock:
             self._stats["admitted"] += 1
             self._outstanding += 1
         try:
-            self._dispatch(freq, frozenset(), attempts=0, from_router=False)
+            self._dispatch(freq, group, frozenset(), attempts=0,
+                           from_router=False)
         except BaseException:
             # refusal accounting lives in shed/rejected (outside the
             # admitted == completed+failed+expired invariant) — the
@@ -347,13 +484,18 @@ class ServingFleet:
                 self._stats["admitted"] -= 1
                 self._outstanding -= 1
                 self._stats["shed"] += 1
+            if qc is not None:
+                self._qos.refund(tenant, qc)
             raise
+        if qc is not None:
+            self._qos.track(qc, freq)
         self._c_out.set_value(self.outstanding)
         return freq
 
-    def __call__(self, data, deadline=None, timeout=None):
-        """Blocking convenience: submit + ``result()``."""
-        return self.submit(data, deadline=deadline).result(timeout)
+    def __call__(self, data, deadline=None, timeout=None, **kw):
+        """Blocking convenience: submit + ``result()`` (``tenant`` /
+        ``klass`` / ``group`` pass through)."""
+        return self.submit(data, deadline=deadline, **kw).result(timeout)
 
     @property
     def outstanding(self):
@@ -373,14 +515,17 @@ class ServingFleet:
             return None
         return freq.deadline - time.monotonic()
 
-    def _ranked(self, excluded):
-        """Ready, unquarantined, under-cap replicas, least-loaded first:
-        ranked on (fleet in-flight, replica queue depth) — both read
-        from the replica's public ``healthz`` snapshot and the fleet's
-        own books, never from private server state."""
+    def _ranked(self, excluded, group=None):
+        """Ready, unquarantined, under-cap replicas of ``group`` (None =
+        every group), least-loaded first: ranked on (fleet in-flight,
+        replica queue depth) — both read from the replica's public
+        ``healthz`` snapshot and the fleet's own books, never from
+        private server state."""
         with self._lock:
+            reps = self.replicas if group is None \
+                else self.groups[group].replicas
             snap = [(rep, rep.in_flight, rep.quarantined)
-                    for rep in self.replicas if rep.index not in excluded]
+                    for rep in reps if rep.index not in excluded]
         cands = []
         for rep, in_flight, quarantined in snap:
             if quarantined or in_flight >= self._max_inflight:
@@ -392,11 +537,11 @@ class ServingFleet:
         cands.sort(key=lambda c: c[:3])
         return [c[3] for c in cands]
 
-    def _dispatch(self, freq, excluded, attempts, from_router):
-        """Hand ``freq`` to the best replica and register the completion
-        callback.  True when a replica accepted it.  When none can:
-        front-door callers get the admission verdict as a raise; the
-        router gets False and keeps the request pending."""
+    def _dispatch(self, freq, group, excluded, attempts, from_router):
+        """Hand ``freq`` to the best replica of its group and register
+        the completion callback.  True when a replica accepted it.  When
+        none can: front-door callers get the admission verdict as a
+        raise; the router gets False and keeps the request pending."""
         remaining = self._remaining(freq)
         if remaining is not None and remaining <= 0:
             # the deadline verdict, not an admission one: a client must
@@ -405,7 +550,7 @@ class ServingFleet:
             raise DeadlineExceededError(
                 f"{self._name}: deadline already passed at routing time")
         last_refusal = None
-        for rep in self._ranked(excluded):
+        for rep in self._ranked(excluded, group):
             # reserve the slot under the lock BEFORE submitting — two
             # client threads racing the same replica must not both slip
             # under the cap
@@ -426,8 +571,8 @@ class ServingFleet:
                     rep.in_flight -= 1
                 raise
             rreq.add_done_callback(
-                lambda r, _rep=rep, _ex=excluded, _at=attempts:
-                self._on_replica_done(freq, _rep, _ex, _at, r))
+                lambda r, _rep=rep, _g=group, _ex=excluded, _at=attempts:
+                self._on_replica_done(freq, _g, _rep, _ex, _at, r))
             return True
         if from_router:
             return False
@@ -439,7 +584,7 @@ class ServingFleet:
             f"{self._name}: no ready replica with in-flight headroom — "
             f"shedding")
 
-    def _on_replica_done(self, freq, rep, excluded, attempts, rreq):
+    def _on_replica_done(self, freq, group, rep, excluded, attempts, rreq):
         """Replica-side resolution (runs on the replica's batch thread,
         or on the refusing thread).  Success and terminal errors resolve
         the fleet future; retryable failures go back to the router."""
@@ -455,7 +600,7 @@ class ServingFleet:
             # will reproduce on any replica — never re-dispatch either
             self._finish(freq, error=err)
             return
-        self._retry_q.put((freq, frozenset(excluded) | {rep.index},
+        self._retry_q.put((freq, group, frozenset(excluded) | {rep.index},
                            attempts + 1, err))
 
     def _finish(self, freq, result=None, error=None):
@@ -503,7 +648,7 @@ class ServingFleet:
                     leftovers.append(self._retry_q.get_nowait())
                 except queue.Empty:
                     break
-            for freq, _ex, _at, err in leftovers:
+            for freq, _g, _ex, _at, err in leftovers:
                 if not freq.done():
                     self._finish(freq, error=ServerClosedError(
                         f"{self._name}: fleet stopped before this request "
@@ -515,7 +660,7 @@ class ServingFleet:
         waiting for a routable replica."""
         still = []
         for entry in pending:
-            freq, excluded, attempts, last_err = entry
+            freq, group, excluded, attempts, last_err = entry
             if freq.done():
                 continue
             if freq.expired():
@@ -527,7 +672,7 @@ class ServingFleet:
                 self._finish(freq, error=last_err)
                 continue
             try:
-                ok = self._dispatch(freq, excluded, attempts,
+                ok = self._dispatch(freq, group, excluded, attempts,
                                     from_router=True)
             except Exception as exc:    # injected fleet.dispatch fault —
                 self._finish(freq, error=exc)   # resolved, never dropped
@@ -536,19 +681,20 @@ class ServingFleet:
                 self._count("redispatched")
                 self._c_redisp.increment()
                 continue
-            if self._draining.is_set() and not self._any_ready():
+            if self._draining.is_set() and not self._any_ready(group):
                 self._finish(freq, error=ServerClosedError(
                     f"{self._name}: draining with no ready replica — "
                     f"request not served (last replica error: "
                     f"{last_err!r})"))
                 continue
-            if not self.alive():
-                # every batch thread is dead: nothing in-process can ever
-                # serve this again — a deadline-less request must resolve,
-                # not hang until someone thinks to call drain()
+            if not self._group_alive(group):
+                # every batch thread this request may route to is dead:
+                # nothing in-process can ever serve it again — a
+                # deadline-less request must resolve, not hang until
+                # someone thinks to call drain()
                 self._finish(freq, error=ServerClosedError(
-                    f"{self._name}: every replica batch thread is dead — "
-                    f"request not served (last replica error: "
+                    f"{self._name}: every routable replica batch thread "
+                    f"is dead — request not served (last replica error: "
                     f"{last_err!r})"))
                 continue
             if excluded:
@@ -558,15 +704,26 @@ class ServingFleet:
                 # request that keeps failing everywhere stays bounded by
                 # max_redispatch instead of spinning forever
                 excluded, attempts = frozenset(), attempts + 1
-            still.append((freq, excluded, attempts, last_err))
+            still.append((freq, group, excluded, attempts, last_err))
         return still
 
-    def _any_ready(self):
+    def _members(self, group=None):
+        """Membership snapshot (list copy under the lock — replicas may
+        be retired or added from other threads at any time)."""
         with self._lock:
-            quarantined = {rep.index for rep in self.replicas
-                           if rep.quarantined}
-        return any(rep.server.ready() for rep in self.replicas
+            return list(self.replicas if group is None
+                        else self.groups[group].replicas)
+
+    def _any_ready(self, group=None):
+        with self._lock:
+            reps = list(self.replicas if group is None
+                        else self.groups[group].replicas)
+            quarantined = {rep.index for rep in reps if rep.quarantined}
+        return any(rep.server.ready() for rep in reps
                    if rep.index not in quarantined)
+
+    def _group_alive(self, group=None):
+        return any(rep.server.alive() for rep in self._members(group))
 
     # ------------------------------------------------------------ quarantine --
     def _health_scan(self):
@@ -574,7 +731,7 @@ class ServingFleet:
         tripped OPEN; schedule probes for auto-quarantined ones."""
         now = time.monotonic()
         n_ready, n_quar = 0, 0
-        for rep in self.replicas:
+        for rep in self._members():
             with self._lock:
                 quarantined = rep.quarantined
                 manual, probing = rep.manual, rep.probing
@@ -630,7 +787,15 @@ class ServingFleet:
             rep.probing = False
 
     def _resolve(self, rep):
-        return self.replicas[rep] if isinstance(rep, int) else rep
+        """A replica by its fleet-unique ``index`` (NOT list position —
+        retire/add shifts positions, indices are forever) or by object."""
+        if not isinstance(rep, int):
+            return rep
+        for r in self._members():
+            if r.index == rep:
+                return r
+        raise KeyError(f"{self._name}: no replica with index {rep} "
+                       f"(retired?)")
 
     def wait_idle(self, rep, timeout=None, poll=0.01):
         """Block until a replica has zero fleet-dispatched work in
@@ -690,10 +855,153 @@ class ServingFleet:
             _logger.warning("%s: replica r%d readmitted after probe",
                             self._name, rep.index)
 
+    # --------------------------------------------------- elastic membership --
+    def add_replica(self, apply_fn=None, group="default", warmup=None):
+        """Grow ``group`` by one replica: spawn → warmup until the
+        bucket-grid census is complete → join the routing set.  The new
+        replica serves NO traffic before its warmup census completes —
+        it is not a fleet member until the final append, so the router,
+        ``healthz`` and failover cannot see a half-warmed server.  With
+        a shared jitted fn the warmup compiles nothing new (every bucket
+        signature is a jit-cache hit): scaling up never grows the
+        fleet-wide executable census.  Returns the new ``_Replica``.
+
+        Raises ``ServerClosedError`` while draining and ``ValueError``
+        when ``apply_fn`` is omitted and the group has no live member to
+        clone (cloning needs the ``HotSwapApply`` protocol: the clone
+        shares the jitted fn and starts on the group's CURRENT params,
+        quantizer included)."""
+        _fault.fire("fleet.scale_up")
+        if self._draining.is_set():
+            raise ServerClosedError(f"{self._name}: draining — not "
+                                    f"scaling up")
+        group = str(group)
+        with self._lock:
+            grp = self.groups.get(group)
+            peers = [] if grp is None else list(grp.replicas)
+        if apply_fn is None:
+            tpl = next((p.apply for p in peers if p.server.alive()), None)
+            if tpl is None or not hasattr(tpl, "swap"):
+                raise ValueError(
+                    f"{self._name}: add_replica(group={group!r}) needs "
+                    f"apply_fn= — no live HotSwapApply peer to clone")
+            params = dict(tpl.params) if isinstance(tpl.params, dict) \
+                else list(tpl.params)
+            apply_fn = HotSwapApply(tpl._fn, params,
+                                    quantizer=tpl.quantizer)
+        with self._lock:
+            idx = self._next_index
+            self._next_index += 1
+        rep = self._build_replica(apply_fn, group, idx)
+        started = self._started.is_set()
+        if started:
+            # warmup (the only place a compile could happen) runs OUTSIDE
+            # the fleet lock and BEFORE membership — a stalled compile
+            # delays the scale-up, never a live request
+            rep.server.start(warmup=warmup)
+            if self._sample is not None \
+                    and len(rep.server.distinct_shapes) < self.grid_census:
+                rep.server.drain(timeout=5)
+                raise RuntimeError(
+                    f"{self._name}: new replica r{rep.index} warmed "
+                    f"{len(rep.server.distinct_shapes)} of "
+                    f"{self.grid_census} bucket signatures — refusing to "
+                    f"admit a census-incomplete replica")
+        with self._lock:
+            if self._draining.is_set():
+                admit = False
+            else:
+                admit = True
+                if group not in self.groups:
+                    self.groups[group] = ReplicaGroup(group)
+                self.groups[group].replicas.append(rep)
+                self.replicas.append(rep)
+                self._stats["scale_ups"] += 1
+        if not admit:
+            rep.server.drain(timeout=5)
+            raise ServerClosedError(f"{self._name}: drained during "
+                                    f"scale-up — replica discarded")
+        _logger.warning("%s: replica r%d added to group %r",
+                        self._name, rep.index, group)
+        return rep
+
+    def retire_replica(self, rep, timeout=30.0, force=False):
+        """Shrink the fleet by one replica, dropping zero accepted
+        requests: manual-quarantine (no new dispatches) → wait for its
+        fleet-dispatched work to resolve (served, or failed over by the
+        router) → drain its server → remove it from the routing set,
+        ``healthz`` and ``stats`` → clear its profiler counter series
+        (``profiler.counters_clear``) so a long-lived autoscaled process
+        does not accrete dead replicas' gauges.
+
+        Refuses (``ValueError``) to retire the last live replica of its
+        group unless ``force=True`` — an accepted request must always
+        have somewhere to resolve.  If the replica's in-flight work does
+        not drain within ``timeout`` the retire ABORTS: the replica is
+        readmitted and a ``RuntimeError`` raises (nothing was removed)."""
+        _fault.fire("fleet.retire")
+        rep = self._resolve(rep)
+        with self._lock:
+            if rep not in self.replicas:
+                raise KeyError(f"{self._name}: replica r{rep.index} is "
+                               f"not a fleet member")
+            candidates = [r for r in self.groups[rep.group].replicas
+                          if r is not rep and not r.quarantined]
+        peers = [r for r in candidates if r.server.alive()]
+        if not peers and not force and not self._draining.is_set():
+            raise ValueError(
+                f"{self._name}: r{rep.index} is the last live replica of "
+                f"group {rep.group!r} — retiring it would strand traffic "
+                f"(force=True overrides)")
+        self.quarantine(rep, manual=True, reason="retire")
+        if not self.wait_idle(rep, timeout=timeout):
+            self.readmit(rep)
+            raise RuntimeError(
+                f"{self._name}: r{rep.index} still had fleet work in "
+                f"flight after {timeout}s — retire aborted, replica "
+                f"readmitted")
+        rep.server.drain(timeout=timeout)
+        with self._lock:
+            self.replicas.remove(rep)
+            self.groups[rep.group].replicas.remove(rep)
+            self._stats["retired"] += 1
+        # the retired member's counter series would otherwise report its
+        # last values forever (and a later add_replica reusing nothing —
+        # indices are unique — would still leak one series per cycle)
+        _profiler.counters_clear(f"{self._name}-r{rep.index}::")
+        _logger.warning("%s: replica r%d retired from group %r",
+                        self._name, rep.index, rep.group)
+        return rep
+
+    def scaling_signals(self, group=None):
+        """The autoscaler's input snapshot for ``group`` (None = whole
+        fleet): live membership, readiness, queue depth, occupancy of
+        the live in-flight capacity, and the cumulative per-class
+        deadline-miss count (the policy diffs it per tick).  Non-blocking
+        reads only — safe on a control-loop cadence."""
+        reps = self._members(group)
+        with self._lock:
+            view = [(rep.quarantined, rep.in_flight) for rep in reps]
+        ready = depth = 0
+        for rep, (quarantined, _) in zip(reps, view):
+            if not quarantined and rep.server.ready():
+                ready += 1
+                depth += rep.server.healthz()["queue_depth"]
+        outstanding = sum(in_flight for _, in_flight in view)
+        capacity = max(1, ready) * self._max_inflight
+        misses = 0
+        if self._qos is not None:
+            misses = sum(s["deadline_miss"]
+                         for s in self._qos.snapshot().values())
+        return {"replicas": len(reps), "ready": ready,
+                "outstanding": outstanding, "queue_depth": depth,
+                "occupancy": outstanding / capacity,
+                "deadline_miss": misses}
+
     # --------------------------------------------------------------- health --
     def alive(self):
         """Liveness: any replica's batch thread is running."""
-        return any(rep.server.alive() for rep in self.replicas)
+        return any(rep.server.alive() for rep in self._members())
 
     def ready(self):
         """Readiness: started, not draining, and at least one
@@ -704,23 +1012,45 @@ class ServingFleet:
     def healthz(self):
         """Fleet probe snapshot: fleet verdicts plus each replica's own
         ``healthz`` extended with the fleet's view of it (``quarantined``,
-        fleet-tracked ``fleet_in_flight``)."""
+        fleet-tracked ``fleet_in_flight``), per-``ReplicaGroup`` rollups,
+        and the fleet-level per-class SLO snapshot (``classes`` — present
+        whenever the fleet has a ``qos=`` policy).  Membership is live:
+        a retired replica's row disappears, a scale-up's appears only
+        once it joined the routing set."""
         with self._lock:
-            view = [(rep, rep.in_flight, rep.quarantined)
+            view = [(rep, rep.in_flight, rep.quarantined, rep.group)
                     for rep in self.replicas]
+            group_names = list(self.groups)
             outstanding = self._outstanding
         replicas = {}
-        for rep, in_flight, quarantined in view:
+        groups = {g: {"replicas": [], "ready_replicas": 0,
+                      "quarantined": 0, "in_flight": 0,
+                      "census": self.grid_census} for g in group_names}
+        for rep, in_flight, quarantined, gname in view:
             h = rep.server.healthz()
             h["quarantined"] = quarantined
             h["fleet_in_flight"] = in_flight
+            h["group"] = gname
             replicas[f"r{rep.index}"] = h
+            g = groups.setdefault(
+                gname, {"replicas": [], "ready_replicas": 0,
+                        "quarantined": 0, "in_flight": 0,
+                        "census": self.grid_census})
+            g["replicas"].append(f"r{rep.index}")
+            g["in_flight"] += in_flight
+            if quarantined:
+                g["quarantined"] += 1
+            elif h["ready"]:
+                g["ready_replicas"] += 1
         return {"alive": self.alive(), "ready": self.ready(),
                 "draining": self._draining.is_set(),
                 "outstanding": outstanding,
                 "ready_replicas": sum(
                     1 for h in replicas.values()
                     if h["ready"] and not h["quarantined"]),
+                "groups": groups,
+                "classes": {} if self._qos is None
+                else self._qos.snapshot(),
                 "replicas": replicas}
 
     @property
@@ -732,7 +1062,7 @@ class ServingFleet:
             out = dict(self._stats)
             out["outstanding"] = self._outstanding
         out["replicas"] = {f"r{rep.index}": rep.server.stats
-                           for rep in self.replicas}
+                           for rep in self._members()}
         return out
 
     # ---------------------------------------------------------------- drain --
@@ -755,7 +1085,7 @@ class ServingFleet:
             time.sleep(self._TICK)
         threads = [threading.Thread(target=rep.server.drain,
                                     name=f"{self._name}-drain-r{rep.index}")
-                   for rep in self.replicas]
+                   for rep in self._members()]
         for t in threads:
             t.start()
         for t in threads:
@@ -767,7 +1097,7 @@ class ServingFleet:
                               else max(0.1, t_end - time.monotonic()))
         self._c_out.set_value(self.outstanding)
         ok = self.outstanding == 0 and not self._router.is_alive() \
-            and not any(rep.server.alive() for rep in self.replicas)
+            and not any(rep.server.alive() for rep in self._members())
         return ok
 
     close = drain
@@ -854,7 +1184,11 @@ class WeightUpdater:
             params, _names = load_snapshot_params(str(snapshot))
         else:
             params = snapshot            # container kind is validated
-        quantizer = getattr(self.fleet.replicas[0].apply, "quantizer", None)
+        members = self.fleet._members()
+        if not members:
+            raise UpdateRolledBackError(
+                "no replica to update — the fleet retired them all")
+        quantizer = getattr(members[0].apply, "quantizer", None)
         if quantizer is not None:
             # reduced-precision fleet: snapshots arrive full-precision
             # from the training job — re-quantize into the served
@@ -869,19 +1203,17 @@ class WeightUpdater:
                     f"snapshot failed the fleet's quantizer ({exc}) — "
                     f"not applied to any replica") from exc
         try:
-            new_params = validate_params(
-                params, self.fleet.replicas[0].apply.params)
+            new_params = validate_params(params, members[0].apply.params)
         except SnapshotRejectedError:
             self.skipped += 1
             raise
         done = []                      # [(replica, its previous params)]
         try:
-            live = [rep for rep in self.fleet.replicas
-                    if rep.server.alive()]
+            live = [rep for rep in members if rep.server.alive()]
             if not live:
                 raise UpdateRolledBackError(
                     "no live replica to update — the fleet is down")
-            for rep in self.fleet.replicas:
+            for rep in members:
                 if rep not in live:
                     # a dead replica cannot serve (it is quarantined and
                     # its probes fail) — aborting the WHOLE update for it
@@ -891,7 +1223,25 @@ class WeightUpdater:
                         "%s updater: skipping dead replica r%d",
                         self.fleet._name, rep.index)
                     continue
-                done.append((rep, self._swap_one(rep, new_params)))
+                try:
+                    done.append((rep, self._swap_one(rep, new_params)))
+                except Exception:
+                    # a replica RETIRED (an autoscaler shrinking
+                    # mid-update) or DEAD (killed after the liveness
+                    # snapshot) out from under the roll is the
+                    # dead-replica case, not a snapshot fault: it cannot
+                    # serve, so its probe failure proves nothing about
+                    # the weights — skip it, keep rolling.  A replica
+                    # that is still a live member re-raises: that IS the
+                    # snapshot (or replica) telling us something.
+                    with self.fleet._lock:
+                        member = rep in self.fleet.replicas
+                    if member and rep.server.alive():
+                        raise
+                    _logger.warning(
+                        "%s updater: replica r%d retired or died "
+                        "mid-update — skipped", self.fleet._name,
+                        rep.index)
         except Exception as exc:
             self.skipped += 1
             self.fleet._count("rollbacks")

@@ -50,16 +50,19 @@ import time
 
 import numpy as np
 
+import queue
+
 from .. import fault as _fault
 from .. import profiler as _profiler
 from .admission import (CircuitOpenError, DeadlineExceededError,
                         RejectedError, Request, ServerClosedError,
-                        TokenBucket)
+                        TenantQoS, TokenBucket)
 from .batcher import BucketSpec
 from .breaker import CircuitBreaker
 
 __all__ = ["PageAllocator", "PoolExhaustedError", "GenerationServer",
            "build_decode_step", "build_prefill_step",
+           "build_prefill_kv_step", "build_handoff_step",
            "build_dense_decode_step"]
 
 
@@ -232,6 +235,65 @@ def build_prefill_step(config, page_size, attention_impl=None):
     return prefill_step
 
 
+def build_prefill_kv_step(config, attention_impl=None):
+    """The DISAGGREGATED prefill executable (one per ``(batch, length)``
+    bucket): whole-prompt forward returning the first sampled token plus
+    the prompt's K/V stacked ``[n_layers, b, L, heads, head_dim]`` —
+    and NO pool arguments.  Because it neither reads nor donates the
+    paged pools, it can run on a PREFILL-group worker concurrently with
+    the decode group's pinned step: a 2048-token prompt no longer stalls
+    every in-flight decode for its step, and a failed prefill can no
+    longer consume the donated pools out from under the decode group's
+    bystanders.  The output is the handoff payload ``build_handoff_step``
+    scatters into the decode group's pool."""
+    import jax.numpy as jnp
+
+    from ..gluon.model_zoo.causal_lm import prefill_forward
+
+    del attention_impl      # prefill is dense-causal (ops.multi_head_attention)
+
+    def prefill_kv_step(params, tokens, lengths, key, temps, topks):
+        logits, k_all, v_all = prefill_forward(params, config, tokens,
+                                               lengths)
+        first = _sample_tokens(logits, key, temps, topks)
+        # zero the padding positions so the handoff buffer stays inert
+        # wherever lengths don't reach (the scatter sinks them to page 0
+        # anyway — this just keeps the payload deterministic)
+        L = tokens.shape[1]
+        valid = (jnp.arange(L)[None, :]
+                 < lengths[:, None])[None, :, :, None, None]
+        return first, jnp.where(valid, k_all, 0.0), \
+            jnp.where(valid, v_all, 0.0)
+
+    return prefill_kv_step
+
+
+def build_handoff_step(config, page_size):
+    """The ONE handoff executable of a disaggregated server: scatter a
+    batch of prefilled sequences' K/V (``[n_layers, B, L, H, D]``, a
+    FIXED ``(B, L)`` staging shape — the model of the prefill→decode
+    wire transfer) into the decode group's paged pools by page table.
+    Inactive lanes and positions past ``lengths`` sink to page 0.
+    Pools are donated; shapes are configuration constants, so however
+    sequences are re-packed across handoffs this is always the same
+    program — the census grows by exactly one."""
+    import jax.numpy as jnp
+
+    def handoff_step(k_pool, v_pool, k_all, v_all, lengths, active,
+                     tables):
+        B, L = k_all.shape[1], k_all.shape[2]
+        pos = jnp.arange(L)
+        valid = (pos[None, :] < lengths[:, None]) & active[:, None]
+        page = jnp.where(valid, tables[:, pos // page_size], 0)   # [B, L]
+        off = jnp.broadcast_to((pos % page_size)[None, :], (B, L))
+        for layer in range(config.n_layers):
+            k_pool = k_pool.at[layer, page, off].set(k_all[layer])
+            v_pool = v_pool.at[layer, page, off].set(v_all[layer])
+        return k_pool, v_pool
+
+    return handoff_step
+
+
 def build_dense_decode_step(config, max_ctx, attention_impl=None):
     """The dense max-length-cache decode variant: identical model and
     sampling, but every slot owns a ``[max_ctx, H, D]`` stripe of
@@ -278,14 +340,15 @@ class _Seq:
     """Decode-loop-private state of one admitted sequence."""
 
     __slots__ = ("req", "prompt", "max_new", "temp", "top_k", "slot",
-                 "pages", "cached", "out", "stamp", "ran")
+                 "pages", "cached", "out", "stamp", "ran", "priority")
 
-    def __init__(self, req, prompt, max_new, temp, top_k):
+    def __init__(self, req, prompt, max_new, temp, top_k, priority=0):
         self.req = req
         self.prompt = prompt
         self.max_new = max_new
         self.temp = temp
         self.top_k = top_k
+        self.priority = priority  # QoS class priority — scheduling order
         self.slot = None
         self.pages = []
         self.cached = 0          # tokens whose K/V is in the pool
@@ -308,6 +371,30 @@ class GenerationServer:
     allocator traffic); client threads touch only the admission deque,
     the lock-guarded stats, and ``Request`` futures.
 
+    **Disaggregated prefill/decode (ISSUE 12).**  With
+    ``prefill_workers >= 1`` the server splits into two replica groups:
+    prefill runs on a worker-thread group through POOL-FREE executables
+    (``build_prefill_kv_step`` — in a multi-chip deployment these
+    workers pin the prefill group's chips) while the decode loop — the
+    decode group — keeps stepping its pinned executable undisturbed.  A
+    finished prefill hands its KV payload + first token off through a
+    staging buffer; the decode loop scatters it into the paged pool with
+    the single fixed-shape ``build_handoff_step`` program and seats the
+    sequence in a slot.  Consequences, both chaos-tested: a long prompt
+    no longer stalls in-flight decodes for its step, and a prefill-side
+    failure can no longer destroy the donated pools under the decode
+    group's bystanders (the pool-free program never touches them).  The
+    executable census becomes ``prefill grid + 2`` (handoff + decode).
+
+    **Per-tenant QoS.**  ``qos=TenantQoS(...)`` adds priority classes
+    and per-tenant token buckets at admission: the scheduler seats
+    higher-priority classes first (FIFO within a class; eviction stays
+    strictly seniority-ordered, so the livelock proof is untouched), an
+    abusive tenant sheds alone with ``TenantThrottledError``, and
+    ``healthz()["classes"]`` reports per-class deadline-miss and
+    p50/p99 latency — the same keys ``InferenceServer`` serves, so
+    fleet routers rank LLM and classifier replicas uniformly.
+
     Profiler series: ``<name>::tokens_out``, ``<name>::page_occupancy``
     (percent of allocatable pages held), ``<name>::preempted``,
     ``<name>::retired`` (sequences leaving a slot for any terminal
@@ -320,7 +407,8 @@ class GenerationServer:
                  n_pages=64, page_size=16, max_context=None,
                  max_queue=128, rate=None, burst=None, breaker=None,
                  default_deadline=None, max_new_tokens=32, eos_id=None,
-                 seed=0, attention_impl=None, name="GenerationServer"):
+                 seed=0, attention_impl=None, prefill_workers=0,
+                 qos=None, name="GenerationServer"):
         import jax
         import jax.numpy as jnp
 
@@ -351,6 +439,7 @@ class GenerationServer:
         self.max_context = self.pages_per_seq * self.alloc.page_size
         self.breaker = breaker if breaker is not None else CircuitBreaker()
         self._limiter = None if rate is None else TokenBucket(rate, burst)
+        self._qos = qos if qos is not None else TenantQoS()
         self._default_deadline = default_deadline
         self._max_new = int(max_new_tokens)
         self._eos = None if eos_id is None else int(eos_id)
@@ -361,9 +450,19 @@ class GenerationServer:
         self._decode = jax.jit(
             build_decode_step(config, self.alloc.page_size,
                               attention_impl), donate_argnums=(1, 2))
-        self._prefill = jax.jit(
-            build_prefill_step(config, self.alloc.page_size,
-                               attention_impl), donate_argnums=(1, 2))
+        self._n_prefill_workers = int(prefill_workers)
+        if self._n_prefill_workers > 0:
+            # disaggregated: pool-free prefill grid + ONE handoff scatter
+            self._prefill = jax.jit(
+                build_prefill_kv_step(config, attention_impl))
+            self._handoff = jax.jit(
+                build_handoff_step(config, self.alloc.page_size),
+                donate_argnums=(0, 1))
+        else:
+            self._prefill = jax.jit(
+                build_prefill_step(config, self.alloc.page_size,
+                                   attention_impl), donate_argnums=(1, 2))
+            self._handoff = None
         self._key_base = jax.random.PRNGKey(int(seed))
         self._steps = 0          # device-call counter → per-step PRNG key
 
@@ -384,14 +483,31 @@ class GenerationServer:
         self._stats = {"admitted": 0, "completed": 0, "failed": 0,
                        "expired": 0, "rejected": 0, "retired": 0,
                        "preempted": 0, "tokens_out": 0, "prefills": 0,
-                       "decode_steps": 0, "active_slots": 0}
+                       "handoffs": 0, "decode_steps": 0, "active_slots": 0}
         self._last_error = None
         self._ready = threading.Event()
         self._draining = threading.Event()
         self._stop = threading.Event()
+        self._loop_exited = threading.Event()
         self._started = threading.Event()
         self._thread = threading.Thread(target=self._loop, name=name,
                                         daemon=True)
+        # disaggregated-mode plumbing: the prefill group's work queue
+        # (bounded — the decode loop is the only producer and checks
+        # full() first, so put_nowait cannot race), the handoff queue
+        # (prefill workers → decode loop), the flight registry (groups a
+        # worker currently owns, swept on loop exit so a dying worker
+        # can never strand its group), and the decode-loop-local seat
+        # backlog of prefilled sequences waiting for slots/pages.
+        self._prefill_q = queue.Queue(
+            maxsize=max(2, 2 * self._n_prefill_workers))
+        self._handoff_q = queue.Queue()
+        self._prefill_flight = {}          # id(group) -> group, _lock-guarded
+        self._handoff_backlog = []         # decode-loop-private
+        self._prefill_threads = [
+            threading.Thread(target=self._prefill_worker,
+                             name=f"{name}-prefill-w{i}", daemon=True)
+            for i in range(self._n_prefill_workers)]
         self._c_tokens = _profiler.Counter(None, f"{name}::tokens_out")
         self._c_pages = _profiler.Counter(None, f"{name}::page_occupancy")
         self._c_preempted = _profiler.Counter(None, f"{name}::preempted")
@@ -420,14 +536,31 @@ class GenerationServer:
         if warmup:
             for b in self.buckets.batch:
                 for L in self.buckets.length:
-                    self._run_prefill(
-                        np.zeros((b, L), np.int32), np.zeros((b,), np.int32),
-                        np.zeros((b,), bool),
-                        np.zeros((b, self.pages_per_seq), np.int32),
-                        np.zeros((b,), np.float32), np.zeros((b,), np.int32))
+                    if self._n_prefill_workers > 0:
+                        self._run_prefill_kv(
+                            np.zeros((b, L), np.int32),
+                            np.zeros((b,), np.int32),
+                            np.zeros((b,), np.float32),
+                            np.zeros((b,), np.int32))
+                    else:
+                        self._run_prefill(
+                            np.zeros((b, L), np.int32),
+                            np.zeros((b,), np.int32),
+                            np.zeros((b,), bool),
+                            np.zeros((b, self.pages_per_seq), np.int32),
+                            np.zeros((b,), np.float32),
+                            np.zeros((b,), np.int32))
+            if self._n_prefill_workers > 0:
+                self._run_handoff(*self._staging(), np.zeros(
+                    (self.buckets.max_batch,), np.int32),
+                    np.zeros((self.buckets.max_batch,), bool),
+                    np.zeros((self.buckets.max_batch, self.pages_per_seq),
+                             np.int32))
             self._run_decode()
         self._started.set()
         self._thread.start()
+        for t in self._prefill_threads:
+            t.start()
         self._ready.set()
         return self
 
@@ -442,25 +575,36 @@ class GenerationServer:
 
     def census(self):
         """The static executable count: one prefill program per (batch,
-        length) bucket plus THE decode program.  ``jit_cache_count()``
-        must equal this after warmup, forever."""
-        return len(self.buckets.batch) * len(self.buckets.length) + 1
+        length) bucket plus THE decode program — plus THE handoff
+        program when disaggregated (``prefill_workers >= 1``).
+        ``jit_cache_count()`` must equal this after warmup, forever."""
+        grid = len(self.buckets.batch) * len(self.buckets.length)
+        return grid + 1 + (1 if self._n_prefill_workers > 0 else 0)
 
     def jit_cache_count(self):
-        """Runtime executables actually compiled (both jit caches)."""
-        return self._prefill._cache_size() + self._decode._cache_size()
+        """Runtime executables actually compiled (every jit cache)."""
+        n = self._prefill._cache_size() + self._decode._cache_size()
+        if self._handoff is not None:
+            n += self._handoff._cache_size()
+        return n
 
     # ------------------------------------------------------------ admission --
     def submit(self, tokens, *, max_new_tokens=None, temperature=0.0,
-               top_k=0, deadline=None):
+               top_k=0, deadline=None, tenant=None, klass=None):
         """Admit one prompt; returns a ``Request`` future resolving to
         the generated ``np.int32`` token ids (EOS excluded).
+
+        ``tenant``/``klass`` are the QoS labels (``TenantQoS``): the
+        class supplies the default deadline, its priority orders the
+        scheduler's seating, and the resolution lands in the class's
+        ``healthz()["classes"]`` stats.
 
         Refusals are immediate and explicit (PR 4 contract):
         ``ServerClosedError`` draining, ``CircuitOpenError`` fast-fail,
         ``RejectedError`` for rate limit / full queue / a prompt no
         length bucket holds / a worst case that could never fit the
-        page pool.  None of them touched the device."""
+        page pool, ``TenantThrottledError`` for an over-rate tenant.
+        None of them touched the device."""
         if self._draining.is_set():
             self._bump("rejected")
             raise ServerClosedError(f"{self._name}: draining — "
@@ -512,29 +656,52 @@ class GenerationServer:
         except RejectedError:
             self._bump("rejected")
             raise
+        # QoS verdict AFTER structural checks (an unservable prompt must
+        # not burn a tenant token), BEFORE the global limiter
+        try:
+            qc = self._qos.classify(tenant=tenant, klass=klass)
+        except RejectedError:
+            self._bump("rejected")
+            raise
+        if deadline is None:
+            deadline = qc.deadline if qc.deadline is not None \
+                else self._default_deadline
         if self._limiter is not None and not self._limiter.try_acquire():
+            self._qos.refund(tenant, qc)
             self._bump("rejected")
             raise RejectedError(f"{self._name}: rate limit exceeded — "
                                 f"shedding")
-        req = Request((prompt,), deadline=deadline if deadline is not None
-                      else self._default_deadline)
-        seq = _Seq(req, prompt, max_new, float(temperature), int(top_k))
+        req = Request((prompt,), deadline=deadline, tenant=tenant,
+                      klass=qc.name)
+        seq = _Seq(req, prompt, max_new, float(temperature), int(top_k),
+                   priority=qc.priority)
         seq.stamp = time.monotonic()
+        # a class's admit_frac is a threshold on TOTAL queue depth:
+        # low-priority work sheds once the whole backlog reaches its
+        # fraction, keeping the rest of the queue exclusively for the
+        # classes above it (the queue-depth twin of the fleet's
+        # in-flight threshold)
+        queue_cap = self._max_queue if qc.admit_frac >= 1.0 \
+            else int(qc.admit_frac * self._max_queue)
         with self._admit_lock:
             if self._stop.is_set():
                 if self._limiter is not None:
                     self._limiter.refund()
+                self._qos.refund(tenant, qc)
                 self._bump("rejected")
                 raise ServerClosedError(f"{self._name}: draining — "
                                         f"not admitting")
-            if len(self._pending) >= self._max_queue:
+            if len(self._pending) >= queue_cap:
                 if self._limiter is not None:
                     self._limiter.refund()
+                self._qos.refund(tenant, qc)
                 self._bump("rejected")
                 raise RejectedError(
-                    f"{self._name}: request queue full "
-                    f"({self._max_queue}) — shedding")
+                    f"{self._name}: request queue at class "
+                    f"{qc.name!r}'s cap ({queue_cap} of "
+                    f"{self._max_queue}) — shedding")
             self._pending.append(seq)
+        self._qos.track(qc, req)
         self._bump("admitted")
         return req
 
@@ -552,9 +719,14 @@ class GenerationServer:
 
     # ----------------------------------------------------------- decode loop --
     def _next_key(self):
+        """A fresh per-device-call PRNG key.  The counter is lock-guarded
+        (disaggregated prefill workers and the decode loop both draw);
+        the fold_in happens OUTSIDE the lock."""
         import jax
-        self._steps += 1
-        return jax.random.fold_in(self._key_base, self._steps)
+        with self._lock:
+            self._steps += 1
+            n = self._steps
+        return jax.random.fold_in(self._key_base, n)
 
     def _run_prefill(self, tokens, lengths, active, tables, temps, topks):
         """One prefill program invocation (pools donated/reassigned)."""
@@ -562,6 +734,28 @@ class GenerationServer:
             self._params, self._k_pool, self._v_pool, tokens, lengths,
             active, tables, self._next_key(), temps, topks)
         return np.asarray(first)
+
+    def _run_prefill_kv(self, tokens, lengths, temps, topks):
+        """One POOL-FREE prefill invocation (disaggregated mode; any
+        prefill-group worker thread).  Host-realizes the outputs so the
+        device wait lands on the worker, never the decode loop."""
+        first, k_all, v_all = self._prefill(
+            self._params, tokens, lengths, self._next_key(), temps, topks)
+        return np.asarray(first), np.asarray(k_all), np.asarray(v_all)
+
+    def _staging(self):
+        """Fresh zeroed host staging buffers for one handoff batch —
+        the fixed ``(B, L)`` shape that keeps the scatter ONE program."""
+        c = self.config
+        B, L = self.buckets.max_batch, max(self.buckets.length)
+        shape = (c.n_layers, B, L, c.n_heads, c.head_dim)
+        return np.zeros(shape, np.float32), np.zeros(shape, np.float32)
+
+    def _run_handoff(self, k_all, v_all, lengths, active, tables):
+        """One handoff-scatter invocation (pools donated/reassigned)."""
+        self._k_pool, self._v_pool = self._handoff(
+            self._k_pool, self._v_pool, k_all, v_all, lengths, active,
+            tables)
 
     def _recover_pools(self):
         """A device call that failed MID-EXECUTION already consumed the
@@ -593,11 +787,26 @@ class GenerationServer:
             self._temps, self._topks)
         return np.asarray(nxt)
 
+    def _pipeline_idle(self):
+        """True when the disaggregated prefill pipeline holds no work
+        (trivially true in fused mode).  Order matters: a group stays in
+        ``_prefill_flight`` until AFTER its handoff payloads are
+        enqueued, so flight must be checked FIRST — checking the queues
+        first races a worker finishing between the two checks, and the
+        stale verdict would let drain strand a prefilled sequence."""
+        if self._n_prefill_workers == 0:
+            return True
+        with self._lock:
+            if self._prefill_flight:
+                return False
+        return not self._handoff_backlog and self._handoff_q.empty() \
+            and self._prefill_q.empty()
+
     def _loop(self):
         try:
             while True:
                 if self._stop.is_set() and not self._seqs \
-                        and not self._pending:
+                        and not self._pending and self._pipeline_idle():
                     return
                 worked = self._retire_expired()
                 if self._draining.is_set() and self.breaker.engaged():
@@ -608,7 +817,11 @@ class GenerationServer:
                         f"{self._name}: circuit open during drain — "
                         f"fast-failing accepted work"))
                     return
-                worked = self._admit() or worked
+                if self._n_prefill_workers > 0:
+                    worked = self._drain_handoffs() or worked
+                    worked = self._dispatch_prefill() or worked
+                else:
+                    worked = self._admit() or worked
                 if self._seqs:
                     self._decode_once()
                     worked = True
@@ -617,6 +830,25 @@ class GenerationServer:
         finally:
             with self._admit_lock:
                 self._stop.set()
+            # only NOW may the prefill group stand down: drain() sets
+            # _stop while the loop is still feeding queued work through
+            # the workers — a worker that exits on _stop alone deadlocks
+            # the drain (groups pile up in a queue nobody serves and
+            # _pipeline_idle never goes true).  Workers key off THIS
+            # event instead, set strictly after the loop stopped
+            # producing.  Then stop them BEFORE the residue sweep: a
+            # worker mid-prefill could otherwise stage its payload after
+            # the sweep and strand the client forever.  Sentinels are a
+            # fast-path; the timeout-get + _loop_exited check is the
+            # guarantee.
+            self._loop_exited.set()
+            for _ in self._prefill_threads:
+                try:
+                    self._prefill_q.put_nowait(None)
+                except queue.Full:
+                    break
+            for t in self._prefill_threads:
+                t.join(timeout=30)
             self._fail_residue()
 
     # ---- retirement ----
@@ -686,29 +918,37 @@ class GenerationServer:
     def _bucket_len(self, n):
         return next(L for L in self.buckets.length if L >= n)
 
-    def _take_prefill_group(self):
-        """Pop one same-length-bucket group of queued sequences that
-        fits the free slots and the free pages, preserving FIFO order
-        for the group's bucket.  Returns [] when nothing can start."""
-        free_slots = len(self._free_slots())
-        if free_slots == 0:
+    def _take_prefill_group(self, need_resources=True):
+        """Pop one same-length-bucket group of queued sequences, highest
+        QoS priority first (FIFO by admission stamp within a class —
+        the per-class p99 ordering the SLO chaos mode asserts).  With
+        ``need_resources`` (the fused path) the group is also capped by
+        free slots and budgeted against free pages; the disaggregated
+        path prefills ahead of seat availability — flow control is the
+        bounded prefill queue.  Returns [] when nothing can start."""
+        if need_resources:
+            limit = min(len(self._free_slots()), self.buckets.max_batch)
+        else:
+            limit = self.buckets.max_batch
+        if limit == 0:
             return []
         with self._admit_lock:
             if not self._pending:
                 return []
-            head = self._pending[0]
-            bucket = self._bucket_len(head.prompt.shape[0])
+            ordered = sorted(self._pending,
+                             key=lambda s: (-s.priority, s.stamp))
+            bucket = self._bucket_len(ordered[0].prompt.shape[0])
             group, budget = [], self.alloc.free_count()
-            limit = min(free_slots, self.buckets.max_batch)
-            for seq in list(self._pending):
+            for seq in ordered:
                 if len(group) >= limit:
                     break
                 if self._bucket_len(seq.prompt.shape[0]) != bucket:
                     continue
-                need = self.alloc.pages_for(seq.prompt.shape[0])
-                if need > budget:
-                    break       # keep FIFO: don't starve the big one
-                budget -= need
+                if need_resources:
+                    need = self.alloc.pages_for(seq.prompt.shape[0])
+                    if need > budget:
+                        break   # keep order: don't starve the big one
+                    budget -= need
                 group.append(seq)
             for seq in group:
                 self._pending.remove(seq)
@@ -732,6 +972,170 @@ class GenerationServer:
             self._prefill_group(group)
             if cautious:
                 return worked
+
+    # ---- disaggregated prefill group ----
+    def _dispatch_prefill(self):
+        """Feed queued sequences to the prefill worker group (bounded
+        queue = flow control; only the decode loop produces, so
+        ``full()`` then ``put_nowait`` cannot race).  Mirrors
+        ``_admit``'s breaker stance: nothing while engaged, a single
+        trial group while cautious."""
+        if self.breaker.engaged():
+            return False
+        cautious = self.breaker.state_code() != 0
+        worked = False
+        while not self._prefill_q.full() \
+                and len(self._handoff_backlog) <= self.n_slots:
+            group = self._take_prefill_group(need_resources=False)
+            if not group:
+                return worked
+            with self._lock:
+                self._prefill_flight[id(group)] = group
+            self._prefill_q.put_nowait(group)
+            worked = True
+            if cautious:
+                return worked
+        return worked
+
+    def _prefill_worker(self):
+        """One prefill-group worker: pull a group, run the pool-free
+        prefill, stage the KV payload onto the handoff queue.  Never
+        touches the pools, the allocator, or the slot arrays — the
+        decode group's state is not this thread's to break."""
+        while True:
+            try:
+                group = self._prefill_q.get(timeout=self._IDLE_TICK * 4)
+            except queue.Empty:
+                # NOT self._stop: drain() sets that while the decode loop
+                # is still dispatching queued work through this group —
+                # exiting then strands every group it would have served.
+                # The loop signals _loop_exited once it truly stops.
+                if self._loop_exited.is_set():
+                    return
+                continue
+            if group is None:              # drain sentinel, one per worker
+                return
+            try:
+                self._do_prefill_kv(group)
+            finally:
+                with self._lock:
+                    self._prefill_flight.pop(id(group), None)
+
+    def _do_prefill_kv(self, group):
+        """Run one group through the pool-free prefill and hand off the
+        per-sequence payloads.  A failure resolves the whole group
+        explicitly (breaker sees it); the pools are untouched either
+        way — prefill-side faults cannot hurt seated sequences."""
+        k = len(group)
+        bucket = self._bucket_len(max(s.prompt.shape[0] for s in group))
+        b = self.buckets.batch_bucket(k)
+        tokens = np.zeros((b, bucket), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        temps = np.zeros((b,), np.float32)
+        topks = np.zeros((b,), np.int32)
+        for i, seq in enumerate(group):
+            n = seq.prompt.shape[0]
+            tokens[i, :n] = seq.prompt
+            lengths[i] = n
+            temps[i] = seq.temp
+            topks[i] = seq.top_k
+        try:
+            _fault.fire("generate.prefill")
+            with _profiler.scope(f"{self._name}.prefill", cat="serving"):
+                first, k_all, v_all = self._run_prefill_kv(
+                    tokens, lengths, temps, topks)
+        except Exception as exc:    # noqa: BLE001 — resolved per sequence
+            self.breaker.record_failure()
+            self._note_step_failure(exc)
+            err = _fault.with_context(exc, f"{self._name} prefill of {k}")
+            for seq in group:
+                self._retire(seq, err, stat="failed")
+            return
+        self.breaker.record_success()
+        self._bump("prefills")
+        for i, seq in enumerate(group):
+            n = seq.prompt.shape[0]
+            # per-sequence payload: the decode loop re-packs any mix of
+            # these into the fixed-shape handoff batch.  Copied — a view
+            # parked in the handoff backlog would pin the whole
+            # [n_layers, b, L, H, D] batch output, not just its own rows
+            self._handoff_q.put((seq, int(first[i]),
+                                 k_all[:, i, :n].copy(),
+                                 v_all[:, i, :n].copy()))
+
+    def _drain_handoffs(self):
+        """Seat prefilled sequences: pack every seatable payload (free
+        slot + pages, deadline not passed) into ONE fixed-shape handoff
+        batch, scatter it into the pools, seat the sequences.  Payloads
+        that cannot seat yet stay in the backlog for the next tick —
+        slots free every step as sequences retire."""
+        backlog = self._handoff_backlog
+        self._handoff_backlog = []
+        while True:
+            try:
+                backlog.append(self._handoff_q.get_nowait())
+            except queue.Empty:
+                break
+        if not backlog:
+            return False
+        worked = False
+        batch, still = [], []
+        now = time.monotonic()
+        free_slots = self._free_slots()
+        budget = self.alloc.free_count()
+        for entry in backlog:
+            seq, first_tok, k_seq, v_seq = entry
+            if seq.req.expired(now):
+                self._retire(seq, DeadlineExceededError(
+                    "deadline exceeded before the prefilled sequence "
+                    "reached a decode slot — pages never held"),
+                    stat="expired")
+                worked = True
+                continue
+            need = self.alloc.pages_for(seq.prompt.shape[0])
+            if len(batch) >= min(len(free_slots), self.buckets.max_batch) \
+                    or need > budget:
+                still.append(entry)
+                continue
+            budget -= need
+            batch.append(entry)
+        self._handoff_backlog = still
+        if not batch:
+            return worked
+        B = self.buckets.max_batch
+        kbuf, vbuf = self._staging()
+        lengths = np.zeros((B,), np.int32)
+        active = np.zeros((B,), bool)
+        tables = np.zeros((B, self.pages_per_seq), np.int32)
+        seated = []
+        try:
+            _fault.fire("fleet.handoff")
+            for j, (seq, first_tok, k_seq, v_seq) in enumerate(batch):
+                n = seq.prompt.shape[0]
+                seq.pages = self.alloc.alloc(self.alloc.pages_for(n))
+                kbuf[:, j, :n] = k_seq
+                vbuf[:, j, :n] = v_seq
+                lengths[j] = n
+                active[j] = True
+                tables[j, :len(seq.pages)] = seq.pages
+                seated.append(seq)
+            with _profiler.scope(f"{self._name}.handoff", cat="serving"):
+                self._run_handoff(kbuf, vbuf, lengths, active, tables)
+        except Exception as exc:    # noqa: BLE001 — resolved per sequence
+            self.breaker.record_failure()
+            self._note_step_failure(exc)
+            err = _fault.with_context(
+                exc, f"{self._name} handoff of {len(batch)}")
+            for seq, _t, _k, _v in batch:
+                self._retire(seq, err, stat="failed")
+            self._recover_pools()
+            return True
+        self._bump("handoffs")
+        slots = self._free_slots()
+        for j, (seq, first_tok, _k, _v) in enumerate(batch):
+            self._seat(seq, slots[j], first_tok)
+        self._note_occupancy()
+        return True
 
     def _prefill_group(self, group):
         """Prefill one bucket-aligned group and seat it in decode slots."""
@@ -781,21 +1185,24 @@ class GenerationServer:
         self.breaker.record_success()
         self._bump("prefills")
         for i, seq in enumerate(group):
-            seq.cached = seq.prompt.shape[0]
-            seq.ran = True
-            tok = int(first[i])
-            s = seq.slot = slots[i]
-            self._seqs[s] = seq
-            self._bump("active_slots")
-            # seat-time slot init — the per-token path only advances
-            # _tokens/_lengths; _ensure_capacity appends table entries
-            self._tables[s, :] = 0
-            self._tables[s, :len(seq.pages)] = seq.pages
-            self._temps[s] = seq.temp
-            self._topks[s] = seq.top_k
-            self._active[s] = True
-            self._finish_token(seq, tok)
+            self._seat(seq, slots[i], int(first[i]))
         self._note_occupancy()
+
+    def _seat(self, seq, slot, tok):
+        """Seat one prefilled sequence in a decode slot: slot init is
+        seat-time only — the per-token path advances ``_tokens`` /
+        ``_lengths``; ``_ensure_capacity`` appends table entries."""
+        seq.cached = seq.prompt.shape[0]
+        seq.ran = True
+        s = seq.slot = slot
+        self._seqs[s] = seq
+        self._bump("active_slots")
+        self._tables[s, :] = 0
+        self._tables[s, :len(seq.pages)] = seq.pages
+        self._temps[s] = seq.temp
+        self._topks[s] = seq.top_k
+        self._active[s] = True
+        self._finish_token(seq, tok)
 
     def _finish_token(self, seq, tok):
         """Account one newly generated token; True if the sequence
@@ -921,12 +1328,33 @@ class GenerationServer:
     def _fail_residue(self):
         """Loop-exit sweep (a clean drain leaves nothing; a crashed loop
         may): every accepted-but-unresolved sequence gets an explicit
-        terminal error."""
+        terminal error — wherever it was parked, including the
+        disaggregated prefill/handoff pipeline (workers are already
+        joined by the caller, so these containers have no producers)."""
         residue = list(self._seqs.values())
         self._seqs = {}
         with self._admit_lock:
             residue += list(self._pending)
             self._pending.clear()
+        while True:
+            try:
+                item = self._prefill_q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                residue += list(item)
+        while True:
+            try:
+                residue.append(self._handoff_q.get_nowait()[0])
+            except queue.Empty:
+                break
+        residue += [entry[0] for entry in self._handoff_backlog]
+        self._handoff_backlog = []
+        with self._lock:
+            flight = list(self._prefill_flight.values())
+            self._prefill_flight = {}
+        for group in flight:
+            residue += list(group)
         for seq in residue:
             if seq.slot is not None:
                 seq.slot = None
@@ -951,22 +1379,34 @@ class GenerationServer:
                 and not self.breaker.engaged())
 
     def healthz(self):
-        """Router-rankable snapshot (same fields as
-        ``InferenceServer.healthz`` plus the paging gauges)."""
+        """Router-rankable snapshot: the same keys as
+        ``InferenceServer.healthz`` — ``breaker_state`` / ``in_flight`` /
+        ``queue_depth`` / ``classes`` (per-class deadline-miss + p50/p99
+        from ``TenantQoS.snapshot``) / ``last_error`` — so a
+        ``ServingFleet`` ranks LLM and classifier replicas uniformly,
+        plus the paging/disaggregation gauges.  Non-blocking: host
+        counters and primitives only."""
+        with self._admit_lock:
+            depth = len(self._pending)
         with self._lock:
             s = self._stats
             in_flight = (s["admitted"] - s["completed"] - s["failed"]
                          - s["expired"])
             active = s["active_slots"]
             last = self._last_error
+            prefill_flight = len(self._prefill_flight)
         return {"alive": self.alive(), "ready": self.ready(),
                 "draining": self._draining.is_set(),
                 "breaker": self.breaker.state,
                 "breaker_state": self.breaker.state_code(),
+                "queue_depth": depth,
                 "in_flight": max(0, in_flight),
                 "active_slots": active,
                 "free_pages": self.alloc.free_count(),
                 "total_pages": self.alloc.allocatable,
+                "prefill_workers": self._n_prefill_workers,
+                "prefill_inflight": prefill_flight,
+                "classes": self._qos.snapshot(),
                 "last_error": None if last is None else
                 {"type": last[0], "age": time.monotonic() - last[1]}}
 

@@ -35,7 +35,7 @@ from .. import fault as _fault
 from .. import profiler as _profiler
 from .admission import (CircuitOpenError, DeadlineExceededError,
                         NonFiniteOutputError, RejectedError, Request,
-                        ServerClosedError, TokenBucket)
+                        ServerClosedError, TenantQoS, TokenBucket)
 from .batcher import BucketSpec, DynamicBatcher
 from .breaker import OPEN, CircuitBreaker
 
@@ -77,8 +77,13 @@ class InferenceServer:
     def __init__(self, apply_fn, buckets=(1, 2, 4, 8), *, max_queue=128,
                  max_delay=0.005, rate=None, burst=None, breaker=None,
                  sample=None, default_deadline=None, guard_nonfinite=True,
-                 pin_signature=True, name="InferenceServer"):
+                 pin_signature=True, qos=None, name="InferenceServer"):
         self._apply = apply_fn
+        # per-tenant/per-class QoS (ISSUE 12).  Always present: without an
+        # explicit policy every request lands in one "default" class with
+        # no tenant limiting, so healthz()["classes"] carries the SLO
+        # snapshot for ANY server — the uniform key fleet routers rank on.
+        self._qos = qos if qos is not None else TenantQoS()
         self.buckets = buckets if isinstance(buckets, BucketSpec) \
             else BucketSpec(buckets)
         self.breaker = breaker if breaker is not None else CircuitBreaker()
@@ -211,13 +216,19 @@ class InferenceServer:
                     f"new signature would recompile")
 
     # ------------------------------------------------------------ admission --
-    def submit(self, data, deadline=None):
+    def submit(self, data, deadline=None, tenant=None, klass=None):
         """Admit one request; returns its ``Request`` future.
 
         Refusals are immediate and explicit: ``ServerClosedError`` while
         draining, ``CircuitOpenError`` while the breaker fast-fails,
         ``RejectedError`` on rate-limit, full queue, or an un-bucketable
-        shape.  None of them touched the device or consumed queue space."""
+        shape, ``TenantThrottledError`` when THIS tenant's QoS bucket is
+        dry.  None of them touched the device or consumed queue space.
+
+        ``tenant``/``klass`` are the QoS labels (see ``TenantQoS``): the
+        class supplies the default deadline when ``deadline`` is None and
+        the resolved request's latency lands in that class's healthz
+        stats."""
         _fault.fire("serving.admit")
         if self._draining.is_set():
             self._bump("rejected")
@@ -245,28 +256,45 @@ class InferenceServer:
         except RejectedError:
             self._bump("rejected")
             raise
+        # the QoS verdict comes AFTER the structural checks (an
+        # unservable payload must not burn a tenant token) and BEFORE the
+        # global limiter (the per-tenant bucket is the finer sieve)
+        try:
+            qc = self._qos.classify(tenant=tenant, klass=klass)
+        except RejectedError:
+            self._bump("shed")
+            self._c_shed.increment()
+            raise
+        if deadline is None:
+            deadline = qc.deadline if qc.deadline is not None \
+                else self._default_deadline
         if self._limiter is not None and not self._limiter.try_acquire():
+            self._qos.refund(tenant, qc)
             self._shed("rate limit exceeded — shedding")
-        req = Request(payload, deadline=deadline if deadline is not None
-                      else self._default_deadline)
+        req = Request(payload, deadline=deadline, tenant=tenant,
+                      klass=qc.name)
         try:
             self._batcher.offer(req)
         except ServerClosedError:
             if self._limiter is not None:    # the refusal served no one —
                 self._limiter.refund()       # give the token back
+            self._qos.refund(tenant, qc)
             self._bump("rejected")
             raise
         except RejectedError as exc:
             if self._limiter is not None:
                 self._limiter.refund()
+            self._qos.refund(tenant, qc)
             self._shed(str(exc))
+        self._qos.track(qc, req)
         self._bump("admitted")
         self._c_depth.set_value(self._batcher.depth())
         return req
 
-    def __call__(self, data, deadline=None, timeout=None):
-        """Blocking convenience: submit + ``result()``."""
-        return self.submit(data, deadline=deadline).result(timeout)
+    def __call__(self, data, deadline=None, timeout=None, **kw):
+        """Blocking convenience: submit + ``result()`` (``tenant`` /
+        ``klass`` pass through)."""
+        return self.submit(data, deadline=deadline, **kw).result(timeout)
 
     def _shed(self, msg):
         self._bump("shed")
@@ -419,11 +447,14 @@ class InferenceServer:
         reaching into private state: ``breaker_state`` (0 closed /
         1 half-open / 2 open — same coding as the profiler counter),
         ``in_flight`` (accepted requests not yet resolved — queued plus
-        mid-batch), and ``last_error`` (``{"type", "age"}`` of the most
+        mid-batch), ``last_error`` (``{"type", "age"}`` of the most
         recent step-level failure, monotonic seconds; ``None`` when the
-        replica has never failed a step).  The snapshot is non-blocking:
-        one short stats copy under the server lock, every other field
-        read from its own primitive — no device work, no queue waits."""
+        replica has never failed a step), and ``classes`` (the per-class
+        SLO snapshot — deadline misses, p50/p99 latency — from
+        ``TenantQoS.snapshot``; a bare server reports everything under
+        ``"default"``).  The snapshot is non-blocking: one short stats
+        copy under the server lock, every other field read from its own
+        primitive — no device work, no queue waits."""
         with self._lock:
             s = self._stats
             in_flight = (s["admitted"] - s["completed"] - s["failed"]
@@ -435,6 +466,7 @@ class InferenceServer:
                 "breaker_state": self.breaker.state_code(),
                 "queue_depth": self._batcher.depth(),
                 "in_flight": max(0, in_flight),
+                "classes": self._qos.snapshot(),
                 "last_error": None if last is None else
                 {"type": last[0], "age": time.monotonic() - last[1]}}
 

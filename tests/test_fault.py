@@ -36,7 +36,8 @@ def test_points_is_the_registered_surface():
     for p in ("io.producer", "prefetch.device_put", "checkpoint.write",
               "checkpoint.replace", "step", "distributed.connect",
               "serving.admit", "serving.batch", "serving.step",
-              "serving.drain"):
+              "serving.drain", "fleet.scale_up", "fleet.retire",
+              "fleet.handoff", "admission.classify"):
         assert p in pts
     with fault.inject("step", RuntimeError):
         assert fault.armed() == ["step"]

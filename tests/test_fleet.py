@@ -20,13 +20,16 @@ import jax
 
 from mxnet_tpu import fault, profiler, serving
 from mxnet_tpu.parallel.checkpoint import wait_for_new
-from mxnet_tpu.serving import (CircuitBreaker, HotSwapApply, RejectedError,
-                               ServerClosedError, ServingFleet,
-                               SnapshotRejectedError, UpdateRolledBackError,
-                               WeightUpdater)
+from mxnet_tpu.serving import (CircuitBreaker, FleetAutoscaler,
+                               HotSwapApply, QoSClass, RejectedError,
+                               ScalingPolicy, ServerClosedError,
+                               ServingFleet, SnapshotRejectedError,
+                               TenantQoS, TenantThrottledError,
+                               UpdateRolledBackError, WeightUpdater)
 
 pytestmark = pytest.mark.fleet
 chaos = pytest.mark.chaos
+slo = pytest.mark.slo
 
 W0 = np.eye(4, dtype=np.float32)
 
@@ -625,10 +628,14 @@ def test_sigterm_serve_forever_drains_fleet_without_drops():
 # ------------------------------------------------------------ fault points --
 def test_fleet_fault_points_registered():
     pts = fault.points()
-    for p in ("fleet.route", "fleet.dispatch", "fleet.swap", "fleet.probe"):
+    for p in ("fleet.route", "fleet.dispatch", "fleet.swap", "fleet.probe",
+              "fleet.scale_up", "fleet.retire", "fleet.handoff",
+              "admission.classify"):
         assert p in pts
     with pytest.raises(ValueError, match="unknown fault point"):
         fault.inject("fleet.rotue", RuntimeError)
+    with pytest.raises(ValueError, match="unknown fault point"):
+        fault.inject("fleet.scale_upp", RuntimeError)
 
 
 @chaos
@@ -702,6 +709,59 @@ def test_healthz_exposes_router_ranking_fields():
         srv.drain()
 
 
+# the router-rankable key set: EVERY server kind a fleet can hold must
+# serve these from healthz() so routers rank LLM and classifier replicas
+# uniformly ("classes" is the ISSUE 12 per-class SLO snapshot)
+_RANKING_KEYS = {"alive", "ready", "draining", "breaker", "breaker_state",
+                 "queue_depth", "in_flight", "classes", "last_error"}
+
+
+@slo
+@pytest.mark.generate
+def test_generation_server_healthz_matches_inference_server_contract():
+    """The ISSUE 12 uniform-ranking satellite: ``GenerationServer``
+    serves the same healthz keys (per-class deadline-miss + p50/p99
+    included) as ``InferenceServer``, non-blocking, so one fleet router
+    ranks both replica kinds with one code path."""
+    from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
+                                                     init_causal_lm)
+    from mxnet_tpu.serving import BucketSpec, GenerationServer
+    fn = make_fn()
+    srv = serving.InferenceServer(FlakyApply(fn, [W0]), buckets=(1, 2),
+                                  sample=np.ones((4,), np.float32),
+                                  max_delay=0.002, name="HzUniInf").start()
+    cfg = CausalLMConfig(vocab_size=32, n_layers=1, n_heads=2, head_dim=4,
+                         d_ff=16)
+    gen = GenerationServer(init_causal_lm(cfg, seed=0), cfg,
+                           buckets=BucketSpec(batch=(1,), length=(8,)),
+                           n_slots=2, n_pages=9, page_size=4,
+                           max_new_tokens=3, name="HzUniGen").start()
+    try:
+        srv(_ex(1))
+        gen(np.arange(4, dtype=np.int32))
+        hs, hg = srv.healthz(), gen.healthz()
+        assert _RANKING_KEYS <= set(hs) and _RANKING_KEYS <= set(hg)
+        for h in (hs, hg):
+            # a QoS-less server still reports a "default" class row with
+            # the full SLO stat schema — routers never special-case
+            assert set(h["classes"]) == {"default"}
+            row = h["classes"]["default"]
+            assert {"deadline_miss", "p50_ms", "p99_ms", "completed",
+                    "throttled", "shed", "priority",
+                    "deadline"} <= set(row)
+            assert row["completed"] >= 1
+            assert row["p99_ms"] is not None and row["p99_ms"] >= 0
+        # the snapshot is non-blocking even with work in flight
+        req = gen.submit(np.arange(4, dtype=np.int32))
+        t0 = time.monotonic()
+        gen.healthz()
+        assert time.monotonic() - t0 < 0.5
+        req.result(30)
+    finally:
+        srv.drain()
+        gen.drain(timeout=30)
+
+
 def test_backoff_delay_attempt_cap():
     """The quarantine-schedule satellite: unbounded attempt counts must
     saturate at max_delay, never overflow the exponent."""
@@ -751,3 +811,404 @@ def test_wait_for_new_polling_contract(tmp_path):
     finally:
         t.join()
     assert got is not None and got[0] == 5
+
+
+# =========================================== ISSUE 12: SLO-aware serving --
+@slo
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_retire_add_cycle_under_traffic_leaks_nothing():
+    """The elastic-membership satellite: a retire→add cycle under live
+    traffic leaks neither counter series (the retired member's
+    ``<fleet>-r<i>::`` gauges are cleared) nor healthz rows (membership
+    is live, not process-lifetime) — and drops zero accepted requests."""
+    fleet = make_fleet(n=3, delays=[0.002] * 3, name="FleetCycle").start()
+    accepted, stop = [], threading.Event()
+    lock = threading.Lock()
+
+    def client():
+        while not stop.is_set():
+            try:
+                r = fleet.submit(_ex(1))
+                with lock:
+                    accepted.append(r)
+            except RejectedError:
+                pass
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.05)
+        assert profiler.counters("FleetCycle-r1::")        # series exist
+        gone = fleet.retire_replica(1, timeout=30)
+        assert gone.index == 1
+        h = fleet.healthz()
+        assert "r1" not in h["replicas"]                   # row dropped
+        assert profiler.counters("FleetCycle-r1::") == {}  # series cleared
+        new = fleet.add_replica()              # clones a HotSwapApply peer
+        assert new.index == 3                  # indices are forever, no reuse
+        assert f"r{new.index}" in fleet.healthz()["replicas"]
+        # the cycle's books: one retire, one scale-up, traffic still flows
+        time.sleep(0.1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        drained = fleet.drain(timeout=60)
+    assert drained
+    assert accepted and all(r.done() for r in accepted)
+    assert [r for r in accepted if r.exception(0) is not None] == []
+    st = fleet.stats
+    assert st["retired"] == 1 and st["scale_ups"] == 1
+    # no counter series outside current membership (r1 retired, r3 added)
+    live = {f"FleetCycle-r{rep.index}" for rep in fleet.replicas}
+    leaked = [s for s in profiler.counters("FleetCycle-r")
+              if s.split("::")[0] not in live]
+    assert leaked == []
+
+
+@slo
+@chaos
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_failover_survives_retire_and_warming_add_mid_redispatch():
+    """The mid-failover membership satellite: a request whose replica
+    died is re-dispatched while (a) that excluded replica is being
+    RETIRED and (b) a new replica is still WARMING — it must resolve on
+    the survivor within its original deadline, and the warming replica
+    must be invisible to routing until its census completes."""
+    fn = make_fn()
+    fleet = make_fleet(n=2, fn=fn, delays=[0.05, 0.002],
+                       name="FleetMidFail").start()
+    gate = threading.Event()
+
+    class GatedApply(FlakyApply):
+        def __call__(self, *leaves):
+            gate.wait(30)                     # warmup blocks until released
+            return super().__call__(*leaves)
+
+    try:
+        # r0 (slow) accepts the request, then dies with it in flight
+        req = fleet.submit(_ex(5), deadline=20.0)
+        fleet.apply_fns[0].dead = True
+        # concurrently: retire the excluded replica + a gated scale-up
+        errs = []
+
+        def retire():
+            try:
+                fleet.retire_replica(0, timeout=30)
+            except Exception as exc:          # noqa: BLE001
+                errs.append(exc)
+
+        adder = threading.Thread(
+            target=lambda: fleet.add_replica(GatedApply(fn, [W0])))
+        retirer = threading.Thread(target=retire)
+        retirer.start()
+        adder.start()
+        # the failover must resolve on r1 while r2 is still warming
+        np.testing.assert_allclose(req.result(20), _ex(5))
+        assert "r2" not in fleet.healthz()["replicas"]   # not a member yet
+        gate.set()
+        adder.join(30)
+        retirer.join(30)
+        assert errs == []
+        h = fleet.healthz()["replicas"]
+        assert "r0" not in h and "r2" in h    # retired gone, warmed joined
+        np.testing.assert_allclose(fleet(_ex(2)), _ex(2))
+    finally:
+        gate.set()
+        assert fleet.drain(timeout=60)
+
+
+@slo
+def test_scale_up_refuses_census_incomplete_replica():
+    """The warmup gate: a replica whose warmup did not cover the bucket
+    grid never joins the routing set (it could recompile under traffic);
+    the failed scale-up leaves membership untouched."""
+    fleet = make_fleet(n=1, name="FleetGate").start()
+    try:
+        before = [rep.index for rep in fleet.replicas]
+        with pytest.raises(RuntimeError, match="census-incomplete"):
+            fleet.add_replica(FlakyApply(fleet.fn, [W0]), warmup=False)
+        assert [rep.index for rep in fleet.replicas] == before
+        assert fleet.stats["scale_ups"] == 0
+        np.testing.assert_allclose(fleet(_ex(1)), _ex(1))
+    finally:
+        assert fleet.drain(timeout=30)
+
+
+@slo
+def test_retire_last_live_replica_refused():
+    fleet = make_fleet(n=2, name="FleetLast").start()
+    try:
+        fleet.retire_replica(0, timeout=30)
+        with pytest.raises(ValueError, match="last live replica"):
+            fleet.retire_replica(1)
+        np.testing.assert_allclose(fleet(_ex(1)), _ex(1))  # still serving
+    finally:
+        assert fleet.drain(timeout=30)
+
+
+@slo
+@chaos
+def test_scale_and_retire_fault_points_injectable():
+    fleet = make_fleet(n=2, name="FleetScaleInj").start()
+    try:
+        with fault.inject("fleet.scale_up", RuntimeError("no capacity")):
+            with pytest.raises(RuntimeError, match="no capacity"):
+                fleet.add_replica()
+        with fault.inject("fleet.retire", RuntimeError("retire blocked")):
+            with pytest.raises(RuntimeError, match="retire blocked"):
+                fleet.retire_replica(0)
+        assert len(fleet.replicas) == 2        # membership untouched
+        np.testing.assert_allclose(fleet(_ex(1)), _ex(1))
+    finally:
+        assert fleet.drain(timeout=30)
+
+
+# ------------------------------------------------------------- QoS routing --
+@slo
+def test_tenant_isolation_abuser_sheds_alone():
+    qos = TenantQoS(classes=[QoSClass("gold", priority=10),
+                             QoSClass("bronze", priority=0)],
+                    default_class="bronze", tenant_rate=1.0, tenant_burst=3)
+    fleet = make_fleet(n=2, qos=qos, name="FleetQoS").start()
+    try:
+        for _ in range(3):                     # burn the abuser's burst
+            fleet.submit(_ex(1), tenant="abuser")
+        with pytest.raises(TenantThrottledError):
+            fleet.submit(_ex(1), tenant="abuser")
+        # the well-behaved neighbour never notices
+        np.testing.assert_allclose(fleet(_ex(2), tenant="nice"), _ex(2))
+        classes = fleet.healthz()["classes"]
+        assert set(classes) == {"gold", "bronze"}
+        assert classes["bronze"]["throttled"] >= 1
+        with pytest.raises(RejectedError, match="unknown priority class"):
+            fleet.submit(_ex(1), klass="platinum")
+    finally:
+        assert fleet.drain(timeout=30)
+
+
+@slo
+def test_admit_frac_reserves_headroom_for_higher_classes():
+    """A low class at its admit_frac share sheds; the high class still
+    admits into the reserved headroom."""
+    qos = TenantQoS(classes=[QoSClass("gold", priority=10),
+                             QoSClass("bronze", priority=0,
+                                      admit_frac=0.5)],
+                    default_class="bronze")
+    fleet = make_fleet(n=1, delays=[0.2], qos=qos, max_inflight=4,
+                       name="FleetHeadroom").start()
+    try:
+        slow = [fleet.submit(_ex(1), klass="bronze") for _ in range(2)]
+        # bronze is now AT its 0.5 * 4 share: the next bronze sheds ...
+        with pytest.raises(RejectedError, match="admit_frac"):
+            fleet.submit(_ex(1), klass="bronze")
+        # ... while gold admits into the reserved headroom
+        gold = fleet.submit(_ex(3), klass="gold")
+        np.testing.assert_allclose(gold.result(30), _ex(3))
+        for r in slow:
+            r.result(30)
+        snap = fleet.healthz()["classes"]
+        assert snap["bronze"]["shed"] >= 1
+        assert snap["gold"]["completed"] >= 1
+    finally:
+        assert fleet.drain(timeout=60)
+
+
+@slo
+def test_unknown_group_refusal_refunds_tenant_token():
+    """A post-classify unknown-group refusal gives the tenant its token
+    back and moves the class admission to shed — repeated typo'd
+    submits must not starve the tenant's legitimate traffic or leave
+    the class books claiming admissions that never ran."""
+    qos = TenantQoS(classes=[QoSClass("gold", priority=10)],
+                    default_class="gold", tenant_rate=1.0, tenant_burst=2)
+    fleet = make_fleet(n=1, qos=qos, name="FleetRefund").start()
+    try:
+        for _ in range(4):          # > burst: only refunds keep flowing
+            with pytest.raises(RejectedError,
+                               match="unknown replica group"):
+                fleet.submit(_ex(0), tenant="t0", group="typo")
+        np.testing.assert_allclose(fleet(_ex(1), tenant="t0"), _ex(1))
+        snap = fleet.healthz()["classes"]["gold"]
+        assert snap["shed"] >= 4
+        assert snap["admitted"] == 1      # refunds un-booked the typos
+    finally:
+        assert fleet.drain(timeout=30)
+
+
+@slo
+def test_qos_class_pins_replica_group():
+    """``QoSClass(group=...)`` confines a class's routing (and failover)
+    to its group; an explicit unknown group refuses."""
+    fn = make_fn()
+    a, b = FlakyApply(fn, [W0]), FlakyApply(fn, [W0])
+    qos = TenantQoS(classes=[QoSClass("gold", priority=10, group="alpha"),
+                             QoSClass("bronze", priority=0, group="beta")],
+                    default_class="bronze")
+    fleet = ServingFleet({"alpha": [a], "beta": [b]}, buckets=(1, 2, 4),
+                         max_delay=0.002, qos=qos,
+                         sample=np.ones((4,), np.float32),
+                         name="FleetGroups").start()
+    try:
+        for i in range(4):
+            fleet(_ex(i), klass="gold")
+        done = _replica_completed(fleet)
+        # group census rollups + strict routing containment (r0=alpha,
+        # r1=beta; the probe/warmup path never counts as completed)
+        assert done["r0"] >= 4 and done["r1"] == 0
+        g = fleet.healthz()["groups"]
+        assert set(g) == {"alpha", "beta"}
+        assert g["alpha"]["replicas"] == ["r0"]
+        assert g["alpha"]["ready_replicas"] == 1
+        with pytest.raises(RejectedError, match="unknown replica group"):
+            fleet.submit(_ex(0), group="gamma")
+        with pytest.raises(ValueError, match="pins group"):
+            ServingFleet({"alpha": [FlakyApply(fn, [W0])]},
+                         qos=TenantQoS(classes=[QoSClass("g", group="zz")]),
+                         sample=np.ones((4,), np.float32))
+    finally:
+        assert fleet.drain(timeout=30)
+
+
+# -------------------------------------------------------------- autoscaler --
+def _signals(replicas=1, ready=None, occupancy=0.0, queue_depth=0,
+             deadline_miss=0):
+    ready = replicas if ready is None else ready
+    return {"replicas": replicas, "ready": ready, "outstanding": 0,
+            "occupancy": occupancy, "queue_depth": queue_depth,
+            "deadline_miss": deadline_miss}
+
+
+@slo
+def test_scaling_policy_hysteresis_bounds_and_cooldown():
+    pol = ScalingPolicy(min_replicas=1, max_replicas=2, up_occupancy=0.5,
+                        down_occupancy=0.1, up_queue_depth=4, up_ticks=2,
+                        down_ticks=2, cooldown=60.0)
+    hot = _signals(replicas=1, occupancy=0.9)
+    assert pol.verdict(hot) is None            # streak 1 of 2
+    assert pol.verdict(hot) == "up"            # sustained pressure
+    assert pol.verdict(_signals(replicas=2, occupancy=0.9)) is None \
+        and pol.verdict(_signals(replicas=2, occupancy=0.9)) is None \
+        # at max_replicas: never "up"
+    calm = _signals(replicas=2, occupancy=0.0)
+    pol2 = ScalingPolicy(min_replicas=1, max_replicas=2, down_ticks=2,
+                         cooldown=0.0)
+    assert pol2.verdict(calm) is None
+    assert pol2.verdict(calm) == "down"
+    # min bound: one ready replica must stay
+    assert pol2.verdict(_signals(replicas=1, occupancy=0.0)) is None
+    # deadwood (dead/quarantined member) retires even at ready == min
+    pol3 = ScalingPolicy(min_replicas=1, max_replicas=4, down_ticks=1,
+                         cooldown=0.0)
+    assert pol3.verdict(_signals(replicas=2, ready=1,
+                                 occupancy=0.0)) == "down"
+    # a queue spike alone triggers pressure
+    pol4 = ScalingPolicy(max_replicas=4, up_queue_depth=4, up_ticks=1,
+                         cooldown=0.0)
+    assert pol4.verdict(_signals(replicas=1, queue_depth=9)) == "up"
+    # a deadline-miss burst alone triggers pressure (diffed per tick)
+    pol5 = ScalingPolicy(max_replicas=4, up_queue_depth=None,
+                         miss_budget=0, up_ticks=1, cooldown=0.0)
+    assert pol5.verdict(_signals(replicas=1, deadline_miss=5)) is None
+    assert pol5.verdict(_signals(replicas=1, deadline_miss=9)) == "up"
+    # cooldown gags verdicts right after an action
+    pol6 = ScalingPolicy(max_replicas=4, up_ticks=1, cooldown=60.0)
+    pol6.record_action()
+    assert pol6.verdict(_signals(replicas=1, occupancy=0.9)) is None
+    with pytest.raises(ValueError, match="min_replicas"):
+        ScalingPolicy(min_replicas=3, max_replicas=2)
+
+
+@slo
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_autoscaler_full_cycle_with_event_log(tmp_path):
+    """End-to-end supervised autoscaling: a storm scales the group up,
+    calm scales it back down, and both verdicts land in the JSONL event
+    log — membership safety (census-complete joins, drained retires) is
+    the fleet's contract; the scaler only decides WHEN."""
+    log_path = str(tmp_path / "scale.jsonl")
+    fleet = make_fleet(n=1, delays=[0.004], max_inflight=8,
+                       name="FleetAuto").start()
+    scaler = FleetAutoscaler(
+        fleet, ScalingPolicy(min_replicas=1, max_replicas=2,
+                             up_occupancy=0.25, down_occupancy=0.1,
+                             up_queue_depth=3, up_ticks=2, down_ticks=8,
+                             cooldown=0.05),
+        tick=0.01, watchdog_secs=60, event_log=log_path).start()
+    stop = threading.Event()
+    accepted, lock = [], threading.Lock()
+
+    def client():
+        while not stop.is_set():
+            try:
+                r = fleet.submit(_ex(1))
+                with lock:
+                    accepted.append(r)
+            except RejectedError:
+                pass
+            time.sleep(0.001)
+
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        t0 = time.time()
+        while scaler.stats["scale_ups"] < 1 and time.time() - t0 < 30:
+            time.sleep(0.02)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    t0 = time.time()
+    while scaler.stats["scale_downs"] < 1 and time.time() - t0 < 30:
+        time.sleep(0.02)
+    assert scaler.stop(timeout=10)
+    st = scaler.stats
+    assert st["scale_ups"] >= 1 and st["scale_downs"] >= 1
+    assert fleet.drain(timeout=60)
+    assert accepted and all(r.done() for r in accepted)
+    with open(log_path) as f:
+        events = [json.loads(line) for line in f]
+    kinds = [e["event"] for e in events]
+    assert "scale-up" in kinds and "scale-down" in kinds \
+        and kinds[-1] == "stop"
+    up = events[kinds.index("scale-up")]
+    assert up["group"] == "default" and "signals" in up
+
+
+@slo
+@chaos
+def test_autoscaler_failed_action_is_logged_and_backed_off():
+    fleet = make_fleet(n=1, delays=[0.01], max_inflight=4,
+                       name="FleetAutoFail").start()
+    scaler = FleetAutoscaler(
+        fleet, ScalingPolicy(min_replicas=1, max_replicas=2,
+                             up_occupancy=0.2, up_queue_depth=2,
+                             up_ticks=1, cooldown=0.0),
+        tick=0.01, backoff_base=0.05, backoff_max=0.2).start()
+    try:
+        with fault.inject("fleet.scale_up", RuntimeError("capacity API "
+                                                         "down")):
+            reqs = []
+            for _ in range(6):
+                try:
+                    reqs.append(fleet.submit(_ex(1)))
+                except RejectedError:
+                    pass                      # at the cap — pressure made
+            t0 = time.time()
+            while scaler.stats["failures"] < 1 and time.time() - t0 < 30:
+                time.sleep(0.02)
+            assert scaler.stats["failures"] >= 1
+            assert len(fleet.replicas) == 1       # nothing half-added
+            for r in reqs:
+                r.result(30)
+        assert any(e["event"] == "scale-failed"
+                   for e in scaler.log.records)
+    finally:
+        scaler.stop(timeout=10)
+        assert fleet.drain(timeout=60)

@@ -457,3 +457,184 @@ def test_profiler_counters_and_healthz():
     finally:
         assert srv.drain(30)
         assert not srv.healthz()["alive"]
+
+
+# =============================== ISSUE 12: disaggregated prefill/decode --
+slo = pytest.mark.slo
+
+
+@slo
+def test_disaggregated_greedy_parity_census_and_handoff():
+    """Disaggregation is a SCHEDULING change, not a math change: the
+    pool-free prefill + handoff-scatter path produces token-identical
+    greedy continuations to the fused server, the census is grid + 2
+    (handoff + decode) and the runtime jit cache equals it under
+    traffic, and every page returns to the pool."""
+    prompts = [np.asarray(p, np.int32)
+               for p in ([3, 1, 4], [1, 5], [9, 2, 6, 5], [3, 5, 8])]
+    fused = make_server(name=f"GenFused-{time.monotonic_ns()}",
+                        n_pages=33).start()
+    try:
+        want = [fused.submit(p, max_new_tokens=4).result(60)
+                for p in prompts]
+    finally:
+        assert fused.drain(30)
+    dis = make_server(name=f"GenDis-{time.monotonic_ns()}", n_pages=33,
+                      prefill_workers=2).start()
+    try:
+        assert dis.census() == 1 * 1 + 2       # grid + handoff + decode
+        assert dis.jit_cache_count() == dis.census()
+        got = [dis.submit(p, max_new_tokens=4).result(60)
+               for p in prompts]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+        assert dis.stats["handoffs"] >= 1      # the path actually ran
+        assert dis.jit_cache_count() == dis.census()   # no recompile
+        assert dis.alloc.free_count() == dis.alloc.allocatable
+        h = dis.healthz()
+        assert h["prefill_workers"] == 2 and h["prefill_inflight"] == 0
+    finally:
+        assert dis.drain(30)
+
+
+@slo
+def test_disaggregated_drain_under_deep_backlog_resolves_everything():
+    """Regression: ``drain()`` sets ``_stop`` while the decode loop is
+    still feeding queued work through the prefill worker group.  Workers
+    used to exit on ``_stop`` + a momentarily-empty queue, stranding
+    every group dispatched after that — the loop then spun forever on a
+    pipeline that could never go idle.  A deep backlog drained
+    immediately after submission must resolve EVERY accepted sequence
+    and terminate."""
+    srv = make_server(buckets=BucketSpec(batch=(1, 2), length=(8,)),
+                      n_slots=2, n_pages=33, max_new_tokens=4,
+                      max_queue=64, prefill_workers=2,
+                      name=f"GenBacklog-{time.monotonic_ns()}").start()
+    reqs = [srv.submit(np.asarray([1 + (i % 7), 2], np.int32))
+            for i in range(24)]
+    assert srv.drain(60)                       # used to hang forever
+    assert all(r.done() for r in reqs)
+    assert all(r.exception(0) is None for r in reqs)   # served, not swept
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+    st = srv.stats
+    assert st["admitted"] == st["completed"] + st["failed"] + st["expired"]
+
+
+@slo
+@chaos
+def test_handoff_fault_fails_group_explicitly_spares_bystanders():
+    """fleet.handoff fires host-side, BEFORE the scatter touches the
+    pools: the staged group fails explicitly, a seated bystander keeps
+    decoding on intact pools, and the server serves on."""
+    srv = make_server(buckets=BucketSpec(batch=(1,), length=(8,)),
+                      n_slots=2, n_pages=33, max_new_tokens=24,
+                      prefill_workers=1,
+                      name=f"GenHandoffFault-{time.monotonic_ns()}").start()
+    try:
+        bystander = srv.submit(np.asarray([2, 7], np.int32),
+                               max_new_tokens=24)
+        t0 = time.time()                       # wait until it is seated
+        while srv.stats["handoffs"] < 1 and time.time() - t0 < 30:
+            time.sleep(0.005)
+        with fault.inject("fleet.handoff", RuntimeError("wire lost")):
+            doomed = srv.submit(np.asarray([5], np.int32))
+            with pytest.raises(RuntimeError, match="wire lost"):
+                doomed.result(30)
+        out = bystander.result(60)             # bystander unharmed
+        assert len(out) == 24
+        # healthy after: a fresh sequence serves end to end
+        assert len(srv.submit(np.asarray([4], np.int32),
+                              max_new_tokens=3).result(60)) == 3
+        assert srv.jit_cache_count() == srv.census()
+    finally:
+        assert srv.drain(30)
+        assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+@slo
+def test_priority_class_jumps_the_queue():
+    """Scheduler seating is priority-ordered: with one decode slot and a
+    deep bronze queue, a late gold submission seats (and finishes)
+    before the queued bronze work."""
+    from mxnet_tpu.serving import QoSClass, TenantQoS
+    qos = TenantQoS(classes=[QoSClass("gold", priority=10),
+                             QoSClass("bronze", priority=0)],
+                    default_class="bronze")
+    srv = make_server(buckets=BucketSpec(batch=(1,), length=(8,)),
+                      n_slots=1, n_pages=17, max_new_tokens=24, qos=qos,
+                      name=f"GenPrio-{time.monotonic_ns()}").start()
+    order, lock = [], threading.Lock()
+
+    def watch(tag, req):
+        req.add_done_callback(
+            lambda r: (lock.acquire(), order.append(tag), lock.release()))
+        return req
+
+    try:
+        bronze = [watch(f"b{i}",
+                        srv.submit(np.asarray([i + 1], np.int32),
+                                   klass="bronze")) for i in range(4)]
+        gold = watch("gold", srv.submit(np.asarray([6], np.int32),
+                                        klass="gold"))
+        gold.result(120)
+        for r in bronze:
+            r.result(120)
+        # gold seated ahead of every still-queued bronze: at most ONE
+        # bronze (the one already in the slot) may finish before it
+        assert order.index("gold") <= 1, order
+        classes = srv.healthz()["classes"]
+        assert classes["gold"]["completed"] == 1
+        assert classes["bronze"]["completed"] == 4
+    finally:
+        assert srv.drain(30)
+
+
+@slo
+def test_generation_tenant_throttle_and_class_queue_cap():
+    """GenerationServer admission: an abusive tenant sheds alone
+    (its bucket, nobody else's) and a low class's admit_frac caps its
+    share of the QUEUE, preserving admission headroom for gold."""
+    from mxnet_tpu.serving import (QoSClass, TenantQoS,
+                                   TenantThrottledError)
+    qos = TenantQoS(classes=[QoSClass("gold", priority=10),
+                             QoSClass("bronze", priority=0,
+                                      admit_frac=0.5)],
+                    default_class="bronze", tenant_rate=1.0,
+                    tenant_burst=2)
+    srv = make_server(buckets=BucketSpec(batch=(1,), length=(8,)),
+                      n_slots=1, n_pages=17, max_new_tokens=24,
+                      max_queue=4, qos=qos,
+                      name=f"GenQoS-{time.monotonic_ns()}").start()
+    try:
+        # phase 1: the abusive tenant burns its bucket and sheds ALONE
+        ab = [srv.submit(np.asarray([3], np.int32), tenant="abuser",
+                         klass="gold") for _ in range(2)]
+        with pytest.raises(TenantThrottledError):
+            srv.submit(np.asarray([3], np.int32), tenant="abuser",
+                       klass="gold")
+        srv.submit(np.asarray([5], np.int32), tenant="t0",
+                   klass="gold").result(120)   # neighbour untouched
+        for r in ab:
+            r.result(120)
+        # phase 2: one seated + two queued bronze = bronze AT its
+        # 0.5 * 4 share of the queue
+        reqs = [srv.submit(np.asarray([1], np.int32), tenant="t1")]
+        t0 = time.time()                       # wait for it to seat
+        while srv.healthz()["queue_depth"] > 0 and time.time() - t0 < 30:
+            time.sleep(0.005)
+        reqs += [srv.submit(np.asarray([i + 2], np.int32),
+                            tenant=f"t{i + 2}") for i in range(2)]
+        with pytest.raises(RejectedError, match="cap"):
+            srv.submit(np.asarray([9], np.int32), tenant="t9")
+        gold = srv.submit(np.asarray([7], np.int32), tenant="g0",
+                          klass="gold")       # headroom reserved for gold
+        gold.result(120)
+        for r in reqs:
+            r.result(120)
+        snap = srv.healthz()["classes"]
+        assert snap["bronze"]["shed"] >= 1
+        assert snap["gold"]["throttled"] >= 1
+    finally:
+        assert srv.drain(60)
+    st = srv.stats
+    assert st["admitted"] == st["completed"] + st["failed"] + st["expired"]

@@ -19,11 +19,13 @@ from mxnet_tpu import fault, profiler, serving
 from mxnet_tpu.serving import (BucketSpec, CircuitBreaker,
                                CircuitOpenError, DeadlineExceededError,
                                InferenceServer, NonFiniteOutputError,
-                               RejectedError, ServerClosedError,
+                               QoSClass, RejectedError, ServerClosedError,
+                               TenantQoS, TenantThrottledError,
                                TokenBucket)
 
 pytestmark = pytest.mark.serving
 chaos = pytest.mark.chaos
+slo = pytest.mark.slo
 
 
 def make_apply(delay=0.0, feature=3):
@@ -934,3 +936,128 @@ def test_score_accepts_plain_iterable():
                                label=[mx.nd.array(np.zeros(8, np.float32))])]
     res = mod.score(iter(batches), "acc")
     assert res and res[0][0] == "accuracy"
+
+
+# ================================================ ISSUE 12: per-tenant QoS --
+@slo
+def test_qos_class_resolution_and_validation():
+    qos = TenantQoS(classes=[QoSClass("gold", priority=10, deadline=0.5),
+                             QoSClass("bronze", priority=0)],
+                    default_class="bronze")
+    assert qos.klass(None).name == "bronze"          # default class
+    assert qos.klass("gold").priority == 10
+    with pytest.raises(RejectedError, match="unknown priority class"):
+        qos.klass("platinum")
+    with pytest.raises(ValueError, match="duplicate class"):
+        TenantQoS(classes=[QoSClass("a"), QoSClass("a")])
+    with pytest.raises(ValueError, match="default_class"):
+        TenantQoS(classes=[QoSClass("a")], default_class="b")
+    with pytest.raises(ValueError, match="admit_frac"):
+        QoSClass("x", admit_frac=0.0)
+
+
+@slo
+def test_per_tenant_buckets_isolate_and_refund():
+    """One tenant's empty bucket sheds that tenant ALONE; a refunded
+    token is honestly re-spendable and the shed lands in the books."""
+    qos = TenantQoS(tenant_rate=1.0, tenant_burst=2)
+    qc = qos.classify(tenant="abuser")
+    qos.classify(tenant="abuser")
+    with pytest.raises(TenantThrottledError, match="abuser"):
+        qos.classify(tenant="abuser")                # burst burnt
+    qos.classify(tenant="nice")                      # neighbour untouched
+    qos.refund("abuser", qc)                         # downstream refusal
+    qos.classify(tenant="abuser")                    # token honestly back
+    snap = qos.snapshot()["default"]
+    assert snap["throttled"] == 1 and snap["shed"] == 1
+    # admitted column: 4 classifies + 1 refund takes one back
+    assert snap["admitted"] == 3
+
+
+@slo
+def test_tenant_bucket_lru_bounds_cardinality():
+    """A tenant-id cardinality attack must not grow host memory without
+    bound: the bucket table is LRU-capped."""
+    qos = TenantQoS(tenant_rate=100.0, max_tenants=4)
+    for i in range(16):
+        qos.classify(tenant=f"t{i}")
+    assert len(qos._buckets) == 4
+    assert "t15" in qos._buckets and "t0" not in qos._buckets
+
+
+@slo
+def test_class_stats_percentiles_and_deadline_miss():
+    qos = TenantQoS(classes=[QoSClass("gold", deadline=0.01)])
+    qc = qos.klass("gold")
+    # resolve two tracked requests: one instant, one past the SLO target
+    fast = serving.Request((None,))
+    qos.track(qc, fast)
+    fast.set_result(1)
+    slow = serving.Request((None,))
+    qos.track(qc, slow)
+    time.sleep(0.03)                                 # > the 10ms target
+    slow.set_result(1)
+    snap = qos.snapshot()["gold"]
+    assert snap["completed"] == 2
+    assert snap["deadline_miss"] == 1                # SLO miss, not error
+    assert snap["p50_ms"] is not None \
+        and snap["p99_ms"] >= snap["p50_ms"]
+    assert snap["priority"] == 0 and snap["deadline"] == 0.01
+
+
+@slo
+def test_server_qos_admission_and_class_deadline():
+    """InferenceServer end-to-end: tenant throttling at submit, the
+    class's default deadline applied, and resolutions landing in the
+    per-class healthz rows."""
+    qos = TenantQoS(classes=[QoSClass("gold", priority=10, deadline=5.0),
+                             QoSClass("bronze", priority=0,
+                                      deadline=0.0001)],
+                    default_class="bronze", tenant_rate=1.0,
+                    tenant_burst=2)
+    srv = InferenceServer(make_apply(delay=0.05), buckets=(1,),
+                          max_delay=0.0, qos=qos,
+                          name="QoSServer").start()
+    x = np.ones((3,), np.float32)
+    try:
+        np.testing.assert_allclose(srv(x, tenant="t0", klass="gold"),
+                                   2.0 * x)
+        # bronze's 0.1ms class deadline expires in queue: the batch
+        # thread is pinned by a slow request while the doomed one waits
+        blocker = srv.submit(x, tenant="t0", klass="gold")
+        with pytest.raises(DeadlineExceededError):
+            srv(x, tenant="t1", klass="bronze")
+        blocker.result(30)
+        # the abusive tenant sheds alone — and the verdict never burned
+        # queue space (rejected accounting, not failed)
+        srv.submit(x, tenant="abuser", klass="gold").result(10)
+        srv.submit(x, tenant="abuser", klass="gold").result(10)
+        with pytest.raises(TenantThrottledError):
+            srv.submit(x, tenant="abuser", klass="gold")
+        classes = srv.healthz()["classes"]
+        assert classes["gold"]["completed"] >= 3
+        assert classes["gold"]["throttled"] == 1
+        assert classes["bronze"]["expired"] >= 1
+        assert classes["bronze"]["deadline_miss"] >= 1
+    finally:
+        srv.drain()
+    st = srv.stats
+    assert st["admitted"] == st["completed"] + st["failed"] + st["expired"]
+
+
+@slo
+@chaos
+def test_admission_classify_fault_point():
+    """admission.classify is injectable: the verdict path itself can be
+    failed deterministically, the server sheds explicitly and stays
+    healthy."""
+    srv = InferenceServer(make_apply(), buckets=(1, 2), max_delay=0.002,
+                          name="ClassifyInj").start()
+    x = np.ones((3,), np.float32)
+    try:
+        with fault.inject("admission.classify", RuntimeError("ldap down")):
+            with pytest.raises(RuntimeError, match="ldap down"):
+                srv.submit(x, tenant="t0")
+        np.testing.assert_allclose(srv(x), 2.0 * x)  # healthy after
+    finally:
+        srv.drain()
