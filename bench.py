@@ -74,6 +74,53 @@ def _cost_fields(step):
     return fields
 
 
+def _trace_on():
+    """Arm the request tracer for a serving bench (ISSUE 13).  Returns
+    True when armed.  ``MXTPU_BENCH_TRACE=0`` opts out; a telemetry
+    import/arming failure never fails the bench (wedge-tolerant like
+    ``_cost_fields``)."""
+    if os.environ.get("MXTPU_BENCH_TRACE", "1").lower() in ("0", "false"):
+        return False
+    try:
+        from mxnet_tpu import telemetry
+        telemetry.enable(sample=1.0)
+        return True
+    except Exception:       # noqa: BLE001 — the throughput line ships
+        return False        # without its latency breakdown
+
+
+def _trace_fields(server_name,
+                  phases=("queue", "prefill", "handoff", "decode",
+                          "coalesce", "step")):
+    """Per-phase latency breakdown for a serving bench's JSON line:
+    p50/p99 (ms) of the request tracer's span-duration histograms
+    (``<server>::<phase>_ms``), measured on the SAME traffic the
+    throughput number comes from — where the time went, not just how
+    much there was.  Keys are stable (``<phase>_ms_p50``/``_p99``);
+    phases the serving path never entered (e.g. ``handoff`` on a fused
+    decode server) report null.  Best-effort like ``_cost_fields``, and
+    disarms the tracer on the way out."""
+    fields = {}
+    try:
+        from mxnet_tpu import telemetry
+        try:
+            hists = telemetry.registry().snapshot(
+                prefix=f"{server_name}::")["histograms"]
+            for phase in phases:
+                snap = hists.get(f"{phase}_ms")
+                for q, tag in ((0.50, "p50"), (0.99, "p99")):
+                    v = None if snap is None \
+                        else telemetry.histogram_quantile(snap, q)
+                    fields[f"{phase}_ms_{tag}"] = None if v is None \
+                        else round(v, 3)
+        finally:
+            telemetry.disable()  # even wedged mid-snapshot — a later
+            #                      bench must not run traced
+    except Exception:       # noqa: BLE001 — wedged mid-snapshot; the
+        pass                # throughput line still ships
+    return fields
+
+
 def _setup():
     import jax
 
@@ -324,6 +371,7 @@ def bench_llm():
     max_new = 64 if on_accel else 8
     n_requests = 256 if on_accel else 32
     params = init_causal_lm(cfg, seed=0)
+    traced = _trace_on()    # per-phase latency breakdown (ISSUE 13)
     srv = GenerationServer(
         params, cfg, buckets=BucketSpec(batch=(1, 2, 4), length=(32, 64)),
         n_slots=n_slots, n_pages=n_pages, page_size=page_size,
@@ -363,6 +411,7 @@ def bench_llm():
     st = srv.stats
     census, jit_count = srv.census(), srv.jit_cache_count()
     srv.drain()
+    trace_fields = _trace_fields("BenchGen") if traced else {}
 
     fields = {}
     if os.environ.get("MXTPU_BENCH_COSTS", "1").lower() not in ("0",
@@ -403,6 +452,7 @@ def bench_llm():
         "n_executables": jit_count,
         "census": census,
         **fields,
+        **trace_fields,
     }))
 
 

@@ -58,13 +58,21 @@ import time
 
 try:  # normal package import (worker side, tests)
     from . import fault as _fault
+    from . import telemetry as _telemetry
 except ImportError:  # pragma: no cover — loaded by file path (tools/launch.py)
     import importlib.util as _ilu
-    _spec = _ilu.spec_from_file_location(
-        "_mxtpu_fault_standalone",
-        os.path.join(os.path.dirname(os.path.abspath(__file__)), "fault.py"))
-    _fault = _ilu.module_from_spec(_spec)
-    _spec.loader.exec_module(_fault)
+
+    def _load_standalone(stem):
+        spec = _ilu.spec_from_file_location(
+            f"_mxtpu_{stem}_standalone",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         f"{stem}.py"))
+        mod = _ilu.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    _fault = _load_standalone("fault")
+    _telemetry = _load_standalone("telemetry")
 
 __all__ = ["EXIT_OK", "EXIT_PREEMPTED", "EXIT_NONFINITE", "HEARTBEAT_ENV",
            "NonFiniteAbortError", "classify_exit", "Heartbeat",
@@ -271,25 +279,33 @@ def latest_committed_step(directory, prefix="ckpt"):
 class EventLog:
     """Append-only JSONL event stream + in-memory record list.
 
-    One line per event: ``{"ts": ..., "event": ..., **fields}`` — the
-    machine-readable supervision history (``tools/chaos_check.py --mode
-    elastic`` parses it back).  ``echo`` mirrors a one-line human form to
-    a stream (the supervisor uses stderr).  Emit only from the owning
+    One line per event: ``{"ts": ..., "mono": ..., "kind": "event",
+    "name"/"event": ..., **fields}`` — the machine-readable supervision
+    history (``tools/chaos_check.py --mode elastic`` parses it back).
+    ISSUE 13: hosted on ``telemetry.JsonlSink``, the ONE JSONL stream
+    implementation of the stack (supervisor log, autoscaler log, and
+    trace export all ride it) — atomic line writes, size rotation, and
+    the shared ``ts``/``mono``/``kind``/``name`` schema, which also
+    gives every event the monotonic stamp autoscale records previously
+    lacked.  The legacy ``event`` key stays on every record so existing
+    parsers keep working.  ``echo`` mirrors a one-line human form to a
+    stream (the supervisor uses stderr).  Emit only from the owning
     thread; worker threads hand verdicts to the owner instead."""
 
-    def __init__(self, path=None, echo=None):
+    def __init__(self, path=None, echo=None, max_bytes=None):
         self.path = str(path) if path else None
         self.records = []
-        self._f = open(self.path, "a") if path else None
+        self._sink = _telemetry.JsonlSink(self.path, max_bytes=max_bytes)
         self._echo = echo
 
     def emit(self, event, **fields):
-        rec = {"ts": round(time.time(), 3), "event": str(event)}
-        rec.update(fields)
+        payload = dict(fields)
+        payload.setdefault("event", str(event))
+        if "name" in payload:          # caller-owned name field wins
+            rec = self._sink.write("event", **payload)
+        else:
+            rec = self._sink.write("event", str(event), **payload)
         self.records.append(rec)
-        if self._f is not None:
-            self._f.write(json.dumps(rec, sort_keys=True) + "\n")
-            self._f.flush()
         if self._echo is not None:
             kv = " ".join(f"{k}={fields[k]}" for k in sorted(fields))
             print(f"[supervisor] {event} {kv}".rstrip(),
@@ -297,9 +313,7 @@ class EventLog:
         return rec
 
     def close(self):
-        if self._f is not None:
-            self._f.close()
-            self._f = None
+        self._sink.close()
 
 
 def _free_port(host="127.0.0.1"):
@@ -442,6 +456,24 @@ class Supervisor:
         (Signal latches only bind on the main thread; this works from
         any.)"""
         self._stop.set()
+
+    def telemetry(self, fmt="json"):
+        """The unified metrics exposition (ISSUE 13): the SAME
+        ``telemetry.exposition`` key schema the serving runtimes serve
+        (one scraper reads the whole stack), with the supervisor's gang
+        counters and worker gauges.  ``fmt="prom"`` renders the
+        Prometheus-style text form.  Works in standalone (file-path)
+        mode — the telemetry twin loads the same way ``fault`` does."""
+        counters = {"restarts": self.restarts,
+                    "events": 0 if self.log is None
+                    else len(self.log.records)}
+        gauges = {"workers": self.num_workers,
+                  "live_workers": len(self.worker_pids()),
+                  "max_restarts": self.max_restarts,
+                  "watchdog_secs": self.watchdog_secs}
+        payload = _telemetry.exposition("supervisor", "Supervisor",
+                                        counters, gauges)
+        return _telemetry.render(payload, fmt)
 
     # ---- the run loop ----
     def run(self):

@@ -86,11 +86,25 @@ import threading
 import time
 
 __all__ = ["inject", "fire", "points", "armed", "register_point",
-           "retry_call", "backoff_delay", "GracefulExit", "with_context"]
+           "set_observer", "retry_call", "backoff_delay", "GracefulExit",
+           "with_context"]
 
 _REGISTRY = {}            # point -> _Injection (armed faults)
 _KNOWN = {}               # point -> location blurb (the documented surface)
 _lock = threading.Lock()
+_OBSERVER = None          # telemetry hook: called with the point name on
+#                           every fault that actually FIRES (raises)
+
+
+def set_observer(fn):
+    """Install ``fn(point)`` to observe every fault firing (or ``None``
+    to remove it).  ``telemetry.enable()`` uses this to record firings
+    as span events on the request being served; the observer runs
+    OUTSIDE the registry lock, just before the armed error raises, and
+    its own exceptions are swallowed — observability must never change
+    what the fault harness does."""
+    global _OBSERVER
+    _OBSERVER = fn
 
 
 def register_point(point, where=""):
@@ -200,6 +214,12 @@ def fire(point):
         if inj is None or not inj._should_fire_locked():
             return
         err = inj.make_error()
+    obs = _OBSERVER
+    if obs is not None:
+        try:
+            obs(point)
+        except Exception:      # noqa: BLE001 — observability must never
+            pass               # change what the fault harness does
     raise err
 
 

@@ -23,10 +23,12 @@ import os
 import threading
 import time
 
+from . import telemetry as _telemetry
+
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
            "dump", "dumps", "reset", "Task", "Frame", "Event", "Counter",
            "Marker", "scope", "counter_value", "counters",
-           "counters_clear"]
+           "counters_clear", "ingest_events"]
 
 _lock = threading.Lock()
 
@@ -188,11 +190,26 @@ class scope:
             record_span(self._name, self._t0, _now_us(), self._cat)
 
 
+def ingest_events(events):
+    """Append pre-built Chrome-trace events to the profiler stream —
+    the channel ``telemetry.Trace.finish`` uses so request spans land
+    on the SAME timeline as profiler spans and counters.  Events are
+    only kept while the profiler is recording."""
+    if not ACTIVE:
+        return
+    with _lock:
+        _P.events.extend(events)
+
+
 # ---------------------------------------------------------------- output --
 def dump(finished=True):
-    """Write the Chrome-trace JSON to the configured filename."""
+    """Write the Chrome-trace JSON to the configured filename.  Events
+    are sorted by timestamp (telemetry traces export whole trees at
+    request resolution, out of arrival order) so ``ts`` is monotonic
+    per tid in the written stream."""
     with _lock:
-        payload = {"traceEvents": list(_P.events),
+        payload = {"traceEvents": sorted(_P.events,
+                                         key=lambda e: e.get("ts", 0)),
                    "displayTimeUnit": "ms"}
     d = os.path.dirname(_P.filename)
     if d:
@@ -282,28 +299,52 @@ def counters(prefix=None):
 
 def counters_clear(prefix=None):
     """Drop Counter registrations (all, or names starting with
-    ``prefix``) from the ``counter_value``/``counters`` namespace.
+    ``prefix``) from the ``counter_value``/``counters`` namespace AND
+    from the telemetry registry backing them.
 
     A serving fleet creates one counter series per replica under its
     own name prefix; a restarted fleet (or a test building several)
     reuses those names, and without this the snapshot would keep
     reporting the dead instance's values until the new one's first
-    write.  Live ``Counter`` objects are unaffected — only the
-    name→instance registry forgets them."""
+    write.  Live ``Counter`` objects keep working against their own
+    (now detached) gauge — only the name→value namespaces forget
+    them."""
     with _lock:
-        for name in [n for n in _COUNTERS
-                     if prefix is None or n.startswith(prefix)]:
+        names = [n for n in _COUNTERS
+                 if prefix is None or n.startswith(prefix)]
+        for name in names:
             del _COUNTERS[name]
+    reg = _telemetry.registry()
+    for name in names:
+        reg.remove(name)
 
 
 class Counter:
-    """Numeric counter series (ref: profiler.Counter)."""
+    """Numeric counter series (ref: profiler.Counter).
+
+    ISSUE 13: the value lives in a ``telemetry.Gauge`` of the shared
+    ``telemetry.registry()`` under the same series name — the profiler
+    snapshot (``counters``/``counter_value``) and the telemetry
+    expositions read the SAME cell, so the two systems can never report
+    different values for one series.  Creating a Counter under an
+    existing name gives the series a FRESH cell starting at ``value``
+    (the fresh-instance semantics fleet restarts rely on) — a stale
+    same-named instance keeps writing its own detached gauge, so a
+    replaced server's background threads can never bleed increments
+    into the replacement's live series."""
 
     def __init__(self, domain=None, name="counter", value=0):
         self.name = (name if domain is None
                      else f"{getattr(domain, 'name', domain)}::{name}")
-        self._value = value
+        reg = _telemetry.registry()
+        reg.remove(self.name)
+        self._gauge = reg.gauge(self.name)
+        self._gauge.set(value)
         _COUNTERS[self.name] = self
+
+    @property
+    def _value(self):
+        return self._gauge.value
 
     def _emit(self):
         if not ACTIVE:
@@ -314,20 +355,18 @@ class Counter:
             _P.events.append(ev)
 
     def set_value(self, value):
-        self._value = value
+        self._gauge.set(value)
         self._emit()
 
     # increments are read-modify-write and counters are shared across
-    # threads (serving sheds from every client thread) — take the module
-    # lock for the update, emit outside it (_emit re-acquires)
+    # threads (serving sheds from every client thread) — the gauge's
+    # own lock makes the update atomic; emit happens outside it
     def increment(self, delta=1):
-        with _lock:
-            self._value += delta
+        self._gauge.add(delta)
         self._emit()
 
     def decrement(self, delta=1):
-        with _lock:
-            self._value -= delta
+        self._gauge.add(-delta)
         self._emit()
 
 

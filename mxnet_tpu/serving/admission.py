@@ -28,6 +28,7 @@ import threading
 import time
 
 from .. import fault as _fault
+from .. import telemetry as _telemetry
 
 __all__ = ["RejectedError", "CircuitOpenError", "ServerClosedError",
            "DeadlineExceededError", "NonFiniteOutputError",
@@ -142,18 +143,27 @@ class QoSClass:
 
 
 class ClassStats:
-    """Sliding-window SLO accounting for one priority class.
+    """SLO accounting for one priority class.
 
     Counters (monotonic): ``admitted`` / ``throttled`` / ``shed`` /
     ``completed`` / ``failed`` / ``expired`` / ``deadline_miss``.
-    Latencies of the last ``window`` resolutions feed the p50/p99 the
-    snapshot reports.  ``snapshot()`` is non-blocking in the healthz
-    sense: one short lock over host counters and a bounded sort — no
-    device work, no queue waits."""
+    Latencies land in BOTH a sliding window of the last ``window``
+    resolutions — which feeds the p50/p99 the ``snapshot()`` reports,
+    so a router ranking replicas on healthz sees CURRENT behaviour (a
+    recovered replica's p99 decays; a degraded one's isn't diluted by
+    hours of healthy history) — and a cumulative ``telemetry.Histogram``
+    (ISSUE 13: fixed log-spaced buckets,
+    ``telemetry.LATENCY_BUCKETS_S``), the mergeable series the unified
+    ``telemetry()`` expositions serve (scrapers window it themselves by
+    differencing scrapes, Prometheus-style).  ``snapshot()`` is
+    non-blocking in the healthz sense: one short lock over host
+    counters and a bounded sort — no device work, no queue waits."""
 
     def __init__(self, window=256):
         self._lock = threading.Lock()
-        self._lat = collections.deque(maxlen=int(window))
+        self._window = collections.deque(maxlen=int(window))
+        self._lat = _telemetry.Histogram("latency_s",
+                                         _telemetry.LATENCY_BUCKETS_S)
         self._counts = {"admitted": 0, "throttled": 0, "shed": 0,
                         "completed": 0, "failed": 0, "expired": 0,
                         "deadline_miss": 0}
@@ -165,16 +175,24 @@ class ClassStats:
     def observe(self, latency, outcome, missed):
         """One resolved request: ``latency`` seconds, ``outcome`` in
         ``completed``/``failed``/``expired``, ``missed`` = SLO verdict."""
+        latency = float(latency)
         with self._lock:
             self._counts[outcome] += 1
             if missed:
                 self._counts["deadline_miss"] += 1
-            self._lat.append(float(latency))
+            self._window.append(latency)
+        self._lat.observe(latency)
+
+    def latency_snapshot(self):
+        """The mergeable (cumulative) histogram snapshot (seconds) —
+        served by the runtimes' ``telemetry()`` expositions as the
+        ``class_<name>_latency_s`` histogram series."""
+        return self._lat.snapshot()
 
     def snapshot(self):
         with self._lock:
             out = dict(self._counts)
-            lat = sorted(self._lat)
+            lat = sorted(self._window)
         n = len(lat)
         out["p50_ms"] = round(lat[n // 2] * 1e3, 3) if n else None
         out["p99_ms"] = round(lat[min(n - 1, (99 * n) // 100)] * 1e3,
@@ -310,6 +328,13 @@ class TenantQoS:
             out[name] = s
         return out
 
+    def latency_snapshots(self):
+        """``{class: ClassStats.latency_snapshot()}`` — the cumulative,
+        mergeable per-class latency histograms the ``telemetry()``
+        expositions serve (as ``class_<name>_latency_s`` series)."""
+        return {name: st.latency_snapshot()
+                for name, st in self._stats.items()}
+
 
 class Request:
     """One accepted inference request: payload + deadline + a future.
@@ -330,15 +355,25 @@ class Request:
     request (``None`` when the server runs without tenant attribution) —
     carried here so schedulers can order work and SLO accounting can
     attribute the resolution without a side table.
+
+    ``trace``/``tspans`` are the request-tracing channel (ISSUE 13):
+    ``telemetry.begin_request`` stamps an accepted request with its
+    ``telemetry.Trace`` and the open phase spans; every downstream
+    instrumentation site guards on the single ``trace is not None``
+    attribute check, so an untraced request allocates nothing and pays
+    one attribute read per site.
     """
 
     __slots__ = ("data", "submitted_at", "deadline", "tenant", "klass",
+                 "trace", "tspans",
                  "_event", "_result", "_error", "_callbacks", "_cb_lock")
 
     def __init__(self, data, deadline=None, tenant=None, klass=None):
         self.data = data
         self.tenant = tenant
         self.klass = klass
+        self.trace = None              # telemetry.Trace once begun
+        self.tspans = None             # {phase: Span}, traced only
         self.submitted_at = time.monotonic()
         self.deadline = None if deadline is None \
             else self.submitted_at + float(deadline)

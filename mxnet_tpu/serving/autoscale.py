@@ -36,6 +36,7 @@ import threading
 import time
 
 from .. import fault as _fault
+from .. import telemetry as _telemetry
 from ..elastic import EventLog
 
 __all__ = ["ScalingPolicy", "FleetAutoscaler"]
@@ -188,6 +189,29 @@ class FleetAutoscaler:
             out = dict(self._stats)
             out["action_in_flight"] = self._action is not None
         return out
+
+    def telemetry(self, fmt="json"):
+        """The unified metrics exposition (ISSUE 13): the control loop's
+        action counters plus liveness gauges under the SAME
+        ``telemetry.exposition`` key schema every runtime serves — one
+        scraper reads fleet, replicas, generation servers, supervisor,
+        and this autoscaler uniformly.  ``fmt="prom"`` renders the
+        Prometheus-style text form."""
+        with self._lock:
+            counters = dict(self._stats)
+            in_flight = self._action is not None
+            attempts = self._attempts
+        gauges = {"action_in_flight": int(in_flight),
+                  "consecutive_failures": attempts,
+                  "alive": int(self._thread is not None
+                               and self._thread.is_alive()),
+                  "tick_secs": self._tick,
+                  "min_replicas": self.policy.min_replicas,
+                  "max_replicas": self.policy.max_replicas,
+                  "events": len(self.log.records)}
+        payload = _telemetry.exposition("fleet_autoscaler", self._name,
+                                        counters, gauges)
+        return _telemetry.render(payload, fmt)
 
     # ------------------------------------------------------------- the loop --
     def _loop(self):

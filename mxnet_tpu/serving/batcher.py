@@ -23,6 +23,7 @@ import time
 import numpy as np
 
 from .. import fault as _fault
+from .. import telemetry as _telemetry
 from .admission import (DeadlineExceededError, RejectedError,
                         ServerClosedError)
 
@@ -256,6 +257,9 @@ class DynamicBatcher:
                         "deadline exceeded in queue — the request never "
                         "touched the device"))
                 continue
+            if req.trace is not None:       # queue wait ends at the pop
+                _telemetry.end_span(req, "queue")
+                _telemetry.open_span(req, "coalesce")
             return req
 
     def _gather(self):
@@ -289,6 +293,15 @@ class DynamicBatcher:
         """Pad + run one group.  Any batching-layer failure (including an
         armed ``serving.batch`` fault) resolves every request explicitly —
         an accepted request is never left hanging."""
+        tspans = None
+        for r in group:                   # close the coalesce window —
+            if r.trace is not None:       # padding + device work follow
+                _telemetry.end_span(r, "coalesce")
+                if tspans is None:
+                    tspans = []
+                tspans.append(r.tspans["_c"])
+        if tspans is not None:            # fault firings → span events
+            _telemetry.push_current(tspans)
         try:
             _fault.fire("serving.batch")
             padded = self.buckets.pad_group(
@@ -311,6 +324,9 @@ class DynamicBatcher:
             for r in group:
                 self._resolve_error(r, err)
             raise
+        finally:
+            if tspans is not None:
+                _telemetry.pop_current()
         for r in group:
             # a runner that forgot a request is a bug, but the client
             # must still get an answer — and an honest one: the batch DID

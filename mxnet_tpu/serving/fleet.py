@@ -48,6 +48,7 @@ import numpy as np
 
 from .. import fault as _fault
 from .. import profiler as _profiler
+from .. import telemetry as _telemetry
 from .admission import (DeadlineExceededError, NonFiniteOutputError,
                         RejectedError, Request, ServerClosedError)
 from .batcher import BucketSpec
@@ -434,6 +435,7 @@ class ServingFleet:
         ``RejectedError`` when no ready replica has in-flight headroom.
         An admission-level refusal never touched any replica's queue and
         is never retried by the fleet."""
+        t0_us = _telemetry.now_us() if _telemetry.ACTIVE else None
         _fault.fire("fleet.route")
         if self._draining.is_set():
             self._count("rejected")
@@ -473,6 +475,11 @@ class ServingFleet:
         with self._lock:
             self._stats["admitted"] += 1
             self._outstanding += 1
+        # trace from the fleet's front door: no queue phase (routing is
+        # synchronous; waits between failover hops get their own spans)
+        if t0_us is not None:
+            _telemetry.begin_request(freq, self._name, t0_us=t0_us,
+                                     queue=False)
         try:
             self._dispatch(freq, group, frozenset(), attempts=0,
                            from_router=False)
@@ -486,6 +493,7 @@ class ServingFleet:
                 self._stats["shed"] += 1
             if qc is not None:
                 self._qos.refund(tenant, qc)
+            _telemetry.abort_request(freq)
             raise
         if qc is not None:
             self._qos.track(qc, freq)
@@ -558,17 +566,37 @@ class ServingFleet:
                 if rep.quarantined or rep.in_flight >= self._max_inflight:
                     continue
                 rep.in_flight += 1
+            dspan = None
+            if freq.trace is not None:
+                # the hop span: replica-side phases nest under it, and
+                # the wait since the previous hop closes here
+                _telemetry.end_span(freq, "failover")
+                dspan = _telemetry.open_span(freq, "dispatch",
+                                             replica=f"r{rep.index}")
             try:
                 _fault.fire("fleet.dispatch")
-                rreq = rep.server.submit(freq.data, deadline=remaining)
+                if dspan is None and _telemetry.ACTIVE:
+                    # the sampling decision was made at the front door —
+                    # an unsampled fleet request must not be re-sampled
+                    # into a partial replica-only tree
+                    with _telemetry.suppress():
+                        rreq = rep.server.submit(freq.data,
+                                                 deadline=remaining)
+                else:
+                    rreq = rep.server.submit(freq.data, deadline=remaining,
+                                             trace_parent=dspan)
             except RejectedError as exc:
                 with self._lock:
                     rep.in_flight -= 1
+                if dspan is not None:
+                    dspan.end(error=type(exc).__name__)
                 last_refusal = exc
                 continue
-            except BaseException:
+            except BaseException as exc:
                 with self._lock:
                     rep.in_flight -= 1
+                if dspan is not None:
+                    dspan.end(error=type(exc).__name__)
                 raise
             rreq.add_done_callback(
                 lambda r, _rep=rep, _g=group, _ex=excluded, _at=attempts:
@@ -600,6 +628,12 @@ class ServingFleet:
             # will reproduce on any replica — never re-dispatch either
             self._finish(freq, error=err)
             return
+        if freq.trace is not None:
+            # the hop failed retryably: the wait until the next dispatch
+            # (or the terminal verdict) is failover time, attributed
+            _telemetry.open_span(freq, "failover",
+                                 from_replica=f"r{rep.index}",
+                                 error=type(err).__name__)
         self._retry_q.put((freq, group, frozenset(excluded) | {rep.index},
                            attempts + 1, err))
 
@@ -827,8 +861,11 @@ class ServingFleet:
             if self._sample is None:
                 ok_now = rep.server.ready()
             else:
-                rreq = rep.server.submit(self._sample,
-                                         deadline=self._probe_deadline)
+                # infrastructure traffic, not a client request — a
+                # probe's tree would pollute the per-phase histograms
+                with _telemetry.suppress():
+                    rreq = rep.server.submit(self._sample,
+                                             deadline=self._probe_deadline)
         except Exception:        # refused (engaged breaker, dead server,
             ok_now = False       # injected fleet.probe fault): not healed
         if ok_now is not None:
@@ -1064,6 +1101,60 @@ class ServingFleet:
         out["replicas"] = {f"r{rep.index}": rep.server.stats
                            for rep in self._members()}
         return out
+
+    def telemetry(self, fmt="json"):
+        """The unified metrics exposition (ISSUE 13), fleet-wide: the
+        router's own counters plus every replica's exposition AGGREGATED
+        (counters/gauges summed under a ``replica_`` prefix, per-phase
+        latency histograms merged bucket-wise — ``queue_ms`` here is the
+        whole fleet's queue distribution) and the fleet-level per-class
+        SLO rows.  State-code gauges where a sum is meaningless
+        (``breaker_state``) aggregate as the WORST replica's value
+        instead.  Same ``telemetry.exposition`` key schema as every
+        other runtime; ``fmt="prom"`` renders Prometheus-style text."""
+        reps = self._members()
+        with self._lock:
+            counters = dict(self._stats)
+            outstanding = self._outstanding
+            quar = [rep.quarantined for rep in reps]
+        rpayloads = [rep.server.telemetry() for rep in reps]
+        agg = _telemetry.merge_payloads(rpayloads)
+        # sum(state codes) of 3 replicas can't tell one-open from
+        # three-half-open — the degraded-replica signal telemetry
+        # exists for; report the worst state across the fleet
+        states = [p["gauges"]["breaker_state"] for p in rpayloads
+                  if "breaker_state" in p.get("gauges", {})]
+        if states:
+            agg["gauges"]["breaker_state"] = max(states)
+        counters.update({f"replica_{k}": v
+                         for k, v in agg["counters"].items()})
+        gauges = {"outstanding": outstanding,
+                  "replicas": len(reps),
+                  "quarantined": sum(1 for q in quar if q),
+                  "ready_replicas": sum(
+                      1 for rep, q in zip(reps, quar)
+                      if not q and rep.server.ready()),
+                  "ready": int(self.ready()), "alive": int(self.alive()),
+                  "draining": int(self._draining.is_set())}
+        gauges.update({f"replica_{k}": v
+                       for k, v in agg["gauges"].items()})
+        # fleet-routed traces are born under the FLEET's name, so their
+        # per-phase histograms (queue/step/dispatch/failover) live under
+        # this prefix — replica expositions only carry front-door-to-
+        # replica traffic; merge both views
+        hists = dict(agg["histograms"])
+        own = _telemetry.registry().snapshot(
+            prefix=f"{self._name}::")["histograms"]
+        if self._qos is not None:      # fleet-level per-class latency —
+            for cname, snap in self._qos.latency_snapshots().items():
+                own[f"class_{cname}_latency_s"] = snap
+        for k, v in own.items():
+            hists[k] = v if k not in hists \
+                else _telemetry.merge_snapshots([hists[k], v])
+        payload = _telemetry.exposition(
+            "serving_fleet", self._name, counters, gauges, hists,
+            {} if self._qos is None else self._qos.snapshot())
+        return _telemetry.render(payload, fmt)
 
     # ---------------------------------------------------------------- drain --
     def drain(self, timeout=None):
@@ -1307,8 +1398,9 @@ class WeightUpdater:
         unless the replica returns an all-finite result in time."""
         _fault.fire("fleet.probe")
         self.fleet._count("probes")
-        rreq = rep.server.submit(self.fleet._sample,
-                                 deadline=self._probe_deadline)
+        with _telemetry.suppress():    # infrastructure, untraced
+            rreq = rep.server.submit(self.fleet._sample,
+                                     deadline=self._probe_deadline)
         out = rreq.result(self._probe_deadline + 1.0)
         leaves = out if isinstance(out, (tuple, list)) else (out,)
         for leaf in leaves:

@@ -54,6 +54,7 @@ import queue
 
 from .. import fault as _fault
 from .. import profiler as _profiler
+from .. import telemetry as _telemetry
 from .admission import (CircuitOpenError, DeadlineExceededError,
                         RejectedError, Request, ServerClosedError,
                         TenantQoS, TokenBucket)
@@ -605,6 +606,7 @@ class GenerationServer:
         length bucket holds / a worst case that could never fit the
         page pool, ``TenantThrottledError`` for an over-rate tenant.
         None of them touched the device."""
+        t0_us = _telemetry.now_us() if _telemetry.ACTIVE else None
         if self._draining.is_set():
             self._bump("rejected")
             raise ServerClosedError(f"{self._name}: draining — "
@@ -683,24 +685,32 @@ class GenerationServer:
         # in-flight threshold)
         queue_cap = self._max_queue if qc.admit_frac >= 1.0 \
             else int(qc.admit_frac * self._max_queue)
+        # trace BEFORE joining the queue — the decode loop may pop the
+        # sequence immediately and needs the queue span already open.  A
+        # refusal below never resolves the request, so the trace is
+        # never exported.
+        if t0_us is not None:
+            _telemetry.begin_request(req, self._name, t0_us=t0_us)
         with self._admit_lock:
-            if self._stop.is_set():
-                if self._limiter is not None:
-                    self._limiter.refund()
-                self._qos.refund(tenant, qc)
-                self._bump("rejected")
+            admitted = not self._stop.is_set() \
+                and len(self._pending) < queue_cap
+            if admitted:
+                self._pending.append(seq)
+            else:
+                stopped = self._stop.is_set()
+        if not admitted:
+            if self._limiter is not None:
+                self._limiter.refund()
+            self._qos.refund(tenant, qc)
+            self._bump("rejected")
+            _telemetry.abort_request(req)
+            if stopped:
                 raise ServerClosedError(f"{self._name}: draining — "
                                         f"not admitting")
-            if len(self._pending) >= queue_cap:
-                if self._limiter is not None:
-                    self._limiter.refund()
-                self._qos.refund(tenant, qc)
-                self._bump("rejected")
-                raise RejectedError(
-                    f"{self._name}: request queue at class "
-                    f"{qc.name!r}'s cap ({queue_cap} of "
-                    f"{self._max_queue}) — shedding")
-            self._pending.append(seq)
+            raise RejectedError(
+                f"{self._name}: request queue at class "
+                f"{qc.name!r}'s cap ({queue_cap} of "
+                f"{self._max_queue}) — shedding")
         self._qos.track(qc, req)
         self._bump("admitted")
         return req
@@ -854,6 +864,8 @@ class GenerationServer:
     # ---- retirement ----
     def _vacate(self, seq):
         """Release a sequence's slot + pages (no request resolution)."""
+        if seq.req.trace is not None:
+            _telemetry.end_span(seq.req, "decode", tokens=len(seq.out))
         if seq.slot is not None:
             s = seq.slot
             self._bump("active_slots", -1)
@@ -989,6 +1001,10 @@ class GenerationServer:
             group = self._take_prefill_group(need_resources=False)
             if not group:
                 return worked
+            for seq in group:          # queue ends at dispatch; prefill
+                if seq.req.trace is not None:   # covers the worker leg
+                    _telemetry.end_span(seq.req, "queue")
+                    _telemetry.open_span(seq.req, "prefill")
             with self._lock:
                 self._prefill_flight[id(group)] = group
             self._prefill_q.put_nowait(group)
@@ -1033,12 +1049,23 @@ class GenerationServer:
         lengths = np.zeros((b,), np.int32)
         temps = np.zeros((b,), np.float32)
         topks = np.zeros((b,), np.int32)
+        pspans = None
+        worker = threading.current_thread().name
         for i, seq in enumerate(group):
             n = seq.prompt.shape[0]
             tokens[i, :n] = seq.prompt
             lengths[i] = n
             temps[i] = seq.temp
             topks[i] = seq.top_k
+            if seq.req.trace is not None:
+                sp = _telemetry.get_span(seq.req, "prefill")
+                if sp is not None:
+                    sp.attrs["worker"] = worker     # who ran the prefill
+                    if pspans is None:
+                        pspans = []
+                    pspans.append(sp)
+        if pspans is not None:
+            _telemetry.push_current(pspans)
         try:
             _fault.fire("generate.prefill")
             with _profiler.scope(f"{self._name}.prefill", cat="serving"):
@@ -1051,10 +1078,16 @@ class GenerationServer:
             for seq in group:
                 self._retire(seq, err, stat="failed")
             return
+        finally:
+            if pspans is not None:
+                _telemetry.pop_current()
         self.breaker.record_success()
         self._bump("prefills")
         for i, seq in enumerate(group):
             n = seq.prompt.shape[0]
+            if seq.req.trace is not None:   # handoff wait + scatter next
+                _telemetry.end_span(seq.req, "prefill")
+                _telemetry.open_span(seq.req, "handoff")
             # per-sequence payload: the decode loop re-packs any mix of
             # these into the fixed-shape handoff batch.  Copied — a view
             # parked in the handoff backlog would pin the whole
@@ -1108,6 +1141,16 @@ class GenerationServer:
         active = np.zeros((B,), bool)
         tables = np.zeros((B, self.pages_per_seq), np.int32)
         seated = []
+        hspans = None
+        for seq, _t, _k, _v in batch:
+            if seq.req.trace is not None:
+                sp = _telemetry.get_span(seq.req, "handoff")
+                if sp is not None:
+                    if hspans is None:
+                        hspans = []
+                    hspans.append(sp)
+        if hspans is not None:
+            _telemetry.push_current(hspans)
         try:
             _fault.fire("fleet.handoff")
             for j, (seq, first_tok, k_seq, v_seq) in enumerate(batch):
@@ -1130,6 +1173,9 @@ class GenerationServer:
                 self._retire(seq, err, stat="failed")
             self._recover_pools()
             return True
+        finally:
+            if hspans is not None:
+                _telemetry.pop_current()
         self._bump("handoffs")
         slots = self._free_slots()
         for j, (seq, first_tok, _k, _v) in enumerate(batch):
@@ -1143,6 +1189,17 @@ class GenerationServer:
         bucket = self._bucket_len(max(s.prompt.shape[0] for s in group))
         b = self.buckets.batch_bucket(k)
         slots = self._free_slots()[:k]
+        pspans = None
+        worker = threading.current_thread().name
+        for seq in group:              # queue ended at the pop; prefill
+            if seq.req.trace is not None:   # covers alloc + the program
+                _telemetry.end_span(seq.req, "queue")
+                sp = _telemetry.open_span(seq.req, "prefill",
+                                          worker=worker)
+                if sp is not None:
+                    if pspans is None:
+                        pspans = []
+                    pspans.append(sp)
         try:
             for seq in group:
                 seq.pages = self.alloc.alloc(
@@ -1152,6 +1209,9 @@ class GenerationServer:
             # only a racing... nothing else allocates; defensive re-queue
             for seq in group:
                 self._vacate(seq)
+                if seq.req.trace is not None:
+                    _telemetry.end_span(seq.req, "prefill")
+                    _telemetry.open_span(seq.req, "queue", requeued=True)
             with self._admit_lock:
                 self._pending.extendleft(reversed(group))
             return
@@ -1169,6 +1229,8 @@ class GenerationServer:
             tables[i, :len(seq.pages)] = seq.pages
             temps[i] = seq.temp
             topks[i] = seq.top_k
+        if pspans is not None:
+            _telemetry.push_current(pspans)
         try:
             _fault.fire("generate.prefill")
             with _profiler.scope(f"{self._name}.prefill", cat="serving"):
@@ -1182,9 +1244,14 @@ class GenerationServer:
                 self._retire(seq, err, stat="failed")
             self._recover_pools()
             return
+        finally:
+            if pspans is not None:
+                _telemetry.pop_current()
         self.breaker.record_success()
         self._bump("prefills")
         for i, seq in enumerate(group):
+            if seq.req.trace is not None:
+                _telemetry.end_span(seq.req, "prefill")
             self._seat(seq, slots[i], int(first[i]))
         self._note_occupancy()
 
@@ -1192,6 +1259,9 @@ class GenerationServer:
         """Seat one prefilled sequence in a decode slot: slot init is
         seat-time only — the per-token path advances ``_tokens`` /
         ``_lengths``; ``_ensure_capacity`` appends table entries."""
+        if seq.req.trace is not None:
+            _telemetry.end_span(seq.req, "handoff")   # no-op when fused
+            _telemetry.open_span(seq.req, "decode", slot=slot)
         seq.cached = seq.prompt.shape[0]
         seq.ran = True
         s = seq.slot = slot
@@ -1265,6 +1335,12 @@ class GenerationServer:
         victim.out = []
         self._bump("preempted")
         self._c_preempted.increment()
+        if victim.req.trace is not None:
+            # preemption is a span event on the tree, and the requeue
+            # wait is a fresh queue span — the restarted life (queue →
+            # prefill → decode again) stays attributed
+            _telemetry.span_event(victim.req, "preempt")
+            _telemetry.open_span(victim.req, "queue", requeued=True)
         with self._admit_lock:
             self._pending.appendleft(victim)
 
@@ -1291,6 +1367,16 @@ class GenerationServer:
                 f"{self._name}: circuit open — fast-failing in-flight "
                 f"generation"), queued=False)
             return
+        dspans = None
+        for seq in self._seqs.values():    # fault firings → span events
+            if seq.req.trace is not None:
+                sp = _telemetry.get_span(seq.req, "decode")
+                if sp is not None:
+                    if dspans is None:
+                        dspans = []
+                    dspans.append(sp)
+        if dspans is not None:
+            _telemetry.push_current(dspans)
         try:
             _fault.fire("generate.decode")
             with _profiler.scope(f"{self._name}.decode", cat="serving"):
@@ -1305,6 +1391,9 @@ class GenerationServer:
                 self._retire(seq, err, stat="failed")
             self._recover_pools()
             return
+        finally:
+            if dspans is not None:
+                _telemetry.pop_current()
         self.breaker.record_success()
         self._bump("decode_steps")
         for seq in list(self._seqs.values()):
@@ -1417,6 +1506,36 @@ class GenerationServer:
         out["free_pages"] = self.alloc.free_count()
         out["breaker"] = self.breaker.state
         return out
+
+    def telemetry(self, fmt="json"):
+        """The unified metrics exposition (ISSUE 13): lifecycle counters,
+        paging/disaggregation gauges, per-phase latency histograms
+        (``queue``/``prefill``/``handoff``/``decode`` span durations,
+        ms), and the per-class SLO rows — the SAME
+        ``telemetry.exposition`` key schema every runtime serves.
+        ``fmt="prom"`` renders Prometheus-style text."""
+        h = self.healthz()
+        with self._lock:
+            counters = dict(self._stats)
+        counters.pop("active_slots", None)     # a gauge, reported below
+        gauges = {"queue_depth": h["queue_depth"],
+                  "in_flight": h["in_flight"],
+                  "breaker_state": h["breaker_state"],
+                  "active_slots": h["active_slots"],
+                  "free_pages": h["free_pages"],
+                  "total_pages": h["total_pages"],
+                  "prefill_workers": h["prefill_workers"],
+                  "prefill_inflight": h["prefill_inflight"],
+                  "ready": int(h["ready"]), "alive": int(h["alive"]),
+                  "draining": int(h["draining"])}
+        hist = _telemetry.registry().snapshot(
+            prefix=f"{self._name}::")["histograms"]
+        for cname, snap in self._qos.latency_snapshots().items():
+            hist[f"class_{cname}_latency_s"] = snap
+        payload = _telemetry.exposition("generation_server", self._name,
+                                        counters, gauges, hist,
+                                        h["classes"])
+        return _telemetry.render(payload, fmt)
 
     # ----------------------------------------------------------------- drain --
     def drain(self, timeout=None):

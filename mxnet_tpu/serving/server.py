@@ -33,6 +33,7 @@ import numpy as np
 
 from .. import fault as _fault
 from .. import profiler as _profiler
+from .. import telemetry as _telemetry
 from .admission import (CircuitOpenError, DeadlineExceededError,
                         NonFiniteOutputError, RejectedError, Request,
                         ServerClosedError, TenantQoS, TokenBucket)
@@ -216,7 +217,8 @@ class InferenceServer:
                     f"new signature would recompile")
 
     # ------------------------------------------------------------ admission --
-    def submit(self, data, deadline=None, tenant=None, klass=None):
+    def submit(self, data, deadline=None, tenant=None, klass=None,
+               trace_parent=None):
         """Admit one request; returns its ``Request`` future.
 
         Refusals are immediate and explicit: ``ServerClosedError`` while
@@ -228,7 +230,12 @@ class InferenceServer:
         ``tenant``/``klass`` are the QoS labels (see ``TenantQoS``): the
         class supplies the default deadline when ``deadline`` is None and
         the resolved request's latency lands in that class's healthz
-        stats."""
+        stats.
+
+        ``trace_parent`` (a ``telemetry.Span``) continues an existing
+        request trace under that span — the fleet router passes its
+        dispatch span here so replica-side phases nest under the hop."""
+        t0_us = _telemetry.now_us() if _telemetry.ACTIVE else None
         _fault.fire("serving.admit")
         if self._draining.is_set():
             self._bump("rejected")
@@ -273,18 +280,27 @@ class InferenceServer:
             self._shed("rate limit exceeded — shedding")
         req = Request(payload, deadline=deadline, tenant=tenant,
                       klass=qc.name)
+        # trace BEFORE the offer — the batch thread may pop the request
+        # immediately and needs the queue span already open.  A refusal
+        # below leaves the request unresolved, so the trace is never
+        # exported (only accepted requests yield trees).
+        if trace_parent is not None or t0_us is not None:
+            _telemetry.begin_request(req, self._name, t0_us=t0_us,
+                                     parent=trace_parent)
         try:
             self._batcher.offer(req)
-        except ServerClosedError:
+        except ServerClosedError as exc:
             if self._limiter is not None:    # the refusal served no one —
                 self._limiter.refund()       # give the token back
             self._qos.refund(tenant, qc)
+            _telemetry.abort_request(req, exc)
             self._bump("rejected")
             raise
         except RejectedError as exc:
             if self._limiter is not None:
                 self._limiter.refund()
             self._qos.refund(tenant, qc)
+            _telemetry.abort_request(req, exc)
             self._shed(str(exc))
         self._qos.track(qc, req)
         self._bump("admitted")
@@ -336,6 +352,16 @@ class InferenceServer:
             self._bump("failed", len(group))
             return
         target = padded[0].shape[0]
+        step_spans = None
+        for r in group:                # device-step span per traced member
+            if r.trace is not None:
+                if step_spans is None:
+                    step_spans = []
+                sp = _telemetry.open_span(r, "step", batch=len(group))
+                if sp is not None:
+                    step_spans.append(sp)
+        if step_spans is not None:     # fault firings → span events
+            _telemetry.push_current(step_spans)
         try:
             _fault.fire("serving.step")
             with _profiler.scope(f"{self._name}.step", cat="serving"):
@@ -350,8 +376,14 @@ class InferenceServer:
                 r.set_error(err)
             self._bump("failed", len(group))
             return
+        finally:
+            if step_spans is not None:
+                _telemetry.pop_current()
         outs = tuple(_to_np(o) for o in
                      (out if isinstance(out, (tuple, list)) else (out,)))
+        if step_spans is not None:     # host realization is the sync point
+            for sp in step_spans:
+                sp.end()
         bad_dim = [o for o in outs if o.shape[:1] != (target,)]
         if bad_dim:
             # malformed output IS a step failure (a wedged/poisoned
@@ -486,6 +518,32 @@ class InferenceServer:
         this next to the jit cache size."""
         with self._lock:
             return set(self._shapes)
+
+    def telemetry(self, fmt="json"):
+        """The unified metrics exposition (ISSUE 13): one
+        ``telemetry.exposition`` payload — counters (the lifecycle
+        totals), gauges (queue depth, in-flight, breaker state),
+        per-phase latency histograms (``admit``/``queue``/``coalesce``/
+        ``step`` span durations, ms), and the per-class SLO rows —
+        under the SAME key schema every runtime serves.  ``fmt="prom"``
+        renders the Prometheus-style text form.  Non-blocking, same as
+        ``healthz``."""
+        h = self.healthz()
+        with self._lock:
+            counters = dict(self._stats)
+        gauges = {"queue_depth": h["queue_depth"],
+                  "in_flight": h["in_flight"],
+                  "breaker_state": h["breaker_state"],
+                  "ready": int(h["ready"]), "alive": int(h["alive"]),
+                  "draining": int(h["draining"])}
+        hist = _telemetry.registry().snapshot(
+            prefix=f"{self._name}::")["histograms"]
+        for cname, snap in self._qos.latency_snapshots().items():
+            hist[f"class_{cname}_latency_s"] = snap
+        payload = _telemetry.exposition("inference_server", self._name,
+                                        counters, gauges, hist,
+                                        h["classes"])
+        return _telemetry.render(payload, fmt)
 
     # ---------------------------------------------------------------- drain --
     def drain(self, timeout=None):

@@ -142,6 +142,7 @@ _PROJECT_PREFIXES = {
     "optimizer", "image", "random", "symbol", "executor", "module", "nn",
     "rnn", "kvstore", "metric", "model", "viz", "mon", "amp", "onnx",
     "recordio", "config", "runtime", "util", "tools", "step", "serving",
+    "telemetry",
 }
 
 
