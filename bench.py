@@ -51,6 +51,30 @@ def _quant_mode():
     return v
 
 
+def _tp_mode():
+    """MXTPU_BENCH_TP={off,N,N:f32,N:int8}: tensor-parallel shards (and
+    the decode-collective wire format) for the LLM bench's
+    ``GenerationServer`` (ISSUE 14 A/B knob).  ``N`` must divide the
+    bench model's head count and d_ff — the server validates loudly.
+    The chosen mode rides in the BENCH JSON line (``tp_shards`` /
+    ``tp_collectives``) next to the per-device cost fields, so the perf
+    trajectory records what was measured."""
+    v = os.environ.get("MXTPU_BENCH_TP", "").strip().lower()
+    if v in ("", "off", "0", "1"):
+        return 1, "f32"
+    shards, _, coll = v.partition(":")
+    coll = coll or "f32"
+    # tp_shards=1 builds mesh-free with NO collectives at all, so a
+    # "1:int8" (or "0:...") line would record a mode that never ran —
+    # the trajectory must say what was measured
+    if not shards.isdigit() or coll not in ("f32", "int8") \
+            or int(shards) < (1 if coll == "f32" else 2):
+        print(f"MXTPU_BENCH_TP={v!r} (expected N or N:f32|N:int8, "
+              f"N >= 2 for int8)", file=sys.stderr)
+        sys.exit(1)
+    return (int(shards), coll) if int(shards) > 1 else (1, "f32")
+
+
 def _cost_fields(step):
     """costguard report fields for a bench's JSON line: the static
     accounting (tools/costguard; PERF.md methodology) rides next to the
@@ -352,7 +376,11 @@ def bench_llm():
     (one program serves every traffic mix — ``n_executables`` in the
     line is the full serving census: prefill grid + 1).  Selected by
     ``python bench.py llm`` or ``MXTPU_BENCH_LLM=1`` (which also adds
-    it to ``all``)."""
+    it to ``all``).  ``MXTPU_BENCH_TP=N[:f32|:int8]`` serves through a
+    tensor-parallel N-way server (ISSUE 14) — the JSON line then adds
+    ``per_device_bytes_GB``/``per_device_collective_KB`` from
+    costguard's per-device section next to ``tp_shards``/
+    ``tp_collectives``."""
     jax = _setup()
 
     from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
@@ -370,12 +398,14 @@ def bench_llm():
     n_pages, page_size = (512, 64) if on_accel else (64, 16)
     max_new = 64 if on_accel else 8
     n_requests = 256 if on_accel else 32
+    tp_shards, tp_collectives = _tp_mode()
     params = init_causal_lm(cfg, seed=0)
     traced = _trace_on()    # per-phase latency breakdown (ISSUE 13)
     srv = GenerationServer(
         params, cfg, buckets=BucketSpec(batch=(1, 2, 4), length=(32, 64)),
         n_slots=n_slots, n_pages=n_pages, page_size=page_size,
         max_new_tokens=max_new, max_queue=n_requests, seed=0,
+        tp_shards=tp_shards, tp_collectives=tp_collectives,
         name="BenchGen")
     srv.start()                       # warmup compiles the whole census
 
@@ -416,26 +446,41 @@ def bench_llm():
     fields = {}
     if os.environ.get("MXTPU_BENCH_COSTS", "1").lower() not in ("0",
                                                                 "false"):
-        try:       # AOT cost analysis of THE decode program (lower-only)
+        try:       # AOT cost analysis of THE decode program (lower-only;
+            #        sharded over the SAME tp mesh as the server, so the
+            #        per-device column reports the shard-local bytes)
             import jax.numpy as jnp
+
+            from tools.costguard.report import unit_report
             sds = jax.ShapeDtypeStruct
             pool = sds((cfg.n_layers, n_pages, page_size, cfg.n_heads,
                         cfg.head_dim), jnp.float32)
             p_avals = jax.eval_shape(lambda: init_causal_lm(cfg, 0))
+            mesh = None
+            if tp_shards > 1:
+                from mxnet_tpu import parallel
+                mesh = parallel.make_mesh(
+                    tp=tp_shards, devices=jax.devices()[:tp_shards])
             lowered = jax.jit(
-                build_decode_step(cfg, page_size, "jnp")).lower(
+                build_decode_step(cfg, page_size, "jnp", mesh=mesh,
+                                  tp_collectives=tp_collectives)).lower(
                 p_avals, pool, pool, sds((n_slots,), jnp.int32),
                 sds((n_slots,), jnp.int32), sds((n_slots,), jnp.bool_),
                 sds((n_slots, srv.pages_per_seq), jnp.int32),
                 sds((2,), jnp.uint32), sds((n_slots,), jnp.float32),
                 sds((n_slots,), jnp.int32))
-            costs = lowered.compile().cost_analysis()
-            if isinstance(costs, list):
-                costs = costs[0] if costs else {}
+            rep = unit_report(lowered.compile(),
+                              n_args=len(jax.tree.leaves(p_avals)) + 9)
+            pd = rep.get("per_device", {})
             fields = {
-                "flops_T": round(costs.get("flops", 0.0) / 1e12, 6),
-                "bytes_GB": round(costs.get("bytes accessed", 0.0) / 1e9,
+                "flops_T": round(rep.get("flops", 0.0) / 1e12, 6),
+                "bytes_GB": round(rep.get("bytes_accessed", 0.0) / 1e9,
                                   4),
+                "per_device_bytes_GB":
+                    round(pd["argument_bytes"] / 1e9, 4)
+                    if "argument_bytes" in pd else None,
+                "per_device_collective_KB":
+                    round(pd.get("collective_bytes", 0.0) / 1e3, 3),
             }
         except Exception:   # noqa: BLE001 — wedged backend mid-AOT;
             pass            # the throughput line still ships
@@ -451,6 +496,8 @@ def bench_llm():
         "preempted": st["preempted"],
         "n_executables": jit_count,
         "census": census,
+        "tp_shards": tp_shards,
+        "tp_collectives": tp_collectives,
         **fields,
         **trace_fields,
     }))

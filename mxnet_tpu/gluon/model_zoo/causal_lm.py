@@ -16,6 +16,23 @@ serving decode loop can index or scan them inside one compiled program.
 Full-sequence attention reuses ``ops.multi_head_attention`` (the BERT
 hot path); single-token decode attention is
 ``ops.paged_decode_attention`` over the serving page pool.
+
+**Tensor parallelism (ISSUE 14).**  Every apply here takes an optional
+``reduce`` hook: ``None`` is the single-chip path (bit-identical to the
+pre-TP code), a callable is the Megatron shape — QKV and FFN-in weights
+column-sharded over the ``tp`` mesh axis (each device computes its OWN
+heads' q/k/v and its own slice of the FFN hidden), output/FFN-out
+weights row-sharded so each device holds a partial product, and
+``reduce`` (an all-reduce over ``tp``) restores the replicated hidden —
+the standard two collectives per layer.  Row-parallel biases (``bo``,
+``b2``) are added once, AFTER the reduce, never per shard.  The local
+head count is derived from the (possibly sharded) ``wqkv`` argument
+shape, so one body serves every shard count.  ``tp_shard_params`` is
+the host-side one-time relayout + placement: ``wqkv``/``bqkv`` columns
+are permuted into shard-grouped ``[q_s | k_s | v_s]`` order so a plain
+contiguous ``PartitionSpec`` chunk hands each device its own heads'
+fused projection (``causal_lm_tp_rules`` in ``parallel.sharding`` is
+the spec table).
 """
 from __future__ import annotations
 
@@ -23,11 +40,14 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ...ops.registry import OPS
 
 __all__ = ["CausalLMConfig", "init_causal_lm", "prefill_forward",
-           "sequence_logits", "decode_hidden", "lm_logits"]
+           "sequence_logits", "decode_hidden", "lm_logits",
+           "tp_param_specs", "tp_permute_qkv", "tp_shard_params",
+           "tp_validate"]
 
 _mha = OPS["multi_head_attention"]
 
@@ -87,42 +107,69 @@ def _ffn(x, w1, b1, w2, b2):
     return jax.nn.gelu(x @ w1 + b1) @ w2 + b2
 
 
+def _layer_tail(params, layer, h, ctx, reduce):
+    """Residual + output projection + FFN tail of one layer, shared by
+    the decode and whole-sequence paths (``ctx`` already merged to
+    ``[..., d_local]``): ``reduce=None`` keeps the exact single-chip
+    expression order; a callable reduces the two row-parallel partial
+    products, with the row-parallel biases (``bo``, ``b2``) added once
+    AFTER it, never per shard.  One body — the TP token-parity
+    contract cannot diverge between prefill and decode."""
+    if reduce is None:
+        h = h + ctx @ params["wo"][layer] + params["bo"][layer]
+        return h + _ffn(_ln(h, params["ln2_s"][layer],
+                            params["ln2_b"][layer]),
+                        params["w1"][layer], params["b1"][layer],
+                        params["w2"][layer], params["b2"][layer])
+    h = h + reduce(ctx @ params["wo"][layer]) + params["bo"][layer]
+    x2 = _ln(h, params["ln2_s"][layer], params["ln2_b"][layer])
+    return h + reduce(jax.nn.gelu(x2 @ params["w1"][layer]
+                                  + params["b1"][layer])
+                      @ params["w2"][layer]) + params["b2"][layer]
+
+
 def lm_logits(params, h):
     """Weight-tied LM head: hidden → vocab logits through the embedding
     matrix (``RNNModel(tie_weights=True)``)."""
     return _ln(h, params["lnf_s"], params["lnf_b"]) @ params["embed"].T
 
 
-def decode_hidden(params, layer, h, attend):
+def decode_hidden(params, layer, h, attend, reduce=None):
     """One pre-LN transformer layer for a SINGLE token position.
 
     ``h`` is ``[slots, d_model]``; ``attend(k, v) -> ctx`` is the
     caller's cache hook: it receives this layer's new per-slot K/V
-    (``[slots, heads, head_dim]``), owns writing them into its cache
-    (paged pool or dense stripe), and returns the attention context over
-    that cache.  Splitting here keeps the model free of any cache
-    layout while the serving layer stays free of the architecture."""
-    d = params["wo"].shape[1]
+    (``[slots, heads, head_dim]`` — LOCAL heads under tensor
+    parallelism), owns writing them into its cache (paged pool or dense
+    stripe), and returns the attention context over that cache.
+    Splitting here keeps the model free of any cache layout while the
+    serving layer stays free of the architecture.
+
+    ``reduce`` is the tensor-parallel all-reduce hook (see the module
+    docstring): ``None`` keeps the exact single-chip expression order;
+    a callable reduces the two row-parallel partial products, with the
+    row-parallel biases added once after it."""
     x = _ln(h, params["ln1_s"][layer], params["ln1_b"][layer])
     qkv = x @ params["wqkv"][layer] + params["bqkv"][layer]
     q, k, v = jnp.split(qkv, 3, axis=-1)
     slots = h.shape[0]
-    ctx = attend(q, k, v)                         # [slots, H, D] resolved
-    h = h + ctx.reshape(slots, d) @ params["wo"][layer] + params["bo"][layer]
-    h = h + _ffn(_ln(h, params["ln2_s"][layer], params["ln2_b"][layer]),
-                 params["w1"][layer], params["b1"][layer],
-                 params["w2"][layer], params["b2"][layer])
-    return h
+    ctx = attend(q, k, v)                   # [slots, H_local, D] resolved
+    return _layer_tail(params, layer, h, ctx.reshape(slots, -1), reduce)
 
 
-def _stack_forward(params, config: CausalLMConfig, tokens, lengths):
+def _stack_forward(params, config: CausalLMConfig, tokens, lengths,
+                   reduce=None):
     """The shared whole-sequence transformer stack: causal
     ``ops.multi_head_attention`` with positions beyond a row's
     ``lengths`` masked as keys (``lengths=None`` = every position
     valid).  Returns ``(h [b, L, d], k_all, v_all)`` with K/V stacked
-    ``[n_layers, b, L, heads, head_dim]``."""
+    ``[n_layers, b, L, heads, head_dim]`` — LOCAL heads when ``reduce``
+    (the tensor-parallel all-reduce hook) is given; the head count is
+    derived from the ``wqkv`` argument, not the config, so sharded and
+    replicated params run the same body."""
     c = config
     b, L = tokens.shape
+    heads = params["wqkv"].shape[-1] // 3 // c.head_dim     # local under tp
     h = params["embed"][tokens]                   # [b, L, d]
     if lengths is None:
         mask = jnp.ones((b, 1, 1, L), jnp.float32)
@@ -133,28 +180,29 @@ def _stack_forward(params, config: CausalLMConfig, tokens, lengths):
     for layer in range(c.n_layers):
         x = _ln(h, params["ln1_s"][layer], params["ln1_b"][layer])
         qkv = x @ params["wqkv"][layer] + params["bqkv"][layer]
-        q, k, v = jnp.split(qkv, 3, axis=-1)      # each [b, L, d]
-        ks.append(k.reshape(b, L, c.n_heads, c.head_dim))
-        vs.append(v.reshape(b, L, c.n_heads, c.head_dim))
-        ctx = _mha(q, k, v, mask=mask, heads=c.n_heads, causal=True,
+        q, k, v = jnp.split(qkv, 3, axis=-1)      # each [b, L, d_local]
+        ks.append(k.reshape(b, L, heads, c.head_dim))
+        vs.append(v.reshape(b, L, heads, c.head_dim))
+        ctx = _mha(q, k, v, mask=mask, heads=heads, causal=True,
                    dropout=0.0, training=False)
-        h = h + ctx @ params["wo"][layer] + params["bo"][layer]
-        h = h + _ffn(_ln(h, params["ln2_s"][layer],
-                         params["ln2_b"][layer]),
-                     params["w1"][layer], params["b1"][layer],
-                     params["w2"][layer], params["b2"][layer])
+        h = _layer_tail(params, layer, h, ctx, reduce)
     return h, jnp.stack(ks), jnp.stack(vs)
 
 
-def prefill_forward(params, config: CausalLMConfig, tokens, lengths):
+def prefill_forward(params, config: CausalLMConfig, tokens, lengths,
+                    reduce=None):
     """Whole-prompt forward: ``tokens [b, L]`` int32, ``lengths [b]``.
 
     Returns ``(logits_last [b, vocab], k_all, v_all)`` with K/V stacked
     ``[n_layers, b, L, heads, head_dim]`` — everything the serving
     layer needs to seed its cache and sample the first new token.  The
-    "last" hidden state is gathered at ``lengths - 1``."""
+    "last" hidden state is gathered at ``lengths - 1``.  Under tensor
+    parallelism (``reduce`` given) the returned K/V carry only the
+    device's OWN head shard — exactly what its shard of the paged pool
+    stores."""
     b, L = tokens.shape
-    h, ks, vs = _stack_forward(params, config, tokens, lengths)
+    h, ks, vs = _stack_forward(params, config, tokens, lengths,
+                               reduce=reduce)
     last = jnp.clip(lengths - 1, 0, L - 1)
     h_last = h[jnp.arange(b), last]               # [b, d]
     return lm_logits(params, h_last), ks, vs
@@ -167,3 +215,72 @@ def sequence_logits(params, config: CausalLMConfig, tokens,
     plain ``jax.grad``; examples/serve_llm.py does exactly that)."""
     h, _, _ = _stack_forward(params, config, tokens, lengths)
     return lm_logits(params, h)
+
+
+# ----------------------------------------------------- tensor parallelism --
+def tp_validate(config: CausalLMConfig, shards: int):
+    """Raise ``ValueError`` when this architecture cannot shard
+    ``shards`` ways: attention shards by WHOLE heads and the FFN hidden
+    by contiguous slices, so both must divide."""
+    if shards < 1:
+        raise ValueError(f"tp shards must be >= 1, got {shards}")
+    if config.n_heads % shards:
+        raise ValueError(
+            f"n_heads {config.n_heads} not divisible by tp shards "
+            f"{shards} — head-parallel attention shards whole heads")
+    if config.d_ff % shards:
+        raise ValueError(
+            f"d_ff {config.d_ff} not divisible by tp shards {shards}")
+
+
+def tp_permute_qkv(params, config: CausalLMConfig, shards: int):
+    """Host-side one-time relayout of the fused QKV projection: permute
+    ``wqkv``/``bqkv`` columns from ``[q | k | v]`` (each head-major)
+    into shard-grouped ``[q_0 k_0 v_0 | q_1 k_1 v_1 | ...]`` order, so
+    the plain contiguous chunk a ``PartitionSpec`` hands each device is
+    that device's own heads' q, k, AND v — and ``jnp.split(qkv, 3)``
+    inside the sharded program still works unchanged.  ``shards == 1``
+    is the identity (the permutation is its own single-group order).
+    Returns a NEW dict; the inputs are never mutated."""
+    tp_validate(config, shards)
+    if shards == 1:
+        return dict(params)
+    d, hd = config.d_model, config.head_dim
+    per = config.n_heads // shards * hd           # shard-local width
+    idx = np.concatenate([np.arange(part * d + s * per,
+                                    part * d + (s + 1) * per)
+                          for s in range(shards) for part in range(3)])
+    out = dict(params)
+    out["wqkv"] = jnp.asarray(params["wqkv"])[..., idx]
+    out["bqkv"] = jnp.asarray(params["bqkv"])[..., idx]
+    return out
+
+
+def tp_param_specs(config: CausalLMConfig, mesh, axis: str = "tp"):
+    """``PartitionSpec`` per param name for the Megatron layout —
+    ``causal_lm_tp_rules`` (parallel.sharding) applied to this
+    architecture's shapes (``jax.eval_shape``: zero device work).
+    Everything the rules don't name (embeddings, norms, row-parallel
+    biases) replicates."""
+    from ...parallel.sharding import causal_lm_tp_rules
+
+    rules = causal_lm_tp_rules(axis)
+    shapes = jax.eval_shape(lambda: init_causal_lm(config, 0))
+    return {k: rules.spec_for(k, v.shape, mesh)
+            for k, v in shapes.items()}
+
+
+def tp_shard_params(params, config: CausalLMConfig, mesh,
+                    axis: str = "tp"):
+    """Place params for tensor-parallel serving: permute the fused QKV
+    into shard-grouped order, then ``device_put`` every leaf with its
+    ``tp_param_specs`` sharding — committed sharded arrays, so the
+    serving programs never re-transfer them per call."""
+    from jax.sharding import NamedSharding
+
+    shards = int(mesh.shape[axis])
+    p = tp_permute_qkv(params, config, shards)
+    specs = tp_param_specs(config, mesh, axis)
+    return {k: jax.device_put(jnp.asarray(v),
+                              NamedSharding(mesh, specs[k]))
+            for k, v in p.items()}

@@ -11,8 +11,8 @@ pieces:
 """
 from .mesh import (AXES, MeshScope, current_mesh, default_mesh, make_mesh,
                    named_sharding, replicated, shard_map, validate_specs)
-from .sharding import (ShardingRules, batch_spec, fsdp_rules, param_sharding,
-                       tp_dense_rules)
+from .sharding import (ShardingRules, batch_spec, causal_lm_tp_rules,
+                       fsdp_rules, param_sharding, tp_dense_rules)
 from .functional import functional_call, param_names_and_values
 from .moe import MoEFFN, moe_dispatch
 from .pipeline import PipelineStack, gpipe
@@ -20,8 +20,10 @@ from .sequence import ring_attention, sp_attention, ulysses_attention
 from .prefetch import DevicePrefetcher
 from .step import (EvalStep, TrainStep, add_transfer_hook,
                    remove_transfer_hook)
-from .quantize import (GRAD_REDUCE_MODES, cast_bf16, dequantize_chunked,
-                       quantize_chunked, reduce_gradients)
+from .quantize import (ACTIVATION_REDUCE_MODES, GRAD_REDUCE_MODES,
+                       all_reduce_activations, cast_bf16,
+                       dequantize_chunked, quantize_chunked,
+                       reduce_gradients)
 from .checkpoint import (CheckpointManager, CheckpointMismatchError,
                          list_checkpoints, load_snapshot_params,
                          load_train_step, load_train_step_sharded,
@@ -37,7 +39,7 @@ __all__ = [
     "AXES", "MeshScope", "current_mesh", "default_mesh", "make_mesh",
     "named_sharding", "replicated",
     "ShardingRules", "batch_spec", "fsdp_rules", "param_sharding",
-    "tp_dense_rules",
+    "tp_dense_rules", "causal_lm_tp_rules",
     "functional_call", "param_names_and_values",
     "ring_attention", "sp_attention", "ulysses_attention",
     "PipelineStack", "gpipe",
@@ -46,4 +48,5 @@ __all__ = [
     "add_transfer_hook", "remove_transfer_hook",
     "GRAD_REDUCE_MODES", "quantize_chunked", "dequantize_chunked",
     "cast_bf16", "reduce_gradients",
+    "ACTIVATION_REDUCE_MODES", "all_reduce_activations",
 ]

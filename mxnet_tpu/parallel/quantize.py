@@ -43,12 +43,19 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["GRAD_REDUCE_MODES", "quantize_chunked", "dequantize_chunked",
-           "cast_bf16", "reduce_gradients"]
+__all__ = ["GRAD_REDUCE_MODES", "ACTIVATION_REDUCE_MODES",
+           "quantize_chunked", "dequantize_chunked", "cast_bf16",
+           "reduce_gradients", "all_reduce_activations"]
 
 #: the TrainStep ``grad_reduce=`` vocabulary ("f32" = the implicit
 #: sharding-inserted full-precision collective, the pre-ISSUE-8 path)
 GRAD_REDUCE_MODES = ("f32", "bf16", "int8")
+
+#: the GenerationServer ``tp_collectives=`` vocabulary — the wire
+#: format of the per-layer activation all-reduce on the tensor-parallel
+#: decode path (EQuARX, arXiv:2506.17615: decode is latency-bound on
+#: collective bytes, so the activation exchange quantizes)
+ACTIVATION_REDUCE_MODES = ("f32", "int8")
 
 #: default elements per quantization chunk (one f32 scale each: 1.6%
 #: overhead on the int8 payload)
@@ -148,6 +155,34 @@ def _reduce_leaf_int8(g, axis_name, n_dev, key, chunk, mean):
     gs = lax.all_gather(s2, axis_name, axis=0)
     out = dequantize_chunked(gq, gs, m)                         # (n_dev, m)
     return out.reshape(-1)[:n].reshape(shape).astype(dtype)
+
+
+def all_reduce_activations(x, axis_name, n_dev, mode="int8", key=None,
+                           chunk=DEFAULT_CHUNK):
+    """Cross-device SUM of one activation tensor with a compressed wire
+    format — the serving twin of ``reduce_gradients``, called INSIDE a
+    ``shard_map`` over ``axis_name`` with ``x`` the device's local
+    partial product (Megatron row-parallel matmul output).  Returns the
+    summed activations in ``x``'s dtype, bit-identical on every device
+    (the int8 path all-gathers ONE set of quantized payloads that every
+    device dequantizes the same way — the replication an out_spec may
+    honestly claim).
+
+    ``mode``: ``"f32"`` = plain ``psum`` (uncompressed reference),
+    ``"int8"`` = the two-phase chunked exchange (``all_to_all`` int8
+    slices → dequant+sum the owned slice → requantize → ``all_gather``)
+    at ~1/4 the f32 wire bytes.  ``key=None`` (the serving default)
+    rounds to nearest: decode wants the same traffic to produce the
+    same tokens on every replica, and the inference forward takes one
+    bounded quantization error per layer rather than accumulating drift
+    across steps — the unbiasedness stochastic rounding buys gradients
+    has no equivalent payoff here."""
+    if mode not in ACTIVATION_REDUCE_MODES:
+        raise ValueError(f"all_reduce_activations: mode {mode!r} not in "
+                         f"{ACTIVATION_REDUCE_MODES}")
+    if mode == "f32":
+        return lax.psum(x, axis_name)
+    return _reduce_leaf_int8(x, axis_name, n_dev, key, chunk, mean=False)
 
 
 def reduce_gradients(grads, axis_name, n_dev, mode="int8", key=None,

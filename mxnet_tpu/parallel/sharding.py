@@ -17,8 +17,9 @@ import re
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-__all__ = ["ShardingRules", "tp_dense_rules", "fsdp_rules", "param_sharding",
-           "batch_spec", "logical_to_sharding"]
+__all__ = ["ShardingRules", "tp_dense_rules", "fsdp_rules",
+           "causal_lm_tp_rules", "param_sharding", "batch_spec",
+           "logical_to_sharding"]
 
 
 def _axis_size(mesh, entry):
@@ -98,6 +99,27 @@ def tp_dense_rules():
         (r"embedding\w*_weight$", (None, "tp")),
         # conv kernels (O, I, kH, kW): shard output channels
         (r"conv\w*_weight$", ("tp", None, None, None)),
+    ])
+
+
+def causal_lm_tp_rules(axis="tp"):
+    """Megatron column/row rules for the functional causal LM's flat
+    param dict (``gluon.model_zoo.causal_lm``; stacked ``[n_layers,
+    ...]`` leaves, so the sharded dim sits one to the right of the
+    layer axis): the fused QKV projection and FFN-in are column-sharded
+    (output features — WHOLE heads for qkv, which is why
+    ``tp_permute_qkv`` pre-groups its columns per shard), the attention
+    output projection and FFN-out are row-sharded (input features —
+    partial products restored by one all-reduce each).  Row-parallel
+    biases (``bo``/``b2``), embeddings, and norms replicate via the
+    default."""
+    return ShardingRules(rules=[
+        (r"^wqkv$", (None, None, axis)),   # [L, d, 3d] column (by head)
+        (r"^bqkv$", (None, axis)),         # [L, 3d]    rides its columns
+        (r"^wo$",   (None, axis, None)),   # [L, d, d]  row
+        (r"^w1$",   (None, None, axis)),   # [L, d, ff] column
+        (r"^b1$",   (None, axis)),         # [L, ff]    rides its columns
+        (r"^w2$",   (None, axis, None)),   # [L, ff, d] row
     ])
 
 

@@ -38,6 +38,15 @@ Sampling is greedy or temperature/top-k per request, drawn from the
 per-step PRNG key inside the compiled program (deterministic under a
 fixed server seed and traffic order).
 
+``tp_shards=N`` shards the whole stack tensor-parallel over an N-way
+``tp`` mesh (``parallel.mesh``): head-parallel paged attention (each
+device owns a head shard of the page pools), Megatron column/row
+sharded projections/FFN, and per-layer activation all-reduces on the
+decode path in f32 or chunked-int8 wire format
+(``tp_collectives=``, ``parallel.quantize.all_reduce_activations``).
+The census, scheduler, and failure semantics are shard-count
+invariant — see the ``GenerationServer`` docstring.
+
 Failure paths are deterministic tests via the ``generate.prefill`` /
 ``generate.decode`` / ``generate.evict`` fault points
 (``tools/chaos_check.py --mode llm`` drives all of them plus SIGTERM).
@@ -155,7 +164,29 @@ def _sample_tokens(logits, key, temps, topks):
 
 
 # -------------------------------------------------------- program builders --
-def build_decode_step(config, page_size, attention_impl=None):
+def _tp_pieces(config, mesh, axis):
+    """Shared tensor-parallel plumbing of the program builders: shard
+    count, local head count, the param/pool PartitionSpecs, and the
+    ``shard_map`` wrapper (``parallel.mesh`` — call-time axis
+    validation) curried with the mesh."""
+    import functools
+
+    from jax.sharding import PartitionSpec
+
+    from ..gluon.model_zoo.causal_lm import tp_param_specs, tp_validate
+    from ..parallel.mesh import shard_map
+
+    shards = int(mesh.shape[axis])
+    tp_validate(config, shards)
+    pspecs = tp_param_specs(config, mesh, axis)
+    pool_spec = PartitionSpec(None, None, None, axis, None)
+    repl = PartitionSpec()
+    wrap = functools.partial(shard_map, mesh=mesh, check_vma=False)
+    return shards, config.n_heads // shards, pspecs, pool_spec, repl, wrap
+
+
+def build_decode_step(config, page_size, attention_impl=None, mesh=None,
+                      tp_axis="tp", tp_collectives="f32"):
     """The ONE decode executable: every in-flight mix of sequences runs
     this program over the fixed slot grid.
 
@@ -168,14 +199,40 @@ def build_decode_step(config, page_size, attention_impl=None):
     input token's K/V is written at position ``lengths[s]`` (page
     ``tables[s, lengths[s] // page_size]``), inactive slots sink to
     page 0, and attention covers ``lengths[s] + 1`` positions.  Pools
-    are donated by the caller, so the update is in-place on device."""
+    are donated by the caller, so the update is in-place on device.
+
+    With ``mesh`` (a ``tp_axis`` mesh) the SAME program lowers once
+    over the mesh as one ``shard_map``: each device owns a head shard
+    of the K/V pools (head-parallel paged attention — per-device pool
+    HBM ∝ 1/shards), QKV/FFN-in are column-sharded and the output/
+    FFN-out projections row-sharded (Megatron), and the two per-layer
+    partial-product all-reduces run through
+    ``parallel.quantize.all_reduce_activations`` in the
+    ``tp_collectives`` wire format (``"f32"`` | ``"int8"`` — EQuARX:
+    decode is latency-bound on collective bytes).  Slot state, tokens,
+    and the sampled output stay replicated, so the serving loop drives
+    both shapes identically."""
     import jax.numpy as jnp
 
     from ..gluon.model_zoo.causal_lm import decode_hidden, lm_logits
     from ..ops.paged_attention import paged_decode_attention
+    from ..parallel.quantize import (ACTIVATION_REDUCE_MODES,
+                                     all_reduce_activations)
 
+    if tp_collectives not in ACTIVATION_REDUCE_MODES:
+        raise ValueError(f"tp_collectives={tp_collectives!r} not in "
+                         f"{ACTIVATION_REDUCE_MODES}")
     n_layers = config.n_layers
     heads, head_dim = config.n_heads, config.head_dim
+    if mesh is None:
+        shards, heads_l, reduce_fn = 1, heads, None
+    else:
+        shards, heads_l, pspecs, pool_spec, repl, wrap = _tp_pieces(
+            config, mesh, tp_axis)
+
+        def reduce_fn(x):
+            return all_reduce_activations(x, tp_axis, shards,
+                                          mode=tp_collectives)
 
     def decode_step(params, k_pool, v_pool, tokens, lengths, active,
                     tables, key, temps, topks):
@@ -191,38 +248,59 @@ def build_decode_step(config, page_size, attention_impl=None):
         for layer in range(n_layers):
             def attend(q, k, v, _l=layer):
                 nonlocal k_pool, v_pool
-                k = k.reshape(slots, heads, head_dim)
-                v = v.reshape(slots, heads, head_dim)
-                q = q.reshape(slots, heads, head_dim)
+                k = k.reshape(slots, heads_l, head_dim)
+                v = v.reshape(slots, heads_l, head_dim)
+                q = q.reshape(slots, heads_l, head_dim)
                 k_pool = k_pool.at[_l, page, off].set(k)
                 v_pool = v_pool.at[_l, page, off].set(v)
                 return paged_decode_attention(q, k_pool[_l], v_pool[_l],
                                               tables, att_len,
                                               impl=attention_impl)
-            h = decode_hidden(params, layer, h, attend)
+            h = decode_hidden(params, layer, h, attend, reduce=reduce_fn)
         nxt = _sample_tokens(lm_logits(params, h), key, temps, topks)
         return nxt, k_pool, v_pool
 
-    return decode_step
+    if mesh is None:
+        return decode_step
+    return wrap(decode_step,
+                in_specs=(pspecs, pool_spec, pool_spec) + (repl,) * 7,
+                out_specs=(repl, pool_spec, pool_spec))
 
 
-def build_prefill_step(config, page_size, attention_impl=None):
+def build_prefill_step(config, page_size, attention_impl=None, mesh=None,
+                       tp_axis="tp"):
     """One prefill executable per ``(batch, length)`` bucket: the whole
     prompt forward (``causal_lm.prefill_forward``), K/V scattered into
     the paged pools by page table, and the FIRST new token sampled —
     so a prefilled sequence enters the decode grid already one token
-    ahead.  Padded rows/positions sink their writes to page 0."""
+    ahead.  Padded rows/positions sink their writes to page 0.
+
+    With ``mesh`` the forward is Megatron-sharded like the decode step
+    and each device scatters its OWN head shard of the prompt K/V into
+    its pool shard.  Prefill collectives stay f32: the prompt forward
+    is compute-bound, not latency-bound on collective bytes (the
+    ``tp_collectives`` knob is a decode-path trade)."""
+    import jax
     import jax.numpy as jnp
 
     from ..gluon.model_zoo.causal_lm import prefill_forward
 
     del attention_impl      # prefill is dense-causal (ops.multi_head_attention)
 
+    if mesh is None:
+        reduce_fn = None
+    else:
+        shards, _hl, pspecs, pool_spec, repl, wrap = _tp_pieces(
+            config, mesh, tp_axis)
+
+        def reduce_fn(x):
+            return jax.lax.psum(x, tp_axis)
+
     def prefill_step(params, k_pool, v_pool, tokens, lengths, active,
                      tables, key, temps, topks):
         b, L = tokens.shape
         logits, k_all, v_all = prefill_forward(params, config, tokens,
-                                               lengths)
+                                               lengths, reduce=reduce_fn)
         pos = jnp.arange(L)
         valid = (pos[None, :] < lengths[:, None]) & active[:, None]
         page = jnp.where(valid, tables[:, pos // page_size], 0)  # [b, L]
@@ -233,10 +311,15 @@ def build_prefill_step(config, page_size, attention_impl=None):
         first = _sample_tokens(logits, key, temps, topks)
         return first, k_pool, v_pool
 
-    return prefill_step
+    if mesh is None:
+        return prefill_step
+    return wrap(prefill_step,
+                in_specs=(pspecs, pool_spec, pool_spec) + (repl,) * 7,
+                out_specs=(repl, pool_spec, pool_spec))
 
 
-def build_prefill_kv_step(config, attention_impl=None):
+def build_prefill_kv_step(config, attention_impl=None, mesh=None,
+                          tp_axis="tp"):
     """The DISAGGREGATED prefill executable (one per ``(batch, length)``
     bucket): whole-prompt forward returning the first sampled token plus
     the prompt's K/V stacked ``[n_layers, b, L, heads, head_dim]`` —
@@ -246,16 +329,31 @@ def build_prefill_kv_step(config, attention_impl=None):
     every in-flight decode for its step, and a failed prefill can no
     longer consume the donated pools out from under the decode group's
     bystanders.  The output is the handoff payload ``build_handoff_step``
-    scatters into the decode group's pool."""
+    scatters into the decode group's pool.
+
+    With ``mesh`` the forward is Megatron-sharded (f32 collectives, see
+    ``build_prefill_step``) and the payload comes back with its head
+    axis sharded over ``tp_axis`` — the wire shape the sharded handoff
+    scatter consumes."""
+    import jax
     import jax.numpy as jnp
 
     from ..gluon.model_zoo.causal_lm import prefill_forward
 
     del attention_impl      # prefill is dense-causal (ops.multi_head_attention)
 
+    if mesh is None:
+        reduce_fn = None
+    else:
+        shards, _hl, pspecs, pool_spec, repl, wrap = _tp_pieces(
+            config, mesh, tp_axis)
+
+        def reduce_fn(x):
+            return jax.lax.psum(x, tp_axis)
+
     def prefill_kv_step(params, tokens, lengths, key, temps, topks):
         logits, k_all, v_all = prefill_forward(params, config, tokens,
-                                               lengths)
+                                               lengths, reduce=reduce_fn)
         first = _sample_tokens(logits, key, temps, topks)
         # zero the padding positions so the handoff buffer stays inert
         # wherever lengths don't reach (the scatter sinks them to page 0
@@ -266,10 +364,14 @@ def build_prefill_kv_step(config, attention_impl=None):
         return first, jnp.where(valid, k_all, 0.0), \
             jnp.where(valid, v_all, 0.0)
 
-    return prefill_kv_step
+    if mesh is None:
+        return prefill_kv_step
+    return wrap(prefill_kv_step,
+                in_specs=(pspecs,) + (repl,) * 5,
+                out_specs=(repl, pool_spec, pool_spec))
 
 
-def build_handoff_step(config, page_size):
+def build_handoff_step(config, page_size, mesh=None, tp_axis="tp"):
     """The ONE handoff executable of a disaggregated server: scatter a
     batch of prefilled sequences' K/V (``[n_layers, B, L, H, D]``, a
     FIXED ``(B, L)`` staging shape — the model of the prefill→decode
@@ -277,8 +379,16 @@ def build_handoff_step(config, page_size):
     Inactive lanes and positions past ``lengths`` sink to page 0.
     Pools are donated; shapes are configuration constants, so however
     sequences are re-packed across handoffs this is always the same
-    program — the census grows by exactly one."""
+    program — the census grows by exactly one.
+
+    With ``mesh`` the payload AND the pools are head-sharded over
+    ``tp_axis``: each device scatters its own head shard, no
+    collectives at all (the scatter indices are head-independent)."""
     import jax.numpy as jnp
+
+    if mesh is not None:
+        _sh, _hl, _ps, pool_spec, repl, wrap = _tp_pieces(
+            config, mesh, tp_axis)
 
     def handoff_step(k_pool, v_pool, k_all, v_all, lengths, active,
                      tables):
@@ -292,7 +402,12 @@ def build_handoff_step(config, page_size):
             v_pool = v_pool.at[layer, page, off].set(v_all[layer])
         return k_pool, v_pool
 
-    return handoff_step
+    if mesh is None:
+        return handoff_step
+    return wrap(handoff_step,
+                in_specs=(pool_spec, pool_spec, pool_spec, pool_spec,
+                          repl, repl, repl),
+                out_specs=(pool_spec, pool_spec))
 
 
 def build_dense_decode_step(config, max_ctx, attention_impl=None):
@@ -387,6 +502,24 @@ class GenerationServer:
     group's bystanders (the pool-free program never touches them).  The
     executable census becomes ``prefill grid + 2`` (handoff + decode).
 
+    **Tensor-parallel sharded decode (ISSUE 14).**  ``tp_shards=N``
+    lowers every program — the prefill grid, THE decode step, and (when
+    disaggregated) the handoff scatter — once over an N-way ``tp`` mesh
+    as ``shard_map`` programs: each device owns a head shard of the K/V
+    page pools (per-device pool HBM ∝ 1/shards, so servable model size
+    AND aggregate slot count multiply with the mesh), the causal LM's
+    QKV/FFN weights are Megatron column/row-sharded, and the two
+    per-layer partial-product all-reduces on the decode path run in the
+    ``tp_collectives`` wire format (``"f32"`` or ``"int8"`` via
+    ``parallel.quantize.all_reduce_activations`` — EQuARX's trade:
+    decode is latency-bound on collective bytes).  Everything host-side
+    is UNCHANGED: the ``PageAllocator`` stays layout-free (a page id
+    addresses every device's shard of that page), slot arrays stay
+    replicated, and the census contract survives — still prefill grid +
+    decode (+ handoff), each lowered once over the mesh, so warmup,
+    donation, preemption, QoS seating, and telemetry span trees are
+    identical to the single-chip server.
+
     **Per-tenant QoS.**  ``qos=TenantQoS(...)`` adds priority classes
     and per-tenant token buckets at admission: the scheduler seats
     higher-priority classes first (FIFO within a class; eviction stays
@@ -409,11 +542,34 @@ class GenerationServer:
                  max_queue=128, rate=None, burst=None, breaker=None,
                  default_deadline=None, max_new_tokens=32, eos_id=None,
                  seed=0, attention_impl=None, prefill_workers=0,
-                 qos=None, name="GenerationServer"):
+                 qos=None, tp_shards=1, tp_collectives="f32",
+                 name="GenerationServer"):
         import jax
         import jax.numpy as jnp
 
+        from ..parallel.quantize import ACTIVATION_REDUCE_MODES
+
         self.config = config
+        self.tp_shards = int(tp_shards)
+        if tp_collectives not in ACTIVATION_REDUCE_MODES:
+            raise ValueError(
+                f"{name}: tp_collectives={tp_collectives!r} not in "
+                f"{ACTIVATION_REDUCE_MODES}")
+        self.tp_collectives = tp_collectives
+        if self.tp_shards > 1:
+            from ..gluon.model_zoo.causal_lm import tp_validate
+            from ..parallel.mesh import make_mesh
+
+            tp_validate(config, self.tp_shards)
+            devices = jax.devices()
+            if self.tp_shards > len(devices):
+                raise ValueError(
+                    f"{name}: tp_shards={self.tp_shards} exceeds the "
+                    f"{len(devices)} visible devices")
+            self._mesh = make_mesh(tp=self.tp_shards,
+                                   devices=devices[:self.tp_shards])
+        else:
+            self._mesh = None
         if buckets is None:
             buckets = BucketSpec(batch=(1, 2), length=(16, 32))
         # a bare batch tuple wraps like InferenceServer's — and then
@@ -447,22 +603,34 @@ class GenerationServer:
         self._name = name
         self._max_queue = int(max_queue)
 
-        self._params = jax.tree.map(jnp.asarray, params)
+        if self._mesh is not None:
+            from ..gluon.model_zoo.causal_lm import tp_shard_params
+
+            # one-time host relayout + committed sharded placement: the
+            # compiled programs never re-transfer weights per call
+            self._params = tp_shard_params(params, config, self._mesh)
+        else:
+            self._params = jax.tree.map(jnp.asarray, params)
         self._decode = jax.jit(
             build_decode_step(config, self.alloc.page_size,
-                              attention_impl), donate_argnums=(1, 2))
+                              attention_impl, mesh=self._mesh,
+                              tp_collectives=self.tp_collectives),
+            donate_argnums=(1, 2))
         self._n_prefill_workers = int(prefill_workers)
         if self._n_prefill_workers > 0:
             # disaggregated: pool-free prefill grid + ONE handoff scatter
             self._prefill = jax.jit(
-                build_prefill_kv_step(config, attention_impl))
+                build_prefill_kv_step(config, attention_impl,
+                                      mesh=self._mesh))
             self._handoff = jax.jit(
-                build_handoff_step(config, self.alloc.page_size),
+                build_handoff_step(config, self.alloc.page_size,
+                                   mesh=self._mesh),
                 donate_argnums=(0, 1))
         else:
             self._prefill = jax.jit(
                 build_prefill_step(config, self.alloc.page_size,
-                                   attention_impl), donate_argnums=(1, 2))
+                                   attention_impl, mesh=self._mesh),
+                donate_argnums=(1, 2))
             self._handoff = None
         self._key_base = jax.random.PRNGKey(int(seed))
         self._steps = 0          # device-call counter → per-step PRNG key
@@ -522,18 +690,16 @@ class GenerationServer:
         to page 0, the allocator is untouched) before readiness flips.
         After warmup the jit caches hold exactly ``census()`` entries
         and live traffic can never add one."""
-        import jax.numpy as jnp
-
         if self._draining.is_set():
             raise ServerClosedError(f"{self._name}: already drained")
-        c, npg, psz = self.config, self.alloc.n_pages, self.alloc.page_size
-        shape = (c.n_layers, npg, psz, c.n_heads, c.head_dim)
         # the decode thread owns the pools once it starts (two lines
         # down); the lock here is for the thread-contract checker —
-        # nothing races a thread that does not exist yet
+        # nothing races a thread that does not exist yet.  The pool
+        # device_put (sharded placement under tp) runs BEFORE taking it:
+        # only the attribute assignment needs the lock.
+        pools = self._new_pools()
         with self._admit_lock:
-            self._k_pool = jnp.zeros(shape, jnp.float32)
-            self._v_pool = jnp.zeros(shape, jnp.float32)
+            self._k_pool, self._v_pool = pools
         if warmup:
             for b in self.buckets.batch:
                 for L in self.buckets.length:
@@ -767,6 +933,31 @@ class GenerationServer:
             self._k_pool, self._v_pool, k_all, v_all, lengths, active,
             tables)
 
+    def _new_pools(self):
+        """Fresh zeroed K/V pools — head axis sharded over the tp mesh
+        when one exists (each device hosts ``n_heads / tp_shards`` of
+        every page: per-device pool HBM ∝ 1/shards), plain single-device
+        arrays otherwise."""
+        import jax
+        import jax.numpy as jnp
+
+        c, npg, psz = self.config, self.alloc.n_pages, self.alloc.page_size
+        shape = (c.n_layers, npg, psz, c.n_heads, c.head_dim)
+        if self._mesh is None:
+            return jnp.zeros(shape, jnp.float32), \
+                jnp.zeros(shape, jnp.float32)
+        from jax.sharding import NamedSharding, PartitionSpec
+        # NB trailing-None-free spec: jax normalizes the sharding it
+        # stamps on jit OUTPUTS to PartitionSpec(None, None, None,
+        # "tp"), and the lowering cache keys on spec equality — a
+        # 5-entry spec here would make the warmup entry (fresh pools)
+        # and the live entries (pools round-tripped through the donated
+        # programs) TWO executables, breaking census == jit-cache
+        sh = NamedSharding(self._mesh,
+                           PartitionSpec(None, None, None, "tp"))
+        return (jax.device_put(jnp.zeros(shape, jnp.float32), sh),
+                jax.device_put(jnp.zeros(shape, jnp.float32), sh))
+
     def _recover_pools(self):
         """A device call that failed MID-EXECUTION already consumed the
         donated pools — every in-flight sequence's cache is gone with
@@ -774,16 +965,16 @@ class GenerationServer:
         error path that got here resolves its own group; this sweeps the
         bystanders whose state was collateral).  A host-side failure
         (e.g. an armed fault point) never reaches this: the pools are
-        intact and bystanders keep decoding."""
-        import jax.numpy as jnp
-
+        intact and bystanders keep decoding.  Under tensor parallelism
+        this is also the mid-decode SHARD-LOSS path: a device falling
+        out of the gang fails the collective, the step raises, and the
+        re-zeroed pools come back sharded over the same mesh — the
+        breaker keeps the server fast-failing until the gang answers
+        again (docs/api.md failure matrix)."""
         if self._k_pool is not None and not self._k_pool.is_deleted() \
                 and not self._v_pool.is_deleted():
             return
-        c, npg, psz = self.config, self.alloc.n_pages, self.alloc.page_size
-        shape = (c.n_layers, npg, psz, c.n_heads, c.head_dim)
-        self._k_pool = jnp.zeros(shape, jnp.float32)
-        self._v_pool = jnp.zeros(shape, jnp.float32)
+        self._k_pool, self._v_pool = self._new_pools()
         for seq in list(self._seqs.values()):
             self._retire(seq, ServerClosedError(
                 "KV pool lost to a failed device step — sequence cannot "
@@ -1495,6 +1686,8 @@ class GenerationServer:
                 "total_pages": self.alloc.allocatable,
                 "prefill_workers": self._n_prefill_workers,
                 "prefill_inflight": prefill_flight,
+                "tp_shards": self.tp_shards,
+                "tp_collectives": self.tp_collectives,
                 "classes": self._qos.snapshot(),
                 "last_error": None if last is None else
                 {"type": last[0], "age": time.monotonic() - last[1]}}
@@ -1526,6 +1719,7 @@ class GenerationServer:
                   "total_pages": h["total_pages"],
                   "prefill_workers": h["prefill_workers"],
                   "prefill_inflight": h["prefill_inflight"],
+                  "tp_shards": h["tp_shards"],
                   "ready": int(h["ready"]), "alive": int(h["alive"]),
                   "draining": int(h["draining"])}
         hist = _telemetry.registry().snapshot(

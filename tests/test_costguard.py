@@ -285,6 +285,65 @@ def test_tp_sharded_census_matches_runtime_jit_cache():
         load_golden("mlp_apply_tp8", REPO)["report"]["n_executables"]
 
 
+# ------------------- ISSUE 14: tensor-parallel sharded decode budgets --
+def test_tp_sharded_decode_per_device_pool_byte_budget():
+    """The sharded-decode golden pair, diffed (PR 8/11 cross-golden
+    pattern): ``llm_decode_step_tp8`` lowers the IDENTICAL model, pool
+    geometry, and slot grid as ``llm_decode_step`` over an 8-way tp
+    mesh — head-sharded pools + Megatron column/row weights — so its
+    per-device ``argument_bytes`` must sit exactly 7/8 of the pool +
+    sharded-weight bytes below the single-chip entry (±2%): per-device
+    KV-pool HBM ∝ 1/shards, the ISSUE 14 acceptance."""
+    tp8 = load_golden("llm_decode_step_tp8", REPO)
+    base = load_golden("llm_decode_step", REPO)
+    assert tp8["meta"]["n_pages"] == base["meta"]["n_pages"]
+    assert tp8["meta"]["page_size"] == base["meta"]["page_size"]
+    assert tp8["report"]["per_device"]["n_devices"] == 8
+    assert base["report"]["per_device"]["n_devices"] == 1
+    # the sharded argument bytes, from the entry's committed geometry:
+    # two f32 pools [L, pages, psz, H, D] + the column/row-sharded
+    # causal-LM weights (wqkv+bqkv+wo+w1+b1+w2 at L=2, d=32, ff=64)
+    L, d, ff = 2, 32, 64
+    pool_bytes = 2 * (L * tp8["meta"]["n_pages"] * tp8["meta"]["page_size"]
+                      * 8 * 4) * 4
+    sharded_w = 4 * L * (d * 3 * d + 3 * d + d * d + d * ff + ff + ff * d)
+    saved = base["report"]["per_device"]["argument_bytes"] \
+        - tp8["report"]["per_device"]["argument_bytes"]
+    expect = (pool_bytes + sharded_w) * 7 // 8
+    assert abs(saved - expect) <= 0.02 * expect, (
+        f"tp=8 per-device argument bytes save {saved} vs the expected "
+        f"7/8 of the pool + sharded weights ({expect}) — the head "
+        f"shard of the KV pool is no longer ∝ 1/shards")
+    # the Megatron all-reduces are visible on the sharded side only,
+    # and BOTH sides keep the one-pinned-executable contract
+    assert tp8["report"]["per_device"]["collective_bytes"] > 0
+    assert base["report"]["per_device"]["collective_bytes"] == 0
+    assert tp8["report"]["n_executables"] == \
+        base["report"]["n_executables"] == 1
+
+
+def test_tp_decode_int8_collective_byte_budget():
+    """The decode-collective quantization floor, as a diff of two
+    COMMITTED goldens: with ``tp_collectives="int8"`` the per-layer
+    activation all-reduces (chunked int8 all_to_all/all_gather,
+    parallel.quantize) must move >= 25% fewer per-device collective
+    bytes than the f32 sibling (committed: ~44% — chunk-scale overhead
+    is what keeps it under the asymptotic 4x) over the identical
+    model, mesh, and census."""
+    f32 = load_golden("llm_decode_step_tp8", REPO)["report"]
+    q8 = load_golden("llm_decode_step_tp8_q8", REPO)["report"]
+    assert f32["per_device"]["collective_bytes"] > 0
+    assert q8["per_device"]["collective_bytes"] <= \
+        0.75 * f32["per_device"]["collective_bytes"], (
+            f"int8 decode collectives moved "
+            f"{q8['per_device']['collective_bytes']} bytes vs f32's "
+            f"{f32['per_device']['collective_bytes']} — the committed "
+            f">=25% reduction no longer holds")
+    assert q8["per_device"]["n_devices"] == \
+        f32["per_device"]["n_devices"] == 8
+    assert q8["n_executables"] == f32["n_executables"] == 1
+
+
 def test_regen_device_count_guard():
     """The census guard's device-count leg: a SHARDED golden refuses
     regeneration when the visible device count differs from the one it
@@ -536,6 +595,36 @@ def test_bench_cost_fields(monkeypatch):
     assert fields["grad_reduce"] == "f32"
     monkeypatch.setenv("MXTPU_BENCH_COSTS", "0")
     assert bench._cost_fields(step) == {}
+
+
+def test_bench_tp_knob(monkeypatch):
+    """MXTPU_BENCH_TP selects the LLM bench's tensor-parallel shape
+    (shards + decode-collective wire format) and rejects junk loudly."""
+    import bench
+    monkeypatch.delenv("MXTPU_BENCH_TP", raising=False)
+    assert bench._tp_mode() == (1, "f32")
+    monkeypatch.setenv("MXTPU_BENCH_TP", "off")
+    assert bench._tp_mode() == (1, "f32")
+    monkeypatch.setenv("MXTPU_BENCH_TP", "2")
+    assert bench._tp_mode() == (2, "f32")
+    monkeypatch.setenv("MXTPU_BENCH_TP", "8:int8")
+    assert bench._tp_mode() == (8, "int8")
+    monkeypatch.setenv("MXTPU_BENCH_TP", "1:f32")
+    assert bench._tp_mode() == (1, "f32")
+    monkeypatch.setenv("MXTPU_BENCH_TP", "8:bf16")
+    with pytest.raises(SystemExit):
+        bench._tp_mode()
+    monkeypatch.setenv("MXTPU_BENCH_TP", "tp8")
+    with pytest.raises(SystemExit):
+        bench._tp_mode()
+    # a mode line must record what was MEASURED: tp_shards=1 never
+    # runs collectives, tp_shards=0 never runs at all
+    monkeypatch.setenv("MXTPU_BENCH_TP", "1:int8")
+    with pytest.raises(SystemExit):
+        bench._tp_mode()
+    monkeypatch.setenv("MXTPU_BENCH_TP", "0:f32")
+    with pytest.raises(SystemExit):
+        bench._tp_mode()
 
 
 def test_bench_quant_knob(monkeypatch):

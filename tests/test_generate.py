@@ -551,6 +551,160 @@ def test_handoff_fault_fails_group_explicitly_spares_bystanders():
         assert srv.alloc.free_count() == srv.alloc.allocatable
 
 
+# ======================= ISSUE 14: tensor-parallel sharded decode --
+# CFG has 2 heads (tp=2-divisible); the 8-way acceptance needs a head
+# per shard — same d_model, 8 x 4 heads
+TP8_CFG = CausalLMConfig(vocab_size=48, n_layers=2, n_heads=8,
+                         head_dim=2, d_ff=32)
+TP8_PARAMS = init_causal_lm(TP8_CFG, seed=5)
+TP8_LOUD = {k: v * 8.0 if k in ("embed", "wqkv", "wo", "w1", "w2") else v
+            for k, v in TP8_PARAMS.items()}
+
+
+def test_tp_sharded_decode_token_exact_parity():
+    """ISSUE 14: sharding is a lowering property, not a math change —
+    the tp=2 server (head-sharded pools, Megatron weights, f32
+    collectives) produces token-identical greedy continuations to the
+    single-chip path on the same prompts/seeds, over prompts long
+    enough to cross page boundaries."""
+    prompts = [np.asarray(p, np.int32)
+               for p in ([5, 9, 2, 7, 1], [3, 1], [11, 4, 6], [8])]
+    single = make_server(n_pages=33, max_new_tokens=8,
+                         name=f"GenTP-s-{time.monotonic_ns()}").start()
+    try:
+        want = [single.submit(p, max_new_tokens=8).result(60)
+                for p in prompts]
+    finally:
+        assert single.drain(30)
+    tp = make_server(n_pages=33, max_new_tokens=8, tp_shards=2,
+                     name=f"GenTP-2-{time.monotonic_ns()}").start()
+    try:
+        h = tp.healthz()
+        assert h["tp_shards"] == 2 and h["tp_collectives"] == "f32"
+        got = [tp.submit(p, max_new_tokens=8).result(60)
+               for p in prompts]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+        assert tp.jit_cache_count() == tp.census()
+    finally:
+        assert tp.drain(30)
+    assert tp.alloc.free_count() == tp.alloc.allocatable
+
+
+def test_tp_int8_collectives_bounded_divergence():
+    """``tp_collectives="int8"`` trades exactness for wire bytes on
+    the decode path ONLY: the first token (prefill — f32 collectives)
+    is exact vs the f32-collective server, later tokens may diverge
+    but generation stays well-formed (full length, in-vocab, census
+    intact, pages reclaimed) and is deterministic under a fixed seed."""
+    prompts = [np.asarray(p, np.int32) for p in ([5, 9, 2], [7, 1, 3])]
+
+    def run(coll):
+        srv = make_server(n_pages=33, max_new_tokens=6, tp_shards=2,
+                          tp_collectives=coll, seed=0,
+                          name=f"GenTPq-{coll}-{time.monotonic_ns()}"
+                          ).start()
+        try:
+            return [srv.submit(p, max_new_tokens=6).result(60)
+                    for p in prompts]
+        finally:
+            assert srv.drain(30)
+            assert srv.alloc.free_count() == srv.alloc.allocatable
+
+    f32 = run("f32")
+    q8a, q8b = run("int8"), run("int8")
+    for w, g, g2 in zip(f32, q8a, q8b):
+        assert g[0] == w[0]              # prefill-sampled token: exact
+        assert len(g) == len(w) == 6
+        assert all(0 <= t < CFG.vocab_size for t in g)
+        np.testing.assert_array_equal(g, g2)   # deterministic
+
+
+def test_tp8_census_matches_runtime_jit_cache_on_real_mesh():
+    """The ISSUE 14 acceptance: a tp=8 GenerationServer on the real
+    8-device mesh — mixed-length, mixed-sampling traffic replay —
+    compiles exactly the static census (prefill grid + decode) at
+    warmup and not one more under sharded traffic."""
+    spec = BucketSpec(batch=(1, 2), length=(8,))
+    srv = GenerationServer(TP8_LOUD, TP8_CFG, buckets=spec, n_slots=4,
+                           n_pages=33, page_size=4, max_new_tokens=3,
+                           seed=0, tp_shards=8,
+                           name=f"GenTP8-{time.monotonic_ns()}")
+    srv.start()
+    census = srv.census()
+    assert census == 2 * 1 + 1
+    assert srv.jit_cache_count() == census
+    try:
+        rng = np.random.RandomState(0)
+        reqs = [srv.submit(
+            rng.randint(0, TP8_CFG.vocab_size,
+                        size=int(rng.randint(1, 8))).astype(np.int32),
+            max_new_tokens=int(rng.randint(1, 4)),
+            temperature=float(i % 2), top_k=int(3 * (i % 2)))
+            for i in range(6)]
+        for r in reqs:
+            r.result(timeout=120)
+        assert srv.jit_cache_count() == census, \
+            "sharded traffic triggered a recompile — the pinned " \
+            "multi-device executable contract is broken"
+        assert srv.stats["decode_steps"] > 0
+    finally:
+        assert srv.drain(60)
+    assert srv.jit_cache_count() == census
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+@slo
+def test_tp_disaggregated_handoff_sharded():
+    """Disaggregation composes with sharding: a tp=2 server with a
+    prefill worker group (pool-free sharded prefill → head-sharded
+    handoff scatter) is token-identical to the single-chip fused path,
+    census = grid + 2, no recompiles, pages reclaimed."""
+    prompts = [np.asarray(p, np.int32)
+               for p in ([3, 1, 4], [1, 5], [9, 2, 6, 5])]
+    fused = make_server(n_pages=33,
+                        name=f"GenTPd-s-{time.monotonic_ns()}").start()
+    try:
+        want = [fused.submit(p, max_new_tokens=4).result(60)
+                for p in prompts]
+    finally:
+        assert fused.drain(30)
+    dis = make_server(n_pages=33, tp_shards=2, prefill_workers=1,
+                      name=f"GenTPd-2-{time.monotonic_ns()}").start()
+    try:
+        assert dis.census() == 1 * 1 + 2       # grid + handoff + decode
+        assert dis.jit_cache_count() == dis.census()
+        got = [dis.submit(p, max_new_tokens=4).result(60)
+               for p in prompts]
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(w, g)
+        assert dis.stats["handoffs"] >= 1
+        assert dis.jit_cache_count() == dis.census()
+    finally:
+        assert dis.drain(30)
+    assert dis.alloc.free_count() == dis.alloc.allocatable
+
+
+def test_tp_validation_errors():
+    """Unservable shard requests fail LOUDLY at construction: a head
+    count the mesh can't divide, an unknown collective format, more
+    shards than devices."""
+    with pytest.raises(ValueError, match="n_heads"):
+        make_server(tp_shards=3)               # 2 heads % 3
+    with pytest.raises(ValueError, match="tp_collectives"):
+        make_server(tp_shards=2, tp_collectives="bf16")
+    cfg16 = CausalLMConfig(vocab_size=48, n_layers=1, n_heads=16,
+                           head_dim=2, d_ff=32)
+    with pytest.raises(ValueError, match="devices"):
+        GenerationServer(init_causal_lm(cfg16, 0), cfg16, tp_shards=16,
+                         buckets=BucketSpec(batch=(1,), length=(8,)))
+    cfg = CausalLMConfig(vocab_size=48, n_layers=1, n_heads=4,
+                         head_dim=4, d_ff=30)   # ff % 4 != 0
+    with pytest.raises(ValueError, match="d_ff"):
+        GenerationServer(init_causal_lm(cfg, 0), cfg, tp_shards=4,
+                         buckets=BucketSpec(batch=(1,), length=(8,)))
+
+
 @slo
 def test_priority_class_jumps_the_queue():
     """Scheduler seating is priority-ordered: with one decode slot and a

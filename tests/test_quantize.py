@@ -162,6 +162,44 @@ def test_reduce_gradients_matches_true_mean_under_shard_map():
     assert np.abs(run("bf16") - true).max() <= np.abs(x).max() / 128
 
 
+def test_all_reduce_activations_modes_and_bound():
+    """The serving activation all-reduce (ISSUE 14, the tp_collectives
+    wire): f32 == psum exactly; int8 stays within the chunk
+    quantization bound of the true sum (two quantization stages, each
+    |err| <= scale/2 = amax/254 per stage per addend, summed over
+    devices); both are bit-identical across devices (taken on faith by
+    out_specs=P() — asserted here by comparing per-device outputs)."""
+    mesh = parallel.make_mesh(tp=8)
+    rng = np.random.RandomState(7)
+    x = rng.randn(8, 6, 37).astype(np.float32)     # [dev, slots, d]
+
+    def run(mode):
+        def inner(xl):
+            r = qz.all_reduce_activations(xl[0], "tp", 8, mode=mode)
+            return r[None]               # [1, ...]: re-stack per device
+
+        f = jax.jit(shard_map(inner, mesh=mesh, in_specs=P("tp"),
+                              out_specs=P("tp"), check_vma=False))
+        return np.asarray(f(x))          # per-device outputs, stacked
+
+    true = x.sum(axis=0)
+    got_f32 = run("f32")
+    for d in range(8):                   # replicated: every device equal
+        np.testing.assert_allclose(got_f32[d], true, rtol=1e-5,
+                                   atol=1e-5)
+    got_q8 = run("int8")
+    for d in range(1, 8):
+        np.testing.assert_array_equal(got_q8[0], got_q8[d])
+    # bounded divergence: phase-1 per-addend error (8 devices) plus the
+    # phase-2 re-quantization of the sum
+    tol = (8 + 1) * 2.0 * np.abs(x).max() / 127
+    assert np.abs(got_q8[0] - true).max() <= tol
+    rel = np.abs(got_q8[0] - true).max() / np.abs(true).max()
+    assert rel < 0.05                    # ~1% in practice
+    with pytest.raises(ValueError):
+        qz.all_reduce_activations(jnp.zeros((4,)), "tp", 8, mode="bf16")
+
+
 # ---------------------------------------------------- TrainStep grad_reduce --
 def _mlp_step(mode, seed=3, skip_nonfinite=False):
     mx.random.seed(seed)

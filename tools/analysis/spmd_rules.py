@@ -302,6 +302,8 @@ def _find_regions(tree: ast.Module) -> List[Region]:
     scopes = [tree] + [n for n in ast.walk(tree)
                        if isinstance(n, ast.FunctionDef)]
     for scope in scopes:
+        scope_binds = scope_assignments(
+            scope if isinstance(scope, ast.FunctionDef) else None, tree)
         for node in iter_scope_nodes(scope):
             if not isinstance(node, ast.Call):
                 continue
@@ -323,6 +325,36 @@ def _find_regions(tree: ast.Module) -> List[Region]:
                     fn = resolve_fn(first.id)
                 if fn is not None or node.keywords:
                     make_region(node, fn, scope)
+            elif isinstance(node.func, ast.Name) and _wrapper_call(
+                    scope_binds.get(node.func.id), aliases) is not None:
+                # stored-curried form (the serving builder idiom):
+                #   wrap = functools.partial(shard_map, mesh=..., ...)
+                #   ...
+                #   wrap(body, in_specs=..., out_specs=...)
+                # The application names the body and carries the specs;
+                # the stored partial carries the mesh.  Same-scope
+                # single-assignment only (scope_assignments) — a wrap
+                # that crosses a function boundary stays an OPEN-mesh
+                # anchor region, judged by runtime validate_specs.
+                curried = _wrapper_call(scope_binds[node.func.id], aliases)
+                if last_component(curried.func) == "partial":
+                    fn = None
+                    first = node.args[0] if node.args else None
+                    if isinstance(first, ast.Name) \
+                            and first.id not in aliases:
+                        fn = resolve_fn(first.id)
+                    if fn is not None or node.keywords:
+                        p_mesh, p_in, p_out = _sm_kwargs(curried)
+                        a_mesh, a_in, a_out = _sm_kwargs(node)
+                        mesh_expr = a_mesh if a_mesh is not None else p_mesh
+                        in_specs = a_in if a_in is not None else p_in
+                        out_specs = a_out if a_out is not None else p_out
+                        axes, closed, mesh_axes = _region_axes(
+                            mesh_expr, in_specs, out_specs, scope_binds)
+                        regions.append(Region(
+                            fn=fn, anchor=node, axes=axes, closed=closed,
+                            mesh_axes=mesh_axes, in_specs=in_specs,
+                            out_specs=out_specs, assigns=scope_binds))
             elif isinstance(node.func, ast.Name) or \
                     isinstance(node.func, ast.Attribute):
                 binder = _axis_binder_call(node)

@@ -32,14 +32,17 @@ apply) and streams a fresh **f32** training snapshot through a rolling
 update under traffic — re-quantized on ingest by the fleet's
 quantizer, 0 drops, census unchanged.
 
-``--mode llm`` runs the ISSUE 10 acceptance end to end: a
-``mx.serving.GenerationServer`` (paged KV cache, one pinned decode
-executable) streams generations from client threads while a
-``generate.decode`` failure burst fires, then lands a SIGTERM
-mid-decode.  The contract: **zero dropped accepted sequences** (every
-accepted ``Request`` resolves to tokens or an explicit error),
-**zero recompiles** (runtime jit-cache count == the prefill-grid + 1
-census before and after the chaos), and **pages fully reclaimed**
+``--mode llm`` runs the ISSUE 10 acceptance end to end — against a
+**tensor-parallel sharded gang** since ISSUE 14: a
+``mx.serving.GenerationServer(tp_shards=2, tp_collectives="int8")``
+(head-sharded paged KV pools, Megatron-sharded weights, quantized
+decode collectives, one pinned multi-device decode executable) streams
+generations from client threads while a ``generate.decode`` failure
+burst fires, then lands a SIGTERM mid-decode.  The contract: **zero
+dropped accepted sequences** (every accepted ``Request`` resolves to
+tokens or an explicit error), **zero recompiles** (runtime jit-cache
+count == the prefill-grid + 1 census before and after the chaos —
+sharding must not add an executable), and **pages fully reclaimed**
 after the drain (free list == allocatable pool size).
 
 ``--mode lint`` runs the full mxlint analyzer twice against a fresh
@@ -213,8 +216,10 @@ def serve_mode(args):
 
 
 def llm_mode(args):
-    """Continuous-batching LLM serving chaos (ISSUE 10): stream
-    generations under a decode-fault burst + SIGTERM mid-decode."""
+    """Continuous-batching LLM serving chaos (ISSUE 10, sharded gang
+    since ISSUE 14): stream generations through a tensor-parallel
+    tp=2 server with int8 decode collectives under a decode-fault
+    burst + SIGTERM mid-decode."""
     import signal
     import threading
 
@@ -228,15 +233,18 @@ def llm_mode(args):
         init_causal_lm(cfg, seed=0), cfg,
         buckets=serving.BucketSpec(batch=(1, 2), length=(8, 16)),
         n_slots=4, n_pages=33, page_size=8, max_new_tokens=6,
-        max_queue=256, seed=0,
+        max_queue=256, seed=0, tp_shards=2, tp_collectives="int8",
         breaker=serving.CircuitBreaker(threshold=3, base_delay=0.02,
                                        max_delay=0.1),
         name="ChaosGen")
     srv.start()
     census = srv.census()
     warm = srv.jit_cache_count()
+    h = srv.healthz()
     print(f"[chaos_check] llm: warmed {warm} executables "
-          f"(census {census}: prefill grid + 1 decode), "
+          f"(census {census}: prefill grid + 1 decode) over "
+          f"tp_shards={h['tp_shards']} "
+          f"({h['tp_collectives']} decode collectives), "
           f"ready={srv.ready()}")
 
     accepted, sheds = [], [0]

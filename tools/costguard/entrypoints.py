@@ -266,7 +266,7 @@ def _serving_mlp_grid_build(name, batch_buckets, length_buckets, features,
                       census=executable_census(spec))
 
 
-def _llm_parts(vocab=256, n_layers=2, n_heads=2, head_dim=16, d_ff=64,
+def _llm_parts(vocab=256, n_layers=2, n_heads=8, head_dim=4, d_ff=64,
                n_slots=8, n_pages=64, page_size=16, pages_per_seq=16):
     """Shared pieces of the LLM serving entry points: the tiny causal
     LM's param avals (``jax.eval_shape`` — zero device work) and the
@@ -276,7 +276,10 @@ def _llm_parts(vocab=256, n_layers=2, n_heads=2, head_dim=16, d_ff=64,
     which is exactly the HBM the paged design reclaims and the
     ``llm_decode_step`` vs ``llm_decode_step_dense`` golden pair
     commits (>= 40% fewer decode-step argument bytes, gated by
-    tests/test_costguard.py::test_llm_paged_kv_byte_budget)."""
+    tests/test_costguard.py::test_llm_paged_kv_byte_budget).  The head
+    layout is 8 heads x 4 (``d_model`` 32, same pool bytes as the
+    original 2 x 16) so ``llm_decode_step_tp8`` shards the IDENTICAL
+    model/geometry 8 ways — the tp pair diffs like-for-like."""
     import jax
     import jax.numpy as jnp
 
@@ -335,6 +338,65 @@ def build_llm_decode_step():
     return EntryBuild(name="llm_decode_step", meta=meta, census=1,
                       programs=[Program("llm_decode_step", lowered,
                                         n_args)])
+
+
+def _llm_decode_step_tp(name, collectives, shards=8):
+    """Shared builder of the tensor-parallel decode entries (ISSUE 14):
+    the IDENTICAL model, pool geometry, and slot grid as
+    ``llm_decode_step``, lowered ONCE over a tp mesh — head-sharded
+    pools, Megatron column/row weights, per-layer activation
+    all-reduces in the ``collectives`` wire format.  Census stays 1:
+    sharding is a lowering property, not a new executable."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu import parallel
+    from mxnet_tpu.serving.generate import build_decode_step
+
+    cfg, p_avals, g, s = _llm_parts()
+    mesh = parallel.make_mesh(tp=shards, devices=jax.devices()[:shards])
+    pool = jax.ShapeDtypeStruct(
+        (cfg.n_layers, g["n_pages"], g["page_size"], cfg.n_heads,
+         cfg.head_dim), jnp.float32)
+    step = jax.jit(build_decode_step(cfg, g["page_size"], "jnp",
+                                     mesh=mesh, tp_collectives=collectives),
+                   donate_argnums=(1, 2))
+    lowered = step.lower(p_avals, pool, pool, s["tokens"], s["lengths"],
+                         s["active"], s["tables"], s["key"], s["temps"],
+                         s["topks"])
+    n_args = _n_leaves(p_avals) + 2 + 7
+    meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
+                     f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
+            "sharded": True, "tp_shards": shards,
+            "tp_collectives": collectives, **g}
+    return EntryBuild(name=name, meta=meta, census=1,
+                      programs=[Program(name, lowered, n_args)])
+
+
+@entrypoint("llm_decode_step_tp8")
+def build_llm_decode_step_tp8():
+    """The tensor-parallel decode executable at tp=8, f32 collectives:
+    head-parallel paged attention (each device owns 1 of 8 head shards
+    of BOTH pools) + column/row-sharded projections/FFN with the two
+    Megatron all-reduces per layer.  The committed contract vs
+    ``llm_decode_step`` — asserted by tests/test_costguard.py::
+    test_tp_sharded_decode_per_device_pool_byte_budget — is per-device
+    ``argument_bytes`` down by 7/8 of the pool + sharded weight bytes
+    (±2%): per-device KV-pool HBM ∝ 1/shards, the ISSUE 14 headline."""
+    return _llm_decode_step_tp("llm_decode_step_tp8", "f32")
+
+
+@entrypoint("llm_decode_step_tp8_q8")
+def build_llm_decode_step_tp8_q8():
+    """``llm_decode_step_tp8`` with ``tp_collectives="int8"``: the
+    per-layer activation all-reduces run through the chunked int8
+    quantize/all_to_all/all_gather machinery (parallel.quantize, the
+    EQuARX trade — decode is latency-bound on collective bytes).  The
+    committed contract vs the f32 sibling — asserted by
+    tests/test_costguard.py::test_tp_decode_int8_collective_byte_budget
+    — is >= 25% fewer per-device ``collective_bytes`` over the same
+    model, mesh, and executable census."""
+    return _llm_decode_step_tp("llm_decode_step_tp8_q8", "int8")
 
 
 @entrypoint("llm_decode_step_dense")
