@@ -98,16 +98,19 @@ def _cost_fields(step):
     return fields
 
 
-def _trace_on():
-    """Arm the request tracer for a serving bench (ISSUE 13).  Returns
-    True when armed.  ``MXTPU_BENCH_TRACE=0`` opts out; a telemetry
-    import/arming failure never fails the bench (wedge-tolerant like
-    ``_cost_fields``)."""
+def _trace_on(sample=1.0):
+    """Arm the request tracer for a bench (ISSUE 13).  Returns True
+    when armed.  ``sample=0.0`` arms ONLY the compile-event stream
+    (ISSUE 15) — the training benches use it so the measured loop pays
+    no span allocation while the BENCH line still gets its
+    ``compile_ms_total``/``compile_cache_hits`` columns.
+    ``MXTPU_BENCH_TRACE=0`` opts out; a telemetry import/arming failure
+    never fails the bench (wedge-tolerant like ``_cost_fields``)."""
     if os.environ.get("MXTPU_BENCH_TRACE", "1").lower() in ("0", "false"):
         return False
     try:
         from mxnet_tpu import telemetry
-        telemetry.enable(sample=1.0)
+        telemetry.enable(sample=sample)
         return True
     except Exception:       # noqa: BLE001 — the throughput line ships
         return False        # without its latency breakdown
@@ -145,6 +148,30 @@ def _trace_fields(server_name,
     return fields
 
 
+def _compile_fields():
+    """Compile-event-stream columns for a BENCH line (ISSUE 15):
+    ``compile_ms_total`` (wall-ms spent creating executables),
+    ``compile_cache_hits`` (dispatches the jit caches absorbed), and
+    ``recompiles_unexpected`` (post-warmup misses — the number that must
+    be zero or the measured throughput was paid for with compile
+    stalls).  Best-effort like ``_cost_fields``; honors the
+    ``MXTPU_BENCH_TRACE=0`` opt-out; disarms the tracer on the way out
+    so a later bench never runs traced."""
+    if os.environ.get("MXTPU_BENCH_TRACE", "1").lower() in ("0", "false"):
+        return {}
+    try:
+        from mxnet_tpu import telemetry
+        try:
+            cs = telemetry.compile_stats()
+            return {"compile_ms_total": round(cs["ms_total"], 1),
+                    "compile_cache_hits": cs["hits"],
+                    "recompiles_unexpected": cs["unexpected"]}
+        finally:
+            telemetry.disable()
+    except Exception:       # noqa: BLE001 — wedged mid-read; the
+        return {}           # throughput line still ships
+
+
 def _setup():
     import jax
 
@@ -168,6 +195,7 @@ def _setup():
 
 def bench_resnet():
     jax = _setup()
+    _trace_on(sample=0.0)   # compile-event stream only (ISSUE 15)
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
@@ -244,6 +272,7 @@ def bench_resnet():
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
         **_cost_fields(step),
+        **_compile_fields(),
     }))
 
 
@@ -252,6 +281,7 @@ def bench_bert():
     seq 128, ~15% masked (20 positions), LAMB — the reference's phase-1 recipe
     (ref: gluonnlp scripts/bert/run_pretraining.py)."""
     jax = _setup()
+    _trace_on(sample=0.0)   # compile-event stream only (ISSUE 15)
 
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, parallel
@@ -307,6 +337,7 @@ def bench_bert():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
         **_cost_fields(step),
+        **_compile_fields(),
     }))
 
 
@@ -315,6 +346,7 @@ def bench_lstm():
     the reference's word_language_model recipe over the fused lax.scan RNN op
     (ref: src/operator/rnn.cc cuDNN path; BASELINE config 3)."""
     jax = _setup()
+    _trace_on(sample=0.0)   # compile-event stream only (ISSUE 15)
 
     import mxnet_tpu as mx
     from mxnet_tpu import parallel, gluon
@@ -360,6 +392,7 @@ def bench_lstm():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_LSTM_TOK_S, 4),
         **_cost_fields(step),
+        **_compile_fields(),
     }))
 
 
@@ -500,6 +533,7 @@ def bench_llm():
         "tp_collectives": tp_collectives,
         **fields,
         **trace_fields,
+        **_compile_fields(),
     }))
 
 
@@ -508,6 +542,7 @@ def bench_ssd():
     cls/loc loss + backward + SGD, one XLA program (ref: GluonCV
     train_ssd.py; BASELINE config 5)."""
     jax = _setup()
+    _trace_on(sample=0.0)   # compile-event stream only (ISSUE 15)
 
     import mxnet_tpu as mx
     from mxnet_tpu import parallel
@@ -567,6 +602,7 @@ def bench_ssd():
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_SSD_IMG_S, 4),
         **_cost_fields(step),
+        **_compile_fields(),
     }))
 
 

@@ -163,6 +163,7 @@ class Heartbeat:
         self._calls = 0
         self._last_written = None
         self._last_phase = None
+        self._last_compiling = False
         os.makedirs(self.directory, exist_ok=True)
 
     @classmethod
@@ -180,10 +181,20 @@ class Heartbeat:
                    every_n_steps=int(env.get("MXTPU_HEARTBEAT_EVERY", "1")
                                      or 1))
 
-    def beat(self, global_step=None, phase="train"):
+    def beat(self, global_step=None, phase="train", last_step_ms=None,
+             compile_in_progress=False):
         """Stamp liveness; returns the record written, or None when the
         cadence thinned this step out.  ``global_step=None`` auto-counts
-        calls (the batch-end-callback form)."""
+        calls (the batch-end-callback form).
+
+        ``last_step_ms`` is the wall time of the just-completed step —
+        the supervisor summarizes these into its fleet-wide ``step_ms``
+        histogram (ISSUE 15).  ``compile_in_progress=True`` marks a
+        stamp written right BEFORE a compiling call: the watchdog grants
+        such a worker the startup grace instead of the steady-state
+        staleness bound, so a long first compile is distinguishable from
+        a hung step.  A change in the flag always writes (the watchdog
+        must see it flip regardless of the cadence)."""
         if global_step is None:
             self._auto_step += 1
             global_step = self._auto_step
@@ -198,20 +209,26 @@ class Heartbeat:
         # batches) follow the cadence — the env knob exists to throttle
         # per-batch write+rename I/O, whatever the phase
         self._calls += 1
+        compiling = bool(compile_in_progress)
         if (phase == self._last_phase and self._last_written is not None
+                and compiling == self._last_compiling
                 and self._calls % self.every_n_steps != 0):
             return None
         rec = {"rank": self.rank, "attempt": self.attempt,
                "global_step": global_step,
                "monotonic_stamp": time.monotonic(),
                "phase": str(phase), "pid": os.getpid(),
-               "wall_time": time.time()}
+               "wall_time": time.time(),
+               "last_step_ms": None if last_step_ms is None
+               else round(float(last_step_ms), 3),
+               "compile_in_progress": compiling}
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(rec, f)
         os.replace(tmp, self.path)
         self._last_written = global_step
         self._last_phase = str(phase)
+        self._last_compiling = compiling
         return rec
 
     def __call__(self, param=None):
@@ -442,6 +459,10 @@ class Supervisor:
         self._watchdog = None
         self._verdicts = queue.Queue()
         self._stop = threading.Event()
+        # step-time visibility (ISSUE 15): last global_step seen per
+        # rank, so each heartbeat's last_step_ms is observed into the
+        # fleet-wide step_ms histogram exactly once
+        self._step_seen = {}
 
     # ---- public observability ----
     def worker_pids(self):
@@ -461,19 +482,50 @@ class Supervisor:
         """The unified metrics exposition (ISSUE 13): the SAME
         ``telemetry.exposition`` key schema the serving runtimes serve
         (one scraper reads the whole stack), with the supervisor's gang
-        counters and worker gauges.  ``fmt="prom"`` renders the
+        counters and worker gauges.  ISSUE 15 adds the fleet-wide
+        ``step_ms`` histogram (each rank's heartbeat ``last_step_ms``,
+        observed by the watchdog scan once per step) plus the
+        ``compiling_workers`` gauge and the uniform ``compile_*`` /
+        ``mem_*`` families, so the elastic gang's step-time visibility
+        sits next to its heartbeats.  ``fmt="prom"`` renders the
         Prometheus-style text form.  Works in standalone (file-path)
         mode — the telemetry twin loads the same way ``fault`` does."""
         counters = {"restarts": self.restarts,
                     "events": 0 if self.log is None
                     else len(self.log.records)}
+        beats = read_heartbeats(self.heartbeat_dir)
         gauges = {"workers": self.num_workers,
                   "live_workers": len(self.worker_pids()),
                   "max_restarts": self.max_restarts,
-                  "watchdog_secs": self.watchdog_secs}
+                  "watchdog_secs": self.watchdog_secs,
+                  "compiling_workers": sum(
+                      1 for rec in beats.values()
+                      if rec.get("compile_in_progress"))}
+        gauges.update(_telemetry.compile_gauges("Supervisor"))
+        gauges.update(_telemetry.memory_gauges(None))
+        hists = _telemetry.registry().snapshot(
+            prefix="Supervisor::")["histograms"]
         payload = _telemetry.exposition("supervisor", "Supervisor",
-                                        counters, gauges)
+                                        counters, gauges, hists)
         return _telemetry.render(payload, fmt)
+
+    def _note_heartbeat(self, rank, rec):
+        """Fold one heartbeat record into the supervisor's step-time
+        telemetry: each NEW (rank, global_step) stamp's ``last_step_ms``
+        lands in the ``Supervisor::step_ms`` histogram once.  Called
+        from the watchdog scan; never raises (observability must not
+        un-guard the gang)."""
+        try:
+            ms = rec.get("last_step_ms")
+            step = rec.get("global_step")
+            if ms is None or self._step_seen.get(rank) == step:
+                return
+            self._step_seen[rank] = step
+            _telemetry.registry().histogram(
+                "Supervisor::step_ms",
+                _telemetry.SPAN_MS_BUCKETS).observe(float(ms))
+        except Exception:  # noqa: BLE001
+            pass
 
     # ---- the run loop ----
     def run(self):
@@ -566,6 +618,15 @@ class Supervisor:
             "DMLC_ATTEMPT": str(attempt),
             HEARTBEAT_ENV: self.heartbeat_dir,
         })
+        if self.event_log:
+            # per-rank flight-recorder bundles (ISSUE 15) land next to
+            # the supervisor's own event log: workers arm via
+            # telemetry.flight_from_env and dump on their death paths
+            # (GracefulExit from the teardown SIGTERM, non-finite abort,
+            # unhandled exception) — collection is the shared directory
+            env[_telemetry.FLIGHT_ENV] = os.path.join(
+                os.path.dirname(os.path.abspath(self.event_log)),
+                "flight")
         if self.log_dir or self.prefix_output:
             # redirected stdio makes python block-buffer: progress lines
             # would lag by kilobytes and a SIGKILLed worker's final
@@ -766,6 +827,7 @@ class Supervisor:
                             self._verdicts.put(("no-heartbeat", rank,
                                                 now - t0))
                         continue
+                    self._note_heartbeat(rank, rec)
                     # NB an "exit"-phase record gets no exemption: a
                     # worker that wedges AFTER its exit beat (shutdown
                     # stuck on the coordination service) is exactly the
@@ -774,7 +836,17 @@ class Supervisor:
                     # long before the stamp ages out
                     if stale_after > 0:
                         age = now - float(rec.get("monotonic_stamp", now))
-                        if age > stale_after:
+                        limit = stale_after
+                        if rec.get("compile_in_progress"):
+                            # the stamp says a compile is in flight: a
+                            # long first compile is bring-up, not a hang
+                            # — grant the startup grace instead of the
+                            # steady-state bound (ISSUE 15; the next
+                            # completed step clears the flag)
+                            limit = max(stale_after,
+                                        self.startup_grace_secs
+                                        or 10.0 * stale_after)
+                        if age > limit:
                             _fault.fire("supervisor.watchdog")
                             self._verdicts.put(("hang", rank, age))
             except Exception as exc:

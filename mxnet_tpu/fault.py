@@ -86,14 +86,16 @@ import threading
 import time
 
 __all__ = ["inject", "fire", "points", "armed", "register_point",
-           "set_observer", "retry_call", "backoff_delay", "GracefulExit",
-           "with_context"]
+           "set_observer", "set_exit_observer", "retry_call",
+           "backoff_delay", "GracefulExit", "with_context"]
 
 _REGISTRY = {}            # point -> _Injection (armed faults)
 _KNOWN = {}               # point -> location blurb (the documented surface)
 _lock = threading.Lock()
 _OBSERVER = None          # telemetry hook: called with the point name on
 #                           every fault that actually FIRES (raises)
+_EXIT_OBSERVER = None     # telemetry hook: called with the signum when a
+#                           GracefulExit latch first catches its signal
 
 
 def set_observer(fn):
@@ -105,6 +107,17 @@ def set_observer(fn):
     what the fault harness does."""
     global _OBSERVER
     _OBSERVER = fn
+
+
+def set_exit_observer(fn):
+    """Install ``fn(signum)`` to observe a ``GracefulExit`` latch
+    catching its FIRST signal (or ``None`` to remove it).
+    ``telemetry.enable_flight()`` uses this to dump the flight-recorder
+    bundle at preemption time — the handler runs it between bytecodes
+    like any Python signal handler, and its exceptions are swallowed:
+    observability must never break the snapshot-then-exit path."""
+    global _EXIT_OBSERVER
+    _EXIT_OBSERVER = fn
 
 
 def register_point(point, where=""):
@@ -376,6 +389,12 @@ class GracefulExit:
             raise KeyboardInterrupt
         self.requested = True
         self.signum = signum
+        obs = _EXIT_OBSERVER
+        if obs is not None:
+            try:
+                obs(signum)
+            except Exception:  # noqa: BLE001 — observability must never
+                pass           # break the snapshot-then-exit path
         # Nested latches (Module.predict/score arm one inside fit's) must
         # not swallow the signal for the outer scope: a SIGTERM during the
         # eval pass still has to make the training loop snapshot-and-exit.

@@ -21,6 +21,7 @@ import numpy as np
 from . import callback as _callback
 from . import elastic as _elastic
 from . import fault as _fault
+from . import telemetry as _telemetry
 from . import initializer as _init
 from . import metric as _metric
 from . import optimizer as _opt
@@ -394,8 +395,11 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
     # supervised runs (tools/launch.py exports MXTPU_HEARTBEAT_DIR) stamp
     # a per-rank heartbeat every batch so the supervisor's watchdog can
     # tell a slow step from a hung one; unsupervised runs get None and
-    # pay nothing
+    # pay nothing.  The same env contract arms the flight recorder
+    # (MXTPU_FLIGHT_DIR): the supervisor collects per-rank post-mortem
+    # bundles next to its event log (ISSUE 15)
     heartbeat = _elastic.Heartbeat.from_env()
+    _telemetry.flight_from_env()
 
     start_epoch, skip_batches = 0, 0
     if resume:
@@ -455,6 +459,13 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
                     nbatch += 1
                 skip_batches = 0
                 while True:
+                    # per-step spans (ISSUE 15): one sampled trace per
+                    # batch, feed (the nxt() pull — the input pipeline's
+                    # wait) vs compute (fwd+bwd+update), mirrored into
+                    # the Chrome-trace stream like request traces.  One
+                    # ACTIVE check per batch when tracing is off.
+                    t_feed0 = _telemetry.now_us() if _telemetry.ACTIVE \
+                        else None
                     try:
                         batch = nxt()
                     except StopIteration:
@@ -462,10 +473,27 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
                     except Exception as exc:
                         nxt = _skip_bad(exc, epoch, nbatch, nxt)
                         continue
+                    t_comp0 = time.perf_counter()
                     mod.forward(batch, is_train=True)
                     mod.backward()
                     mod.update()
                     mod.update_metric(eval_metric, batch.label)
+                    step_ms = (time.perf_counter() - t_comp0) * 1e3
+                    if t_feed0 is not None:
+                        tr = _telemetry.maybe_trace("step",
+                                                    server="Module.fit",
+                                                    t0=t_feed0)
+                        if tr is not None:
+                            now = _telemetry.now_us()
+                            t_mid = now - step_ms * 1e3
+                            tr.open("feed", parent=tr.root,
+                                    t0=t_feed0).end(t_mid)
+                            tr.open("compute", parent=tr.root,
+                                    t0=t_mid).end(now)
+                            tr.root.attrs["epoch"] = epoch
+                            tr.root.attrs["nbatch"] = nbatch
+                            tr.root.end(now)
+                            tr.finish()
                     if batch_end_callback:
                         batch_end_callback(_callback.BatchEndParam(
                             epoch=epoch, nbatch=nbatch,
@@ -479,7 +507,7 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
                         # while the checkpoint says step 10000
                         heartbeat.beat(
                             int(_opt_owner(mod)._optimizer.num_update),
-                            phase="train")
+                            phase="train", last_step_ms=step_ms)
                     if gexit.requested:
                         if heartbeat is not None:
                             heartbeat.beat(phase="snapshot")

@@ -115,6 +115,13 @@ class DevicePrefetcher:
                       "producer_wait_s": 0.0, "consumer_wait_s": 0.0}
         self._depth_counter = _profiler.Counter(
             None, "DevicePrefetcher::queue_depth")
+        # the wait split as cumulative-ms counter series (ISSUE 15):
+        # readable with the profiler off, and what TrainStep's per-step
+        # spans read feed-wait deltas from
+        self._cwait_counter = _profiler.Counter(
+            None, "DevicePrefetcher::consumer_wait_ms")
+        self._pwait_counter = _profiler.Counter(
+            None, "DevicePrefetcher::producer_wait_ms")
 
     # ----------------------------------------------------------- produce --
     def _produce(self, it, q, stop):
@@ -137,8 +144,10 @@ class DevicePrefetcher:
                     break
                 except _queue.Full:
                     continue
+            waited = time.perf_counter() - t0
+            self._pwait_counter.increment(waited * 1e3)
             with self._lock:
-                self.stats["producer_wait_s"] += time.perf_counter() - t0
+                self.stats["producer_wait_s"] += waited
                 if enqueued and item is not self._STOP \
                         and not isinstance(item, Exception):
                     # a batch dropped by a halt is NOT produced: keeps the
@@ -181,8 +190,10 @@ class DevicePrefetcher:
                             if stop.is_set():
                                 item = self._STOP
                                 break
+                waited = time.perf_counter() - t0
+                self._cwait_counter.increment(waited * 1e3)
                 with self._lock:
-                    self.stats["consumer_wait_s"] += time.perf_counter() - t0
+                    self.stats["consumer_wait_s"] += waited
                     self._set_depth_locked(q)
                 if item is self._STOP:
                     return

@@ -18,6 +18,8 @@ Usage:
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -28,6 +30,7 @@ from .. import autograd as _autograd
 from ..fault import fire as _fire
 from ..elastic import NonFiniteAbortError
 from .. import profiler as _profiler
+from .. import telemetry as _telemetry
 from ..profiler import scope as _pscope
 from ..ndarray import NDArray
 from ..gluon.block import Block, _flatten_nd, _unflatten_nd
@@ -206,6 +209,10 @@ class TrainStep:
         self._built = False
         self._jit = None
         self._num_update = optimizer.begin_num_update
+        # feed-wait attribution for the per-step span (ISSUE 15): the
+        # cumulative DevicePrefetcher consumer-wait reading at the last
+        # traced step, so each step span carries the wait accrued since
+        self._feed_wait_seen = None
 
     @property
     def data_sharding(self):
@@ -439,14 +446,63 @@ class TrainStep:
             self._fresh_jit = True
         return data_leaves, label_leaves
 
+    def _invoke(self, args):
+        """The one jit dispatch of a step (the donated first call
+        suppresses XLA's expected "donated buffers were not usable"
+        notice — for that compile only, not process-wide)."""
+        if self._donate_batch and getattr(self, "_fresh_jit", False):
+            import warnings
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore", message="Some donated buffers were not usable")
+                out = self._jit(*args)
+            self._fresh_jit = False
+            return out
+        return self._jit(*args)
+
+    def _run_guarded(self, args):
+        """``_invoke`` through the compile-event chokepoint."""
+        with _telemetry.compile_guard("TrainStep", self._jit, key="step"):
+            return self._invoke(args)
+
+    @staticmethod
+    def _finish_step_trace(tr, error=None):
+        """Export a step trace on a FAILING path: the flight-recorder
+        bundle dumped at abort time must contain the spans of the very
+        step that died, not every step except it.  ``finish()`` closes
+        the still-open spans itself; never raises."""
+        if tr is None:
+            return
+        try:
+            if error is not None:
+                cls = error if isinstance(error, type) else type(error)
+                tr.root.attrs["error"] = cls.__name__
+            tr.root.end()
+            tr.finish()
+        except Exception:   # noqa: BLE001 — tracing never worsens a death
+            pass
+
     def _step(self, data, label):
         _fire("step")
+        t_wall = time.perf_counter()
         data_leaves, label_leaves = self._prepare(data, label)
+        # does this signature still owe its compile?  Stamped on the
+        # heartbeat BEFORE the compiling call so the supervisor's
+        # watchdog can tell a long first compile from a hung step
+        # (ISSUE 15 — startup grace stops being a blind timer)
+        if self._heartbeat is not None and self._jit._cache_size() == 0:
+            self._heartbeat.beat(self._num_update, phase="train",
+                                 compile_in_progress=True)
+        tr = _telemetry.maybe_trace("step", server="TrainStep") \
+            if _telemetry.ACTIVE else None
         key = _random.next_key()
         lr = jnp.float32(self._base_lr())
         dat_sh = NamedSharding(self.mesh, self._data_pspec)
+        sp_h2d = None if tr is None else tr.open("h2d", parent=tr.root)
         data_leaves = [_put_batch(l, dat_sh) for l in data_leaves]
         label_leaves = [_put_batch(l, dat_sh) for l in label_leaves]
+        if sp_h2d is not None:
+            sp_h2d.end()
         args = (self._train_arrays, self._aux_arrays, self._states,
                 self._t, key, lr, *data_leaves, *label_leaves)
         if getattr(self, "_last_avals", None) is None:
@@ -454,18 +510,13 @@ class TrainStep:
             # with (shapes are fixed until sig changes)
             self._last_avals = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
-        if self._donate_batch and getattr(self, "_fresh_jit", False):
-            # batch buffers rarely alias an output shape: XLA's "donated
-            # buffers were not usable" notice is expected on this compile,
-            # and is suppressed only for it (not process-wide)
-            import warnings
-            with warnings.catch_warnings():
-                warnings.filterwarnings(
-                    "ignore", message="Some donated buffers were not usable")
-                out = self._jit(*args)
-            self._fresh_jit = False
-        else:
-            out = self._jit(*args)
+        sp_compute = None if tr is None else tr.open("compute",
+                                                     parent=tr.root)
+        try:
+            out = self._run_guarded(args)
+        except BaseException as exc:
+            self._finish_step_trace(tr, error=exc)
+            raise
         if self._skip_nonfinite:
             (self._train_arrays, self._aux_arrays, self._states, self._t,
              loss, finite) = out
@@ -484,6 +535,16 @@ class TrainStep:
                         lv = float(np.asarray(loss))
                     except Exception:
                         lv = float("nan")
+                    # the numeric-abort flight trigger (ISSUE 15): the
+                    # dying step's trace exports FIRST (into the ring),
+                    # then the post-mortem bundle lands, then the raise
+                    # unwinds
+                    self._finish_step_trace(tr, error=NonFiniteAbortError)
+                    tr = None          # the except/finish below must not
+                    #                    double-handle an exported trace
+                    _telemetry.flight_trip(
+                        "nonfinite-abort", step=int(self._num_update),
+                        consecutive_skips=self.consecutive_skips)
                     raise NonFiniteAbortError(
                         f"TrainStep: {self.consecutive_skips} consecutive "
                         f"non-finite updates (budget {budget}) at "
@@ -498,8 +559,32 @@ class TrainStep:
              loss) = out
             self._num_update += 1
         self.optimizer.num_update = self._num_update
+        step_ms = (time.perf_counter() - t_wall) * 1e3
+        if sp_compute is not None:
+            sp_compute.end()
+        if tr is not None:
+            # feed-wait attribution: the DevicePrefetcher consumer-wait
+            # accrued since the last traced step rides the root span
+            # (the wait happened before this step's window opened, so
+            # it is an attribute + histogram, not a child span)
+            try:
+                w = _profiler.counter_value(
+                    "DevicePrefetcher::consumer_wait_ms")
+                if w is not None:
+                    seen = self._feed_wait_seen
+                    delta = 0.0 if seen is None else max(0.0, w - seen)
+                    self._feed_wait_seen = w
+                    tr.root.attrs["feed_wait_ms"] = round(delta, 3)
+                    _telemetry.registry().histogram(
+                        "TrainStep::feed_wait_ms",
+                        _telemetry.SPAN_MS_BUCKETS).observe(delta)
+                tr.root.attrs["num_update"] = int(self._num_update)
+                tr.root.end()
+                tr.finish()
+            except Exception:   # noqa: BLE001 — tracing never fails a step
+                pass
         if self._heartbeat is not None:
-            self._heartbeat.beat(self._num_update)
+            self._heartbeat.beat(self._num_update, last_step_ms=step_ms)
         return NDArray(loss)
 
     # ------------------------------------------------------------- costing --
@@ -684,7 +769,8 @@ class EvalStep:
         key = _random.next_key()
         dat_sh = NamedSharding(self.mesh, self._data_pspec)
         data_leaves = [_put_batch(l, dat_sh) for l in data_leaves]
-        outs = self._jit(self._arrays, key, *data_leaves)
+        with _telemetry.compile_guard("EvalStep", self._jit, key="eval"):
+            outs = self._jit(self._arrays, key, *data_leaves)
         res = _unflatten_nd(self._holder.out_tree,
                             tuple(NDArray(o) for o in outs))
         if isinstance(res, tuple) and len(res) == 1:

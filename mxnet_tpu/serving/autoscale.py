@@ -209,6 +209,10 @@ class FleetAutoscaler:
                   "min_replicas": self.policy.min_replicas,
                   "max_replicas": self.policy.max_replicas,
                   "events": len(self.log.records)}
+        # the uniform ISSUE 15 gauge families (the control loop compiles
+        # nothing and stamps no report — zeros, but scrapers never branch)
+        gauges.update(_telemetry.compile_gauges(self._name))
+        gauges.update(_telemetry.memory_gauges(None))
         payload = _telemetry.exposition("fleet_autoscaler", self._name,
                                         counters, gauges)
         return _telemetry.render(payload, fmt)

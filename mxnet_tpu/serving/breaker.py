@@ -20,6 +20,7 @@ import threading
 import time
 
 from .. import fault as _fault
+from .. import telemetry as _telemetry
 
 __all__ = ["CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN"]
 
@@ -84,14 +85,25 @@ class CircuitBreaker:
     def record_failure(self):
         """One step failure.  Trips on the ``threshold``-th consecutive
         failure, or instantly from HALF_OPEN (the probe failed); each
-        re-open doubles the next probe delay via ``fault.backoff_delay``."""
+        re-open doubles the next probe delay via ``fault.backoff_delay``.
+        A fresh trip into OPEN — from CLOSED, the start of a dark
+        episode — fires the flight-recorder dump (ISSUE 15): the
+        seconds of spans/faults/compiles that preceded the replica
+        going dark are exactly what the post-mortem needs.  Re-trips
+        (failed half-open probes of a still-dark replica) do NOT dump
+        again: a sustained outage probes every few seconds for hours,
+        and one bundle per episode is the record, not one per probe."""
+        dump = False
         with self._lock:
             self._failures += 1
             if self.threshold <= 0:
                 return
             if self._state == HALF_OPEN or self._failures >= self.threshold:
+                dump = self._state == CLOSED
                 self._opens += 1
                 self.trips += 1
                 self._state = OPEN
                 self._retry_at = time.monotonic() + _fault.backoff_delay(
                     self._opens, self._base, self._max, self._jitter)
+        if dump:         # outside the lock: dump() does file I/O
+            _telemetry.flight_trip("breaker-open", trips=self.trips)

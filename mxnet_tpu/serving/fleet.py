@@ -103,6 +103,17 @@ class HotSwapApply:
         self._fn = fn
         self.params = params
         self.quantizer = quantizer
+        # compile-event stream (ISSUE 15): expose the shared jit fn's
+        # cache so every replica's InferenceServer reports REAL
+        # executable growth — replica 1's warmup against executables
+        # replica 0 already compiled records hits, not phantom compiles.
+        # jit_cache_owner names the SHARED fn (not this per-replica
+        # wrapper) so concurrent growth observations across replicas
+        # dedupe through one high-water mark.
+        cache_size = getattr(fn, "_cache_size", None)
+        if callable(cache_size):
+            self.jit_cache_size = cache_size
+            self.jit_cache_owner = fn
 
     def __call__(self, *leaves):
         return self._fn(self.params, *leaves)
@@ -266,7 +277,7 @@ class ServingFleet:
                  max_redispatch=None, probe_base_delay=0.05,
                  probe_max_delay=2.0, probe_jitter=0.25,
                  probe_deadline=5.0, breaker=None, max_queue=128,
-                 qos=None, **server_kw):
+                 qos=None, memory_report=None, **server_kw):
         if isinstance(applies, dict):
             group_map = {str(g): list(fns) for g, fns in applies.items()}
         else:
@@ -278,6 +289,9 @@ class ServingFleet:
         self.buckets = buckets if isinstance(buckets, BucketSpec) \
             else BucketSpec(buckets)
         self._sample = sample
+        # live memory gauges (ISSUE 15): one stamped costguard report
+        # describes every replica (same executables fleet-wide)
+        self._mem_gauges = _telemetry.memory_gauges(memory_report)
         self._default_deadline = default_deadline
         self._qos = qos
         if qos is not None:
@@ -1136,6 +1150,11 @@ class ServingFleet:
                       if not q and rep.server.ready()),
                   "ready": int(self.ready()), "alive": int(self.alive()),
                   "draining": int(self._draining.is_set())}
+        # the runtime-introspection families (ISSUE 15): the fleet's own
+        # compile site (replica sites ride the replica_ prefix) + the
+        # stamped memory bytes
+        gauges.update(_telemetry.compile_gauges(self._name))
+        gauges.update(self._mem_gauges)
         gauges.update({f"replica_{k}": v
                        for k, v in agg["gauges"].items()})
         # fleet-routed traces are born under the FLEET's name, so their
@@ -1155,6 +1174,14 @@ class ServingFleet:
             "serving_fleet", self._name, counters, gauges, hists,
             {} if self._qos is None else self._qos.snapshot())
         return _telemetry.render(payload, fmt)
+
+    def stamp_memory_report(self, report):
+        """Stamp a costguard-style memory report onto the fleet's
+        ``mem_*`` exposition gauges (see
+        ``InferenceServer.stamp_memory_report``; one report describes
+        every replica — they share the executables)."""
+        self._mem_gauges = _telemetry.memory_gauges(report)
+        return self._mem_gauges
 
     # ---------------------------------------------------------------- drain --
     def drain(self, timeout=None):

@@ -543,7 +543,7 @@ class GenerationServer:
                  default_deadline=None, max_new_tokens=32, eos_id=None,
                  seed=0, attention_impl=None, prefill_workers=0,
                  qos=None, tp_shards=1, tp_collectives="f32",
-                 name="GenerationServer"):
+                 memory_report=None, name="GenerationServer"):
         import jax
         import jax.numpy as jnp
 
@@ -681,6 +681,15 @@ class GenerationServer:
         self._c_pages = _profiler.Counter(None, f"{name}::page_occupancy")
         self._c_preempted = _profiler.Counter(None, f"{name}::preempted")
         self._c_retired = _profiler.Counter(None, f"{name}::retired")
+        # live memory gauges (ISSUE 15): per-device argument/peak bytes
+        # from an already-parsed costguard report, stamped at warmup
+        self._mem_gauges = _telemetry.memory_gauges(memory_report)
+        # per-slot page-occupancy histogram: observed at every
+        # retirement, so the exposition shows how sequences actually
+        # used the pool (not just the aggregate free count)
+        self._h_slot_pages = _telemetry.registry().histogram(
+            f"{name}::slot_pages",
+            _telemetry.log_buckets(1.0, 4096.0, per_decade=4))
 
     # ------------------------------------------------------------ lifecycle --
     def start(self, warmup=True):
@@ -724,6 +733,13 @@ class GenerationServer:
                     np.zeros((self.buckets.max_batch, self.pages_per_seq),
                              np.int32))
             self._run_decode()
+            # the whole executable space exists now (census() programs):
+            # any later compile at this site is an UNEXPECTED recompile —
+            # the counter chaos_check --mode obs asserts stays zero.  A
+            # warmup=False server compiles lazily by choice, so nothing
+            # is pinned and its compiles stay ordinary events.
+            if _telemetry.ACTIVE:
+                _telemetry.pin_compile_census(self._name)
         self._started.set()
         self._thread.start()
         for t in self._prefill_threads:
@@ -906,17 +922,24 @@ class GenerationServer:
 
     def _run_prefill(self, tokens, lengths, active, tables, temps, topks):
         """One prefill program invocation (pools donated/reassigned)."""
-        first, self._k_pool, self._v_pool = self._prefill(
-            self._params, self._k_pool, self._v_pool, tokens, lengths,
-            active, tables, self._next_key(), temps, topks)
+        with _telemetry.compile_guard(
+                self._name, self._prefill,
+                key=f"prefill/b{tokens.shape[0]}_l{tokens.shape[1]}"):
+            first, self._k_pool, self._v_pool = self._prefill(
+                self._params, self._k_pool, self._v_pool, tokens, lengths,
+                active, tables, self._next_key(), temps, topks)
         return np.asarray(first)
 
     def _run_prefill_kv(self, tokens, lengths, temps, topks):
         """One POOL-FREE prefill invocation (disaggregated mode; any
         prefill-group worker thread).  Host-realizes the outputs so the
         device wait lands on the worker, never the decode loop."""
-        first, k_all, v_all = self._prefill(
-            self._params, tokens, lengths, self._next_key(), temps, topks)
+        with _telemetry.compile_guard(
+                self._name, self._prefill,
+                key=f"prefill/b{tokens.shape[0]}_l{tokens.shape[1]}"):
+            first, k_all, v_all = self._prefill(
+                self._params, tokens, lengths, self._next_key(), temps,
+                topks)
         return np.asarray(first), np.asarray(k_all), np.asarray(v_all)
 
     def _staging(self):
@@ -929,9 +952,10 @@ class GenerationServer:
 
     def _run_handoff(self, k_all, v_all, lengths, active, tables):
         """One handoff-scatter invocation (pools donated/reassigned)."""
-        self._k_pool, self._v_pool = self._handoff(
-            self._k_pool, self._v_pool, k_all, v_all, lengths, active,
-            tables)
+        with _telemetry.compile_guard(self._name, self._handoff, key="handoff"):
+            self._k_pool, self._v_pool = self._handoff(
+                self._k_pool, self._v_pool, k_all, v_all, lengths, active,
+                tables)
 
     def _new_pools(self):
         """Fresh zeroed K/V pools — head axis sharded over the tp mesh
@@ -982,10 +1006,11 @@ class GenerationServer:
 
     def _run_decode(self):
         """One decode program invocation over the full slot grid."""
-        nxt, self._k_pool, self._v_pool = self._decode(
-            self._params, self._k_pool, self._v_pool, self._tokens,
-            self._lengths, self._active, self._tables, self._next_key(),
-            self._temps, self._topks)
+        with _telemetry.compile_guard(self._name, self._decode, key="decode"):
+            nxt, self._k_pool, self._v_pool = self._decode(
+                self._params, self._k_pool, self._v_pool, self._tokens,
+                self._lengths, self._active, self._tables,
+                self._next_key(), self._temps, self._topks)
         return np.asarray(nxt)
 
     def _pipeline_idle(self):
@@ -1080,6 +1105,8 @@ class GenerationServer:
 
     def _retire(self, seq, error=None, stat="completed"):
         """Terminal retirement: vacate, resolve the future, account."""
+        if seq.pages:
+            self._h_slot_pages.observe(len(seq.pages))
         self._vacate(seq)
         if error is None:
             seq.req.set_result(np.asarray(seq.out, np.int32))
@@ -1700,6 +1727,15 @@ class GenerationServer:
         out["breaker"] = self.breaker.state
         return out
 
+    def stamp_memory_report(self, report):
+        """Stamp a costguard-style memory report (``argument_bytes`` /
+        ``peak_bytes`` / ``per_device``) onto this server's ``mem_*``
+        exposition gauges — the bytes are a property of the compiled
+        program set, so one stamp at warmup is live until the census
+        changes (see ``InferenceServer.stamp_memory_report``)."""
+        self._mem_gauges = _telemetry.memory_gauges(report)
+        return self._mem_gauges
+
     def telemetry(self, fmt="json"):
         """The unified metrics exposition (ISSUE 13): lifecycle counters,
         paging/disaggregation gauges, per-phase latency histograms
@@ -1716,16 +1752,27 @@ class GenerationServer:
                   "breaker_state": h["breaker_state"],
                   "active_slots": h["active_slots"],
                   "free_pages": h["free_pages"],
+                  "used_pages": h["total_pages"] - h["free_pages"],
                   "total_pages": h["total_pages"],
                   "prefill_workers": h["prefill_workers"],
                   "prefill_inflight": h["prefill_inflight"],
                   "tp_shards": h["tp_shards"],
                   "ready": int(h["ready"]), "alive": int(h["alive"]),
                   "draining": int(h["draining"])}
-        hist = _telemetry.registry().snapshot(
-            prefix=f"{self._name}::")["histograms"]
-        for cname, snap in self._qos.latency_snapshots().items():
-            hist[f"class_{cname}_latency_s"] = snap
+        # the runtime-introspection families (ISSUE 15): jit-cache
+        # behavior + stamped memory bytes, same keys on every runtime
+        gauges.update(_telemetry.compile_gauges(self._name))
+        gauges.update(self._mem_gauges)
+        snap = _telemetry.registry().snapshot(prefix=f"{self._name}::")
+        # the registry gauges under this server's prefix ride along too
+        # (page_occupancy/tokens_out/preempted/retired were previously
+        # invisible to the exposition — the ISSUE 15 satellite fix);
+        # healthz-derived values win on key collision
+        for k, v in snap["gauges"].items():
+            gauges.setdefault(k, v)
+        hist = snap["histograms"]
+        for cname, csnap in self._qos.latency_snapshots().items():
+            hist[f"class_{cname}_latency_s"] = csnap
         payload = _telemetry.exposition("generation_server", self._name,
                                         counters, gauges, hist,
                                         h["classes"])

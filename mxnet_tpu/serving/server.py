@@ -78,8 +78,27 @@ class InferenceServer:
     def __init__(self, apply_fn, buckets=(1, 2, 4, 8), *, max_queue=128,
                  max_delay=0.005, rate=None, burst=None, breaker=None,
                  sample=None, default_deadline=None, guard_nonfinite=True,
-                 pin_signature=True, qos=None, name="InferenceServer"):
+                 pin_signature=True, qos=None, memory_report=None,
+                 name="InferenceServer"):
         self._apply = apply_fn
+        # compile-event stream (ISSUE 15): when the apply fn exposes its
+        # jit cache (a raw jax.jit, fleet.HotSwapApply, or the int8
+        # module_apply closure), compile events come from REAL cache
+        # growth — a fleet replica warming against a shared jit fn
+        # records hits, not phantom compiles.  Otherwise the dispatched-
+        # signature set stands in (one executable per padded signature
+        # is the module_apply/executor contract).
+        probe = getattr(apply_fn, "jit_cache_size", None)
+        if probe is None:
+            probe = getattr(apply_fn, "_cache_size", None)
+        self._cache_probe = probe if callable(probe) else None
+        # the object whose jit cache the probe reads (the SHARED fn for
+        # HotSwapApply wrappers) — the dedupe key for concurrent growth
+        self._cache_owner = getattr(apply_fn, "jit_cache_owner",
+                                    apply_fn)
+        # live memory gauges (ISSUE 15): per-device argument/peak bytes
+        # from an already-parsed costguard report, stamped at warmup
+        self._mem_gauges = _telemetry.memory_gauges(memory_report)
         # per-tenant/per-class QoS (ISSUE 12).  Always present: without an
         # explicit policy every request lands in one "default" class with
         # no tenant limiting, so healthz()["classes"] carries the SLO
@@ -144,13 +163,46 @@ class InferenceServer:
                                  "construction")
             for leaves in self._sample_grid():
                 for b in self.buckets.batch:
-                    self._apply(*self._padded(leaves, b))
+                    sig = (b,) + BucketSpec.signature(leaves)
+                    self._tracked_apply(self._padded(leaves, b), sig)
                     with self._lock:
-                        self._shapes.add((b,)
-                                         + BucketSpec.signature(leaves))
+                        self._shapes.add(sig)
+            # every executable the grid allows now exists: later misses
+            # are UNEXPECTED recompiles (the chaos-asserted counter)
+            if _telemetry.ACTIVE:
+                _telemetry.pin_compile_census(self._name)
         self._batcher.start()
         self._ready.set()
         return self
+
+    def _tracked_apply(self, padded, sig):
+        """Run the apply fn through the compile-event chokepoint
+        (ISSUE 15).  With a jit-cache probe the verdict is real cache
+        growth; without one, a signature this server never dispatched
+        counts as the compile it implies."""
+        if not _telemetry.ACTIVE:
+            return self._apply(*padded)
+        key = f"b{sig[0]}"
+        if self._cache_probe is not None:
+            with _telemetry.track_compile(self._name,
+                                          probe=self._cache_probe,
+                                          key=key,
+                                          hw_key=self._cache_owner):
+                return self._apply(*padded)
+        with self._lock:
+            new = sig not in self._shapes
+        with _telemetry.track_compile(self._name, key=key,
+                                      assume_miss=new):
+            return self._apply(*padded)
+
+    def stamp_memory_report(self, report):
+        """Stamp a costguard-style memory report (``argument_bytes`` /
+        ``peak_bytes`` / ``per_device``) onto this server's ``mem_*``
+        exposition gauges — what warmup tooling calls after compiling
+        the grid (the bytes are a property of the executables, so one
+        stamp is live until the program set changes)."""
+        self._mem_gauges = _telemetry.memory_gauges(report)
+        return self._mem_gauges
 
     def __enter__(self):
         if not self._batcher.alive():
@@ -362,10 +414,11 @@ class InferenceServer:
                     step_spans.append(sp)
         if step_spans is not None:     # fault firings → span events
             _telemetry.push_current(step_spans)
+        sig = (target,) + BucketSpec.signature(group[0].data)
         try:
             _fault.fire("serving.step")
             with _profiler.scope(f"{self._name}.step", cat="serving"):
-                out = self._apply(*padded)
+                out = self._tracked_apply(padded, sig)
         except Exception as exc:      # noqa: BLE001 — resolved per request
             self.breaker.record_failure()
             self._note_step_failure(exc)
@@ -424,7 +477,7 @@ class InferenceServer:
         self._c_breaker.set_value(self.breaker.state_code())
         with self._lock:
             self._stats["batches"] += 1
-            self._shapes.add((target,) + BucketSpec.signature(group[0].data))
+            self._shapes.add(sig)
         self._c_occupancy.set_value(int(100 * len(group) / target))
         for i, r in enumerate(group):
             if self._guard and not mask[i]:
@@ -536,10 +589,19 @@ class InferenceServer:
                   "breaker_state": h["breaker_state"],
                   "ready": int(h["ready"]), "alive": int(h["alive"]),
                   "draining": int(h["draining"])}
-        hist = _telemetry.registry().snapshot(
-            prefix=f"{self._name}::")["histograms"]
-        for cname, snap in self._qos.latency_snapshots().items():
-            hist[f"class_{cname}_latency_s"] = snap
+        # the runtime-introspection families (ISSUE 15): jit-cache
+        # behavior + stamped memory bytes, same keys on every runtime
+        gauges.update(_telemetry.compile_gauges(self._name))
+        gauges.update(self._mem_gauges)
+        snap = _telemetry.registry().snapshot(prefix=f"{self._name}::")
+        # every registry gauge under this server's prefix (the profiler
+        # counter series: shed/expired/batch_occupancy/...) rides the
+        # exposition too — healthz-derived values win on key collision
+        for k, v in snap["gauges"].items():
+            gauges.setdefault(k, v)
+        hist = snap["histograms"]
+        for cname, csnap in self._qos.latency_snapshots().items():
+            hist[f"class_{cname}_latency_s"] = csnap
         payload = _telemetry.exposition("inference_server", self._name,
                                         counters, gauges, hist,
                                         h["classes"])
@@ -668,4 +730,9 @@ def _module_apply_int8(module):
         outs = [np.asarray(o) for o in outs]
         return outs[0] if len(outs) == 1 else tuple(outs)
 
+    # compile-event stream (ISSUE 15): expose the jit cache so a server
+    # over this apply reports real executable growth, not signatures
+    # (and the owning jit fn, the concurrent-growth dedupe key)
+    apply.jit_cache_size = qapply._cache_size
+    apply.jit_cache_owner = qapply
     return apply

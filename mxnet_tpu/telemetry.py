@@ -49,6 +49,32 @@ paths; this is the equivalent for the REQUEST path:
   storm with faults + a replica kill, so the tracer itself regresses
   like a test.
 
+ISSUE 15 adds the runtime-introspection half — the observability that
+is NOT request-scoped:
+
+- **Compile-event stream** — ``compile_event`` is the ONE chokepoint
+  every compile path reports through (``TrainStep``/``EvalStep``
+  ``_prepare``, serving warmup + ``module_apply``, ``fleet.HotSwapApply``,
+  the four ``serving/generate.py`` program builders, the costguard
+  entrypoint builders).  One event per executable created (site,
+  signature key, wall-ms, n_executables after); cache HITS increment a
+  counter instead of emitting events, so ``sum(events) == census ==
+  runtime jit-cache count`` holds by construction.  ``track_compile``
+  is the guarded probe call sites wrap a possibly-compiling call in;
+  ``pin_compile_census`` declares a site's post-warmup executable count,
+  after which any further miss increments ``recompiles_unexpected``
+  (the counter ``chaos_check --mode obs`` asserts is zero) and lands a
+  ``recompile`` span event on the in-flight requests.
+- **Flight recorder** — ``flight()`` is a bounded in-memory ring of the
+  last N spans / fault firings / compile events / trip records;
+  ``flight().dump()`` writes one JSONL post-mortem bundle (header,
+  ring, final metrics snapshot) and NEVER raises — a dying process must
+  not die harder for its black box.  ``flight_trip`` fires the dump
+  automatically on breaker OPEN, non-finite abort, ``GracefulExit``
+  latch, and unhandled (thread) death; ``elastic.Supervisor`` exports
+  ``MXTPU_FLIGHT_DIR`` so per-rank bundles land in its event-log
+  directory.
+
 Like ``fault.py`` this module imports ONLY the standard library, and it
 is loadable by file path outside the package (``elastic.py`` loads it
 that way so the supervisor process stays jax-free).
@@ -57,12 +83,14 @@ from __future__ import annotations
 
 import bisect
 import collections
+import contextlib
 import itertools
 import json
 import os
 import random as _random
 import threading
 import time
+import weakref
 
 __all__ = [
     "Span", "Trace", "enable", "disable", "enabled", "config",
@@ -76,6 +104,12 @@ __all__ = [
     "JsonlSink", "read_spans",
     "exposition", "render", "render_prometheus", "merge_payloads",
     "audit_spans", "audit_jsonl", "guard_cost",
+    "compile_event", "track_compile", "compile_guard",
+    "pin_compile_census",
+    "compile_site_stats", "compile_stats", "compile_events",
+    "compile_gauges", "reset_compiles", "memory_gauges",
+    "FlightRecorder", "flight", "enable_flight", "flight_from_env",
+    "flight_trip", "FLIGHT_ENV", "maybe_trace",
 ]
 
 SCHEMA = "mxtpu.telemetry/1"
@@ -309,6 +343,13 @@ class Trace:
                 self._export_profiler()
             except Exception:
                 _oops()
+        if _FLIGHT.enabled:
+            try:
+                for rec in self.records():
+                    rec.pop("kind", None)
+                    _FLIGHT.record("span", rec.pop("name"), **rec)
+            except Exception:
+                _oops()
         if _CFG.collect:
             _CFG.collected.append(self)
 
@@ -337,6 +378,21 @@ class Trace:
                                "args": {"trace": self.trace_id,
                                         "span": sp.sid}})
         _profiler.ingest_events(events)
+
+
+def maybe_trace(name, server="", t0=None, attrs=None):
+    """A fresh ``Trace`` honoring the off-switch, suppression, and the
+    sampling rate — or None.  The non-request spelling of
+    ``begin_request`` (training-step spans use it: there is no Request
+    future to carry the trace, the emitting loop owns the whole
+    lifecycle and calls ``finish()`` itself)."""
+    if not ACTIVE or _suppressed() or not _sampled():
+        return None
+    try:
+        return Trace(name, server=server, t0=t0, attrs=attrs)
+    except Exception:
+        _oops()
+        return None
 
 
 # ------------------------------------------------- request instrumentation --
@@ -515,7 +571,10 @@ class use_spans:
 
 def note_fault(point):
     """``fault.fire`` observer: record an armed fault actually firing as
-    an event on every current span (installed by ``enable()``)."""
+    an event on every current span (installed by ``enable()``) and into
+    the flight-recorder ring (the post-mortem must show what was armed
+    and fired in the seconds before the trip)."""
+    _FLIGHT.record("fault", point)
     stack = getattr(_tls, "stack", None)
     if not stack:
         return
@@ -984,3 +1043,547 @@ def audit_jsonl(path, **kw):
         if problems:
             bad[tid] = problems
     return bad
+
+
+# ========================================================== compile stream
+# ISSUE 15: the ONE chokepoint every compile path reports through.  An
+# *event* is an executable coming into existence (sum of events == the
+# static census == the runtime jit-cache count); a cache HIT only bumps a
+# counter — emitting per-step hit records would flood the flight ring
+# with the steady state the ring exists to contextualize.
+
+class _CompileSite:
+    """Per-site compile accounting (site = one runtime's jit boundary)."""
+
+    __slots__ = ("n", "pinned", "hits", "misses", "ms_total", "unexpected")
+
+    def __init__(self):
+        self.n = 0               # executables created at this site
+        self.pinned = None       # post-warmup census; misses past it are
+        self.hits = 0            # unexpected recompiles
+        self.misses = 0
+        self.ms_total = 0.0
+        self.unexpected = 0
+
+
+_COMPILE_LOCK = threading.Lock()
+_COMPILE_SITES = {}
+_COMPILE_EVENTS = collections.deque(maxlen=1024)
+
+
+def compile_event(site, key=None, ms=None, cache_hit=False,
+                  n_executables=None, **attrs):
+    """Record one compile-boundary observation at ``site``.
+
+    ``cache_hit=True`` increments the site's hit counter and returns
+    None (no event record).  Otherwise one event is recorded: a new
+    executable exists — ``key`` is a short signature label, ``ms`` the
+    wall time of the compiling call, ``n_executables`` the site's cache
+    size after (default: previous count + 1).  A miss past the site's
+    ``pin_compile_census`` count is an *unexpected recompile*: it
+    increments the ``compile::recompiles_unexpected`` counter and lands
+    a ``recompile`` span event on the thread's current spans (the same
+    channel fault firings use), because a post-warmup compile stall is
+    a production incident, not bookkeeping."""
+    site = str(site)
+    with _COMPILE_LOCK:
+        st = _COMPILE_SITES.get(site)
+        if st is None:
+            st = _COMPILE_SITES[site] = _CompileSite()
+        if cache_hit:
+            st.hits += 1
+            unexpected = False
+        else:
+            st.misses += 1
+            st.n = int(n_executables) if n_executables is not None \
+                else st.n + 1
+            if ms is not None:
+                st.ms_total += float(ms)
+            unexpected = st.pinned is not None and st.n > st.pinned
+            if unexpected:
+                st.unexpected += 1
+        n_after = st.n
+        if not cache_hit:
+            rec = {"site": site, "key": key,
+                   "ms": None if ms is None else round(float(ms), 3),
+                   "n_executables": n_after, "unexpected": unexpected}
+            if attrs:
+                rec["attrs"] = attrs
+            # the recent-events deque is read by scraper threads
+            # (compile_events) — append under the same lock so a
+            # concurrent reader never sees a mid-iteration mutation
+            _COMPILE_EVENTS.append(rec)
+    reg = _REGISTRY
+    try:
+        reg.counter("compile::cache_hits" if cache_hit
+                    else "compile::cache_misses").add()
+        if not cache_hit:
+            # events == executables created == misses, everywhere: the
+            # registry counter must agree with compile_stats()["events"]
+            # and the documented sum(events) == census invariant
+            reg.counter("compile::events").add()
+            if ms is not None:
+                reg.counter("compile::ms_total").add(float(ms))
+            reg.gauge(f"compile_cache::{site}").set(n_after)
+            if unexpected:
+                reg.counter("compile::recompiles_unexpected").add()
+    except Exception:
+        _oops()
+    if cache_hit:
+        return None
+    if unexpected:
+        stack = getattr(_tls, "stack", None)
+        if stack:
+            for sp in stack[-1]:
+                try:
+                    sp.event("recompile", site=site, key=key)
+                except Exception:
+                    _oops()
+    sink = _CFG.sink
+    if sink is not None:
+        try:
+            sink.write("compile", site, **{k: v for k, v in rec.items()
+                                           if k != "site"})
+        except Exception:
+            _oops()
+    _FLIGHT.record("compile", site, **{k: v for k, v in rec.items()
+                                       if k != "site"})
+    return rec
+
+
+def pin_compile_census(site, n=None):
+    """Declare ``site``'s executable count final (the post-warmup
+    census).  ``n=None`` pins at whatever the site has accumulated —
+    the warmup-tail spelling.  Every later miss is an unexpected
+    recompile (see ``compile_event``)."""
+    site = str(site)
+    with _COMPILE_LOCK:
+        st = _COMPILE_SITES.get(site)
+        if st is None:
+            st = _COMPILE_SITES[site] = _CompileSite()
+        st.pinned = st.n if n is None else int(n)
+        return st.pinned
+
+
+def compile_site_stats(site):
+    """One site's compile accounting (zeros for a site never seen)."""
+    with _COMPILE_LOCK:
+        st = _COMPILE_SITES.get(str(site))
+        if st is None:
+            return {"n_executables": 0, "pinned": None, "hits": 0,
+                    "misses": 0, "ms_total": 0.0, "unexpected": 0}
+        return {"n_executables": st.n, "pinned": st.pinned,
+                "hits": st.hits, "misses": st.misses,
+                "ms_total": st.ms_total, "unexpected": st.unexpected}
+
+
+def compile_stats():
+    """Process-wide compile-stream totals (the BENCH-line columns)."""
+    with _COMPILE_LOCK:
+        sites = dict(_COMPILE_SITES)
+        out = {"events": 0, "hits": 0, "misses": 0, "ms_total": 0.0,
+               "unexpected": 0, "sites": {}}
+        for name, st in sites.items():
+            out["hits"] += st.hits
+            out["misses"] += st.misses
+            out["ms_total"] += st.ms_total
+            out["unexpected"] += st.unexpected
+            out["sites"][name] = st.n
+        out["events"] = out["misses"]
+        return out
+
+
+def compile_events(clear=False):
+    """Recent compile-event records (one per executable created)."""
+    with _COMPILE_LOCK:
+        out = list(_COMPILE_EVENTS)
+        if clear:
+            _COMPILE_EVENTS.clear()
+    return out
+
+
+def compile_gauges(site):
+    """The ``compile_*`` gauge family one runtime's exposition serves —
+    identical keys on every runtime so scrapers never branch."""
+    st = compile_site_stats(site)
+    return {"compile_executables": st["n_executables"],
+            "compile_cache_hits": st["hits"],
+            "compile_cache_misses": st["misses"],
+            "compile_ms_total": round(st["ms_total"], 3),
+            "recompiles_unexpected": st["unexpected"]}
+
+
+def reset_compiles():
+    """Forget every site, recent event, and probe high-water mark (test
+    isolation; the registry counters are cleared separately via
+    ``registry().clear()``)."""
+    with _COMPILE_LOCK:
+        _COMPILE_SITES.clear()
+        _COMPILE_EVENTS.clear()
+    with _PROBE_LOCK:
+        _PROBE_HW.clear()
+
+
+# High-water marks of probed jit caches: concurrent dispatch of an
+# uncompiled signature through ONE shared jit fn (fleet replicas over a
+# shared HotSwapApply, a lazy GenerationServer's prefill workers) would
+# otherwise let BOTH in-flight probes observe the same cache growth and
+# double-count the compile.  Weak keys: the mark dies with the fn.
+_PROBE_LOCK = threading.Lock()
+_PROBE_HW = weakref.WeakKeyDictionary()
+
+
+class track_compile:
+    """``with track_compile(site, jit_fn, key=...):`` around a call that
+    may compile.  When the tracer is off this is a no-op (nothing is
+    probed or recorded).  With a jit wrapper (anything exposing
+    ``_cache_size``) or an explicit ``probe`` callable, the cache size
+    is read before/after: growth emits one ``compile_event`` per new
+    executable with the block's wall-ms split between them, no growth
+    records a hit — growth another concurrent tracked block already
+    claimed is deduplicated through a per-fn high-water mark (pass
+    ``hw_key`` with ``probe`` to name the owning object; a ``jit_fn``
+    is its own key).  Without a probe, ``assume_miss`` decides (the
+    signature-tracking servers know whether a payload shape is new
+    before dispatching it), except when the block raised — a failed
+    dispatch proves no executable exists."""
+
+    __slots__ = ("_site", "_key", "_assume", "_probe", "_on", "_t0",
+                 "_n0", "_hw_key")
+
+    def __init__(self, site, jit_fn=None, key=None, assume_miss=False,
+                 probe=None, hw_key=None):
+        self._site = site
+        self._key = key
+        self._assume = bool(assume_miss)
+        if probe is None and jit_fn is not None:
+            probe = getattr(jit_fn, "_cache_size", None)
+        self._probe = probe if callable(probe) else None
+        self._hw_key = hw_key if hw_key is not None else jit_fn
+
+    def __enter__(self):
+        self._on = ACTIVE
+        if not self._on:
+            return self
+        self._t0 = time.perf_counter()
+        self._n0 = None
+        if self._probe is not None:
+            try:
+                self._n0 = int(self._probe())
+            except Exception:
+                self._probe = None
+                _oops()
+        return self
+
+    def _probe_growth(self):
+        """Cache growth this block may claim (serialized; high-water
+        deduped so a concurrent observer of the same compile records a
+        hit, not a second event)."""
+        with _PROBE_LOCK:
+            n1 = int(self._probe())
+            base = self._n0
+            if self._hw_key is not None:
+                try:
+                    hw = _PROBE_HW.get(self._hw_key, 0)
+                    base = max(base, hw)
+                    _PROBE_HW[self._hw_key] = max(hw, n1)
+                except TypeError:      # not weakref-able: no dedupe
+                    pass
+            return n1 - base
+
+    def __exit__(self, *exc):
+        if not self._on:
+            return False
+        try:
+            ms = (time.perf_counter() - self._t0) * 1e3
+            if self._probe is not None and self._n0 is not None:
+                # delta-based: accurate even when the call raised (a
+                # compile that completed before the failure still counts)
+                grew = self._probe_growth()
+                if grew <= 0:
+                    compile_event(self._site, key=self._key,
+                                  cache_hit=True)
+                else:
+                    for _ in range(grew):
+                        compile_event(self._site, key=self._key,
+                                      ms=ms / grew)
+            elif exc and exc[0] is not None:
+                # probe-less + the call raised: nothing proves an
+                # executable exists.  Recording the assumed miss would
+                # double-count every retry of a failing new signature
+                # (the caller re-assumes until a dispatch SUCCEEDS and
+                # commits the signature), drifting the site count past
+                # the census and falsely tripping recompiles_unexpected.
+                pass
+            elif self._assume:
+                compile_event(self._site, key=self._key, ms=ms)
+            else:
+                compile_event(self._site, key=self._key, cache_hit=True)
+        except Exception:
+            _oops()
+        return False
+
+
+# one shared, stateless null context: the dark-path stand-in for
+# track_compile, so untraced hot loops (per-token decode, per-step train
+# dispatch) allocate NOTHING — the off-switch contract
+_DARK_GUARD = contextlib.nullcontext()
+
+
+def compile_guard(site, jit_fn=None, key=None):
+    """``track_compile`` when the tracer is armed, one shared null
+    context when it is dark — the guard every compile call site wraps
+    its possibly-compiling dispatch in."""
+    if ACTIVE:
+        return track_compile(site, jit_fn, key=key)
+    return _DARK_GUARD
+
+
+def memory_gauges(report=None):
+    """Flatten a costguard-style memory report (``argument_bytes`` /
+    ``peak_bytes`` + the sharded ``per_device`` section) into the
+    ``mem_*`` gauge family the serving expositions stamp at warmup —
+    zeros when no report has been stamped, so the key schema is uniform
+    whether or not a deployment wires costguard in."""
+    report = report or {}
+    pd = report.get("per_device") or {}
+
+    def val(d, k):
+        v = d.get(k)
+        return 0 if v is None else v
+
+    return {"mem_argument_bytes": val(report, "argument_bytes"),
+            "mem_peak_bytes": val(report, "peak_bytes"),
+            "mem_per_device_argument_bytes": val(pd, "argument_bytes"),
+            "mem_per_device_peak_bytes": val(pd, "peak_bytes")}
+
+
+# ========================================================= flight recorder
+FLIGHT_ENV = "MXTPU_FLIGHT_DIR"
+
+
+class FlightRecorder:
+    """Crash flight recorder (ISSUE 15): a bounded in-memory ring of the
+    last N telemetry happenings — finished spans, fault firings, compile
+    events, trip records — plus ``dump()``, which writes one JSONL
+    post-mortem bundle (a header line, the ring, one final metrics
+    snapshot).  Recording and dumping NEVER raise: the recorder runs in
+    dying processes, and the death it documents must not get worse.
+
+    The ring is only fed while ``enabled`` (``telemetry.enable_flight``
+    arms it); a disabled recorder costs one attribute read per feed
+    site.  Span records of a trace whose root was evicted from the ring
+    are dropped at dump time, so every trace in a bundle is complete and
+    ``audit_jsonl`` applies to bundles unchanged."""
+
+    def __init__(self, limit=2048):
+        self._lock = threading.Lock()
+        self._ring = collections.deque(maxlen=int(limit))
+        self.enabled = False
+        self.directory = None
+        self.dumps = 0
+        self.last_path = None
+
+    def configure(self, directory=None, limit=None, enabled=True):
+        with self._lock:
+            if limit is not None and int(limit) != self._ring.maxlen:
+                self._ring = collections.deque(self._ring,
+                                               maxlen=int(limit))
+            if directory is not None:
+                self.directory = str(directory)
+                try:
+                    os.makedirs(self.directory, exist_ok=True)
+                except OSError:
+                    _oops()
+            self.enabled = bool(enabled)
+        return self
+
+    def record(self, kind, name=None, **fields):
+        """Append one ring entry (never raises).  Appends take the
+        recorder lock: ``dump()`` snapshots the ring by iterating it,
+        and a lock-free concurrent append would raise "deque mutated
+        during iteration" inside the one code path that must never
+        fail."""
+        if not self.enabled:
+            return
+        try:
+            rec = {"ts": round(time.time(), 6),
+                   "mono": round(time.monotonic(), 6),
+                   "kind": str(kind),
+                   "name": None if name is None else str(name)}
+            rec.update(fields)
+            with self._lock:
+                self._ring.append(rec)
+        except Exception:
+            _oops()
+
+    def records(self):
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self):
+        with self._lock:
+            self._ring.clear()
+
+    def dump(self, reason="manual", path=None, **attrs):
+        """Write the post-mortem bundle; returns its path, or None on
+        any failure (swallowed — see the class docstring)."""
+        try:
+            return self._dump(str(reason), path, attrs)
+        except Exception:
+            _oops()
+            return None
+
+    def _dump(self, reason, path, attrs):
+        entries = self.records()
+        # a trace whose root span was evicted can no longer audit —
+        # drop its orphaned spans so the bundle stays audit-clean
+        roots = {r.get("trace") for r in entries
+                 if r.get("kind") == "span" and r.get("parent") is None}
+        entries = [r for r in entries if r.get("kind") != "span"
+                   or r.get("trace") in roots]
+        with self._lock:
+            self.dumps += 1
+            n = self.dumps
+        if path is None:
+            rank = os.environ.get("DMLC_WORKER_ID", "")
+            tag = f"-r{rank}" if rank else ""
+            path = os.path.join(
+                self.directory or ".",
+                f"flight{tag}-{os.getpid()}-{n}.jsonl")
+        stamp = {"ts": round(time.time(), 6),
+                 "mono": round(time.monotonic(), 6)}
+        header = {**stamp, "kind": "flight", "name": "dump",
+                  "reason": reason, "pid": os.getpid(),
+                  "records": len(entries), "tracer_errors": _CFG.errors}
+        if attrs:
+            header.update(attrs)
+        try:
+            snapshot = _REGISTRY.snapshot()
+        except Exception:
+            _oops()
+            snapshot = None
+        with open(path, "w") as f:
+            f.write(json.dumps(header, default=str) + "\n")
+            for rec in entries:
+                f.write(json.dumps(rec, default=str) + "\n")
+            if snapshot is not None:
+                f.write(json.dumps({**stamp, "kind": "metrics",
+                                    "name": "snapshot", **snapshot},
+                                   default=str) + "\n")
+        self.last_path = path
+        return path
+
+
+_FLIGHT = FlightRecorder()
+
+
+def flight():
+    """The process flight recorder (see ``FlightRecorder``)."""
+    return _FLIGHT
+
+
+_LAST_TRIP = [None, 0.0]     # (reason, monotonic) — signal-cascade dedupe
+
+
+def flight_trip(reason, **attrs):
+    """A trigger fired: record it and dump the bundle.  No-op while the
+    recorder is disarmed; identical reasons within one second coalesce
+    (a latched signal forwarding through nested ``GracefulExit`` scopes
+    would otherwise dump once per scope)."""
+    if not _FLIGHT.enabled:
+        return None
+    now = time.monotonic()
+    if _LAST_TRIP[0] == reason and now - _LAST_TRIP[1] < 1.0:
+        return _FLIGHT.last_path
+    _LAST_TRIP[0], _LAST_TRIP[1] = reason, now
+    _FLIGHT.record("trip", reason, **attrs)
+    return _FLIGHT.dump(reason=reason, **attrs)
+
+
+def _graceful_exit_trip(signum):
+    """GracefulExit observer.  The dump runs on a short-lived thread,
+    NOT in the signal handler: the handler executes on the interrupted
+    main thread between bytecodes, and the recorder/registry locks it
+    would need are plain (non-reentrant) locks that the very frame it
+    interrupted may be holding — dumping inline could deadlock the
+    snapshot-then-exit path the latch exists for.  Non-daemon, so
+    interpreter shutdown waits for the (bounded, fast) dump instead of
+    truncating the bundle."""
+    threading.Thread(
+        target=lambda: flight_trip("graceful-exit", signum=signum),
+        name="flight-dump", daemon=False).start()
+
+
+_FLIGHT_HOOKS = [False]
+
+
+def _install_flight_hooks():
+    """Chain ``sys.excepthook`` + ``threading.excepthook`` so an
+    unhandled (worker-thread) death dumps the bundle before the default
+    handling runs.  Installed once per process; the previous hooks
+    always run afterward."""
+    if _FLIGHT_HOOKS[0]:
+        return
+    _FLIGHT_HOOKS[0] = True
+    import sys
+    prev_exc = sys.excepthook
+
+    def _exc_hook(tp, val, tb):
+        flight_trip("unhandled-exception",
+                    error=getattr(tp, "__name__", str(tp)))
+        try:
+            prev_exc(tp, val, tb)
+        except Exception:
+            pass
+
+    sys.excepthook = _exc_hook
+    prev_thread = threading.excepthook
+
+    def _thread_hook(args):
+        # SystemExit excluded: it is the deliberate replica-kill /
+        # drain spelling, not an unhandled death
+        if args.exc_type is not SystemExit:
+            flight_trip("worker-death",
+                        error=getattr(args.exc_type, "__name__", "?"),
+                        thread=getattr(args.thread, "name", None))
+        try:
+            prev_thread(args)
+        except Exception:
+            pass
+
+    threading.excepthook = _thread_hook
+
+
+def enable_flight(directory=None, limit=None, install_hooks=True):
+    """Arm the flight recorder: ring feeds start, the automatic triggers
+    fire (breaker OPEN, non-finite abort, ``GracefulExit``, unhandled
+    death), and bundles land under ``directory`` (default: cwd).  Also
+    installs the fault observer so firings are recorded even when
+    request tracing itself is off."""
+    # a fresh arming is a fresh episode: the same-reason coalesce
+    # window must not suppress its first trip because a PREVIOUS
+    # episode tripped the same reason moments ago
+    _LAST_TRIP[0], _LAST_TRIP[1] = None, 0.0
+    _FLIGHT.configure(directory=directory, limit=limit, enabled=True)
+    if install_hooks:
+        _install_flight_hooks()
+    try:    # package mode only; the standalone launcher has no fault twin
+        from . import fault as _fault
+        _fault.set_exit_observer(_graceful_exit_trip)
+        if _fault._OBSERVER is None:
+            _fault.set_observer(note_fault)
+    except (ImportError, AttributeError):
+        pass
+    return _FLIGHT
+
+
+def flight_from_env(environ=None):
+    """Arm the recorder from the supervisor's env contract
+    (``MXTPU_FLIGHT_DIR``), or None when unsupervised — training loops
+    call this unconditionally, like ``Heartbeat.from_env``."""
+    env = os.environ if environ is None else environ
+    directory = env.get(FLIGHT_ENV)
+    if not directory:
+        return None
+    return enable_flight(directory=directory)

@@ -102,6 +102,14 @@ UNARY = {
 def test_unary(name):
     ref, (lo, hi), diff = UNARY[name]
     x = _u((3, 4), lo, hi, seed=hash(name) % 2 ** 31)
+    if name == "relu":
+        # keep every element a margin outside the kink at 0: the numeric
+        # gradient's central difference (h ≈ 1e-3) straddles it whenever
+        # the hash-salted seed lands a sample within h, which made this
+        # test fail on ~3% of PYTHONHASHSEED values
+        small = np.abs(x) < 0.05
+        x = np.where(small, np.where(x < 0, x - 0.05, x + 0.05),
+                     x).astype(np.float32)
     out = _np_out(_run(name, [x]))
     assert np.all(np.isfinite(np.asarray(out, np.float64)))
     if ref is not None:

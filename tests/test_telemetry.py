@@ -23,8 +23,8 @@ import jax
 
 from mxnet_tpu import elastic, fault, profiler, telemetry
 from mxnet_tpu.gluon.model_zoo.causal_lm import CausalLMConfig, init_causal_lm
-from mxnet_tpu.serving import (BucketSpec, GenerationServer, HotSwapApply,
-                               InferenceServer, ServingFleet)
+from mxnet_tpu.serving import (BucketSpec, CircuitBreaker, GenerationServer,
+                               HotSwapApply, InferenceServer, ServingFleet)
 from mxnet_tpu.serving.admission import ClassStats
 from mxnet_tpu.serving.autoscale import FleetAutoscaler, ScalingPolicy
 
@@ -48,8 +48,15 @@ def _telemetry_clean():
     cfg.collected.clear()
     cfg.sample = 1.0
     telemetry.registry().clear()
+    telemetry.reset_compiles()
+    fl = telemetry.flight()
+    fl.enabled = False
+    fl.clear()
+    fl.directory = None
+    fl.last_path = None
     profiler.counters_clear()
     fault.set_observer(None)
+    fault.set_exit_observer(None)
 
 
 # ------------------------------------------------------------------ helpers --
@@ -569,8 +576,16 @@ def test_exposition_schema_is_uniform_across_runtimes(tmp_path):
         kinds = {p["kind"] for p in payloads}
         assert kinds == {"inference_server", "serving_fleet",
                          "fleet_autoscaler", "supervisor"}
+        # the ISSUE 15 gauge families ride EVERY runtime's exposition
+        # with identical keys (compile-cache behavior + stamped memory)
+        families = {"compile_executables", "compile_cache_hits",
+                    "compile_cache_misses", "compile_ms_total",
+                    "recompiles_unexpected", "mem_argument_bytes",
+                    "mem_peak_bytes", "mem_per_device_argument_bytes",
+                    "mem_per_device_peak_bytes"}
         for p in payloads:
             assert p["schema"] == telemetry.SCHEMA
+            assert families <= set(p["gauges"]), p["kind"]
             # every payload renders to prometheus text
             text = telemetry.render_prometheus(p)
             assert f'kind="{p["kind"]}"' in text
@@ -686,3 +701,461 @@ def test_profiler_export_needs_recording():
     assert telemetry.finished_traces()
     assert not [e for e in profiler._P.events
                 if e.get("cat") == "trace"]
+
+
+# ================================================== ISSUE 15: introspection --
+# Compile-event stream, live memory gauges, training-step spans, and the
+# crash flight recorder.
+
+def _tiny_train_step(heartbeat=None, **kw):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(9)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu", in_units=4),
+            nn.Dense(2, in_units=8))
+    net.initialize()
+    return parallel.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        mx.optimizer.create("sgd", learning_rate=0.1),
+        heartbeat=heartbeat, **kw)
+
+
+def test_compile_stream_generation_census_and_jit_cache():
+    """The acceptance contract: one compile event per executable — the
+    site's miss count equals the static census AND the runtime jit-cache
+    count, before and after full-grid traffic; traffic itself only
+    records hits."""
+    telemetry.enable(collect=True)
+    srv = GenerationServer(PARAMS, CFG,
+                           buckets=BucketSpec(batch=(1, 2),
+                                              length=(8, 16)),
+                           n_slots=2, n_pages=33, page_size=4,
+                           max_new_tokens=4, seed=0, name="CensusGen")
+    try:
+        srv.start()
+        st = telemetry.compile_site_stats("CensusGen")
+        assert st["misses"] == srv.census() == srv.jit_cache_count()
+        assert st["pinned"] == srv.census()
+        # full-grid traffic: both length buckets, batched pairs
+        reqs = [srv.submit(np.arange(1, n + 1, dtype=np.int32),
+                           max_new_tokens=3)
+                for n in (3, 3, 12, 12)]
+        for r in reqs:
+            r.result(60)
+    finally:
+        srv.drain()
+    st = telemetry.compile_site_stats("CensusGen")
+    assert st["misses"] == srv.census() == srv.jit_cache_count()
+    assert st["hits"] > 0                      # the steady state
+    assert st["unexpected"] == 0
+    assert st["ms_total"] > 0
+    # one event RECORD per executable, each carrying the site cache size
+    evs = [e for e in telemetry.compile_events()
+           if e["site"] == "CensusGen"]
+    assert len(evs) == srv.census()
+    assert evs[-1]["n_executables"] == srv.census()
+
+
+def test_compile_stream_signature_fallback_and_unexpected_recompile():
+    """A server over an opaque apply fn tracks compiles by dispatched
+    signature; a post-warmup NEW signature (pin_signature=False) is an
+    unexpected recompile — counted, never silent."""
+    telemetry.enable()
+    srv = make_server(name="SigComp", pin_signature=False)
+    try:
+        st = telemetry.compile_site_stats("SigComp")
+        assert st["misses"] == 3               # warmup grid: b1/b2/b4
+        assert st["pinned"] == 3
+        srv(_ex(1))                            # known signature: a hit
+        st = telemetry.compile_site_stats("SigComp")
+        assert st["hits"] >= 1 and st["misses"] == 3
+        assert st["unexpected"] == 0
+        srv(_ex(1, n=5))                       # foreign shape compiles
+    finally:
+        srv.drain()
+    st = telemetry.compile_site_stats("SigComp")
+    assert st["misses"] == 4
+    assert st["unexpected"] == 1
+    assert telemetry.registry().get(
+        "compile::recompiles_unexpected").value == 1
+
+
+def test_fleet_hotswap_compile_events_share_the_jit_cache():
+    """Replica warmups against the fleet's ONE shared HotSwapApply jit
+    fn must not fabricate compile events: replica 0 records the real
+    compiles, its siblings record hits."""
+    telemetry.enable()
+    fleet = make_fleet(n=3, name="CompFleet")
+    fleet.start()
+    try:
+        r0 = telemetry.compile_site_stats("CompFleet-r0")
+        assert r0["misses"] == 3               # the real grid compiles
+        for i in (1, 2):
+            ri = telemetry.compile_site_stats(f"CompFleet-r{i}")
+            assert ri["misses"] == 0           # shared cache absorbed it
+            assert ri["hits"] == 3
+    finally:
+        fleet.drain()
+
+
+def test_costguard_entrypoint_builds_emit_census_events():
+    """The committed-entrypoint half of the acceptance contract: a
+    builder's compile events == its census == its program count."""
+    from tools.costguard import entrypoints
+
+    telemetry.enable()
+    for entry in ("serving_mlp_grid", "mlp_apply_tp1"):
+        eb = entrypoints.build(entry)
+        st = telemetry.compile_site_stats(f"costguard::{entry}")
+        assert st["misses"] == eb.census == len(eb.programs), entry
+
+
+def test_trainstep_step_spans_and_compile_events():
+    telemetry.enable(collect=True)
+    step = _tiny_train_step()
+    x = np.random.RandomState(0).randn(16, 4).astype(np.float32)
+    y = np.zeros((16,), np.int32)
+    for _ in range(3):
+        step(x, y).asnumpy()
+    st = telemetry.compile_site_stats("TrainStep")
+    assert st["misses"] == 1 and st["hits"] == 2
+    trees = [tr for tr in telemetry.finished_traces()
+             if tr.server == "TrainStep"]
+    assert len(trees) == 3
+    for tr in trees:
+        assert telemetry.audit_spans(tr) == []
+        names = {sp.name for sp in tr.spans}
+        assert {"step", "h2d", "compute"} <= names
+    snap = telemetry.registry().snapshot()
+    assert "TrainStep::step_ms" in snap["histograms"]
+    assert snap["histograms"]["TrainStep::step_ms"]["count"] == 3
+
+
+def test_trainstep_steps_untraced_when_dark():
+    step = _tiny_train_step()
+    x = np.zeros((16, 4), np.float32)
+    y = np.zeros((16,), np.int32)
+    step(x, y).asnumpy()
+    assert telemetry.finished_traces() == []
+    assert telemetry.compile_site_stats("TrainStep")["misses"] == 0
+
+
+def test_heartbeat_carries_step_fields(tmp_path):
+    hb = elastic.Heartbeat(tmp_path, rank=0, every_n_steps=50)
+    rec = hb.beat(1, last_step_ms=12.5)
+    assert rec["last_step_ms"] == 12.5
+    assert rec["compile_in_progress"] is False
+    # the compile flag flipping ALWAYS writes, whatever the cadence
+    rec = hb.beat(1, compile_in_progress=True)
+    assert rec is not None and rec["compile_in_progress"] is True
+    rec = hb.beat(2, last_step_ms=800.0)
+    assert rec is not None and rec["compile_in_progress"] is False
+    # steady state: the 50-step cadence thins unchanged-flag beats out
+    assert hb.beat(3, last_step_ms=1.0) is None
+    on_disk = elastic.read_heartbeats(tmp_path)[0]
+    assert on_disk["last_step_ms"] == 800.0
+
+
+def test_trainstep_heartbeat_gains_step_time_and_compile_flag(tmp_path):
+    hb = elastic.Heartbeat(tmp_path, rank=0)
+    step = _tiny_train_step(heartbeat=hb)
+    x = np.zeros((16, 4), np.float32)
+    y = np.zeros((16,), np.int32)
+    step(x, y).asnumpy()
+    rec = elastic.read_heartbeats(tmp_path)[0]
+    assert rec["last_step_ms"] is not None and rec["last_step_ms"] > 0
+    assert rec["compile_in_progress"] is False   # cleared post-compile
+
+
+def test_supervisor_step_ms_histogram_and_exposition():
+    sup = elastic.Supervisor(["true"], 1)
+    sup._note_heartbeat(0, {"last_step_ms": 10.0, "global_step": 5})
+    sup._note_heartbeat(0, {"last_step_ms": 10.0, "global_step": 5})
+    sup._note_heartbeat(1, {"last_step_ms": 30.0, "global_step": 5})
+    sup._note_heartbeat(0, {"last_step_ms": 20.0, "global_step": 6})
+    p = sup.telemetry()
+    assert p["histograms"]["step_ms"]["count"] == 3   # dupe folded once
+    assert "compiling_workers" in p["gauges"]
+    assert "compile_executables" in p["gauges"]       # uniform families
+    assert "mem_peak_bytes" in p["gauges"]
+
+
+def test_memory_report_stamps_exposition_gauges():
+    telemetry.enable()
+    report = {"argument_bytes": 1000, "peak_bytes": 2000,
+              "per_device": {"argument_bytes": 125, "peak_bytes": 250}}
+    srv = make_genserver(memory_report=report, name="MemGen")
+    srv.start()
+    try:
+        g = srv.telemetry()["gauges"]
+        assert g["mem_argument_bytes"] == 1000
+        assert g["mem_per_device_argument_bytes"] == 125
+        srv.stamp_memory_report({"argument_bytes": 7})
+        g = srv.telemetry()["gauges"]
+        assert g["mem_argument_bytes"] == 7
+        assert g["mem_peak_bytes"] == 0        # unstamped keys stay, zero
+    finally:
+        srv.drain()
+
+
+def test_generation_exposition_carries_registry_gauges_and_slot_pages():
+    """The ISSUE 15 satellite fix: page_occupancy/tokens_out (profiler
+    counter series) are visible in telemetry() as gauges, and per-slot
+    page occupancy lands in the slot_pages histogram at retirement."""
+    telemetry.enable()
+    srv = make_genserver(name="PageGen")
+    srv.start()
+    try:
+        srv.submit(np.array([1, 2, 3], np.int32),
+                   max_new_tokens=3).result(30)
+        pay = srv.telemetry()
+        assert pay["gauges"]["tokens_out"] == pay["counters"]["tokens_out"]
+        assert "page_occupancy" in pay["gauges"]
+        assert pay["gauges"]["used_pages"] == 0        # retired: freed
+        snap = pay["histograms"]["slot_pages"]
+        assert snap["count"] == 1                      # one retirement
+        assert snap["sum"] >= 1                        # held >= 1 page
+    finally:
+        srv.drain()
+
+
+# ------------------------------------------------------------ flight recorder
+def test_flight_ring_is_bounded():
+    fl = telemetry.flight()
+    fl.configure(limit=4, enabled=True)
+    for i in range(10):
+        fl.record("x", str(i))
+    names = [r["name"] for r in fl.records()]
+    assert names == ["6", "7", "8", "9"]
+
+
+def test_flight_dump_bundle_roundtrips_through_audit(tmp_path):
+    """The bundle is ONE JSONL file: header, ring (complete span trees
+    only), metrics snapshot — and audit_jsonl applies to it unchanged."""
+    telemetry.enable(collect=True)
+    telemetry.enable_flight(directory=tmp_path, limit=4096)
+    srv = make_server(name="FlightSrv")
+    try:
+        for i in range(4):
+            srv(_ex(i))
+    finally:
+        srv.drain()
+    telemetry.compile_event("FlightSite", key="k", ms=1.0)
+    path = telemetry.flight().dump(reason="test-dump")
+    assert path is not None and path.startswith(str(tmp_path))
+    assert telemetry.audit_jsonl(path) == {}
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert recs[0]["kind"] == "flight" and recs[0]["reason"] == "test-dump"
+    kinds = {r["kind"] for r in recs}
+    assert {"flight", "span", "compile", "metrics"} <= kinds
+    assert len(telemetry.read_spans(path)) == 4       # all four trees
+    # the metrics snapshot is the LAST line and carries the registry
+    assert recs[-1]["kind"] == "metrics"
+    assert "gauges" in recs[-1]
+
+
+def test_flight_dump_drops_rootless_trace_tails(tmp_path):
+    """Span records whose trace root was evicted from the ring must not
+    reach the bundle — a half tree would fail the audit the bundle
+    exists to pass."""
+    telemetry.enable(collect=True)
+    srv = make_server(name="EvictSrv")
+    try:
+        srv(_ex(1))
+    finally:
+        srv.drain()
+    tr = [t for t in telemetry.finished_traces()
+          if t.server == "EvictSrv"][0]
+    fl = telemetry.flight()
+    fl.configure(directory=tmp_path, limit=len(tr.spans) - 1,
+                 enabled=True)
+    for rec in tr.records():                   # root evicted by the tail
+        rec.pop("kind")
+        fl.record("span", rec.pop("name"), **rec)
+    path = fl.dump(reason="evict-test")
+    assert telemetry.read_spans(path) == {}    # rootless tail dropped
+    assert telemetry.audit_jsonl(path) == {}
+
+
+def test_breaker_open_trips_flight_dump(tmp_path):
+    telemetry.enable()
+    telemetry.enable_flight(directory=tmp_path)
+    srv = make_server(name="TripSrv",
+                      breaker=CircuitBreaker(threshold=2, base_delay=5.0))
+    try:
+        with fault.inject("serving.step", RuntimeError("dead device")):
+            for _ in range(2):
+                with pytest.raises(RuntimeError):
+                    srv(_ex(1), timeout=10)
+    finally:
+        srv.drain()
+    path = telemetry.flight().last_path
+    assert path is not None
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert recs[0]["reason"] == "breaker-open"
+    # the fault firings that killed the replica are on the record
+    assert any(r["kind"] == "fault" and r["name"] == "serving.step"
+               for r in recs)
+
+
+def test_nonfinite_abort_trips_flight_dump(tmp_path):
+    telemetry.enable(collect=True)          # the dying step is traced
+    telemetry.enable_flight(directory=tmp_path)
+    step = _tiny_train_step(skip_nonfinite=True, nonfinite_budget=1)
+    x = np.full((16, 4), np.nan, np.float32)
+    y = np.zeros((16,), np.int32)
+    with pytest.raises(elastic.NonFiniteAbortError):
+        step(x, y)
+    path = telemetry.flight().last_path
+    assert path is not None
+    header = json.loads(open(path).readline())
+    assert header["reason"] == "nonfinite-abort"
+    assert header["consecutive_skips"] == 1
+    # the bundle contains the spans of the very step that DIED (the
+    # review-pass regression: an aborting traced step leaked its open
+    # trace, so the post-mortem documented every step except the fatal
+    # one), marked with the abort error
+    recs = [json.loads(line) for line in open(path)]
+    fatal = [r for r in recs if r.get("kind") == "span"
+             and r.get("name") == "step"
+             and r.get("attrs", {}).get("error") == "NonFiniteAbortError"]
+    assert fatal, [r.get("name") for r in recs]
+
+
+def test_flight_dump_survives_concurrent_ring_feeds(tmp_path):
+    """record() and dump() are lock-serialized: a concurrent append
+    mid-snapshot must never cost the dying process its bundle (the
+    'deque mutated during iteration' review-pass regression)."""
+    fl = telemetry.flight()
+    fl.configure(directory=tmp_path, limit=256, enabled=True)
+    stop = threading.Event()
+
+    def feeder():
+        i = 0
+        while not stop.is_set():
+            fl.record("x", str(i))
+            i += 1
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    try:
+        paths = [fl.dump(reason="stress") for _ in range(50)]
+    finally:
+        stop.set()
+        t.join()
+    assert all(p is not None for p in paths)
+
+
+def test_enable_flight_resets_trip_coalescing(tmp_path):
+    """Re-arming the recorder is a fresh episode: the 1-second
+    same-reason coalesce window from a PREVIOUS episode must not
+    swallow the new episode's first trip."""
+    telemetry.enable_flight(directory=tmp_path)
+    p1 = telemetry.flight_trip("same-reason")
+    assert p1 is not None
+    telemetry.flight().enabled = False
+    telemetry.enable_flight(directory=tmp_path)
+    p2 = telemetry.flight_trip("same-reason")   # within 1s of p1
+    assert p2 is not None and p2 != p1
+
+
+def test_graceful_exit_trips_flight_dump(tmp_path):
+    import os
+    import signal
+
+    telemetry.enable_flight(directory=tmp_path)
+    with fault.GracefulExit() as g:
+        if not g.enabled:
+            pytest.skip("not on the main thread")
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 5
+        while not g.requested and time.monotonic() < deadline:
+            time.sleep(0.01)
+    assert g.requested
+    # the dump runs on a short-lived thread, NOT in the signal handler
+    # (lock re-entrance would deadlock the snapshot-then-exit path)
+    deadline = time.monotonic() + 5
+    while telemetry.flight().last_path is None \
+            and time.monotonic() < deadline:
+        time.sleep(0.01)
+    path = telemetry.flight().last_path
+    assert path is not None
+    header = json.loads(open(path).readline())
+    assert header["reason"] == "graceful-exit"
+    assert header["signum"] == int(signal.SIGTERM)
+
+
+def test_flight_dump_never_raises(tmp_path):
+    fl = telemetry.flight()
+    fl.configure(directory=tmp_path, enabled=True)
+    fl.record("x", "y")
+    # an unwritable target must yield None, not an exception — the
+    # recorder runs in dying processes (the failure-matrix contract)
+    assert fl.dump(reason="r", path="/nonexistent-dir/nope/f.jsonl") \
+        is None
+    assert fl.dump(reason="r") is not None     # and stays functional
+
+
+def test_flight_disabled_is_inert(tmp_path):
+    fl = telemetry.flight()
+    assert fl.enabled is False
+    fl.record("x", "y")
+    assert fl.records() == []
+    assert telemetry.flight_trip("anything") is None
+
+
+def test_lazy_generation_server_compiles_are_not_unexpected():
+    """A warmup=False server compiles lazily by choice: nothing is
+    pinned at start, so bring-up compiles must stay ordinary events
+    (the review-pass regression: pinning outside the warmup branch
+    froze the census at 0 and flagged every lazy compile)."""
+    telemetry.enable()
+    srv = make_genserver(name="LazyGen")
+    srv.start(warmup=False)
+    try:
+        srv.submit(np.array([1, 2, 3], np.int32),
+                   max_new_tokens=2).result(60)
+    finally:
+        srv.drain()
+    st = telemetry.compile_site_stats("LazyGen")
+    assert st["misses"] > 0                    # the lazy compiles
+    assert st["pinned"] is None
+    assert st["unexpected"] == 0
+
+
+def test_failed_new_signature_dispatch_records_no_phantom_compile():
+    """Probe-less signature tracking: a dispatch of a NEW signature
+    that RAISES proves no executable exists — recording the assumed
+    miss would double-count every retry until one succeeds."""
+    telemetry.enable()
+    srv = make_server(name="PhantomSrv", pin_signature=False)
+    try:
+        assert telemetry.compile_site_stats("PhantomSrv")["misses"] == 3
+        with fault.inject("serving.step", RuntimeError("transient")):
+            with pytest.raises(RuntimeError):
+                srv(_ex(1, n=5), timeout=10)   # new shape, step fails
+        st = telemetry.compile_site_stats("PhantomSrv")
+        assert st["misses"] == 3               # no phantom event
+        assert st["unexpected"] == 0
+        srv(_ex(1, n=5))                       # now it really compiles
+    finally:
+        srv.drain()
+    st = telemetry.compile_site_stats("PhantomSrv")
+    assert st["misses"] == 4
+    assert st["unexpected"] == 1               # past the pinned census
+
+
+def test_compile_events_registry_counter_counts_misses_only():
+    telemetry.enable()
+    telemetry.compile_event("EvSite", key="a", ms=1.0)
+    telemetry.compile_event("EvSite", key="a", cache_hit=True)
+    telemetry.compile_event("EvSite", key="a", cache_hit=True)
+    reg = telemetry.registry()
+    assert reg.get("compile::events").value == 1
+    assert reg.get("compile::cache_hits").value == 2
+    assert telemetry.compile_stats()["events"] == 1

@@ -92,7 +92,13 @@ children contained, durations attributed to within tolerance), fault
 firings land as span events, the JSONL export reconstructs the same
 clean trees, and the tracing-off path costs **< 5%** of request
 latency (per-guard cost × a generous guards-per-request budget vs the
-measured untraced per-request latency).
+measured untraced per-request latency).  The ISSUE 15 flight leg kills
+a traced generation worker mid-step with an unbounded decode fault
+storm: the breaker trip must leave a complete flight-recorder bundle
+(audit-clean span trees, the fatal ``generate.decode`` firing on
+record, a metrics snapshot, compile events == the serving census, and
+``recompiles_unexpected == 0``) while every accepted sequence still
+resolves explicitly.
 
 ``--list-modes`` prints the mode registry and exits.
 
@@ -1060,6 +1066,119 @@ def _obs_llm_leg():
     return fails
 
 
+def _obs_flight_leg():
+    """The crash flight recorder (ISSUE 15): a traced generation worker
+    is killed mid-step by a decode fault storm that trips the breaker —
+    the breaker-OPEN trigger must leave a complete post-mortem bundle
+    (audit-clean span trees, the fatal fault firing on record,
+    ``recompiles_unexpected == 0``) and every accepted sequence must
+    still resolve explicitly.  Returns failure strings."""
+    import json as _json
+    import tempfile as _tempfile
+    import threading
+
+    from mxnet_tpu import fault, serving, telemetry
+    from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
+                                                     init_causal_lm)
+
+    d = _tempfile.mkdtemp(prefix="chaos_flight_")
+    telemetry.enable_flight(directory=d, limit=4096)
+    fails = []
+    cfg = CausalLMConfig(vocab_size=48, n_layers=2, n_heads=2,
+                         head_dim=8, d_ff=32)
+    srv = serving.GenerationServer(
+        init_causal_lm(cfg, seed=5), cfg,
+        buckets=serving.BucketSpec(batch=(1,), length=(8,)),
+        n_slots=2, n_pages=17, page_size=4, max_new_tokens=6, seed=0,
+        # threshold=1: the FIRST mid-step death trips OPEN (prefill
+        # successes interleave with decode failures, so a higher
+        # threshold never sees consecutive ones on this tiny model)
+        breaker=serving.CircuitBreaker(threshold=1, base_delay=0.5),
+        name="FlightGen")
+    try:
+        srv.start()       # traced warmup: compile events == census
+
+        accepted = []
+        count_lock = threading.Lock()
+
+        def client(k):
+            rng = np.random.RandomState(k)
+            for _ in range(3):
+                try:
+                    req = srv.submit(rng.randint(1, 40, (3,))
+                                     .astype(np.int32), max_new_tokens=4)
+                    with count_lock:
+                        accepted.append(req)
+                except (serving.RejectedError, serving.ServerClosedError):
+                    pass
+                time.sleep(0.01)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(3)]
+        # an unbounded decode fault storm armed BEFORE traffic (two
+        # clean steps, then every decode step fails): the worker dies
+        # mid-generation and keeps dying until the breaker trips OPEN —
+        # THE mid-step kill the recorder exists for
+        with fault.inject("generate.decode",
+                          RuntimeError("decode storm — worker killed"),
+                          after_n=2):
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            deadline = time.monotonic() + 15
+            while srv.breaker.state_code() != 2 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)
+    finally:
+        srv.drain()
+
+    unresolved = sum(1 for r in accepted if not r.done())
+    if unresolved:
+        fails.append(f"obs flight: {unresolved} accepted sequences were "
+                     f"silently dropped")
+    bundle = telemetry.flight().last_path
+    if bundle is None:
+        fails.append("obs flight: the breaker trip left no "
+                     "flight-recorder bundle")
+        telemetry.flight().enabled = False
+        return fails
+    bad = telemetry.audit_jsonl(bundle)
+    if bad:
+        tid, problems = next(iter(bad.items()))
+        fails.append(f"obs flight: bundle has {len(bad)} bad span trees "
+                     f"(e.g. {tid}: {problems})")
+    with open(bundle) as f:
+        recs = [_json.loads(line) for line in f if line.strip()]
+    header = recs[0]
+    if header.get("kind") != "flight" \
+            or header.get("reason") != "breaker-open":
+        fails.append(f"obs flight: bundle header is {header.get('kind')}/"
+                     f"{header.get('reason')}, expected a breaker-open "
+                     f"dump")
+    fatal = [r for r in recs if r.get("kind") == "fault"
+             and r.get("name") == "generate.decode"]
+    if not fatal:
+        fails.append("obs flight: the fatal generate.decode firing is "
+                     "not in the bundle")
+    if not any(r.get("kind") == "metrics" for r in recs):
+        fails.append("obs flight: bundle carries no metrics snapshot")
+    cs = telemetry.compile_site_stats("FlightGen")
+    if cs["unexpected"] != 0:
+        fails.append(f"obs flight: {cs['unexpected']} unexpected "
+                     f"recompiles (must be 0)")
+    if cs["misses"] != srv.census():
+        fails.append(f"obs flight: {cs['misses']} compile events != "
+                     f"census {srv.census()}")
+    telemetry.flight().enabled = False
+    print(f"[chaos_check] obs flight: accepted={len(accepted)} "
+          f"bundle={os.path.basename(bundle)} records={len(recs)} "
+          f"fault_recs={len(fatal)} compile_events={cs['misses']} "
+          f"census={srv.census()} recompiles_unexpected="
+          f"{cs['unexpected']}")
+    return fails
+
+
 def _obs_overhead_leg():
     """The off-switch bound: with telemetry disabled, the serving stack
     pays one module-attribute read + branch per instrumentation site.
@@ -1120,10 +1239,12 @@ def obs_mode(args):
     try:
         fails, n_fleet = _obs_fleet_leg()
         fails += _obs_llm_leg()
+        fails += _obs_flight_leg()
     finally:
         telemetry.disable()
         telemetry.config().sink.close()
         telemetry.config().sink = None
+        telemetry.flight().enabled = False
     # the JSONL export must reconstruct to the same clean trees
     bad_jsonl = telemetry.audit_jsonl(sink_path)
     n_exported = len(telemetry.read_spans(sink_path))
@@ -1137,9 +1258,11 @@ def obs_mode(args):
             print(f"[chaos_check] FAIL: {f}")
         return 1
     print(f"[chaos_check] PASS: traced storm survived — 0 dropped "
-          f"accepted requests, 100% complete span trees on both legs "
+          f"accepted requests, 100% complete span trees on all legs "
           f"({n_exported} trees exported + JSONL audit clean), "
-          f"attribution within tolerance, off-switch overhead < 5%")
+          f"attribution within tolerance, breaker-trip flight bundle "
+          f"audit-clean with 0 unexpected recompiles, off-switch "
+          f"overhead < 5%")
     return 0
 
 

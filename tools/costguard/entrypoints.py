@@ -59,7 +59,30 @@ def build(name: str, **overrides) -> EntryBuild:
     if name not in _REGISTRY:
         raise KeyError(f"unknown entry point {name!r} "
                        f"(registered: {names()})")
-    return _REGISTRY[name](**overrides)
+    import time
+    t0 = time.perf_counter()
+    eb = _REGISTRY[name](**overrides)
+    _note_compile_events(eb, (time.perf_counter() - t0) * 1e3)
+    return eb
+
+
+def _note_compile_events(eb: EntryBuild, total_ms: float) -> None:
+    """ISSUE 15: the costguard builders are one of the compile paths the
+    telemetry compile-event stream covers — one event per lowered
+    program unit at site ``costguard::<entry>``, so
+    ``sum(events) == the entry's census`` holds here exactly like it
+    does for the runtime jit caches.  No-op while the tracer is dark;
+    never fails a build."""
+    try:
+        from mxnet_tpu import telemetry
+        if not telemetry.ACTIVE:
+            return
+        per_ms = round(total_ms / max(1, len(eb.programs)), 3)
+        for prog in eb.programs:
+            telemetry.compile_event(f"costguard::{eb.name}",
+                                    key=prog.name, ms=per_ms)
+    except Exception:  # noqa: BLE001 — observability never fails a build
+        pass
 
 
 def source_of(name: str) -> Path:
