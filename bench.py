@@ -413,7 +413,12 @@ def bench_llm():
     tensor-parallel N-way server (ISSUE 14) — the JSON line then adds
     ``per_device_bytes_GB``/``per_device_collective_KB`` from
     costguard's per-device section next to ``tp_shards``/
-    ``tp_collectives``."""
+    ``tp_collectives``.  ``MXTPU_BENCH_PREFIX=1`` switches traffic to
+    the 90%-shared-prefix shape (ISSUE 16): every request repeats one
+    common system prompt plus a short random tail, so CoW prefix
+    sharing carries the load — the line then adds ``page_bytes_per_seq``
+    (pool bytes actually CHARGED per sequence), ``pages_shared_mapped``
+    and ``cow_faults``, wedge-tolerant like the cost fields."""
     jax = _setup()
 
     from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
@@ -442,7 +447,26 @@ def bench_llm():
         name="BenchGen")
     srv.start()                       # warmup compiles the whole census
 
+    prefix_mode = os.environ.get("MXTPU_BENCH_PREFIX", "").lower() \
+        not in ("", "0", "false")
     rng = np.random.RandomState(0)
+    if prefix_mode:
+        # one system prompt shared by EVERY request: 90% of a fixed
+        # prompt length, covering whole pages so the prefix index can
+        # map them (the 10% tail is per-request random)
+        plen = page_size * 5 // 2                     # 40 cpu / 160 tpu
+        shared = rng.randint(0, cfg.vocab_size,
+                             size=int(plen * 0.9)).astype(np.int32)
+
+        def make_prompt():
+            tail = rng.randint(0, cfg.vocab_size,
+                               size=plen - len(shared)).astype(np.int32)
+            return np.concatenate([shared, tail])
+    else:
+        def make_prompt():
+            return rng.randint(0, cfg.vocab_size,
+                               size=int(rng.randint(4, 60))) \
+                .astype(np.int32)
     occupancy = []
     stop = [False]
 
@@ -460,10 +484,7 @@ def bench_llm():
     try:
         try:
             t0 = time.perf_counter()
-            reqs = [srv.submit(rng.randint(0, cfg.vocab_size,
-                                           size=int(rng.randint(4, 60)))
-                               .astype(np.int32))
-                    for _ in range(n_requests)]
+            reqs = [srv.submit(make_prompt()) for _ in range(n_requests)]
             for r in reqs:
                 r.result(timeout=600)
             dt = time.perf_counter() - t0
@@ -500,10 +521,11 @@ def bench_llm():
                 p_avals, pool, pool, sds((n_slots,), jnp.int32),
                 sds((n_slots,), jnp.int32), sds((n_slots,), jnp.bool_),
                 sds((n_slots, srv.pages_per_seq), jnp.int32),
+                sds((n_slots,), jnp.int32), sds((n_slots,), jnp.int32),
                 sds((2,), jnp.uint32), sds((n_slots,), jnp.float32),
                 sds((n_slots,), jnp.int32))
             rep = unit_report(lowered.compile(),
-                              n_args=len(jax.tree.leaves(p_avals)) + 9)
+                              n_args=len(jax.tree.leaves(p_avals)) + 11)
             pd = rep.get("per_device", {})
             fields = {
                 "flops_T": round(rep.get("flops", 0.0) / 1e12, 6),
@@ -517,6 +539,22 @@ def bench_llm():
             }
         except Exception:   # noqa: BLE001 — wedged backend mid-AOT;
             pass            # the throughput line still ships
+    prefix_fields = {}
+    if prefix_mode:
+        try:    # wedge-tolerant like the cost fields: stats are host
+            #   counters, but never let accounting kill the BENCH line
+            page_bytes = (2 * cfg.n_layers * page_size * cfg.n_heads
+                          * cfg.head_dim * 4)
+            prefix_fields = {
+                "prefix_shared_frac": 0.9,
+                "page_bytes_per_seq": round(
+                    st["pages_charged"] * page_bytes
+                    / max(st["completed"], 1)),
+                "pages_shared_mapped": st["pages_shared_mapped"],
+                "cow_faults": st["cow_faults"],
+            }
+        except Exception:   # noqa: BLE001
+            pass
     tok_s = st["tokens_out"] / dt / len(jax.devices())
     print(json.dumps({
         "metric": _METRIC_NAMES["llm"],
@@ -532,6 +570,7 @@ def bench_llm():
         "tp_shards": tp_shards,
         "tp_collectives": tp_collectives,
         **fields,
+        **prefix_fields,
         **trace_fields,
         **_compile_fields(),
     }))

@@ -46,6 +46,7 @@ from ...ops.registry import OPS
 
 __all__ = ["CausalLMConfig", "init_causal_lm", "prefill_forward",
            "sequence_logits", "decode_hidden", "lm_logits",
+           "draft_config", "window_logits", "verify_logits",
            "tp_param_specs", "tp_permute_qkv", "tp_shard_params",
            "tp_validate"]
 
@@ -206,6 +207,68 @@ def prefill_forward(params, config: CausalLMConfig, tokens, lengths,
     last = jnp.clip(lengths - 1, 0, L - 1)
     h_last = h[jnp.arange(b), last]               # [b, d]
     return lm_logits(params, h_last), ks, vs
+
+
+def draft_config(config: CausalLMConfig, *, n_layers=1, n_heads=None,
+                 head_dim=None, d_ff=None) -> CausalLMConfig:
+    """The DRAFT-model constructor for speculative decoding: a smaller
+    config in the same family sharing the target's vocabulary (the
+    acceptance test compares distributions over the same token space —
+    a vocab mismatch can never be exact, so it is not a parameter).
+    Defaults shrink depth only; width knobs override the target's."""
+    return CausalLMConfig(
+        vocab_size=config.vocab_size,
+        n_layers=int(n_layers),
+        n_heads=config.n_heads if n_heads is None else int(n_heads),
+        head_dim=config.head_dim if head_dim is None else int(head_dim),
+        d_ff=config.d_ff if d_ff is None else int(d_ff))
+
+
+def window_logits(params, config: CausalLMConfig, tokens, n_valid,
+                  reduce=None):
+    """Last-position next-token logits over a RIGHT-ALIGNED dense token
+    window ``tokens [S, W]`` with ``n_valid [S]`` trailing entries
+    valid — the draft model's forward in the speculative verify step:
+    no KV cache, no page pool, just a bounded re-read of recent
+    context.  Right alignment keeps the newest token at position
+    ``W - 1``, so "the last position" needs no gather; the mask
+    invalidates the ``W - n_valid`` leading slots as KEYS, and with a
+    causal mask on top the last position attends to exactly the valid
+    suffix.  Returns ``[S, vocab]``."""
+    S, W = tokens.shape
+    heads = params["wqkv"].shape[-1] // 3 // config.head_dim
+    h = params["embed"][tokens]                           # [S, W, d]
+    mask = (jnp.arange(W)[None, :]
+            >= (W - n_valid)[:, None]).astype(jnp.float32)[:, None,
+                                                           None, :]
+    for layer in range(config.n_layers):
+        x = _ln(h, params["ln1_s"][layer], params["ln1_b"][layer])
+        qkv = x @ params["wqkv"][layer] + params["bqkv"][layer]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        ctx = _mha(q, k, v, mask=mask, heads=heads, causal=True,
+                   dropout=0.0, training=False)
+        h = _layer_tail(params, layer, h, ctx, reduce)
+    return lm_logits(params, h[:, -1])
+
+
+def verify_logits(params, config: CausalLMConfig, tokens, attend,
+                  reduce=None):
+    """Next-token logits at EVERY position of a candidate block
+    ``tokens [S, K1]`` — the TARGET model's forward in the speculative
+    verify step.  The ``S * K1`` lanes flatten into one
+    ``decode_hidden`` stack pass; ``attend(layer, q, k, v) -> ctx`` is
+    the caller's cache hook over the flattened lanes (it owns the paged
+    pool writes and per-lane causal masking via attention lengths —
+    exactly the ``decode_hidden`` contract, plus the layer index so one
+    hook serves the whole stack).  Returns ``[S, K1, vocab]``."""
+    S, K1 = tokens.shape
+    h = params["embed"][tokens].reshape(S * K1, -1)
+    for layer in range(config.n_layers):
+        h = decode_hidden(
+            params, layer, h,
+            (lambda q, k, v, _l=layer: attend(_l, q, k, v)),
+            reduce=reduce)
+    return lm_logits(params, h).reshape(S, K1, -1)
 
 
 def sequence_logits(params, config: CausalLMConfig, tokens,

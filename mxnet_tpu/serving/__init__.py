@@ -33,7 +33,7 @@ from .fleet import (ServingFleet, ReplicaGroup, HotSwapApply,
                     WeightUpdater, SnapshotRejectedError,
                     UpdateRolledBackError, validate_params)
 from .generate import (GenerationServer, PageAllocator,
-                       PoolExhaustedError)
+                       PoolExhaustedError, prefix_admission_plan)
 from .autoscale import FleetAutoscaler, ScalingPolicy
 
 __all__ = ["InferenceServer", "module_apply", "BucketSpec",
@@ -44,4 +44,5 @@ __all__ = ["InferenceServer", "module_apply", "BucketSpec",
            "ServingFleet", "ReplicaGroup", "HotSwapApply", "WeightUpdater",
            "SnapshotRejectedError", "UpdateRolledBackError",
            "validate_params", "GenerationServer", "PageAllocator",
-           "PoolExhaustedError", "FleetAutoscaler", "ScalingPolicy"]
+           "PoolExhaustedError", "prefix_admission_plan",
+           "FleetAutoscaler", "ScalingPolicy"]

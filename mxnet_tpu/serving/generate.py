@@ -73,7 +73,8 @@ from .breaker import CircuitBreaker
 __all__ = ["PageAllocator", "PoolExhaustedError", "GenerationServer",
            "build_decode_step", "build_prefill_step",
            "build_prefill_kv_step", "build_handoff_step",
-           "build_dense_decode_step"]
+           "build_dense_decode_step", "build_verify_step",
+           "prefix_admission_plan"]
 
 
 class PoolExhaustedError(RuntimeError):
@@ -84,7 +85,7 @@ class PoolExhaustedError(RuntimeError):
 
 
 class PageAllocator:
-    """Host-side free list over the fixed page pool.
+    """Host-side REFCOUNTED free list over the fixed page pool.
 
     Page 0 is reserved as the *write sink*: masked/inactive lanes of the
     compiled programs scatter their K/V there, so the executables never
@@ -92,7 +93,20 @@ class PageAllocator:
     methods are thread-safe (one lock, no blocking under it); the free
     list is LIFO, so a freed sequence's pages are immediately reused —
     fragmentation cannot accrete by construction (any free page serves
-    any sequence; there is nothing contiguous to fragment)."""
+    any sequence; there is nothing contiguous to fragment).
+
+    **Prefix sharing (ISSUE 16).**  Every live page carries a refcount:
+    ``alloc`` hands out pages at refcount 1, ``share`` maps additional
+    holders onto already-resident pages (a prompt whose leading blocks
+    are already cached pays NOTHING for them), and ``free`` decrements —
+    a page returns to the free list only when its LAST holder lets go.
+    The allocator stays layout-free (a page id addresses every tp
+    shard's stripe of that page at once), so sharing composes with
+    head-sharded pools with no extra bookkeeping.  ``free`` on a page
+    this allocator does not consider live (double-free, or an id that
+    was never allocated) raises ``ValueError`` instead of silently
+    corrupting the free list — load-bearing once refcounts arbitrate
+    page lifetime across sequences."""
 
     def __init__(self, n_pages, page_size):
         if n_pages < 2:
@@ -104,6 +118,7 @@ class PageAllocator:
         self.page_size = int(page_size)
         self._lock = threading.Lock()
         self._free = list(range(1, self.n_pages))   # LIFO tail = next out
+        self._refs = {}                             # page -> live refcount
 
     @property
     def allocatable(self):
@@ -121,25 +136,105 @@ class PageAllocator:
     def alloc(self, n_pages):
         """Take ``n_pages`` pages or raise ``PoolExhaustedError`` (taking
         nothing — allocation is all-or-nothing so a half-admitted
-        sequence can never strand pages)."""
+        sequence can never strand pages).  Fresh pages start at
+        refcount 1."""
         n = int(n_pages)
+        if n <= 0:
+            return []     # a fully shared prompt allocates nothing
         with self._lock:
             if n > len(self._free):
                 raise PoolExhaustedError(
                     f"need {n} pages, {len(self._free)} free "
                     f"(pool {self.allocatable})")
             taken, self._free[-n:] = self._free[-n:], []
-            return taken if n else []
+            for p in taken:
+                self._refs[p] = 1
+            return taken
+
+    def share(self, pages):
+        """Add one holder to each of ``pages`` (all must be live) —
+        the prefix-sharing mapping: the new sequence holds the SAME
+        resident pages instead of allocating copies.  Raises
+        ``ValueError`` on a page that is not live (the prefix index
+        may only hand out pages somebody still holds)."""
+        with self._lock:
+            for p in pages:
+                if p not in self._refs:
+                    raise ValueError(
+                        f"PageAllocator.share: page {p} is not live — "
+                        f"the prefix index handed out a freed page")
+            for p in pages:
+                self._refs[p] += 1
+        return list(pages)
+
+    def refcount(self, page):
+        """Live holders of ``page`` (0 when free/unknown)."""
+        with self._lock:
+            return self._refs.get(int(page), 0)
+
+    def shared_pages(self):
+        """Pages currently held by MORE than one sequence."""
+        with self._lock:
+            return sum(1 for c in self._refs.values() if c > 1)
+
+    def extra_refs(self):
+        """Total holders beyond the first, over all live pages — the
+        number of page copies prefix sharing made unnecessary
+        (``bytes_saved_by_sharing`` = this x page bytes)."""
+        with self._lock:
+            return sum(c - 1 for c in self._refs.values() if c > 1)
+
+    def live_pages(self):
+        """Count of live (allocated, refcount >= 1) pages."""
+        with self._lock:
+            return len(self._refs)
 
     def free(self, pages):
-        """Return pages to the pool (idempotence is the caller's job —
-        the scheduler frees a sequence's pages exactly once, at
-        retirement or eviction)."""
+        """Drop one holder from each of ``pages``; a page whose LAST
+        holder lets go returns to the LIFO free list.  Returns the list
+        of pages actually released (the caller's prefix index drops
+        exactly those).  A page with no live refcount — a double free,
+        or an id never allocated — raises ``ValueError`` with nothing
+        freed: silently extending the free list would hand the same
+        page to two sequences and corrupt both caches."""
         with self._lock:
-            self._free.extend(pages)
+            drops = {}
+            for p in pages:
+                drops[p] = drops.get(p, 0) + 1
+            for p, n in drops.items():
+                if self._refs.get(p, 0) < n:
+                    raise ValueError(
+                        f"PageAllocator.free: page {p} is not live "
+                        f"(double free, or never allocated) — refusing "
+                        f"to corrupt the free list")
+            released = []
+            for p in pages:
+                self._refs[p] -= 1
+                if self._refs[p] == 0:
+                    del self._refs[p]
+                    self._free.append(p)
+                    released.append(p)
+            return released
 
 
 # --------------------------------------------------------------- samplers --
+def _scaled_masked(logits, temps, topks):
+    """Temperature-scaled, top-k-masked logits — the SHARED sampling
+    transform: ``softmax`` of this is each row's sampling distribution.
+    Factored out of ``_sample_tokens`` because the speculative verify
+    step must evaluate the SAME distribution twice (the draft's ``q``
+    and the target's ``p``) for the acceptance ratio to be exact."""
+    import jax.numpy as jnp
+
+    vocab = logits.shape[-1]
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    order = jnp.sort(scaled, axis=-1)[:, ::-1]          # descending
+    kidx = jnp.clip(topks - 1, 0, vocab - 1)
+    thr = jnp.take_along_axis(order, kidx[:, None], axis=1)
+    cut = (topks[:, None] > 0) & (scaled < thr)
+    return jnp.where(cut, jnp.asarray(-1e30, scaled.dtype), scaled)
+
+
 def _sample_tokens(logits, key, temps, topks):
     """Per-slot next-token choice inside the compiled program: greedy
     where ``temps == 0``, temperature softmax-sampling elsewhere, with
@@ -149,14 +244,9 @@ def _sample_tokens(logits, key, temps, topks):
     import jax
     import jax.numpy as jnp
 
-    slots, vocab = logits.shape
+    slots = logits.shape[0]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    order = jnp.sort(scaled, axis=-1)[:, ::-1]          # descending
-    kidx = jnp.clip(topks - 1, 0, vocab - 1)
-    thr = jnp.take_along_axis(order, kidx[:, None], axis=1)
-    cut = (topks[:, None] > 0) & (scaled < thr)
-    masked = jnp.where(cut, jnp.asarray(-1e30, scaled.dtype), scaled)
+    masked = _scaled_masked(logits, temps, topks)
     keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
         jnp.arange(slots, dtype=jnp.uint32))
     drawn = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
@@ -192,14 +282,22 @@ def build_decode_step(config, page_size, attention_impl=None, mesh=None,
 
     Signature (all shapes configuration constants):
       ``(params, k_pool, v_pool, tokens[S], lengths[S], active[S],
-      tables[S, P], key, temps[S], topks[S])`` →
-      ``(next_tokens[S], k_pool, v_pool)``.
+      tables[S, P], cow_src[S], cow_dst[S], key, temps[S],
+      topks[S])`` → ``(next_tokens[S], k_pool, v_pool)``.
 
     ``lengths[s]`` is the slot's cache occupancy BEFORE this step; the
     input token's K/V is written at position ``lengths[s]`` (page
     ``tables[s, lengths[s] // page_size]``), inactive slots sink to
     page 0, and attention covers ``lengths[s] + 1`` positions.  Pools
     are donated by the caller, so the update is in-place on device.
+
+    ``cow_src``/``cow_dst`` are the copy-on-write fault lanes (ISSUE
+    16): before anything else the program copies page ``cow_src[s]``
+    onto page ``cow_dst[s]`` in both pools — the in-graph K/V page copy
+    of a sequence diverging from a shared prefix, already remapped in
+    ``tables`` by the host.  Slots without a fault pass ``(0, 0)``, a
+    self-copy of the sink page — so the copy is ALWAYS part of the one
+    pinned program and a CoW fault can never compile anything.
 
     With ``mesh`` (a ``tp_axis`` mesh) the SAME program lowers once
     over the mesh as one ``shard_map``: each device owns a head shard
@@ -235,8 +333,15 @@ def build_decode_step(config, page_size, attention_impl=None, mesh=None,
                                           mode=tp_collectives)
 
     def decode_step(params, k_pool, v_pool, tokens, lengths, active,
-                    tables, key, temps, topks):
+                    tables, cow_src, cow_dst, key, temps, topks):
         slots = tokens.shape[0]
+        # CoW fault lanes first: dst pages take on src pages' content
+        # BEFORE this step's writes/reads (faultless slots self-copy
+        # the page-0 sink).  The gather reads the pre-step pool, so a
+        # lane whose src page was concurrently recycled still copies
+        # the prefix content it diverged from.
+        k_pool = k_pool.at[:, cow_dst].set(k_pool[:, cow_src])
+        v_pool = v_pool.at[:, cow_dst].set(v_pool[:, cow_src])
         h = params["embed"][tokens]                     # [S, d]
         pos = lengths
         page = jnp.take_along_axis(tables, (pos // page_size)[:, None],
@@ -263,7 +368,7 @@ def build_decode_step(config, page_size, attention_impl=None, mesh=None,
     if mesh is None:
         return decode_step
     return wrap(decode_step,
-                in_specs=(pspecs, pool_spec, pool_spec) + (repl,) * 7,
+                in_specs=(pspecs, pool_spec, pool_spec) + (repl,) * 9,
                 out_specs=(repl, pool_spec, pool_spec))
 
 
@@ -451,12 +556,242 @@ def build_dense_decode_step(config, max_ctx, attention_impl=None):
     return dense_step
 
 
+def build_verify_step(config, draft_cfg, page_size, spec_k, window,
+                      attention_impl=None, mesh=None, tp_axis="tp",
+                      tp_collectives="f32"):
+    """The ONE speculative-decoding executable: a small draft LM
+    proposes ``spec_k`` tokens and the target model scores all
+    ``spec_k + 1`` positions in the SAME compiled program — the census
+    grows by exactly one whatever the traffic does.
+
+    Signature (all shapes configuration constants):
+      ``(params, draft_params, k_pool, v_pool, tokens[S],
+      window[S, W], n_valid[S], lengths[S], active[S], tables[S, P],
+      cow_src[S], cow_dst[S], key, temps[S], topks[S])`` →
+      ``(emitted[S, spec_k + 1], n_accept[S], k_pool, v_pool)``.
+
+    Per slot the program (1) applies the CoW fault copy exactly like
+    ``build_decode_step``, (2) runs the draft ``spec_k`` times over a
+    right-aligned dense token window (``window``/``n_valid`` — the
+    draft needs no pool), sampling proposal ``d_i`` from the SAME
+    tempered/top-k distribution family as the target, (3) flattens the
+    ``spec_k + 1`` candidate positions of all slots into ``S*(k+1)``
+    lanes of the paged target forward — K/V for every lane written at
+    ``lengths[s] + i``, attention masked to ``lengths[s] + i + 1``, so
+    causality per lane is exact — and (4) accepts a leading run of
+    proposals.  Greedy slots accept while ``d_i`` equals the target
+    argmax (token-identical to plain decode by construction); sampling
+    slots accept ``d_i`` with probability ``min(1, p_i(d_i)/q_i(d_i))``
+    and on rejection draw from ``normalize(max(p_i - q_i, 0))``
+    (all-accepted slots draw the bonus token from ``p_k``) — the
+    Leviathan/Chen speculative-sampling identity, so the emitted
+    process is distribution-EXACT whatever the draft proposes.
+
+    ``emitted[s, :n_accept[s] + 1]`` are the step's real tokens (the
+    ``+1`` is the correction/bonus, which becomes the next pending
+    token); later entries are dead lanes.  K/V written past the
+    accepted run is stale but masked — ``lengths`` advances only over
+    accepted tokens, and the next step overwrites those positions.
+
+    With ``mesh`` the target forward shards exactly like
+    ``build_decode_step`` (head-parallel pools, Megatron weights,
+    ``tp_collectives`` wire format); the draft params stay replicated —
+    a draft small enough to speculate with is small enough to
+    replicate."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..gluon.model_zoo.causal_lm import (init_causal_lm,
+                                             verify_logits, window_logits)
+    from ..ops.paged_attention import paged_decode_attention
+    from ..parallel.quantize import (ACTIVATION_REDUCE_MODES,
+                                     all_reduce_activations)
+
+    if tp_collectives not in ACTIVATION_REDUCE_MODES:
+        raise ValueError(f"tp_collectives={tp_collectives!r} not in "
+                         f"{ACTIVATION_REDUCE_MODES}")
+    if int(spec_k) < 1:
+        raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+    if draft_cfg.vocab_size != config.vocab_size:
+        raise ValueError(
+            f"draft vocab {draft_cfg.vocab_size} != target vocab "
+            f"{config.vocab_size} — speculative acceptance compares "
+            f"distributions over the SAME token space")
+    k = int(spec_k)
+    K1 = k + 1
+    n_layers = config.n_layers
+    heads, head_dim = config.n_heads, config.head_dim
+    if mesh is None:
+        heads_l, reduce_fn = heads, None
+    else:
+        shards, heads_l, pspecs, pool_spec, repl, wrap = _tp_pieces(
+            config, mesh, tp_axis)
+        # the draft is replicated: every leaf gets the empty spec (its
+        # key set comes from an eval_shape init — zero device work)
+        draft_pspecs = {name: repl for name in jax.eval_shape(
+            lambda: init_causal_lm(draft_cfg, 0))}
+
+        def reduce_fn(x):
+            return all_reduce_activations(x, tp_axis, shards,
+                                          mode=tp_collectives)
+
+    def verify_step(params, draft_params, k_pool, v_pool, tokens, window,
+                    n_valid, lengths, active, tables, cow_src, cow_dst,
+                    key, temps, topks):
+        S = tokens.shape[0]
+        W = window.shape[1]
+        # (1) CoW fault lanes, exactly as in the decode step
+        k_pool = k_pool.at[:, cow_dst].set(k_pool[:, cow_src])
+        v_pool = v_pool.at[:, cow_dst].set(v_pool[:, cow_src])
+
+        # (2) draft proposes k tokens from the dense right-aligned
+        # window (pool-free; the draft runs replicated under tp).  q_i
+        # is the proposal distribution the acceptance ratio divides by
+        # — the SAME tempered/top-k transform the target uses.
+        dkey = jax.random.fold_in(key, 1)
+        drafts, qprobs = [], []
+        win, nv = window, n_valid
+        for i in range(k):
+            lg = window_logits(draft_params, draft_cfg, win, nv)
+            masked = _scaled_masked(lg, temps, topks)
+            qprobs.append(jax.nn.softmax(masked, axis=-1))
+            keys_i = jax.vmap(
+                lambda s, _i=i: jax.random.fold_in(
+                    jax.random.fold_in(dkey, _i), s))(
+                jnp.arange(S, dtype=jnp.uint32))
+            drawn = jax.vmap(jax.random.categorical)(
+                keys_i, masked).astype(jnp.int32)
+            d_i = jnp.where(temps > 0.0, drawn,
+                            jnp.argmax(lg, axis=-1).astype(jnp.int32))
+            drafts.append(d_i)
+            win = jnp.concatenate([win[:, 1:], d_i[:, None]], axis=1)
+            nv = jnp.minimum(nv + 1, W)
+
+        # (3) ONE target forward over S*(k+1) flattened lanes: lane
+        # (s, i) holds candidate token i of slot s at position
+        # lengths[s] + i.  All lanes write K/V first, then attend with
+        # att_len = pos + 1 — later lanes see earlier candidates,
+        # earlier lanes mask later writes: per-lane causality is exact.
+        T = jnp.stack([tokens] + drafts, axis=1)          # [S, K1]
+        lanes = S * K1
+        pos_l = (lengths[:, None]
+                 + jnp.arange(K1)[None, :]).reshape(lanes)
+        tables_l = jnp.repeat(tables, K1, axis=0)         # [lanes, P]
+        active_l = jnp.repeat(active, K1)
+        page_l = jnp.take_along_axis(
+            tables_l, (pos_l // page_size)[:, None], axis=1)[:, 0]
+        page_l = jnp.where(active_l, page_l, 0)           # sink inactive
+        off_l = pos_l % page_size
+        att_len = jnp.where(active_l, pos_l + 1, 0)
+
+        def attend(_l, q, kk, vv):
+            nonlocal k_pool, v_pool
+            kk = kk.reshape(lanes, heads_l, head_dim)
+            vv = vv.reshape(lanes, heads_l, head_dim)
+            q = q.reshape(lanes, heads_l, head_dim)
+            k_pool = k_pool.at[_l, page_l, off_l].set(kk)
+            v_pool = v_pool.at[_l, page_l, off_l].set(vv)
+            return paged_decode_attention(q, k_pool[_l], v_pool[_l],
+                                          tables_l, att_len,
+                                          impl=attention_impl)
+        logits = verify_logits(params, config, T, attend,
+                               reduce=reduce_fn)          # [S, K1, V]
+
+        # (4) leading-run acceptance, both arms always computed
+        vocab = logits.shape[-1]
+        d_all = jnp.stack(drafts, axis=1)                 # [S, k]
+        q_all = jnp.stack(qprobs, axis=1)                 # [S, k, V]
+        masked_all = _scaled_masked(
+            logits.reshape(S * K1, vocab),
+            jnp.repeat(temps, K1), jnp.repeat(topks, K1)
+        ).reshape(S, K1, vocab)
+        p_all = jax.nn.softmax(masked_all, axis=-1)       # [S, K1, V]
+        tgt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        a_greedy = jnp.cumprod(
+            (d_all == tgt_greedy[:, :k]).astype(jnp.int32),
+            axis=1).sum(axis=1)
+        p_d = jnp.take_along_axis(p_all[:, :k], d_all[:, :, None],
+                                  axis=2)[..., 0]
+        q_d = jnp.take_along_axis(q_all, d_all[:, :, None],
+                                  axis=2)[..., 0]
+        ukeys = jax.vmap(lambda s: jax.random.fold_in(
+            jax.random.fold_in(key, 2), s))(
+            jnp.arange(S, dtype=jnp.uint32))
+        u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(ukeys)
+        a_sample = jnp.cumprod(
+            (u <= p_d / jnp.maximum(q_d, 1e-30)).astype(jnp.int32),
+            axis=1).sum(axis=1)
+        a = jnp.where(temps > 0.0, a_sample, a_greedy).astype(jnp.int32)
+
+        # correction at the first rejection (residual p - q, renormed;
+        # a zero residual means p == q there — fall back to p), bonus
+        # from p_k when everything was accepted
+        resid = jnp.maximum(p_all[:, :k] - q_all, 0.0)
+        rsum = resid.sum(axis=-1, keepdims=True)
+        resid = jnp.where(rsum > 0.0, resid / jnp.maximum(rsum, 1e-30),
+                          p_all[:, :k])
+        corr_dist = jnp.concatenate([resid, p_all[:, k:]], axis=1)
+        ckeys = jax.vmap(lambda i: jax.random.fold_in(
+            jax.random.fold_in(key, 3), i))(
+            jnp.arange(lanes, dtype=jnp.uint32))
+        corr_drawn = jax.vmap(jax.random.categorical)(
+            ckeys, jnp.log(jnp.maximum(
+                corr_dist.reshape(lanes, vocab), 1e-38))
+        ).astype(jnp.int32).reshape(S, K1)
+        corr = jnp.where(temps[:, None] > 0.0, corr_drawn, tgt_greedy)
+        d_ext = jnp.concatenate(
+            [d_all, jnp.zeros((S, 1), jnp.int32)], axis=1)
+        j = jnp.arange(K1)[None, :]
+        emitted = jnp.where(j < a[:, None], d_ext, corr)
+        return emitted, a, k_pool, v_pool
+
+    if mesh is None:
+        return verify_step
+    return wrap(verify_step,
+                in_specs=(pspecs, draft_pspecs, pool_spec, pool_spec)
+                + (repl,) * 11,
+                out_specs=(repl, repl, pool_spec, pool_spec))
+
+
+def prefix_admission_plan(n_pages, page_size, prompt_len, max_new,
+                          shared_prefix_len):
+    """Worst-case-fit admission math under prefix sharing — the pure
+    arithmetic the scheduler's budgeting implements and the costguard
+    ``llm_admission_*`` golden pair pins (docs/api.md "LLM serving").
+
+    A sequence's worst case is ``pages_for(prompt_len + max_new)``
+    pages.  With a resident shared prefix of ``shared_prefix_len``
+    tokens, its leading FULL blocks map onto already-resident pages at
+    zero cost, so admission charges only the ``charged_pages``
+    remainder — the first holder of the prefix still pays in full.
+    Returns the per-sequence page counts and the admissible concurrent
+    sequences with and without sharing at this pool size."""
+    ps = int(page_size)
+    pool = int(n_pages) - 1                   # page 0 is the write sink
+    total = -(-(int(prompt_len) + int(max_new)) // ps)
+    shared = min(int(shared_prefix_len) // ps,
+                 int(prompt_len) // ps)
+    charged = total - shared
+    unshared = pool // total if total else 0
+    if pool < total:
+        with_sharing = 0
+    elif charged == 0:
+        with_sharing = pool                   # every follower is free
+    else:
+        with_sharing = 1 + (pool - total) // charged
+    return {"pages_per_seq": total, "shared_pages": shared,
+            "charged_pages": charged, "admissible_unshared": unshared,
+            "admissible_shared": with_sharing,
+            "multiplier": with_sharing / max(unshared, 1)}
+
+
 # ---------------------------------------------------------------- scheduler --
 class _Seq:
     """Decode-loop-private state of one admitted sequence."""
 
     __slots__ = ("req", "prompt", "max_new", "temp", "top_k", "slot",
-                 "pages", "cached", "out", "stamp", "ran", "priority")
+                 "pages", "cached", "out", "stamp", "ran", "priority",
+                 "shared_n")
 
     def __init__(self, req, prompt, max_new, temp, top_k, priority=0):
         self.req = req
@@ -471,6 +806,7 @@ class _Seq:
         self.out = []            # generated token ids (EOS excluded)
         self.stamp = 0.0         # admission order — eviction picks youngest
         self.ran = False         # ever prefilled (survives preemption)
+        self.shared_n = 0        # leading pages mapped from the prefix index
 
 
 class GenerationServer:
@@ -543,13 +879,34 @@ class GenerationServer:
                  default_deadline=None, max_new_tokens=32, eos_id=None,
                  seed=0, attention_impl=None, prefill_workers=0,
                  qos=None, tp_shards=1, tp_collectives="f32",
-                 memory_report=None, name="GenerationServer"):
+                 draft=None, draft_config=None, spec_k=3,
+                 spec_window=16, memory_report=None,
+                 name="GenerationServer"):
         import jax
         import jax.numpy as jnp
 
         from ..parallel.quantize import ACTIVATION_REDUCE_MODES
 
         self.config = config
+        # speculative decoding (ISSUE 16): a draft model switches the
+        # scheduler's step from the decode program to the verify
+        # program — spec_k proposals scored per step, output
+        # distribution exact (greedy: token-identical)
+        self._spec_k = int(spec_k)
+        self._spec_window = int(spec_window)
+        self._draft_cfg = draft_config
+        if draft is not None:
+            if draft_config is None:
+                raise ValueError(f"{name}: draft= needs draft_config= "
+                                 f"(the draft's CausalLMConfig)")
+            if draft_config.vocab_size != config.vocab_size:
+                raise ValueError(
+                    f"{name}: draft vocab {draft_config.vocab_size} != "
+                    f"target vocab {config.vocab_size}")
+            if self._spec_k < 1:
+                raise ValueError(f"{name}: spec_k must be >= 1")
+            if self._spec_window < 1:
+                raise ValueError(f"{name}: spec_window must be >= 1")
         self.tp_shards = int(tp_shards)
         if tp_collectives not in ACTIVATION_REDUCE_MODES:
             raise ValueError(
@@ -584,9 +941,12 @@ class GenerationServer:
         self.alloc = PageAllocator(n_pages, page_size)
         # per-sequence page-table width: enough for the longest prompt
         # bucket plus the default generation budget (the table is a
-        # configuration constant — it shapes the compiled programs)
+        # configuration constant — it shapes the compiled programs);
+        # speculative mode adds spec_k — the verify step writes k
+        # lookahead positions past the pending token
         if max_context is None:
-            max_context = max(self.buckets.length) + int(max_new_tokens)
+            max_context = max(self.buckets.length) + int(max_new_tokens) \
+                + (self._spec_k if draft is not None else 0)
         if max_context < max(self.buckets.length) + 1:
             raise ValueError(
                 f"{name}: max_context {max_context} cannot hold the "
@@ -616,6 +976,27 @@ class GenerationServer:
                               attention_impl, mesh=self._mesh,
                               tp_collectives=self.tp_collectives),
             donate_argnums=(1, 2))
+        if draft is not None:
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                # the draft replicates over the mesh (tiny by design)
+                rep = NamedSharding(self._mesh, PartitionSpec())
+                self._draft_params = {
+                    kname: jax.device_put(jnp.asarray(v), rep)
+                    for kname, v in draft.items()}
+            else:
+                self._draft_params = jax.tree.map(jnp.asarray, draft)
+            self._verify = jax.jit(
+                build_verify_step(config, draft_config,
+                                  self.alloc.page_size, self._spec_k,
+                                  self._spec_window, attention_impl,
+                                  mesh=self._mesh,
+                                  tp_collectives=self.tp_collectives),
+                donate_argnums=(2, 3))
+        else:
+            self._draft_params = None
+            self._verify = None
         self._n_prefill_workers = int(prefill_workers)
         if self._n_prefill_workers > 0:
             # disaggregated: pool-free prefill grid + ONE handoff scatter
@@ -645,6 +1026,19 @@ class GenerationServer:
                                 np.int32)
         self._temps = np.zeros((self.n_slots,), np.float32)
         self._topks = np.zeros((self.n_slots,), np.int32)
+        # CoW fault lanes, reset each step; (0, 0) = inert sink self-copy
+        self._cow_src = np.zeros((self.n_slots,), np.int32)
+        self._cow_dst = np.zeros((self.n_slots,), np.int32)
+        # speculative draft context: right-aligned token windows
+        self._window = np.zeros((self.n_slots, self._spec_window),
+                                np.int32)
+        self._nvalid = np.ones((self.n_slots,), np.int32)
+        # prefix index (decode-loop-private): parent page (0 = root) →
+        # {full-block token tuple: resident page}, plus the reverse map
+        # releases use.  A chain walk from the root maps a new prompt's
+        # leading blocks onto resident pages (``_match_prefix``).
+        self._children = {}
+        self._indexed_by_page = {}
 
         self._pending = collections.deque()
         self._admit_lock = threading.Lock()
@@ -652,7 +1046,10 @@ class GenerationServer:
         self._stats = {"admitted": 0, "completed": 0, "failed": 0,
                        "expired": 0, "rejected": 0, "retired": 0,
                        "preempted": 0, "tokens_out": 0, "prefills": 0,
-                       "handoffs": 0, "decode_steps": 0, "active_slots": 0}
+                       "handoffs": 0, "decode_steps": 0, "active_slots": 0,
+                       "verify_steps": 0, "spec_proposed": 0,
+                       "spec_accepted": 0, "cow_faults": 0,
+                       "pages_charged": 0, "pages_shared_mapped": 0}
         self._last_error = None
         self._ready = threading.Event()
         self._draining = threading.Event()
@@ -690,6 +1087,10 @@ class GenerationServer:
         self._h_slot_pages = _telemetry.registry().histogram(
             f"{name}::slot_pages",
             _telemetry.log_buckets(1.0, 4096.0, per_decade=4))
+        # per-step draft acceptance rate (accepted / spec_k), observed
+        # per slot each verify step — the speculative win's live gauge
+        self._h_accept = _telemetry.registry().histogram(
+            f"{name}::spec_accept_rate", [i / 8 for i in range(1, 9)])
 
     # ------------------------------------------------------------ lifecycle --
     def start(self, warmup=True):
@@ -733,6 +1134,10 @@ class GenerationServer:
                     np.zeros((self.buckets.max_batch, self.pages_per_seq),
                              np.int32))
             self._run_decode()
+            if self._verify is not None:
+                # the verify program joins the pinned set: inert
+                # all-inactive arguments, writes sink to page 0
+                self._run_verify()
             # the whole executable space exists now (census() programs):
             # any later compile at this site is an UNEXPECTED recompile —
             # the counter chaos_check --mode obs asserts stays zero.  A
@@ -759,16 +1164,21 @@ class GenerationServer:
     def census(self):
         """The static executable count: one prefill program per (batch,
         length) bucket plus THE decode program — plus THE handoff
-        program when disaggregated (``prefill_workers >= 1``).
-        ``jit_cache_count()`` must equal this after warmup, forever."""
+        program when disaggregated (``prefill_workers >= 1``), plus THE
+        verify program when speculative (``draft=`` — census grows by
+        exactly one).  ``jit_cache_count()`` must equal this after
+        warmup, forever."""
         grid = len(self.buckets.batch) * len(self.buckets.length)
-        return grid + 1 + (1 if self._n_prefill_workers > 0 else 0)
+        return grid + 1 + (1 if self._n_prefill_workers > 0 else 0) \
+            + (1 if self._verify is not None else 0)
 
     def jit_cache_count(self):
         """Runtime executables actually compiled (every jit cache)."""
         n = self._prefill._cache_size() + self._decode._cache_size()
         if self._handoff is not None:
             n += self._handoff._cache_size()
+        if self._verify is not None:
+            n += self._verify._cache_size()
         return n
 
     # ------------------------------------------------------------ admission --
@@ -828,13 +1238,20 @@ class GenerationServer:
                     f"prompt length {n} exceeds the largest length bucket "
                     f"{max(self.buckets.length)} — no prefill executable "
                     f"exists for this shape")
-            if n + max_new > self.max_context:
+            # speculative mode verifies spec_k lookahead positions past
+            # the pending token — the worst case must hold them too
+            spare = self._spec_k if self._verify is not None else 0
+            if n + max_new + spare > self.max_context:
                 raise RejectedError(
-                    f"prompt {n} + max_new_tokens {max_new} exceeds the "
-                    f"page capacity {self.max_context} per sequence")
-            if self.alloc.pages_for(n + max_new) > self.alloc.allocatable:
+                    f"prompt {n} + max_new_tokens {max_new}"
+                    + (f" + spec_k {spare}" if spare else "")
+                    + f" exceeds the page capacity {self.max_context} "
+                    f"per sequence")
+            if self.alloc.pages_for(n + max_new + spare) \
+                    > self.alloc.allocatable:
                 raise RejectedError(
-                    f"worst case needs {self.alloc.pages_for(n + max_new)} "
+                    f"worst case needs "
+                    f"{self.alloc.pages_for(n + max_new + spare)} "
                     f"pages, pool holds {self.alloc.allocatable} — this "
                     f"request could never be served")
         except RejectedError:
@@ -1010,8 +1427,21 @@ class GenerationServer:
             nxt, self._k_pool, self._v_pool = self._decode(
                 self._params, self._k_pool, self._v_pool, self._tokens,
                 self._lengths, self._active, self._tables,
-                self._next_key(), self._temps, self._topks)
+                self._cow_src, self._cow_dst, self._next_key(),
+                self._temps, self._topks)
         return np.asarray(nxt)
+
+    def _run_verify(self):
+        """One verify program invocation over the full slot grid
+        (speculative mode's decode step; pools donated/reassigned)."""
+        with _telemetry.compile_guard(self._name, self._verify, key="verify"):
+            emitted, n_acc, self._k_pool, self._v_pool = self._verify(
+                self._params, self._draft_params, self._k_pool,
+                self._v_pool, self._tokens, self._window, self._nvalid,
+                self._lengths, self._active, self._tables,
+                self._cow_src, self._cow_dst, self._next_key(),
+                self._temps, self._topks)
+        return np.asarray(emitted), np.asarray(n_acc)
 
     def _pipeline_idle(self):
         """True when the disaggregated prefill pipeline holds no work
@@ -1049,7 +1479,10 @@ class GenerationServer:
                 else:
                     worked = self._admit() or worked
                 if self._seqs:
-                    self._decode_once()
+                    if self._verify is not None:
+                        self._verify_once()
+                    else:
+                        self._decode_once()
                     worked = True
                 if not worked and not self._seqs:
                     time.sleep(self._IDLE_TICK)
@@ -1077,6 +1510,134 @@ class GenerationServer:
                 t.join(timeout=30)
             self._fail_residue()
 
+    # ---- prefix sharing ----
+    def _release(self, pages):
+        """Drop one hold on ``pages`` and withdraw the prefix-index
+        entries of every page that actually left residency — the ONLY
+        way scheduler code returns pages (a raw ``alloc.free`` would
+        leave the index advertising free-listed pages).  Decode-loop
+        thread only, like every index touch."""
+        released = self.alloc.free(pages)
+        for p in released:
+            ent = self._indexed_by_page.pop(p, None)
+            if ent is not None:
+                parent, toks = ent
+                kids = self._children.get(parent)
+                if kids is not None:
+                    kids.pop(toks, None)
+                    if not kids:
+                        self._children.pop(parent, None)
+        for p in released:
+            # a released parent takes its child table with it (its
+            # children were released in the same call — nothing live
+            # can outlive the prefix it chains from)
+            self._children.pop(p, None)
+        return released
+
+    def _deindex(self, page):
+        """Withdraw one page's prefix-index entry (about to be written
+        by its sole holder — the advertised block content would lie)."""
+        ent = self._indexed_by_page.pop(int(page), None)
+        if ent is not None:
+            parent, toks = ent
+            kids = self._children.get(parent)
+            if kids is not None:
+                kids.pop(toks, None)
+                if not kids:
+                    self._children.pop(parent, None)
+
+    def _match_prefix(self, prompt):
+        """Resident pages a new prompt's leading blocks can map onto:
+        walk the index chain from the root matching FULL token blocks
+        exactly; the final PARTIAL block may additionally map onto a
+        resident full block whose leading tokens match (a superset —
+        the extra tokens are masked by ``lengths``, and the sequence's
+        first write into that page takes the CoW fault).  Returns the
+        (possibly empty) list of resident page ids, prefix order."""
+        ps = self.alloc.page_size
+        n = int(prompt.shape[0])
+        shared, parent = [], 0
+        for b in range(-(-n // ps)):
+            kids = self._children.get(parent)
+            if not kids:
+                break
+            chunk = prompt[b * ps:(b + 1) * ps]
+            if chunk.shape[0] == ps:
+                page = kids.get(tuple(int(t) for t in chunk))
+                if page is None:
+                    break
+                shared.append(page)
+                parent = page
+            else:
+                r = chunk.shape[0]
+                part = tuple(int(t) for t in chunk)
+                for toks, page in kids.items():
+                    if toks[:r] == part:
+                        shared.append(page)     # superset: CoW on write
+                        break
+                break
+        return shared
+
+    def _index_prompt(self, seq):
+        """Publish a seated sequence's FULL prompt blocks to the prefix
+        index (first writer wins — a block already resident elsewhere
+        keeps its canonical page).  Only full blocks are indexable:
+        their content is complete and, because decode writes always
+        land past the prompt, immutable while resident."""
+        ps = self.alloc.page_size
+        parent = 0
+        for b in range(int(seq.prompt.shape[0]) // ps):
+            toks = tuple(int(t) for t in seq.prompt[b * ps:(b + 1) * ps])
+            page = seq.pages[b]
+            kids = self._children.get(parent)
+            cur = None if kids is None else kids.get(toks)
+            if cur is None:
+                if kids is None:
+                    kids = self._children.setdefault(parent, {})
+                kids[toks] = page
+                self._indexed_by_page[page] = (parent, toks)
+                cur = page
+            if cur != page:
+                # the canonical chain diverged from our residency (a
+                # twin indexed first) — stop; the canonical pages
+                # already serve future matches
+                break
+            parent = page
+
+    def _map_pages(self, seq):
+        """Hand one admitted sequence its prompt pages: leading blocks
+        resident in the prefix index are SHARED (a refcount bump, zero
+        pool cost); only the remainder is allocated — all-or-nothing,
+        so ``PoolExhaustedError`` leaves nothing taken."""
+        n = int(seq.prompt.shape[0])
+        shared = self._match_prefix(seq.prompt)
+        own = self.alloc.alloc(self.alloc.pages_for(n) - len(shared))
+        self.alloc.share(shared)
+        seq.pages = shared + own
+        seq.shared_n = len(shared)
+        self._bump("pages_charged", len(own))
+        if shared:
+            self._bump("pages_shared_mapped", len(shared))
+        # index NOW, not at seat time: the same program call that maps
+        # these pages fills them (prefill scatter / handoff), so a
+        # LATER sequence in the same batch can already share them — a
+        # fleet of identical system prompts shares from request two
+        # onward.  A failed prefill releases the pages, which withdraws
+        # the entries again.
+        self._index_prompt(seq)
+
+    def _scatter_table_row(self, seq):
+        """The page-table row a PREFILL/HANDOFF scatter may write
+        through: shared blocks are zeroed so their writes sink to page
+        0 — resident shared pages must never be rewritten (a superset-
+        shared page holds MORE tokens than this prompt claims, and the
+        program zero-pads past ``lengths``).  The DECODE table keeps
+        the real ids: attention reads the resident prefix."""
+        row = np.zeros((self.pages_per_seq,), np.int32)
+        row[:len(seq.pages)] = seq.pages
+        row[:seq.shared_n] = 0
+        return row
+
     # ---- retirement ----
     def _vacate(self, seq):
         """Release a sequence's slot + pages (no request resolution)."""
@@ -1091,11 +1652,16 @@ class GenerationServer:
             self._tables[s, :] = 0
             self._temps[s] = 0.0
             self._topks[s] = 0
+            self._cow_src[s] = 0
+            self._cow_dst[s] = 0
+            self._window[s, :] = 0
+            self._nvalid[s] = 1
             self._seqs.pop(s, None)
             seq.slot = None
         if seq.pages:
-            self.alloc.free(seq.pages)
+            self._release(seq.pages)
             seq.pages = []
+        seq.shared_n = 0
         self._note_occupancy()
 
     def _note_occupancy(self):
@@ -1175,7 +1741,11 @@ class GenerationServer:
                 if self._bucket_len(seq.prompt.shape[0]) != bucket:
                     continue
                 if need_resources:
-                    need = self.alloc.pages_for(seq.prompt.shape[0])
+                    # charge only NON-shared pages: blocks resident in
+                    # the prefix index cost nothing — the concurrency
+                    # multiplier of prefix sharing lands here
+                    need = self.alloc.pages_for(seq.prompt.shape[0]) \
+                        - len(self._match_prefix(seq.prompt))
                     if need > budget:
                         break   # keep order: don't starve the big one
                     budget -= need
@@ -1343,7 +1913,8 @@ class GenerationServer:
                     stat="expired")
                 worked = True
                 continue
-            need = self.alloc.pages_for(seq.prompt.shape[0])
+            need = self.alloc.pages_for(seq.prompt.shape[0]) \
+                - len(self._match_prefix(seq.prompt))
             if len(batch) >= min(len(free_slots), self.buckets.max_batch) \
                     or need > budget:
                 still.append(entry)
@@ -1373,12 +1944,12 @@ class GenerationServer:
             _fault.fire("fleet.handoff")
             for j, (seq, first_tok, k_seq, v_seq) in enumerate(batch):
                 n = seq.prompt.shape[0]
-                seq.pages = self.alloc.alloc(self.alloc.pages_for(n))
+                self._map_pages(seq)
                 kbuf[:, j, :n] = k_seq
                 vbuf[:, j, :n] = v_seq
                 lengths[j] = n
                 active[j] = True
-                tables[j, :len(seq.pages)] = seq.pages
+                tables[j] = self._scatter_table_row(seq)
                 seated.append(seq)
             with _profiler.scope(f"{self._name}.handoff", cat="serving"):
                 self._run_handoff(kbuf, vbuf, lengths, active, tables)
@@ -1420,8 +1991,7 @@ class GenerationServer:
                     pspans.append(sp)
         try:
             for seq in group:
-                seq.pages = self.alloc.alloc(
-                    self.alloc.pages_for(seq.prompt.shape[0]))
+                self._map_pages(seq)
         except PoolExhaustedError:
             # _take_prefill_group budgeted against the free count, so
             # only a racing... nothing else allocates; defensive re-queue
@@ -1444,7 +2014,7 @@ class GenerationServer:
             tokens[i, :n] = seq.prompt
             lengths[i] = n
             active[i] = True
-            tables[i, :len(seq.pages)] = seq.pages
+            tables[i] = self._scatter_table_row(seq)
             temps[i] = seq.temp
             topks[i] = seq.top_k
         if pspans is not None:
@@ -1486,11 +2056,17 @@ class GenerationServer:
         self._seqs[s] = seq
         self._bump("active_slots")
         self._tables[s, :] = 0
+        # the REAL table: shared pages included — decode attention
+        # reads the resident prefix (the scatter row already sank its
+        # writes to page 0)
         self._tables[s, :len(seq.pages)] = seq.pages
         self._temps[s] = seq.temp
         self._topks[s] = seq.top_k
         self._active[s] = True
-        self._finish_token(seq, tok)
+        self._cow_src[s] = 0
+        self._cow_dst[s] = 0
+        if not self._finish_token(seq, tok) and self._verify is not None:
+            self._refresh_window(seq)
 
     def _finish_token(self, seq, tok):
         """Account one newly generated token; True if the sequence
@@ -1513,8 +2089,10 @@ class GenerationServer:
         return False
 
     # ---- decode ----
-    def _ensure_capacity(self, seq):
-        """Guarantee a page exists for this step's write position.  When
+    def _ensure_capacity(self, seq, lookahead=0):
+        """Guarantee pages exist for this step's write positions (the
+        pending token plus ``lookahead`` speculative candidates), then
+        arm the slot's CoW fault if the write block is shared.  When
         the pool is dry, eviction is strictly seniority-ordered: a
         sequence may only preempt YOUNGER neighbours (later admission
         stamp — preserved across preemptions, so a restarted sequence
@@ -1526,10 +2104,15 @@ class GenerationServer:
         livelock where two sequences endlessly restart each other, is
         impossible by construction.  Returns False when ``seq`` yielded
         (the caller must skip it this step)."""
-        while self.alloc.pages_for(seq.cached + 1) > len(seq.pages):
+        while True:
             try:
-                seq.pages.extend(self.alloc.alloc(1))
-                self._tables[seq.slot, len(seq.pages) - 1] = seq.pages[-1]
+                while self.alloc.pages_for(seq.cached + 1 + lookahead) \
+                        > len(seq.pages):
+                    seq.pages.extend(self.alloc.alloc(1))
+                    self._tables[seq.slot, len(seq.pages) - 1] = \
+                        seq.pages[-1]
+                self._cow_guard(seq)
+                return True
             except PoolExhaustedError:
                 victims = [s for s in self._seqs.values()
                            if s is not seq and s.stamp > seq.stamp]
@@ -1540,7 +2123,45 @@ class GenerationServer:
                     return False
                 else:
                     raise     # alone and dry: admission math was violated
-        return True
+
+    def _cow_guard(self, seq):
+        """Copy-on-write fault check for this step's write block.  Only
+        the block holding position ``seq.cached`` can be shared (all
+        shared blocks are prompt blocks and writes land at or past the
+        prompt's tail; later lookahead positions are in freshly
+        allocated pages), so ONE check per slot per step suffices.  On
+        a fault: allocate a fresh page (``PoolExhaustedError``
+        propagates to the caller's preemption loop), drop our hold on
+        the shared page, remap table + page list, and arm the in-graph
+        page copy lanes.  A sole-holder write into a still-indexed page
+        instead withdraws the index entry — the block's advertised
+        content is about to change."""
+        s = seq.slot
+        blk = seq.cached // self.alloc.page_size
+        page = seq.pages[blk]
+        if self.alloc.refcount(page) > 1:
+            fresh = self.alloc.alloc(1)[0]
+            self._release([page])          # others still hold it
+            seq.pages[blk] = fresh
+            seq.shared_n = min(seq.shared_n, blk)
+            self._tables[s, blk] = fresh
+            self._cow_src[s] = page
+            self._cow_dst[s] = fresh
+            self._bump("cow_faults")
+        elif page in self._indexed_by_page:
+            self._deindex(page)
+
+    def _refresh_window(self, seq):
+        """Right-align the draft's token context: the last
+        ``spec_window`` tokens of prompt + generated-so-far, the
+        pending token included (the draft proposes its successors)."""
+        s = seq.slot
+        W = self._spec_window
+        toks = np.concatenate(
+            [seq.prompt, np.asarray(seq.out, np.int32)])[-W:]
+        self._window[s, :] = 0
+        self._window[s, W - len(toks):] = toks
+        self._nvalid[s] = len(toks)
 
     def _preempt(self, victim):
         """Evict a sequence: free its pages and requeue it at the FRONT
@@ -1565,6 +2186,8 @@ class GenerationServer:
     def _decode_once(self):
         """One token for every in-flight sequence: capacity, the pinned
         decode executable, then per-slot retirement/advance."""
+        self._cow_src[:] = 0        # fault lanes re-arm per step
+        self._cow_dst[:] = 0
         try:
             # oldest first: seniors claim pages (evicting juniors if the
             # pool is dry) before juniors decide whether to yield
@@ -1618,6 +2241,77 @@ class GenerationServer:
             seq.cached += 1          # this step wrote the input token
             self._finish_token(seq, int(nxt[seq.slot]))
 
+    def _verify_once(self):
+        """One SPECULATIVE step for every in-flight sequence: capacity
+        with ``spec_k`` lookahead, the pinned verify executable, then
+        1..k+1 accepted tokens per slot.  Mirrors ``_decode_once``'s
+        failure/breaker/span semantics exactly — same fault point, so
+        chaos drives both paths with one name."""
+        self._cow_src[:] = 0
+        self._cow_dst[:] = 0
+        try:
+            for seq in sorted(self._seqs.values(), key=lambda s: s.stamp):
+                if seq.slot is None:
+                    continue     # preempted by an earlier neighbour
+                self._ensure_capacity(seq, lookahead=self._spec_k)
+        except PoolExhaustedError as exc:
+            self._fail_everything(_fault.with_context(
+                exc, f"{self._name} page pool wedged"))
+            return
+        if not self._seqs:
+            return
+        if not self.breaker.allow():
+            self._fail_everything(CircuitOpenError(
+                f"{self._name}: circuit open — fast-failing in-flight "
+                f"generation"), queued=False)
+            return
+        dspans = None
+        for seq in self._seqs.values():
+            if seq.req.trace is not None:
+                sp = _telemetry.get_span(seq.req, "decode")
+                if sp is not None:
+                    if dspans is None:
+                        dspans = []
+                    dspans.append(sp)
+        if dspans is not None:
+            _telemetry.push_current(dspans)
+        try:
+            _fault.fire("generate.decode")
+            with _profiler.scope(f"{self._name}.verify", cat="serving"):
+                emitted, n_acc = self._run_verify()
+        except Exception as exc:    # noqa: BLE001 — resolved per sequence
+            self.breaker.record_failure()
+            self._note_step_failure(exc)
+            err = _fault.with_context(
+                exc, f"{self._name} verify step over "
+                f"{len(self._seqs)} sequences")
+            for seq in list(self._seqs.values()):
+                self._retire(seq, err, stat="failed")
+            self._recover_pools()
+            return
+        finally:
+            if dspans is not None:
+                _telemetry.pop_current()
+        self.breaker.record_success()
+        self._bump("decode_steps")
+        self._bump("verify_steps")
+        k = self._spec_k
+        for seq in list(self._seqs.values()):
+            s = seq.slot
+            a = int(n_acc[s])
+            self._bump("spec_proposed", k)
+            self._bump("spec_accepted", a)
+            self._h_accept.observe(a / k)
+            # positions 0..a hold real K/V (pending + accepted drafts);
+            # emitted[a] is the correction/bonus — the next pending
+            # token, K/V not yet written
+            for j in range(a + 1):
+                seq.cached += 1
+                if self._finish_token(seq, int(emitted[s, j])):
+                    break
+            else:
+                self._refresh_window(seq)
+
     def _fail_everything(self, err, queued=True):
         """Explicitly resolve every in-flight (and optionally queued)
         sequence — the terminal sweep for breaker-open-during-drain and
@@ -1669,8 +2363,9 @@ class GenerationServer:
             if seq.req.done():
                 continue
             if seq.pages:
-                self.alloc.free(seq.pages)
+                self._release(seq.pages)
                 seq.pages = []
+                seq.shared_n = 0
             seq.req.set_error(ServerClosedError(
                 "server stopped before this sequence finished"))
             self._bump("failed")
@@ -1711,6 +2406,8 @@ class GenerationServer:
                 "active_slots": active,
                 "free_pages": self.alloc.free_count(),
                 "total_pages": self.alloc.allocatable,
+                "pages_shared": self.alloc.shared_pages(),
+                "speculative": int(self._verify is not None),
                 "prefill_workers": self._n_prefill_workers,
                 "prefill_inflight": prefill_flight,
                 "tp_shards": self.tp_shards,
@@ -1719,11 +2416,20 @@ class GenerationServer:
                 "last_error": None if last is None else
                 {"type": last[0], "age": time.monotonic() - last[1]}}
 
+    def _page_bytes(self):
+        """HBM bytes one page id addresses across BOTH pools (f32
+        K + V, every layer, all heads — the whole stripe a shared
+        page avoids duplicating)."""
+        c = self.config
+        return (2 * c.n_layers * self.alloc.page_size * c.n_heads
+                * c.head_dim * 4)
+
     @property
     def stats(self):
         with self._lock:
             out = dict(self._stats)
         out["free_pages"] = self.alloc.free_count()
+        out["pages_shared"] = self.alloc.shared_pages()
         out["breaker"] = self.breaker.state
         return out
 
@@ -1754,6 +2460,15 @@ class GenerationServer:
                   "free_pages": h["free_pages"],
                   "used_pages": h["total_pages"] - h["free_pages"],
                   "total_pages": h["total_pages"],
+                  # prefix-sharing gauges (ISSUE 16): resident pages
+                  # with >1 holder, CoW faults taken, and the pool
+                  # bytes sharing is currently standing in for
+                  "pages_shared": h["pages_shared"],
+                  "pages_cow_faults": counters.get("cow_faults", 0),
+                  "bytes_saved_by_sharing":
+                      self.alloc.extra_refs() * self._page_bytes(),
+                  "spec_k": self._spec_k if self._verify is not None
+                      else 0,
                   "prefill_workers": h["prefill_workers"],
                   "prefill_inflight": h["prefill_inflight"],
                   "tp_shards": h["tp_shards"],

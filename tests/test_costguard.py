@@ -173,6 +173,61 @@ def test_llm_serving_census_is_prefill_grid_plus_one():
     assert decode["report"]["n_executables"] == decode["census"] == 1
 
 
+def test_llm_prefix_sharing_admission_budget():
+    """The CoW prefix-sharing win (ISSUE 16), pinned as a committed
+    golden PAIR: at a 90%-shared prefix (176 of 192 prompt tokens),
+    worst-case-fit admission charges only NON-shared pages, so (a) at
+    the FIXED 128-page pool the admissible concurrency multiplier is
+    >= 2x the unshared baseline, and (b) serving the SAME 8-slot worst
+    case needs <= 55% of the unshared pool's decode-step
+    ``argument_bytes``.  The plan numbers in the goldens' meta are
+    recomputed here through the LIVE ``prefix_admission_plan`` — a
+    drive-by change to the admission math trips this gate, not just a
+    regen."""
+    from mxnet_tpu.serving.generate import prefix_admission_plan
+
+    unshared = load_golden("llm_admission_unshared", REPO)
+    shared = load_golden("llm_admission_shared", REPO)
+    mu, ms = unshared["meta"], shared["meta"]
+    # identical traffic contract on both sides
+    for k in ("prompt_len", "max_new", "shared_prefix_len", "page_size",
+              "n_slots"):
+        assert mu[k] == ms[k], k
+    plan = prefix_admission_plan(mu["n_pages"], mu["page_size"],
+                                 mu["prompt_len"], mu["max_new"],
+                                 mu["shared_prefix_len"])
+    for k, v in plan.items():
+        assert mu[k] == v, (k, mu[k], v)
+    assert plan["admissible_unshared"] == mu["n_slots"] == 8
+    assert plan["admissible_shared"] >= 2 * plan["admissible_unshared"], (
+        f"prefix sharing admits {plan['admissible_shared']} vs "
+        f"{plan['admissible_unshared']} unshared — the committed >=2x "
+        f"concurrency multiplier at 90% shared prefix no longer holds")
+    ub = unshared["report"]["memory"]["argument_bytes"]
+    sb = shared["report"]["memory"]["argument_bytes"]
+    assert ub > 0
+    assert sb <= 0.55 * ub, (
+        f"shared-prefix decode argument bytes {sb} vs unshared {ub} — "
+        f"the committed page-bytes/sequence reduction no longer holds")
+    assert unshared["report"]["n_executables"] == \
+        shared["report"]["n_executables"] == 1
+
+
+def test_llm_speculative_census_is_plus_one():
+    """Speculative decoding adds EXACTLY one executable — the pinned
+    verify step — to the serving census; the draft model never gets a
+    program of its own (its proposal loop lives inside the verify
+    executable).  Committed as a golden so a second speculative
+    program (a stray draft forward, an unrolled variant) trips tier-1."""
+    verify = load_golden("llm_verify_step", REPO)
+    assert verify["report"]["n_executables"] == verify["census"] == 1
+    assert verify["meta"]["spec_k"] >= 1
+    # the verify step prices BOTH param sets: speculation is not free
+    decode = load_golden("llm_decode_step", REPO)["report"]
+    assert verify["report"]["memory"]["argument_bytes"] > \
+        decode["memory"]["argument_bytes"]
+
+
 # ------------------------- ISSUE 11: sharded per-device cost budgets --
 def test_program_num_partitions_parser():
     from tools.costguard.report import program_num_partitions

@@ -792,3 +792,300 @@ def test_generation_tenant_throttle_and_class_queue_cap():
         assert srv.drain(60)
     st = srv.stats
     assert st["admitted"] == st["completed"] + st["failed"] + st["expired"]
+
+
+# ------------------------ ISSUE 16: CoW prefix sharing + speculation --
+def _draft_pair(seed=5):
+    from mxnet_tpu.gluon.model_zoo.causal_lm import draft_config
+    dcfg = draft_config(CFG, n_layers=1)
+    return dcfg, init_causal_lm(dcfg, seed=seed)
+
+
+def test_allocator_double_free_and_unknown_page_raise():
+    """A page with no live refcount — freed twice, or an id never
+    allocated — raises ``ValueError`` with NOTHING freed: silently
+    re-listing it would hand the same page to two sequences."""
+    a = PageAllocator(9, 4)
+    held = a.alloc(3)
+    a.free(held)
+    before = a.free_count()
+    with pytest.raises(ValueError, match="not live"):
+        a.free(held[:1])                       # double free
+    assert a.free_count() == before
+    keep = a.alloc(2)
+    with pytest.raises(ValueError, match="not live"):
+        a.free(keep + [keep[0]])               # dup inside ONE call
+    assert a.free_count() == before - 2        # nothing freed
+    with pytest.raises(ValueError, match="not live"):
+        a.free([0])                            # the sink is never live
+    with pytest.raises(ValueError, match="not live"):
+        a.free([999])                          # never allocated
+    a.free(keep)
+    assert a.free_count() == a.allocatable
+
+
+def test_allocator_refcount_share_semantics():
+    """share() adds holders to LIVE pages only; free() releases a page
+    when its LAST holder lets go; the sharing gauges follow."""
+    a = PageAllocator(9, 4)
+    pages = a.alloc(2)
+    assert [a.refcount(p) for p in pages] == [1, 1]
+    a.share(pages)
+    a.share(pages[:1])
+    assert a.refcount(pages[0]) == 3 and a.refcount(pages[1]) == 2
+    assert a.shared_pages() == 2 and a.extra_refs() == 3
+    assert a.live_pages() == 2
+    assert a.free(pages) == []                 # (3,2) -> (2,1): still held
+    assert a.free(pages[:1]) == []             # (2,1) -> (1,1)
+    assert sorted(a.free(pages)) == sorted(pages)   # last holders let go
+    assert a.free_count() == a.allocatable and a.live_pages() == 0
+    with pytest.raises(ValueError, match="not live"):
+        a.share(pages[:1])                     # freed pages can't be shared
+    assert a.refcount(pages[0]) == 0
+
+
+def test_prefix_admission_plan_math():
+    from mxnet_tpu.serving import prefix_admission_plan
+    plan = prefix_admission_plan(129, 16, 192, 64, 176)
+    assert plan["pages_per_seq"] == 16 and plan["shared_pages"] == 11
+    assert plan["charged_pages"] == 5
+    assert plan["admissible_unshared"] == 8
+    assert plan["admissible_shared"] == 23
+    assert plan["multiplier"] == pytest.approx(23 / 8)
+    # no sharing at all → both sides agree
+    base = prefix_admission_plan(129, 16, 192, 64, 0)
+    assert base["admissible_shared"] == base["admissible_unshared"] == 8
+    # shared prefix can never exceed the prompt's own full blocks
+    cap = prefix_admission_plan(129, 16, 32, 64, 10_000)
+    assert cap["shared_pages"] == 2
+
+
+def test_prefix_sharing_cow_exactness_and_drain_invariants():
+    """The tentpole acceptance: a sharer mapped onto a donor's resident
+    prefix pages (one of them a SUPERSET partial-block match, so the
+    first divergent write takes a real CoW fault) decodes token-
+    identically to the unshared oracle, and after drain every refcount
+    returned to zero — free list == pool."""
+    donor = ((np.arange(8, dtype=np.int32) * 5) + 1) % CFG.vocab_size
+    sharer = donor[:6].copy()                  # 1 full block + superset tail
+    srv = make_server(buckets=BucketSpec(batch=(1, 2), length=(8,)),
+                      n_slots=4, n_pages=33).start()
+    try:
+        r1 = srv.submit(donor)                 # one prefill group of two:
+        r2 = srv.submit(sharer)                # sharing is map-time, not
+        o1 = r1.result(timeout=60)             # seat-time
+        o2 = r2.result(timeout=60)
+        np.testing.assert_array_equal(o1, oracle_greedy(LOUD, donor, 6))
+        np.testing.assert_array_equal(o2, oracle_greedy(LOUD, sharer, 6))
+        st = srv.stats
+        assert st["pages_shared_mapped"] >= 2  # full + superset block
+        assert st["cow_faults"] >= 1           # divergence at token 6
+        g = srv.telemetry()["gauges"]
+        assert g["pages_cow_faults"] >= 1
+        assert "bytes_saved_by_sharing" in g and "pages_shared" in g
+    finally:
+        assert srv.drain(30)
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+    assert srv.alloc.live_pages() == 0 and srv.alloc.shared_pages() == 0
+
+
+def test_sharer_retire_never_frees_referenced_pages():
+    """A donor retiring EARLY only drops ITS hold: the sharer keeps
+    decoding through the shared pages, and a later sequence reusing the
+    freed pool cannot clobber them (exactness is the proof — a
+    wrongly-freed page would be rewritten under the sharer)."""
+    donor = ((np.arange(8, dtype=np.int32) * 7) + 2) % CFG.vocab_size
+    clobber = ((np.arange(8, dtype=np.int32) * 11) + 5) % CFG.vocab_size
+    srv = make_server(buckets=BucketSpec(batch=(1, 2), length=(8,)),
+                      n_slots=4, n_pages=17).start()
+    try:
+        r1 = srv.submit(donor, max_new_tokens=2)   # retires first
+        r2 = srv.submit(donor, max_new_tokens=6)   # full-prompt sharer
+        o1 = r1.result(timeout=60)
+        r3 = srv.submit(clobber, max_new_tokens=4)  # churns the free list
+        o2 = r2.result(timeout=60)
+        o3 = r3.result(timeout=60)
+        np.testing.assert_array_equal(o1, oracle_greedy(LOUD, donor, 2))
+        np.testing.assert_array_equal(o2, oracle_greedy(LOUD, donor, 6))
+        np.testing.assert_array_equal(o3, oracle_greedy(LOUD, clobber, 4))
+        assert srv.stats["pages_shared_mapped"] >= 2
+    finally:
+        assert srv.drain(30)
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+def test_sharer_preemption_with_shared_pages_recovers_exactly():
+    """Pool-pressure preemption of a SHARER must not free pages the
+    donor still references: two sequences share one prompt page, each
+    fits the pool alone but not together, the younger is evicted and
+    restarted — both streams still match the oracle and the drain
+    invariant holds."""
+    prompt = np.asarray([1, 2, 3, 4], np.int32)     # exactly one block
+    srv = make_server(buckets=BucketSpec(batch=(1,), length=(4,)),
+                      n_slots=2, n_pages=8, page_size=4,
+                      max_new_tokens=24).start()
+    try:
+        r1 = srv.submit(prompt, max_new_tokens=24)
+        r2 = srv.submit(prompt, max_new_tokens=24)
+        o1 = r1.result(timeout=120)
+        o2 = r2.result(timeout=120)
+        want = oracle_greedy(LOUD, prompt, 24)
+        np.testing.assert_array_equal(o1, want)
+        np.testing.assert_array_equal(o2, want)
+        st = srv.stats
+        assert st["preempted"] >= 1
+        assert st["pages_shared_mapped"] >= 1
+    finally:
+        assert srv.drain(60)
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+    assert srv.alloc.live_pages() == 0
+
+
+def test_speculative_greedy_token_identical_to_oracle():
+    """Distribution exactness, greedy arm: a speculative server (draft
+    proposals + ONE pinned verify step) emits byte-identical streams to
+    the non-speculative oracle, whatever the accept rate."""
+    dcfg, dparams = _draft_pair()
+    srv = make_server(buckets=BucketSpec(batch=(1, 2), length=(8,)),
+                      n_slots=4, n_pages=33, draft=dparams,
+                      draft_config=dcfg, spec_k=2).start()
+    try:
+        prompts = [((np.arange(n, dtype=np.int32) * m) + 1)
+                   % CFG.vocab_size
+                   for n, m in ((3, 5), (6, 7), (8, 11), (5, 2))]
+        reqs = [srv.submit(p) for p in prompts]
+        for p, r in zip(prompts, reqs):
+            np.testing.assert_array_equal(r.result(timeout=120),
+                                          oracle_greedy(LOUD, p, 6))
+        st = srv.stats
+        assert st["verify_steps"] > 0 and st["spec_proposed"] > 0
+        # greedy draft-vs-target agreement is high on a shared family
+        # but never total — both branches of accept/reject ran
+        assert 0 < st["spec_accepted"] <= st["spec_proposed"]
+    finally:
+        assert srv.drain(60)
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+def test_speculative_sampling_statistical_identity():
+    """Distribution exactness, sampling arm (Leviathan/Chen rejection
+    scheme): the FIRST emitted token's marginal under speculative
+    verify equals the target model's tempered top-k distribution —
+    regardless of the draft's proposal quality.  Empirical check over
+    many fixed-seed draws of the verify executable against the
+    analytically computed target distribution."""
+    from mxnet_tpu.serving.generate import (build_prefill_step,
+                                            build_verify_step)
+    dcfg, dparams = _draft_pair()
+    page, n_prompt, temp, topk = 4, 6, 1.0, 8
+    prompt = ((np.arange(n_prompt, dtype=np.int32) * 5) + 2) \
+        % CFG.vocab_size
+    pool = jnp.zeros((CFG.n_layers, 9, page, CFG.n_heads, CFG.head_dim),
+                     jnp.float32)
+    tables = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    toks = np.zeros((1, 8), np.int32)
+    toks[0, :n_prompt] = prompt
+    pre = jax.jit(build_prefill_step(CFG, page))
+    t0, kp, vp = pre(LOUD, pool, pool, jnp.asarray(toks),
+                     jnp.asarray([n_prompt], np.int32),
+                     jnp.asarray([True]), tables,
+                     jax.random.PRNGKey(0), jnp.asarray([0.0]),
+                     jnp.asarray([0], np.int32))    # greedy pending token
+    t0 = int(t0[0])
+    # analytic target marginal for the token AFTER the pending one
+    full = np.zeros((1, 16), np.int32)
+    full[0, :n_prompt] = prompt
+    full[0, n_prompt] = t0
+    logits, _, _ = prefill_forward(LOUD, CFG, jnp.asarray(full),
+                                   jnp.asarray([n_prompt + 1], np.int32))
+    z = np.asarray(logits)[0] / temp
+    kth = np.sort(z)[-topk]
+    z = np.where(z >= kth, z, -np.inf)
+    p_ref = np.exp(z - z.max())
+    p_ref /= p_ref.sum()
+    vf = jax.jit(build_verify_step(CFG, dcfg, page, spec_k=2, window=8))
+    window = np.zeros((1, 8), np.int32)
+    window[0, -(n_prompt + 1):] = list(prompt) + [t0]
+    args = (jnp.asarray([t0], jnp.int32), jnp.asarray(window),
+            jnp.asarray([n_prompt + 1], np.int32),
+            jnp.asarray([n_prompt], np.int32), jnp.asarray([True]),
+            tables, jnp.asarray([0], jnp.int32),
+            jnp.asarray([0], jnp.int32))
+    base = jax.random.PRNGKey(42)
+    counts = np.zeros(CFG.vocab_size)
+    n_draws = 600
+    for i in range(n_draws):
+        emitted, _, _, _ = vf(LOUD, dparams, kp, vp, *args,
+                              jax.random.fold_in(base, i),
+                              jnp.asarray([temp], jnp.float32),
+                              jnp.asarray([topk], jnp.int32))
+        counts[int(emitted[0, 0])] += 1
+    emp = counts / n_draws
+    assert emp[np.asarray(p_ref) == 0].sum() == 0   # never off-support
+    tv = 0.5 * np.abs(emp - p_ref).sum()
+    assert tv < 0.12, (
+        f"speculative first-token marginal diverges from the target "
+        f"distribution: TV={tv:.3f}\n emp={np.nonzero(counts)[0]}")
+    # determinism: the same key replays the same acceptance decisions
+    e1 = vf(LOUD, dparams, kp, vp, *args, base,
+            jnp.asarray([temp], jnp.float32),
+            jnp.asarray([topk], jnp.int32))[0]
+    e2 = vf(LOUD, dparams, kp, vp, *args, base,
+            jnp.asarray([temp], jnp.float32),
+            jnp.asarray([topk], jnp.int32))[0]
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+
+
+def test_census_with_speculative_and_shared_traffic():
+    """ISSUE 16 acceptance: the speculative census is the prefill grid
+    + decode + EXACTLY ONE verify executable, and a mixed replay —
+    shared-prefix pairs, unshared ragged prompts, greedy and sampled
+    rows — never compiles one more."""
+    dcfg, dparams = _draft_pair()
+    spec = BucketSpec(batch=(1, 2), length=(8, 16))
+    srv = make_server(buckets=spec, n_slots=4, n_pages=65,
+                      draft=dparams, draft_config=dcfg, spec_k=2,
+                      max_new_tokens=4).start()
+    try:
+        census = srv.census()
+        assert census == 2 * 2 + 1 + 1         # grid + decode + verify
+        assert srv.jit_cache_count() == census
+        rng = np.random.RandomState(0)
+        system = rng.randint(0, CFG.vocab_size, size=8).astype(np.int32)
+        reqs = []
+        for i in range(10):
+            if i % 2:                          # shared-prefix traffic
+                tail = rng.randint(0, CFG.vocab_size,
+                                   size=1 + (i % 3)).astype(np.int32)
+                p = np.concatenate([system, tail])
+            else:                              # unshared ragged
+                p = rng.randint(0, CFG.vocab_size,
+                                size=int(rng.randint(1, 15))) \
+                    .astype(np.int32)
+            reqs.append(srv.submit(p, temperature=float(i % 2),
+                                   top_k=int(4 * (i % 2))))
+        for r in reqs:
+            r.result(timeout=120)
+        assert srv.jit_cache_count() == census, \
+            "speculative/shared traffic triggered a recompile"
+        st = srv.stats
+        assert st["pages_shared_mapped"] >= 2
+        assert st["verify_steps"] > 0
+    finally:
+        assert srv.drain(60)
+    assert srv.jit_cache_count() == census
+    assert srv.alloc.free_count() == srv.alloc.allocatable
+
+
+def test_speculative_validation_errors():
+    dcfg, dparams = _draft_pair()
+    with pytest.raises(ValueError, match="draft_config"):
+        make_server(draft=dparams)
+    bad = CausalLMConfig(vocab_size=CFG.vocab_size + 1, n_layers=1,
+                         n_heads=2, head_dim=8, d_ff=32)
+    with pytest.raises(ValueError, match="vocab"):
+        make_server(draft=dparams, draft_config=bad)
+    with pytest.raises(ValueError, match="spec_k"):
+        make_server(draft=dparams, draft_config=dcfg, spec_k=0)
+    with pytest.raises(ValueError, match="spec_window"):
+        make_server(draft=dparams, draft_config=dcfg, spec_window=0)

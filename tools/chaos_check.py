@@ -318,6 +318,7 @@ def llm_mode(args):
                      f"{srv.alloc.allocatable} after drain")
     if srv.alive():
         fails.append("decode loop survived the drain")
+    fails.extend(_llm_spec_leg(args))
     if fails:
         for f in fails:
             print(f"[chaos_check] FAIL: {f}")
@@ -325,8 +326,124 @@ def llm_mode(args):
     print(f"[chaos_check] PASS: drain completed with every accepted "
           f"sequence resolved ({oks} served, {errs} explicitly errored, "
           f"0 dropped), 0 recompiles ({warm} executables == census), "
-          f"pages fully reclaimed")
+          f"pages fully reclaimed; shared-prefix + speculative leg clean")
     return 0
+
+
+def _llm_spec_leg(args):
+    """ISSUE 16 leg: CoW prefix sharing + speculative decoding under
+    chaos — 4 clients stream prompts built on ONE common system prompt
+    through a speculative server (draft LM proposals, ONE pinned verify
+    executable) while a ``generate.decode`` fault burst fires and
+    SIGTERM lands mid-decode.  Must hold: 0 dropped accepted sequences,
+    ``recompiles_unexpected == 0``, free list == pool after drain.
+    Returns failure strings."""
+    import signal
+    import threading
+
+    from mxnet_tpu import fault, serving
+    from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
+                                                     draft_config,
+                                                     init_causal_lm)
+
+    cfg = CausalLMConfig(vocab_size=64, n_layers=2, n_heads=2,
+                         head_dim=8, d_ff=32)
+    dcfg = draft_config(cfg, n_layers=1)
+    srv = serving.GenerationServer(
+        init_causal_lm(cfg, seed=0), cfg,
+        buckets=serving.BucketSpec(batch=(1, 2), length=(16,)),
+        n_slots=4, n_pages=65, page_size=4, max_new_tokens=6,
+        max_queue=256, seed=0,
+        draft=init_causal_lm(dcfg, seed=1), draft_config=dcfg, spec_k=2,
+        breaker=serving.CircuitBreaker(threshold=3, base_delay=0.02,
+                                       max_delay=0.1),
+        name="ChaosSpecGen")
+    srv.start()
+    census, warm = srv.census(), srv.jit_cache_count()
+    print(f"[chaos_check] llm spec leg: warmed {warm} executables "
+          f"(census {census}: prefill grid + decode + verify), spec_k=2, "
+          f"one system prompt over 4 clients")
+
+    # every client's prompt = the SAME system prompt + a short random
+    # tail: the prefix index maps the leading pages once, everyone else
+    # shares them (CoW on first divergence)
+    system = np.random.RandomState(7).randint(0, 64, size=8) \
+        .astype(np.int32)
+    accepted, sheds = [], [0]
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(k):
+        rng = np.random.RandomState(200 + k)
+        for i in range(args.requests):
+            if stop.is_set():
+                return
+            tail = rng.randint(0, 64,
+                               size=int(rng.randint(1, 7))).astype(np.int32)
+            try:
+                req = srv.submit(np.concatenate([system, tail]),
+                                 max_new_tokens=int(rng.randint(1, 7)),
+                                 temperature=float(i % 2), top_k=4)
+                with lock:
+                    accepted.append(req)
+            except serving.RejectedError:
+                with lock:
+                    sheds[0] += 1
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=client, args=(k,))
+               for k in range(4)]
+    with fault.inject("generate.decode",
+                      RuntimeError("injected verify fault"),
+                      after_n=5, times=3) as h:
+        for t in threads:
+            t.start()
+        threading.Timer(0.3, os.kill, (os.getpid(), signal.SIGTERM)).start()
+        drained = srv.serve_forever(poll=0.01)
+    stop.set()
+    for t in threads:
+        t.join()
+
+    resolved = sum(1 for r in accepted if r.done())
+    oks = sum(1 for r in accepted
+              if r.done() and r.exception(timeout=0) is None)
+    errs = resolved - oks
+    st = srv.stats
+    recomp = srv.telemetry()["gauges"].get("recompiles_unexpected", 0)
+    print(f"[chaos_check] llm spec leg: accepted={len(accepted)} ok={oks} "
+          f"errored={errs} shed={sheds[0]} injected_fired={h.fired} "
+          f"verify_steps={st['verify_steps']} "
+          f"spec_accepted={st['spec_accepted']}/{st['spec_proposed']} "
+          f"pages_shared_mapped={st['pages_shared_mapped']} "
+          f"cow_faults={st['cow_faults']}")
+    fails = []
+    if not drained:
+        fails.append("spec leg: drain did not complete")
+    if resolved != len(accepted):
+        fails.append(f"spec leg: {len(accepted) - resolved} accepted "
+                     f"sequences were silently dropped")
+    if h.fired == 0:
+        fails.append("spec leg: injected decode faults never fired")
+    if errs == 0:
+        fails.append("spec leg: no sequence surfaced the injected failure")
+    if oks == 0:
+        fails.append("spec leg: no sequence was actually served")
+    if st["verify_steps"] == 0:
+        fails.append("spec leg: the verify executable never ran")
+    if st["pages_shared_mapped"] == 0:
+        fails.append("spec leg: the common system prompt never shared a "
+                     "page")
+    if recomp != 0:
+        fails.append(f"spec leg: recompiles_unexpected == {recomp}")
+    if srv.jit_cache_count() != warm or warm != census:
+        fails.append(f"spec leg: jit cache {srv.jit_cache_count()} vs "
+                     f"warmup {warm} vs census {census}")
+    if srv.alloc.free_count() != srv.alloc.allocatable:
+        fails.append(f"spec leg: page leak — {srv.alloc.free_count()} "
+                     f"free of {srv.alloc.allocatable} after drain")
+    if srv.alive():
+        fails.append("spec leg: decode loop survived the drain")
+    return fails
 
 
 def _fleet_int8_leg(step, mgr):

@@ -321,6 +321,8 @@ def _llm_parts(vocab=256, n_layers=2, n_heads=8, head_dim=4, d_ff=64,
         "lengths": sds((n_slots,), jnp.int32),
         "active": sds((n_slots,), jnp.bool_),
         "tables": sds((n_slots, pages_per_seq), jnp.int32),
+        "cow_src": sds((n_slots,), jnp.int32),
+        "cow_dst": sds((n_slots,), jnp.int32),
         "key": sds((2,), jnp.uint32),
         "temps": sds((n_slots,), jnp.float32),
         "topks": sds((n_slots,), jnp.int32),
@@ -353,9 +355,9 @@ def build_llm_decode_step():
     step = jax.jit(build_decode_step(cfg, g["page_size"], "jnp"),
                    donate_argnums=(1, 2))
     lowered = step.lower(p_avals, pool, pool, s["tokens"], s["lengths"],
-                         s["active"], s["tables"], s["key"], s["temps"],
-                         s["topks"])
-    n_args = _n_leaves(p_avals) + 2 + 7
+                         s["active"], s["tables"], s["cow_src"],
+                         s["cow_dst"], s["key"], s["temps"], s["topks"])
+    n_args = _n_leaves(p_avals) + 2 + 9
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged", **g}
     return EntryBuild(name="llm_decode_step", meta=meta, census=1,
@@ -385,9 +387,9 @@ def _llm_decode_step_tp(name, collectives, shards=8):
                                      mesh=mesh, tp_collectives=collectives),
                    donate_argnums=(1, 2))
     lowered = step.lower(p_avals, pool, pool, s["tokens"], s["lengths"],
-                         s["active"], s["tables"], s["key"], s["temps"],
-                         s["topks"])
-    n_args = _n_leaves(p_avals) + 2 + 7
+                         s["active"], s["tables"], s["cow_src"],
+                         s["cow_dst"], s["key"], s["temps"], s["topks"])
+    n_args = _n_leaves(p_avals) + 2 + 9
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
             "sharded": True, "tp_shards": shards,
@@ -449,6 +451,112 @@ def build_llm_decode_step_dense():
     return EntryBuild(name="llm_decode_step_dense", meta=meta, census=1,
                       programs=[Program("llm_decode_step_dense", lowered,
                                         n_args)])
+
+
+@entrypoint("llm_verify_step")
+def build_llm_verify_step(spec_k=3, spec_window=16):
+    """THE speculative-decoding verify executable (ISSUE 16): the draft
+    LM proposes ``spec_k`` tokens per slot and the target model scores
+    all ``spec_k + 1`` flattened lanes in this ONE program — the census
+    of a speculative server is the non-speculative census plus exactly
+    this entry.  Draft params ride along as ordinary arguments (a
+    1-layer sibling of the target config, same vocab), so
+    ``argument_bytes`` prices the full speculation tax."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.gluon.model_zoo.causal_lm import (draft_config,
+                                                     init_causal_lm)
+    from mxnet_tpu.serving.generate import build_verify_step
+
+    cfg, p_avals, g, s = _llm_parts()
+    dcfg = draft_config(cfg, n_layers=1)
+    d_avals = jax.eval_shape(lambda: init_causal_lm(dcfg, 0))
+    pool = jax.ShapeDtypeStruct(
+        (cfg.n_layers, g["n_pages"], g["page_size"], cfg.n_heads,
+         cfg.head_dim), jnp.float32)
+    sds = jax.ShapeDtypeStruct
+    step = jax.jit(build_verify_step(cfg, dcfg, g["page_size"], spec_k,
+                                     spec_window, "jnp"),
+                   donate_argnums=(2, 3))
+    lowered = step.lower(
+        p_avals, d_avals, pool, pool, s["tokens"],
+        sds((g["n_slots"], spec_window), jnp.int32),
+        sds((g["n_slots"],), jnp.int32), s["lengths"], s["active"],
+        s["tables"], s["cow_src"], s["cow_dst"], s["key"], s["temps"],
+        s["topks"])
+    n_args = _n_leaves(p_avals, d_avals) + 2 + 11
+    meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
+                     f"{cfg.n_heads}h{cfg.head_dim}",
+            "draft": f"causal_lm {dcfg.vocab_size}v {dcfg.n_layers}L "
+                     f"{dcfg.n_heads}h{dcfg.head_dim}",
+            "kv": "paged", "spec_k": spec_k, "spec_window": spec_window,
+            **g}
+    return EntryBuild(name="llm_verify_step", meta=meta, census=1,
+                      programs=[Program("llm_verify_step", lowered,
+                                        n_args)])
+
+
+def _llm_admission(name, n_pages, shared_prefix_len, prompt_len=192,
+                   max_new=64):
+    """Shared builder of the prefix-sharing admission golden pair: the
+    IDENTICAL decode program and slot grid, lowered over a pool sized
+    to admit the same worst-case traffic with and without CoW prefix
+    sharing.  Admission charges only NON-shared pages
+    (``prefix_admission_plan``), so at a 90%-shared prefix the shared
+    pool shrinks to sink + one resident prefix + charged pages per
+    slot — the committed ``argument_bytes`` gap IS the
+    page-bytes-per-sequence win, and the plan in ``meta`` pins the
+    >= 2x admissible-concurrency multiplier at fixed pool size."""
+    import jax
+    import jax.numpy as jnp
+
+    from mxnet_tpu.serving.generate import (build_decode_step,
+                                            prefix_admission_plan)
+
+    cfg, p_avals, g, s = _llm_parts(n_pages=n_pages)
+    plan = prefix_admission_plan(n_pages, g["page_size"], prompt_len,
+                                 max_new, shared_prefix_len)
+    pool = jax.ShapeDtypeStruct(
+        (cfg.n_layers, g["n_pages"], g["page_size"], cfg.n_heads,
+         cfg.head_dim), jnp.float32)
+    step = jax.jit(build_decode_step(cfg, g["page_size"], "jnp"),
+                   donate_argnums=(1, 2))
+    lowered = step.lower(p_avals, pool, pool, s["tokens"], s["lengths"],
+                         s["active"], s["tables"], s["cow_src"],
+                         s["cow_dst"], s["key"], s["temps"], s["topks"])
+    n_args = _n_leaves(p_avals) + 2 + 9
+    meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
+                     f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
+            "prompt_len": prompt_len, "max_new": max_new,
+            "shared_prefix_len": shared_prefix_len, **plan, **g}
+    return EntryBuild(name=name, meta=meta, census=1,
+                      programs=[Program(name, lowered, n_args)])
+
+
+@entrypoint("llm_admission_unshared")
+def build_llm_admission_unshared():
+    """Unshared admission baseline: every sequence is charged its full
+    worst case (16 pages: 192-token prompt + 64 new at page_size 16),
+    so the 8-slot grid needs a 128-page pool (n_pages 129 with the
+    sink).  ``meta.admissible_unshared`` = 8."""
+    return _llm_admission("llm_admission_unshared", n_pages=129,
+                          shared_prefix_len=176)
+
+
+@entrypoint("llm_admission_shared")
+def build_llm_admission_shared():
+    """The 90%-shared-prefix sibling: 176 of 192 prompt tokens are a
+    common system prefix (11 full pages resident ONCE), so admission
+    charges 5 pages per sequence and the same 8-slot worst case fits in
+    sink + 16 + 7x5 = 52 pages.  Diffed against
+    ``llm_admission_unshared`` by tests/test_costguard.py — the
+    committed floors are argument-bytes ratio and the >= 2x
+    admissible-concurrency multiplier at the FIXED 128-page pool
+    (``prefix_admission_plan(129, 16, 192, 64, 176)`` admits 23 shared
+    vs 8 unshared)."""
+    return _llm_admission("llm_admission_shared", n_pages=52,
+                          shared_prefix_len=176)
 
 
 @entrypoint("llm_prefill_grid")
