@@ -172,6 +172,42 @@ def _compile_fields():
         return {}           # throughput line still ships
 
 
+def _ckpt_fields(step):
+    """Snapshot-stall columns for a training BENCH line (ISSUE 17):
+    ``ckpt_sync_ms`` — wall time of one synchronous ``save_train_step``
+    (fetch + serialize + fsync + commit) on the bench's real payload —
+    and ``ckpt_stall_ms`` — what the step loop actually pays per
+    snapshot on the async pipeline (device→host fetch only).  The ratio
+    is the async win the tier-1 stall test bounds.  Writes to a temp
+    dir, best-effort like ``_cost_fields``; ``MXTPU_BENCH_CKPT=0`` opts
+    out."""
+    if os.environ.get("MXTPU_BENCH_CKPT", "1").lower() in ("0", "false"):
+        return {}
+    import shutil
+    import tempfile
+    fields = {}
+    d = tempfile.mkdtemp(prefix="mxtpu_bench_ckpt_")
+    try:
+        from mxnet_tpu.parallel import checkpoint as _ck
+        t0 = time.perf_counter()
+        _ck.save_train_step(step, os.path.join(d, "ckpt-00000001.npz"))
+        fields["ckpt_sync_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+        snap = _ck.AsyncSnapshotter()
+        try:
+            t0 = time.perf_counter()
+            snap.save(step, os.path.join(d, "ckpt-00000002.npz"))
+            fields["ckpt_stall_ms"] = round(
+                (time.perf_counter() - t0) * 1e3, 2)
+            snap.wait_until_finished(timeout=120.0)
+        finally:
+            snap.close(timeout=120.0)
+    except Exception:       # noqa: BLE001 — wedged backend mid-fetch;
+        pass                # the throughput line still ships
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+    return fields
+
+
 def _setup():
     import jax
 
@@ -272,6 +308,7 @@ def bench_resnet():
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
         **_cost_fields(step),
+        **_ckpt_fields(step),
         **_compile_fields(),
     }))
 
@@ -337,6 +374,7 @@ def bench_bert():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
         **_cost_fields(step),
+        **_ckpt_fields(step),
         **_compile_fields(),
     }))
 
@@ -392,6 +430,7 @@ def bench_lstm():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_LSTM_TOK_S, 4),
         **_cost_fields(step),
+        **_ckpt_fields(step),
         **_compile_fields(),
     }))
 
@@ -641,6 +680,7 @@ def bench_ssd():
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_SSD_IMG_S, 4),
         **_cost_fields(step),
+        **_ckpt_fields(step),
         **_compile_fields(),
     }))
 

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 
 import jax
 
@@ -22,6 +23,7 @@ __all__ = ["init", "shutdown", "rank", "num_workers", "barrier",
            "all_sum", "all_gather", "broadcast"]
 
 _initialized = False
+_epoch = 0            # completed init→shutdown round-trips
 _logger = logging.getLogger(__name__)
 
 
@@ -68,6 +70,18 @@ def init(coordinator=None, num_processes=None, process_id=None,
         retries = int(os.environ.get("DMLC_RETRY", "4") or 4)
     if timeout is None:
         timeout = float(os.environ.get("DMLC_INIT_TIMEOUT", "300") or 300)
+    if _epoch > 0 and process_id != 0:
+        # Re-init after a shutdown().  The leader re-creates the service
+        # on the SAME address, so a non-leader that reconnects too early
+        # can successfully REGISTER WITH THE OLD, DYING SERVICE (the
+        # service accepts it as a restarted task) — and when the leader
+        # then destroys that service, this rank's fresh error-poller
+        # turns the teardown into a process abort (xla client.h:80).
+        # The leader needs only milliseconds between the shutdown rally
+        # and the old service's death, so a short hold here keeps
+        # non-leaders out of that window.
+        time.sleep(float(os.environ.get("MXTPU_REINIT_DELAY", "0.5")
+                         or 0.5))
     # CPU backend rehearsal (SURVEY.md §4 distributed-without-a-cluster)
     # needs gloo for cross-process collectives; on TPU the ICI/DCN fabric
     # is used and this config is ignored.
@@ -108,19 +122,43 @@ def init(coordinator=None, num_processes=None, process_id=None,
     _initialized = True
 
 
+def _drain_before_shutdown():
+    """Rally every rank at a bounded barrier before anyone tears down.
+    The leader hosts the coordination service in-process: if it raced
+    ahead and destroyed the service while a peer's client were still
+    live, that peer's error-poller would mistake the teardown for a
+    peer death and abort the whole process (xla client.h:80 is a
+    LOG(FATAL)).  The rally pins the skew between "last rank enters
+    shutdown" and "leader destroys the service" to milliseconds.
+    Best-effort: any failure (a peer already dead, no client) falls
+    through to the plain shutdown."""
+    from jax._src import distributed as _jax_dist
+    if getattr(_jax_dist.global_state, "client", None) is None:
+        return
+    try:
+        barrier("mxtpu-pre-shutdown", timeout=5)
+    except Exception:
+        pass  # a peer is already gone: no ordering left to protect
+
+
 def shutdown():
     """Tear the coordination service down so a later ``init()`` can
     rebuild it — the shutdown→re-init round-trip a restarted elastic
     attempt relies on.  Idempotent; the connected flag (and the barrier
     sequence counters) reset even when the underlying shutdown raises,
     so a retrying re-init never wedges on half-torn state."""
-    global _initialized
+    global _initialized, _epoch
     if not _initialized:
         return
+    try:
+        _drain_before_shutdown()
+    except Exception:
+        pass
     try:
         jax.distributed.shutdown()
     finally:
         _initialized = False
+        _epoch += 1
         _barrier_seq.clear()
 
 

@@ -503,6 +503,10 @@ class Supervisor:
                       if rec.get("compile_in_progress"))}
         gauges.update(_telemetry.compile_gauges("Supervisor"))
         gauges.update(_telemetry.memory_gauges(None))
+        # snapshot-stream health (ISSUE 17): the supervisor's progress
+        # accounting rides the checkpoint directory, so its exposition
+        # carries the ckpt_* family too
+        gauges.update(_telemetry.ckpt_gauges())
         hists = _telemetry.registry().snapshot(
             prefix="Supervisor::")["histograms"]
         payload = _telemetry.exposition("supervisor", "Supervisor",

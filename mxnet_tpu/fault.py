@@ -30,8 +30,16 @@ point                        location
                              DataLoader host-batch production (per batch)
 ``prefetch.device_put``      DevicePrefetcher producer, before placement
 ``checkpoint.write``         save_train_step entry (before any file I/O)
+``checkpoint.serialize``     checkpoint writer, after the v1.1 digests are
+                             computed, before the payload is serialized
+                             (catches ``BitFlipInjection`` → silent
+                             corruption only the digest check can see)
+``checkpoint.fsync``         checkpoint writer, after the temp payload is
+                             flushed, before ``os.fsync`` makes it durable
 ``checkpoint.replace``       save_train_step, after the temp payload is
                              written, before ``os.replace`` commits it
+``checkpoint.verify``        integrity verification entry — every digest
+                             check (load paths + ``verify_checkpoint``)
 ``step``                     TrainStep._step entry (before batch placement)
 ``distributed.connect``      distributed.init, inside each connect attempt
 ``serving.admit``            InferenceServer.submit entry (before any
@@ -257,7 +265,12 @@ for _p, _w in (
     ("io.producer", "PrefetchingIter/DataLoader producers, per batch"),
     ("prefetch.device_put", "DevicePrefetcher producer, before placement"),
     ("checkpoint.write", "save_train_step entry, before any file I/O"),
+    ("checkpoint.serialize", "checkpoint writer, after digests, before "
+                             "serialization (BitFlipInjection hook)"),
+    ("checkpoint.fsync", "checkpoint writer, after flush, before os.fsync"),
     ("checkpoint.replace", "save_train_step, before os.replace commits"),
+    ("checkpoint.verify", "integrity verification entry, every digest "
+                          "check"),
     ("step", "TrainStep._step entry, before batch placement"),
     ("distributed.connect", "distributed.init, inside each connect attempt"),
     ("serving.admit", "InferenceServer.submit entry"),

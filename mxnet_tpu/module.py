@@ -513,6 +513,9 @@ def _fit_loop(mod, symbol, logger, train_data, eval_data, eval_metric,
                             heartbeat.beat(phase="snapshot")
                         _save_fit_snapshot(mod, symbol, checkpoint_prefix,
                                            epoch, nbatch)
+                        # any in-flight AsyncSnapshotter writes commit
+                        # BEFORE the process exits (ISSUE 17)
+                        _flush_async_checkpoints(logger)
                         logger.info(
                             "Epoch[%d] Batch[%d] caught signal %s: snapshot "
                             "saved under %r, exiting cleanly (resume with "
@@ -615,6 +618,21 @@ def _prune_fit_snapshots(prefix, keep_stamp=None):
                 os.remove(os.path.join(d, name))
             except OSError:
                 pass
+
+
+def _flush_async_checkpoints(logger):
+    """Drain any live ``AsyncSnapshotter`` before a SIGTERM exit: a
+    snapshot the step loop believed saved must be ON DISK before the
+    process dies — the elastic supervisor's progress accounting reads
+    the directory, never the queue.  Best-effort: a flush failure must
+    not turn a clean exit into a crash."""
+    try:
+        from .parallel.checkpoint import flush_pending
+        if not flush_pending(timeout=60.0):
+            logger.warning("async checkpoint flush timed out — a queued "
+                           "snapshot may not have committed")
+    except Exception as exc:    # noqa: BLE001 — exiting anyway
+        logger.warning("async checkpoint flush failed: %s", exc)
 
 
 def _save_fit_snapshot(mod, symbol, prefix, epoch, nbatch):

@@ -11,6 +11,24 @@ state, step counter) on the mesh, so it gets its own save/restore that:
   class, state layout, step count.  Restores into any mesh/sharding layout
   (re-``device_put`` against the step's shardings), so a checkpoint taken
   on dp=8 restores onto dp×tp or a different device count.
+- v1.1 (ISSUE 17): the manifest additionally carries a format version
+  plus a per-array crc32 digest and byte size for every ``p.*`` /
+  ``s.*`` / ``a.*`` entry, computed at write time BEFORE the bytes hit
+  the container.  ``verify_checkpoint`` deep-checks a snapshot without
+  constructing a TrainStep; every load path verifies digests before
+  staging anything, so a bit-flipped array is *damage* (skipped by
+  ``resume_latest``, rejected by the serving ``WeightUpdater``), never
+  silently-loaded poison.  Pre-v1.1 snapshots (no digests) still load —
+  the digest check is skipped and logged.
+- durability: the payload file AND its directory entry are fsynced
+  before+after the atomic ``os.replace`` commit, so "committed" survives
+  power loss, not just process death.
+- ``AsyncSnapshotter`` / ``CheckpointManager(async_save=True)``: the
+  step loop pays only the device→host fetch at the step boundary; a
+  background writer thread serializes, fsyncs, and commits.  Bounded
+  queue with skip-if-busy, ``wait_until_finished()``, and a process-wide
+  ``flush_pending()`` hook the SIGTERM / nonfinite-abort exits call so
+  a snapshot training believed committed is never lost in the queue.
 - multi-process: every rank gathers (all-gather for sharded arrays rides
   the fabric) and rank 0 writes; restore reads on every rank and re-shards
   via the step's own placement path.
@@ -23,21 +41,29 @@ from __future__ import annotations
 import json
 import logging
 import os
+import queue as _queue
+import threading
 import time as _time
+import weakref
+import zlib
 
 import numpy as np
 import jax
 
 from ..fault import fire as _fire
 from .. import elastic as _elastic
+from .. import telemetry as _telemetry
 
 __all__ = ["save_train_step", "load_train_step",
            "save_train_step_sharded", "load_train_step_sharded",
            "CheckpointManager", "CheckpointMismatchError",
+           "CheckpointCorruptError", "BitFlipInjection",
+           "verify_checkpoint", "AsyncSnapshotter", "flush_pending",
            "resume_latest", "list_checkpoints", "latest_checkpoint",
            "latest_step", "wait_for_new", "load_snapshot_params"]
 
 _MANIFEST = "__manifest__"
+FORMAT_VERSION = "1.1"
 _logger = logging.getLogger(__name__)
 
 
@@ -46,6 +72,38 @@ class CheckpointMismatchError(ValueError):
     name/shape, aux, or optimizer disagreement).  Distinct from unreadable
     (truncated/corrupt) files so recovery paths like ``resume_latest`` can
     skip damage but refuse to paper over a user error."""
+
+
+class CheckpointCorruptError(ValueError):
+    """A snapshot whose BYTES are wrong: missing/truncated payload entry,
+    byte-size drift, or a crc32 digest mismatch against the v1.1
+    manifest.  Always *damage* (never user error): ``resume_latest``
+    skips it for an older intact sibling, and the serving
+    ``WeightUpdater`` rejects it before any replica swap."""
+
+
+class BitFlipInjection(Exception):
+    """Fault-armed corruption injector (ISSUE 17).  Armed on the
+    ``checkpoint.serialize`` point via ``fault.inject``, the writer
+    CATCHES it (instead of propagating) and flips one bit in one payload
+    entry AFTER the manifest digests were computed — the committed
+    snapshot is then silently corrupt at the container level (the zip
+    CRCs are consistent with the flipped bytes), exactly the damage only
+    the v1.1 digest check can catch::
+
+        with fault.inject("checkpoint.serialize",
+                          checkpoint.BitFlipInjection(), times=1):
+            mgr.save()                    # commits a poisoned snapshot
+
+    ``key`` picks the payload entry (default: the largest ``p.*``),
+    ``byte`` the offset (default: the middle), ``bit`` the bit (0-7)."""
+
+    def __init__(self, key=None, byte=None, bit=0):
+        super().__init__(f"bit-flip injection (key={key}, byte={byte}, "
+                         f"bit={bit})")
+        self.key = key
+        self.byte = byte
+        self.bit = int(bit) & 7
 
 
 def _norm_name(n):
@@ -80,18 +138,11 @@ def _to_host(step, a):
     return np.asarray(a)
 
 
-def save_train_step(step, fname):
-    """Write params + optimizer state + aux + step count to ``fname``.
-
-    Layout: ``p.<i>`` trainable param i (in ``step._train_idx`` order),
-    ``s.<i>.<j>`` its j-th optimizer state array, ``a.<i>`` aux array i,
-    plus a JSON manifest with the param names for name-checked restore.
-
-    Preemption-safe: the ``.npz`` payload lands in ``fname + '.tmp'`` and
-    is committed with ``os.replace`` (atomic on POSIX), so a crash at ANY
-    point leaves either the previous complete checkpoint or the new one —
-    never a truncated payload under the final name.  Manifest and payload
-    live in the one file, so they can never disagree."""
+def _collect_payload(step):
+    """Fetch the step's arrays to host; ``(payload, manifest)`` where
+    ``payload`` maps ``p.*``/``s.*``/``a.*`` entry names to host arrays.
+    This is the ONLY part of a snapshot the step loop must block on —
+    the async writer pays everything downstream of it."""
     if not step._built:
         raise ValueError("TrainStep has not run yet — nothing to checkpoint")
     _fire("checkpoint.write")
@@ -110,14 +161,132 @@ def save_train_step(step, fname):
         "num_update": int(step._num_update),
         "state_counts": [len(s) for s in step._states],
     }
-    payload[_MANIFEST] = np.frombuffer(
-        json.dumps(manifest).encode(), dtype=np.uint8)
-    if jax.process_index() == 0:
-        tmp = fname + ".tmp"
-        with open(tmp, "wb") as f:
-            np.savez(f, **payload)
-        _fire("checkpoint.replace")
-        os.replace(tmp, fname)
+    return payload, manifest
+
+
+def _entry_bytes(a):
+    """The canonical byte view a digest is computed over (and verified
+    against): C-contiguous raw array bytes."""
+    return np.ascontiguousarray(a).tobytes()
+
+
+def _apply_bitflip(payload, flip):
+    """Honour an armed ``BitFlipInjection``: flip one bit in one entry's
+    bytes (digests were already computed, so the corruption is silent to
+    the container and visible only to the v1.1 digest check)."""
+    key = flip.key
+    if key is None:
+        params = [k for k in payload if k.startswith("p.")]
+        key = max(params or sorted(payload),
+                  key=lambda k: payload[k].nbytes)
+    a = payload[key]
+    buf = bytearray(_entry_bytes(a))
+    i = (len(buf) // 2 if flip.byte is None else int(flip.byte)) \
+        % max(1, len(buf))
+    buf[i] ^= 1 << flip.bit
+    payload = dict(payload)
+    payload[key] = np.frombuffer(bytes(buf), dtype=a.dtype).reshape(a.shape)
+    _logger.warning("checkpoint.serialize: injected bit-flip in %r "
+                    "(byte %d, bit %d)", key, i, flip.bit)
+    return payload
+
+
+def _fsync_dir(directory):
+    """fsync the directory entry so a committed rename survives power
+    loss, not just process death.  Platforms that refuse to fsync a
+    directory fd (some network filesystems) are skipped."""
+    try:
+        fd = os.open(directory or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _write_payload(payload, manifest, fname, trace=None):
+    """Serialize + fsync + atomically commit one snapshot (the writer
+    half of ``save_train_step``, shared with the async writer thread).
+
+    The v1.1 integrity manifest (format version, per-entry crc32 digest
+    and byte size) is stamped here, BEFORE serialization, so anything
+    that corrupts the bytes downstream — including the fault-armed
+    ``BitFlipInjection`` — is caught by the digest check at load time.
+    Durability: payload fsync before the ``os.replace`` commit, directory
+    fsync after it.  Returns the payload byte total."""
+    man = dict(manifest)
+    digests, sizes = {}, {}
+    total = 0
+    for k, a in payload.items():
+        b = _entry_bytes(a)
+        digests[k] = zlib.crc32(b) & 0xFFFFFFFF
+        sizes[k] = len(b)
+        total += len(b)
+    man["format"] = FORMAT_VERSION
+    man["digests"] = digests
+    man["sizes"] = sizes
+    sp = None if trace is None else trace.open("serialize",
+                                               parent=trace.root)
+    try:
+        _fire("checkpoint.serialize")
+    except BitFlipInjection as flip:
+        payload = _apply_bitflip(payload, flip)
+    blob = dict(payload)
+    blob[_MANIFEST] = np.frombuffer(
+        json.dumps(man).encode(), dtype=np.uint8)
+    tmp = fname + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **blob)
+        f.flush()
+        _fire("checkpoint.fsync")
+        os.fsync(f.fileno())
+    if sp is not None:
+        sp.end()
+    sp = None if trace is None else trace.open("commit", parent=trace.root)
+    _fire("checkpoint.replace")
+    os.replace(tmp, fname)
+    _fsync_dir(os.path.dirname(os.path.abspath(fname)))
+    if sp is not None:
+        sp.end()
+    _telemetry.registry().gauge("ckpt_bytes").set(total)
+    return total
+
+
+def save_train_step(step, fname):
+    """Write params + optimizer state + aux + step count to ``fname``.
+
+    Layout: ``p.<i>`` trainable param i (in ``step._train_idx`` order),
+    ``s.<i>.<j>`` its j-th optimizer state array, ``a.<i>`` aux array i,
+    plus a JSON manifest with the param names for name-checked restore
+    and the v1.1 integrity section (format version + per-entry crc32
+    digest and byte size).
+
+    Preemption-safe: the ``.npz`` payload lands in ``fname + '.tmp'`` and
+    is committed with ``os.replace`` (atomic on POSIX), so a crash at ANY
+    point leaves either the previous complete checkpoint or the new one —
+    never a truncated payload under the final name.  Manifest and payload
+    live in the one file, so they can never disagree.  Durable: payload
+    and directory entry are fsynced around the commit, so a committed
+    snapshot survives power loss too."""
+    t0 = _time.perf_counter()
+    tr = _telemetry.maybe_trace("snapshot", server="save_train_step") \
+        if _telemetry.ACTIVE else None
+    sp = None if tr is None else tr.open("fetch", parent=tr.root)
+    payload, manifest = _collect_payload(step)
+    if sp is not None:
+        sp.end()
+    try:
+        if jax.process_index() == 0:
+            _write_payload(payload, manifest, fname, trace=tr)
+        _telemetry.registry().gauge("ckpt_last_snapshot_ms").set(
+            round((_time.perf_counter() - t0) * 1e3, 3))
+    finally:
+        if tr is not None:
+            tr.root.end()
+            tr.finish()
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
         multihost_utils.sync_global_devices("ckpt_save")
@@ -131,8 +300,20 @@ def load_train_step(step, fname):
     step's own shardings, so restoring onto a different mesh works."""
     if not step._built:
         raise ValueError("build the TrainStep (run one step) before restore")
-    z = np.load(fname)
-    manifest = json.loads(bytes(z[_MANIFEST]).decode())
+    try:
+        z = np.load(fname)
+    except FileNotFoundError:
+        raise
+    except Exception as exc:          # torn zip container = damage
+        _corrupt(fname, f"unreadable container: {exc}")
+    try:
+        manifest = json.loads(bytes(z[_MANIFEST]).decode())
+    except Exception as exc:
+        _corrupt(fname, f"manifest missing or unreadable: {exc}")
+    # integrity FIRST: a bit-flipped/truncated entry must surface as
+    # CheckpointCorruptError (damage) before any model-match verdict or
+    # staging — never as a spurious mismatch, never as loaded poison
+    _verify_entries(z, manifest, fname)
     names = [step._names[i] for i in step._train_idx]
     saved_names = manifest["train_names"]
     if len(saved_names) != len(names):
@@ -196,6 +377,100 @@ def load_train_step(step, fname):
     import jax.numpy as jnp
     step._t = jax.device_put(jnp.zeros((), jnp.int32) + num_update,
                              step._repl)
+
+
+# -------------------------------------------------- integrity (v1.1) ------
+
+def _corrupt(path, msg):
+    """Record one integrity failure (gauge + flight-recorder dump) and
+    raise ``CheckpointCorruptError`` — the single chokepoint every
+    verification failure funnels through."""
+    _telemetry.registry().gauge("ckpt_verify_failures").add(1)
+    _telemetry.flight_trip("ckpt-verify-failure", path=str(path),
+                           error=str(msg))
+    raise CheckpointCorruptError(f"{path}: {msg}")
+
+
+def _verify_entries(z, manifest, path, entries=None):
+    """Digest-check payload entries against the v1.1 manifest BEFORE any
+    bytes are staged.  ``entries`` restricts the check (the params-only
+    reader verifies only ``p.*``); None checks every digest-covered entry
+    plus flags uncovered strays.  Returns True when digests were checked,
+    False for a pre-v1.1 snapshot (no digest section — skipped, logged).
+    Raises ``CheckpointCorruptError`` on any missing entry, byte-size
+    drift, or crc32 mismatch."""
+    _fire("checkpoint.verify")
+    digests = manifest.get("digests")
+    if digests is None:
+        _logger.info("checkpoint %s: pre-v1.1 snapshot (no digest "
+                     "section) — integrity check skipped", path)
+        return False
+    sizes = manifest.get("sizes") or {}
+    files = set(getattr(z, "files", ()))
+    if entries is None:
+        keys = list(digests)
+        strays = files - set(digests) - {_MANIFEST}
+        if strays:
+            _corrupt(path, f"payload entries {sorted(strays)} are not "
+                           f"covered by the v1.1 digest section")
+    else:
+        keys = list(entries)
+    for k in keys:
+        if k not in digests:
+            _corrupt(path, f"entry {k!r} has no digest in the manifest")
+        if k not in files:
+            _corrupt(path, f"payload entry {k!r} missing from container")
+        try:
+            b = _entry_bytes(z[k])
+        except Exception as exc:
+            _corrupt(path, f"payload entry {k!r} unreadable: {exc}")
+        if k in sizes and len(b) != int(sizes[k]):
+            _corrupt(path, f"payload entry {k!r} is {len(b)} bytes, "
+                           f"manifest says {sizes[k]}")
+        if (zlib.crc32(b) & 0xFFFFFFFF) != int(digests[k]):
+            _corrupt(path, f"crc32 mismatch on payload entry {k!r} "
+                           f"(bytes corrupted after write, or flipped "
+                           f"between digest and serialize)")
+    return True
+
+
+def verify_checkpoint(path):
+    """Deep-check one committed snapshot WITHOUT constructing a
+    TrainStep: container readability, manifest parse, and (v1.1) every
+    entry's byte size + crc32 digest.  Returns the parsed manifest dict
+    on success; raises ``CheckpointCorruptError`` on any damage
+    (``FileNotFoundError`` passes through untouched — a pruned path is
+    *stale*, not corrupt).  Pre-v1.1 snapshots verify container
+    readability only (every entry decompressed), logged as such.
+
+    This is the operator / CI spelling: ``verify_checkpoint(p)`` over a
+    retention directory proves the snapshot stream intact end to end."""
+    try:
+        z = np.load(path)
+    except FileNotFoundError:
+        raise
+    except Exception as exc:
+        _corrupt(path, f"unreadable container: {exc}")
+    try:
+        files = set(z.files)
+        if _MANIFEST not in files:
+            _corrupt(path, "no __manifest__ entry — not a v1 snapshot")
+        try:
+            manifest = json.loads(bytes(z[_MANIFEST]).decode())
+        except Exception as exc:
+            _corrupt(path, f"manifest unreadable: {exc}")
+        if not _verify_entries(z, manifest, path):
+            # pre-v1.1: no digests to check, but still decompress every
+            # entry so zip-level truncation cannot hide
+            for k in sorted(files - {_MANIFEST}):
+                try:
+                    z[k]
+                except Exception as exc:
+                    _corrupt(path, f"payload entry {k!r} unreadable: "
+                                   f"{exc}")
+        return manifest
+    finally:
+        z.close()
 
 
 # ---------------------------------------------------------------- v2 ------
@@ -452,11 +727,36 @@ def load_snapshot_params(fname):
     arrays in saved (``p.<k>``) order and ``names`` the matching
     manifest names.  This is the weight-update reader — a serving
     process streams training snapshots into its replicas without ever
-    constructing the training step they came from."""
-    z = np.load(fname)
-    manifest = json.loads(bytes(z[_MANIFEST]).decode())
-    names = list(manifest["train_names"])
-    return [z[f"p.{k}"] for k in range(len(names))], names
+    constructing the training step they came from.
+
+    Integrity: the ``p.*`` entries are digest-verified (v1.1) before
+    anything is returned — a corrupt snapshot raises
+    ``CheckpointCorruptError`` so the updater can reject it WITHOUT a
+    replica swap.  ``FileNotFoundError`` propagates untouched: a path
+    pruned between discovery and read is *stale* (re-poll), not bad."""
+    try:
+        z = np.load(fname)
+    except FileNotFoundError:
+        raise
+    except Exception as exc:          # torn zip container = damage
+        _corrupt(fname, f"unreadable container: {exc}")
+    try:
+        try:
+            manifest = json.loads(bytes(z[_MANIFEST]).decode())
+        except Exception as exc:
+            _corrupt(fname, f"manifest missing or unreadable: {exc}")
+        names = list(manifest["train_names"])
+        keys = [f"p.{k}" for k in range(len(names))]
+        _verify_entries(z, manifest, fname, entries=keys)
+        params = []
+        for k in keys:
+            try:
+                params.append(z[k])
+            except Exception as exc:
+                _corrupt(fname, f"payload entry {k!r} unreadable: {exc}")
+        return params, names
+    finally:
+        z.close()
 
 
 def resume_latest(step, directory, prefix="ckpt"):
@@ -509,6 +809,199 @@ def resume_latest(step, directory, prefix="ckpt"):
     return None
 
 
+# ------------------------------------------------------ async pipeline ----
+
+_LIVE_LOCK = threading.Lock()
+_LIVE_SNAPSHOTTERS = weakref.WeakSet()
+
+
+def flush_pending(timeout=None):
+    """Drain every live ``AsyncSnapshotter`` in the process: returns True
+    when all queued snapshot writes have committed (or none exist), False
+    on timeout.  The SIGTERM / nonfinite-abort exit paths call this so a
+    snapshot training believed saved is never lost in the queue — the
+    elastic supervisor's progress accounting reads the directory, not the
+    queue."""
+    with _LIVE_LOCK:
+        snaps = list(_LIVE_SNAPSHOTTERS)
+    ok = True
+    for s in snaps:
+        ok = s.wait_until_finished(timeout=timeout) and ok
+    return ok
+
+
+class AsyncSnapshotter:
+    """Non-blocking snapshot writes: the step loop pays ONLY the
+    device→host fetch; a background writer thread serializes, fsyncs,
+    and atomically commits through the same ``_write_payload`` as the
+    synchronous path (identical v1.1 format, identical durability).
+
+    The queue is bounded (``max_pending``, default 1 → double buffer:
+    one snapshot being written while the next is fetched).  When the
+    writer is still busy at the next save point the snapshot is SKIPPED
+    — counted in ``snapshots_skipped`` and the ``ckpt_snapshots_skipped``
+    gauge — rather than stalling training: a slow disk degrades snapshot
+    *frequency*, never step time.  ``wait_until_finished()`` drains;
+    every live instance is registered so the process-wide
+    ``flush_pending()`` (SIGTERM / nonfinite-abort paths) can drain them
+    all.  Writer-thread failures are latched in ``errors`` and logged —
+    the step loop is never interrupted by a failed background write.
+
+    Multi-process: every rank pays the fetch (sharded-array all-gathers
+    ride the fabric), rank 0 enqueues; there is deliberately no global
+    device sync per save — the fetch itself is the only coupling."""
+
+    def __init__(self, max_pending=1, on_commit=None):
+        self.max_pending = max(1, int(max_pending))
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._q = _queue.Queue()
+        self._pending = 0
+        self._skipped = 0
+        self._written = 0
+        self._errors = []
+        self._closed = False
+        self._on_commit = on_commit
+        self._thread = threading.Thread(target=self._run,
+                                        name="ckpt-writer", daemon=True)
+        self._thread.start()
+        with _LIVE_LOCK:
+            _LIVE_SNAPSHOTTERS.add(self)
+
+    # -- step-loop side ----------------------------------------------------
+    def save(self, step, fname):
+        """Snapshot ``step`` toward ``fname``.  Blocks only for the
+        device→host fetch; returns True when the write was enqueued,
+        False when it was skipped because ``max_pending`` writes are
+        already in flight.  The ``ckpt_last_snapshot_ms`` gauge records
+        the stall THIS call cost the step loop (fetch only)."""
+        t0 = _time.perf_counter()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("AsyncSnapshotter is closed")
+            if self._pending >= self.max_pending:
+                self._skipped += 1
+                _telemetry.registry().gauge(
+                    "ckpt_snapshots_skipped").set(self._skipped)
+                _logger.warning(
+                    "AsyncSnapshotter: skipping snapshot %s — %d write(s) "
+                    "still in flight (slow disk? raise max_pending or "
+                    "lower the snapshot rate)", fname, self._pending)
+                return False
+            self._pending += 1
+            _telemetry.registry().gauge(
+                "ckpt_pending_writes").set(self._pending)
+        try:
+            tr = _telemetry.maybe_trace("snapshot", server="async") \
+                if _telemetry.ACTIVE else None
+            sp = None if tr is None else tr.open("fetch", parent=tr.root)
+            payload, manifest = _collect_payload(step)
+            if sp is not None:
+                sp.end()
+            if tr is not None:
+                tr.root.end()
+                tr.finish()
+        except BaseException:
+            with self._idle:
+                self._pending -= 1
+                self._idle.notify_all()
+            raise
+        if jax.process_index() == 0:
+            self._q.put((payload, manifest, fname))
+        else:                                  # non-writer rank: fetch was
+            with self._idle:                   # the whole job
+                self._pending -= 1
+                self._idle.notify_all()
+        _telemetry.registry().gauge("ckpt_last_snapshot_ms").set(
+            round((_time.perf_counter() - t0) * 1e3, 3))
+        return True
+
+    def wait_until_finished(self, timeout=None):
+        """Block until every enqueued snapshot has committed; True when
+        drained, False on timeout."""
+        deadline = None if timeout is None \
+            else _time.monotonic() + float(timeout)
+        with self._idle:
+            while self._pending > 0:
+                remaining = None if deadline is None \
+                    else deadline - _time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+            return True
+
+    def close(self, timeout=None):
+        """Drain, stop the writer thread, deregister (idempotent)."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+        if already:
+            return
+        self.wait_until_finished(timeout=timeout)
+        self._q.put(None)
+        self._thread.join(timeout=10.0 if timeout is None else timeout)
+        with _LIVE_LOCK:
+            _LIVE_SNAPSHOTTERS.discard(self)
+
+    # -- introspection (locked: written by the writer thread) --------------
+    @property
+    def pending_writes(self):
+        with self._lock:
+            return self._pending
+
+    @property
+    def snapshots_skipped(self):
+        with self._lock:
+            return self._skipped
+
+    @property
+    def snapshots_written(self):
+        with self._lock:
+            return self._written
+
+    @property
+    def errors(self):
+        """``(fname, exception)`` pairs from failed background writes."""
+        with self._lock:
+            return list(self._errors)
+
+    # -- writer thread -----------------------------------------------------
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            payload, manifest, fname = item
+            tr = _telemetry.maybe_trace("snapshot", server="ckpt-writer") \
+                if _telemetry.ACTIVE else None
+            try:
+                _write_payload(payload, manifest, fname, trace=tr)
+                with self._lock:
+                    self._written += 1
+                cb = self._on_commit
+                if cb is not None:
+                    try:
+                        cb(fname)
+                    except Exception:
+                        _logger.exception(
+                            "AsyncSnapshotter: on_commit hook failed "
+                            "for %s", fname)
+            except Exception as exc:
+                _logger.error("AsyncSnapshotter: background write of %s "
+                              "failed: %s", fname, exc)
+                with self._lock:
+                    self._errors.append((fname, exc))
+            finally:
+                if tr is not None:
+                    tr.root.end()
+                    tr.finish()
+                with self._idle:
+                    self._pending -= 1
+                    _telemetry.registry().gauge(
+                        "ckpt_pending_writes").set(self._pending)
+                    self._idle.notify_all()
+
+
 class CheckpointManager:
     """Periodic, retained, preemption-safe checkpoints for a TrainStep.
 
@@ -520,16 +1013,31 @@ class CheckpointManager:
     from crashed writes are cleaned opportunistically.  Multi-process:
     rank 0 writes and prunes, every rank synchronises inside
     ``save_train_step``.
+
+    ``async_save=True`` routes writes through an ``AsyncSnapshotter``:
+    ``save()``/``maybe_save()`` block only for the device→host fetch and
+    the commit + retention pruning happen on the writer thread.  A save
+    landing while ``max_pending`` writes are still in flight is skipped
+    (see ``snapshots_skipped``).  Call ``wait_until_finished()`` before
+    reading the directory, and ``close()`` when done (the module-level
+    ``flush_pending()`` drains every live snapshotter on SIGTERM /
+    nonfinite-abort exits).
     """
 
     def __init__(self, step, directory, every_n_steps=0, keep_last=3,
-                 prefix="ckpt"):
+                 prefix="ckpt", async_save=False, max_pending=1):
         self.step = step
         self.directory = str(directory)
         self.every_n_steps = int(every_n_steps)
         self.keep_last = max(1, int(keep_last))
         self.prefix = prefix
         self._last_saved = None
+        # retention runs on the caller thread (sync) or the writer thread
+        # (async on_commit) — one lock so concurrent prunes never race
+        self._retain_lock = threading.Lock()
+        self._snapshotter = AsyncSnapshotter(
+            max_pending=max_pending,
+            on_commit=lambda _fname: self._retain()) if async_save else None
         if jax.process_index() == 0:
             os.makedirs(self.directory, exist_ok=True)
 
@@ -538,9 +1046,16 @@ class CheckpointManager:
                             f"{self.prefix}-{num_update:08d}.npz")
 
     def save(self):
-        """Snapshot now; returns the committed path."""
+        """Snapshot now; returns the committed path — or, async, the
+        DESTINED path (committed once the writer lands it; None when the
+        bounded queue skipped this save)."""
         n = int(self.step._num_update)
         fname = self._fname(n)
+        if self._snapshotter is not None:
+            if not self._snapshotter.save(self.step, fname):
+                return None
+            self._last_saved = n
+            return fname
         save_train_step(self.step, fname)
         self._last_saved = n
         self._retain()
@@ -575,18 +1090,52 @@ class CheckpointManager:
         return wait_for_new(self.directory, last_seen=last_seen,
                             timeout=timeout, prefix=self.prefix, poll=poll)
 
+    def wait_until_finished(self, timeout=None):
+        """Drain pending async writes (no-op when sync); True when the
+        directory reflects every accepted ``save()``."""
+        if self._snapshotter is None:
+            return True
+        return self._snapshotter.wait_until_finished(timeout=timeout)
+
+    def close(self, timeout=None):
+        """Drain and stop the async writer (no-op when sync)."""
+        if self._snapshotter is not None:
+            self._snapshotter.close(timeout=timeout)
+
+    @property
+    def snapshots_skipped(self):
+        """Saves dropped by the async bounded queue (0 when sync)."""
+        if self._snapshotter is None:
+            return 0
+        return self._snapshotter.snapshots_skipped
+
+    @property
+    def write_errors(self):
+        """``(fname, exception)`` pairs from failed async writes."""
+        if self._snapshotter is None:
+            return []
+        return self._snapshotter.errors
+
     def _retain(self):
         if jax.process_index() != 0:
             return
-        cks = self.checkpoints()
-        for _, path in cks[:-self.keep_last]:
-            try:
-                os.remove(path)
-            except OSError:
-                pass
-        for name in os.listdir(self.directory):
-            if name.startswith(self.prefix + "-") and name.endswith(".tmp"):
+        with self._retain_lock:
+            cks = self.checkpoints()
+            newest = cks[-1][1] if cks else None
+            for _, path in cks[:-self.keep_last]:
+                if path == newest:
+                    # never prune the newest committed snapshot — it is
+                    # the one a wait_for_new watcher was just handed and
+                    # the one resume must always find
+                    continue
                 try:
-                    os.remove(os.path.join(self.directory, name))
+                    os.remove(path)
                 except OSError:
                     pass
+            for name in os.listdir(self.directory):
+                if name.startswith(self.prefix + "-") and \
+                        name.endswith(".tmp"):
+                    try:
+                        os.remove(os.path.join(self.directory, name))
+                    except OSError:
+                        pass

@@ -545,6 +545,14 @@ class TrainStep:
                     _telemetry.flight_trip(
                         "nonfinite-abort", step=int(self._num_update),
                         consecutive_skips=self.consecutive_skips)
+                    try:
+                        # queued async snapshots commit before the abort
+                        # unwinds (ISSUE 17): the last GOOD state must be
+                        # on disk when the supervisor inspects the wreck
+                        from .checkpoint import flush_pending
+                        flush_pending(timeout=60.0)
+                    except Exception:  # noqa: BLE001 — the abort verdict
+                        pass           # must not be masked by a flush
                     raise NonFiniteAbortError(
                         f"TrainStep: {self.consecutive_skips} consecutive "
                         f"non-finite updates (budget {budget}) at "

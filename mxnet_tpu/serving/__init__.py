@@ -31,7 +31,8 @@ from .breaker import CircuitBreaker
 from .server import InferenceServer, module_apply
 from .fleet import (ServingFleet, ReplicaGroup, HotSwapApply,
                     WeightUpdater, SnapshotRejectedError,
-                    UpdateRolledBackError, validate_params)
+                    SnapshotPrunedError, UpdateRolledBackError,
+                    validate_params)
 from .generate import (GenerationServer, PageAllocator,
                        PoolExhaustedError, prefix_admission_plan)
 from .autoscale import FleetAutoscaler, ScalingPolicy
@@ -42,7 +43,8 @@ __all__ = ["InferenceServer", "module_apply", "BucketSpec",
            "DeadlineExceededError", "NonFiniteOutputError",
            "TenantThrottledError", "QoSClass", "ClassStats", "TenantQoS",
            "ServingFleet", "ReplicaGroup", "HotSwapApply", "WeightUpdater",
-           "SnapshotRejectedError", "UpdateRolledBackError",
+           "SnapshotRejectedError", "SnapshotPrunedError",
+           "UpdateRolledBackError",
            "validate_params", "GenerationServer", "PageAllocator",
            "PoolExhaustedError", "prefix_admission_plan",
            "FleetAutoscaler", "ScalingPolicy"]

@@ -213,6 +213,7 @@ class FleetAutoscaler:
         # nothing and stamps no report — zeros, but scrapers never branch)
         gauges.update(_telemetry.compile_gauges(self._name))
         gauges.update(_telemetry.memory_gauges(None))
+        gauges.update(_telemetry.ckpt_gauges())
         payload = _telemetry.exposition("fleet_autoscaler", self._name,
                                         counters, gauges)
         return _telemetry.render(payload, fmt)

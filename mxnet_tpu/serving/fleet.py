@@ -56,8 +56,8 @@ from .breaker import OPEN
 from .server import InferenceServer
 
 __all__ = ["ServingFleet", "ReplicaGroup", "HotSwapApply", "WeightUpdater",
-           "SnapshotRejectedError", "UpdateRolledBackError",
-           "validate_params"]
+           "SnapshotRejectedError", "SnapshotPrunedError",
+           "UpdateRolledBackError", "validate_params"]
 
 _logger = logging.getLogger(__name__)
 
@@ -67,6 +67,14 @@ class SnapshotRejectedError(RuntimeError):
     drift against the served params, or non-finite values) and was NOT
     applied to any replica.  The caller skips the snapshot — the fleet
     keeps serving the previous weights at full capacity."""
+
+
+class SnapshotPrunedError(RuntimeError):
+    """The snapshot path vanished between discovery and read — retention
+    pruned it (``CheckpointManager._retain``) while the updater held the
+    name.  STALE, not bad: retention never prunes the newest committed
+    snapshot, so a newer one exists — re-poll and apply that instead of
+    counting this one as skipped."""
 
 
 class UpdateRolledBackError(RuntimeError):
@@ -1155,6 +1163,10 @@ class ServingFleet:
         # stamped memory bytes
         gauges.update(_telemetry.compile_gauges(self._name))
         gauges.update(self._mem_gauges)
+        # snapshot-stream health (ISSUE 17): the fleet is the CONSUMER
+        # end of the checkpoint stream (WeightUpdater), so verify
+        # failures / skip counts surface here too
+        gauges.update(_telemetry.ckpt_gauges())
         gauges.update({f"replica_{k}": v
                        for k, v in agg["gauges"].items()})
         # fleet-routed traces are born under the FLEET's name, so their
@@ -1298,8 +1310,25 @@ class WeightUpdater:
         sequence.  Raises ``SnapshotRejectedError`` (nothing touched) or
         ``UpdateRolledBackError`` (fleet restored to previous weights)."""
         if isinstance(snapshot, (str, os.PathLike)):
-            from ..parallel.checkpoint import load_snapshot_params
-            params, _names = load_snapshot_params(str(snapshot))
+            from ..parallel.checkpoint import (CheckpointCorruptError,
+                                               load_snapshot_params)
+            try:
+                params, _names = load_snapshot_params(str(snapshot))
+            except FileNotFoundError as exc:
+                # retention pruned the path after discovery: stale, not
+                # bad — NOT counted in skipped (nothing was wrong with
+                # the snapshot; a newer one is committed)
+                raise SnapshotPrunedError(
+                    f"snapshot {snapshot} pruned by retention before it "
+                    f"could be read — re-poll for the newer one") from exc
+            except CheckpointCorruptError as exc:
+                # v1.1 integrity verdict (digest/size/container damage):
+                # rejected BEFORE validate_params, before any replica
+                # sees a byte of it
+                self.skipped += 1
+                raise SnapshotRejectedError(
+                    f"snapshot {snapshot} failed integrity verification "
+                    f"({exc}) — not applied to any replica") from exc
         else:
             params = snapshot            # container kind is validated
         members = self.fleet._members()
@@ -1441,7 +1470,9 @@ class WeightUpdater:
         new snapshot); applies the newest unseen one.  Returns its
         ``num_update`` or None.  A snapshot that fails (validation or
         rollback) is marked seen — a poisoned file must not be retried
-        on every poll — and the error propagates."""
+        on every poll — and the error propagates.  A path PRUNED between
+        discovery and read is stale, not bad: logged, ``None`` returned,
+        and the next poll picks up the newer snapshot retention kept."""
         if self._directory is None:
             raise ValueError("WeightUpdater: no watch directory — "
                              "construct with source=")
@@ -1453,7 +1484,11 @@ class WeightUpdater:
             return None
         num_update, path = found
         self.last_seen = num_update
-        self.update(path)
+        try:
+            self.update(path)
+        except SnapshotPrunedError as exc:
+            _logger.info("%s updater: %s", self.fleet._name, exc)
+            return None
         return num_update
 
     def start(self):

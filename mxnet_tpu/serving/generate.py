@@ -2478,6 +2478,7 @@ class GenerationServer:
         # behavior + stamped memory bytes, same keys on every runtime
         gauges.update(_telemetry.compile_gauges(self._name))
         gauges.update(self._mem_gauges)
+        gauges.update(_telemetry.ckpt_gauges())
         snap = _telemetry.registry().snapshot(prefix=f"{self._name}::")
         # the registry gauges under this server's prefix ride along too
         # (page_occupancy/tokens_out/preempted/retired were previously

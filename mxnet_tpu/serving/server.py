@@ -593,6 +593,7 @@ class InferenceServer:
         # behavior + stamped memory bytes, same keys on every runtime
         gauges.update(_telemetry.compile_gauges(self._name))
         gauges.update(self._mem_gauges)
+        gauges.update(_telemetry.ckpt_gauges())
         snap = _telemetry.registry().snapshot(prefix=f"{self._name}::")
         # every registry gauge under this server's prefix (the profiler
         # counter series: shed/expired/batch_occupancy/...) rides the

@@ -107,7 +107,7 @@ __all__ = [
     "compile_event", "track_compile", "compile_guard",
     "pin_compile_census",
     "compile_site_stats", "compile_stats", "compile_events",
-    "compile_gauges", "reset_compiles", "memory_gauges",
+    "compile_gauges", "reset_compiles", "memory_gauges", "ckpt_gauges",
     "FlightRecorder", "flight", "enable_flight", "flight_from_env",
     "flight_trip", "FLIGHT_ENV", "maybe_trace",
 ]
@@ -1356,6 +1356,27 @@ def memory_gauges(report=None):
             "mem_peak_bytes": val(report, "peak_bytes"),
             "mem_per_device_argument_bytes": val(pd, "argument_bytes"),
             "mem_per_device_peak_bytes": val(pd, "peak_bytes")}
+
+
+def ckpt_gauges():
+    """The ``ckpt_*`` gauge family (ISSUE 17) every runtime's exposition
+    serves — snapshot-stream health read straight off the registry, so
+    the keys exist (as zeros) even before the first checkpoint:
+    ``ckpt_last_snapshot_ms`` (step-loop stall of the last save — full
+    write when sync, fetch only when async), ``ckpt_bytes`` (payload
+    bytes of the last committed snapshot), ``ckpt_pending_writes``
+    (async writes in flight), ``ckpt_verify_failures`` (integrity
+    rejections), ``ckpt_snapshots_skipped`` (saves dropped by the async
+    bounded queue)."""
+    reg = registry()
+
+    def val(name):
+        g = reg.get(name)
+        return 0 if g is None else g.value
+
+    return {k: val(k) for k in
+            ("ckpt_last_snapshot_ms", "ckpt_bytes", "ckpt_pending_writes",
+             "ckpt_verify_failures", "ckpt_snapshots_skipped")}
 
 
 # ========================================================= flight recorder

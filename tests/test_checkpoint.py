@@ -354,12 +354,27 @@ def test_resume_latest_model_mismatch_raises(tmp_path):
 
 
 def _corrupt_payload_keep_marker(path):
-    """Rewrite one committed snapshot with a wrong-shaped p.0 payload:
-    the marker (name, container, manifest) stays valid, the payload no
-    longer matches the model — in-place damage, not a model change."""
+    """Rewrite one committed snapshot with a wrong-shaped p.0 payload,
+    keeping the container INTERNALLY consistent — the v1.1 digests are
+    recomputed over the new bytes, exactly what a different model's
+    legitimate snapshot looks like.  The integrity check must pass and
+    the model-match VALIDATION must be what rejects it (the
+    digest-inconsistent flavour of damage is test_checkpoint_durability's
+    corruption matrix)."""
     import json
+    import zlib
     z = dict(np.load(path))
     z["p.0"] = np.zeros((1, 1), np.float32)
+    manifest = json.loads(bytes(z["__manifest__"]).decode())
+    if "digests" in manifest:
+        entries = {k: a for k, a in z.items() if k != "__manifest__"}
+        manifest["digests"] = {
+            k: zlib.crc32(np.ascontiguousarray(a).tobytes()) & 0xFFFFFFFF
+            for k, a in entries.items()}
+        manifest["sizes"] = {
+            k: np.ascontiguousarray(a).nbytes for k, a in entries.items()}
+        z["__manifest__"] = np.frombuffer(
+            json.dumps(manifest).encode(), dtype=np.uint8)
     with open(path, "wb") as f:
         np.savez(f, **z)
     # sanity: the manifest still reads fine

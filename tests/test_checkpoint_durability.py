@@ -1,0 +1,508 @@
+"""Durable checkpoints (ISSUE 17): v1.1 per-entry digest verification
+over the corruption matrix, the AsyncSnapshotter pipeline (bounded
+queue, skip-if-busy, stall bound), fsync durability fault points,
+retention safety, and pre-v1.1 back-compat.
+
+`tools/chaos_check.py --mode ckpt` is the storm-level acceptance (kill
+-9 mid-write + armed bit-flips under a live WeightUpdater); this file is
+the deterministic tier-1 slice of the same contract.
+"""
+import io
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import zipfile
+import zlib
+
+import numpy as np
+import pytest
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import fault, gluon, parallel, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import checkpoint as ck
+from mxnet_tpu.parallel.checkpoint import (AsyncSnapshotter,
+                                           BitFlipInjection,
+                                           CheckpointCorruptError,
+                                           CheckpointManager,
+                                           FORMAT_VERSION, flush_pending,
+                                           load_snapshot_params,
+                                           load_train_step, resume_latest,
+                                           save_train_step,
+                                           verify_checkpoint)
+
+pytestmark = pytest.mark.ckpt
+
+_MANIFEST_MEMBER = "__manifest__.npy"
+
+GAUGES = ("ckpt_last_snapshot_ms", "ckpt_bytes", "ckpt_pending_writes",
+          "ckpt_verify_failures", "ckpt_snapshots_skipped")
+
+
+def _net(seed):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.BatchNorm(in_channels=16),
+            nn.Dense(4, in_units=16))
+    net.initialize()
+    return net
+
+
+def _step_for(net, opt_name="adam", **opt_kw):
+    mesh = parallel.make_mesh(dp=len(jax.devices()))
+    opt = mx.optimizer.create(opt_name, **opt_kw)
+    return parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                              opt, mesh=mesh)
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [(rng.randn(16, 8).astype(np.float32),
+             rng.randint(0, 4, (16,))) for _ in range(n)]
+
+
+@pytest.fixture(scope="module")
+def snap(tmp_path_factory):
+    """One built TrainStep plus two committed v1.1 snapshots of it —
+    the corruption-matrix tests each corrupt a fresh COPY."""
+    step = _step_for(_net(7))
+    batches = _batches(3, seed=5)
+    d = tmp_path_factory.mktemp("snaps")
+    step(*batches[0])
+    p1 = str(d / "ckpt-00000001.npz")
+    save_train_step(step, p1)
+    step(*batches[1])
+    p2 = str(d / "ckpt-00000002.npz")
+    save_train_step(step, p2)
+    return {"step": step, "p1": p1, "p2": p2, "batches": batches}
+
+
+# --------------------------------------------------- corruption matrix ----
+
+def _members(path):
+    with zipfile.ZipFile(path) as z:
+        return {n: z.read(n) for n in z.namelist()}
+
+
+def _rewrite(path, members):
+    # writestr recomputes zip member CRCs, so the container stays
+    # internally consistent — the damage is visible ONLY to the v1.1
+    # manifest digests (the hard case; torn files are the easy one)
+    with zipfile.ZipFile(path, "w") as z:
+        for n, blob in members.items():
+            z.writestr(n, blob)
+
+
+def _npy_blob(a):
+    buf = io.BytesIO()
+    np.save(buf, a)
+    return buf.getvalue()
+
+
+def _truncate_zip(path):
+    with open(path, "rb") as f:
+        raw = f.read()
+    with open(path, "wb") as f:
+        f.write(raw[:len(raw) // 2])
+
+
+def _flip_array_bit(path):
+    m = _members(path)
+    big = max((n for n in m if n.startswith("p.")), key=lambda n: len(m[n]))
+    blob = bytearray(m[big])
+    blob[-1] ^= 1                       # data region, not the .npy header
+    m[big] = bytes(blob)
+    _rewrite(path, m)
+
+
+def _truncate_manifest(path):
+    m = _members(path)
+    m[_MANIFEST_MEMBER] = m[_MANIFEST_MEMBER][:len(m[_MANIFEST_MEMBER]) // 2]
+    _rewrite(path, m)
+
+
+def _garbage_manifest(path):
+    m = _members(path)
+    m[_MANIFEST_MEMBER] = _npy_blob(
+        np.frombuffer(b"}{ not json at all", dtype=np.uint8))
+    _rewrite(path, m)
+
+
+def _drop_param_entry(path):
+    # short payload under a committed name: the writer died after the
+    # rename was already visible (or a partial external copy)
+    m = _members(path)
+    big = max((n for n in m if n.startswith("p.")), key=lambda n: len(m[n]))
+    del m[big]
+    _rewrite(path, m)
+
+
+CORRUPTORS = {
+    "truncated-zip": _truncate_zip,
+    "bitflipped-array": _flip_array_bit,
+    "truncated-manifest": _truncate_manifest,
+    "garbage-manifest": _garbage_manifest,
+    "missing-param-entry": _drop_param_entry,
+}
+
+
+@pytest.mark.parametrize("kind", sorted(CORRUPTORS))
+def test_corruption_matrix_detected_before_staging(kind, snap, tmp_path):
+    """Every corruption shape raises CheckpointCorruptError from BOTH
+    readers — the deep verifier and the params-only serving reader —
+    before a single byte is staged anywhere."""
+    path = str(tmp_path / "ckpt-00000002.npz")
+    shutil.copy(snap["p2"], path)
+    CORRUPTORS[kind](path)
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(path)
+    with pytest.raises(CheckpointCorruptError):
+        load_snapshot_params(path)
+
+
+def test_verify_checkpoint_ok_returns_v11_manifest(snap):
+    manifest = verify_checkpoint(snap["p2"])
+    assert manifest["format"] == FORMAT_VERSION
+    with np.load(snap["p2"]) as z:
+        entries = set(z.files) - {"__manifest__"}
+    assert set(manifest["digests"]) == entries
+    assert set(manifest["sizes"]) == entries
+    assert all(int(n) > 0 for n in manifest["sizes"].values())
+    assert sorted(manifest["digests"]) == sorted(manifest["sizes"])
+
+
+def test_verify_checkpoint_missing_file_is_stale_not_corrupt(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        verify_checkpoint(str(tmp_path / "ckpt-00000404.npz"))
+
+
+def test_verify_failures_gauge_counts_every_detection(snap, tmp_path):
+    path = str(tmp_path / "ckpt-00000002.npz")
+    shutil.copy(snap["p2"], path)
+    _flip_array_bit(path)
+    g = telemetry.registry().gauge("ckpt_verify_failures")
+    before = g.value
+    with pytest.raises(CheckpointCorruptError, match="crc32 mismatch"):
+        verify_checkpoint(path)
+    assert g.value == before + 1
+
+
+def test_resume_latest_skips_bitflipped_to_older_intact(tmp_path):
+    """A digest-failing newest snapshot is DAMAGE: resume_latest skips it
+    with a warning and restores the next-older intact one — recovery is
+    never wedged by one flipped bit."""
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(3))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=3)
+    batches = _batches(3, seed=8)
+    for x, y in batches:
+        step(x, y)
+        mgr.maybe_save()
+    _flip_array_bit(mgr.checkpoints()[-1][1])
+
+    step2 = _step_for(_net(44))
+    step2(*batches[0])
+    assert resume_latest(step2, d) == 2          # skipped 3, restored 2
+
+
+def test_load_train_step_rejects_corrupt_before_touching_step(snap, tmp_path):
+    path = str(tmp_path / "ckpt-00000002.npz")
+    shutil.copy(snap["p2"], path)
+    _flip_array_bit(path)
+    step = snap["step"]
+    params = [np.asarray(a).copy() for a in step._train_arrays]
+    n_before = step._num_update
+    with pytest.raises(CheckpointCorruptError):
+        load_train_step(step, path)
+    for b, a in zip(params, step._train_arrays):
+        np.testing.assert_array_equal(b, np.asarray(a))
+    assert step._num_update == n_before
+
+
+# ------------------------------------------------- fault-armed bit flip ----
+
+def test_bitflip_injection_is_invisible_to_container_but_not_digest(
+        snap, tmp_path):
+    """The armed BitFlipInjection corrupts AFTER digests are computed but
+    BEFORE serialization: zip member CRCs match the flipped bytes, so
+    only the v1.1 manifest digest can catch it — the exact silent-
+    corruption shape the format exists for."""
+    bad = str(tmp_path / "ckpt-00000002.npz")
+    with fault.inject("checkpoint.serialize", BitFlipInjection(),
+                      times=1) as h:
+        save_train_step(snap["step"], bad)
+    assert h.fired == 1
+    with zipfile.ZipFile(bad) as z:              # container self-consistent
+        assert z.testzip() is None
+    with pytest.raises(CheckpointCorruptError, match="crc32 mismatch"):
+        verify_checkpoint(bad)
+    with pytest.raises(CheckpointCorruptError):
+        load_snapshot_params(bad)
+
+
+def test_ckpt_fault_points_registered():
+    pts = fault.points()
+    for name in ("checkpoint.serialize", "checkpoint.fsync",
+                 "checkpoint.verify", "checkpoint.replace",
+                 "checkpoint.write"):
+        assert name in pts, name
+
+
+def test_fsync_fault_aborts_before_commit(snap, tmp_path):
+    """checkpoint.fsync fires between flush and fsync: a disk that dies
+    there must leave NO committed name — only the torn .tmp."""
+    f = str(tmp_path / "ckpt-00000042.npz")
+    with fault.inject("checkpoint.fsync", RuntimeError("disk gone"),
+                      times=1) as h:
+        with pytest.raises(RuntimeError, match="disk gone"):
+            save_train_step(snap["step"], f)
+    assert h.fired == 1
+    assert not os.path.exists(f)                 # never committed
+    assert os.path.exists(f + ".tmp")            # torn tmp, wrong name
+
+
+def test_verify_fault_point_fires_on_every_check(snap):
+    with fault.inject("checkpoint.verify", RuntimeError("verify-probe"),
+                      times=1) as h:
+        with pytest.raises(RuntimeError, match="verify-probe"):
+            verify_checkpoint(snap["p2"])
+    assert h.fired == 1
+
+
+# --------------------------------------------------- pre-v1.1 back-compat -
+
+def _strip_v11(path):
+    """Rewrite a real snapshot's manifest without format/digests/sizes —
+    byte-identical payload, pre-v1.1 metadata."""
+    with np.load(path) as z:
+        manifest = json.loads(bytes(z["__manifest__"]).decode())
+    for k in ("format", "digests", "sizes"):
+        manifest.pop(k, None)
+    m = _members(path)
+    m[_MANIFEST_MEMBER] = _npy_blob(np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8))
+    _rewrite(path, m)
+
+
+def test_pre_v11_snapshot_still_loads(snap, tmp_path, caplog):
+    """Back-compat regression: snapshots written before the digest
+    section must verify (container-level), load fully, and serve params
+    — with the skipped digest check logged, not silent."""
+    path = str(tmp_path / "ckpt-00000002.npz")
+    shutil.copy(snap["p2"], path)
+    _strip_v11(path)
+
+    with caplog.at_level(logging.INFO, logger="mxnet_tpu.parallel.checkpoint"):
+        manifest = verify_checkpoint(path)       # no raise
+    assert "pre-v1.1" in caplog.text
+    assert "digests" not in manifest
+
+    params, names = load_snapshot_params(path)   # serving reader
+    assert len(params) == len(names) > 0
+    v11_params, _ = load_snapshot_params(snap["p2"])
+    for got, want in zip(params, v11_params):    # byte-identical payload
+        np.testing.assert_array_equal(got, want)
+
+    step2 = _step_for(_net(11))                  # full restore
+    step2(*snap["batches"][0])
+    load_train_step(step2, path)
+    assert step2._num_update == 2
+
+
+def test_pre_v11_truncated_entry_still_detected(snap, tmp_path):
+    """No digests does NOT mean no checking: verify_checkpoint
+    decompresses every entry, so zip-level truncation cannot hide."""
+    path = str(tmp_path / "ckpt-00000002.npz")
+    shutil.copy(snap["p2"], path)
+    _strip_v11(path)
+    m = _members(path)
+    big = max((n for n in m if n.startswith("p.")), key=lambda n: len(m[n]))
+    m[big] = m[big][:len(m[big]) // 2]
+    _rewrite(path, m)
+    with pytest.raises(CheckpointCorruptError):
+        verify_checkpoint(path)
+
+
+# ------------------------------------------------------- async pipeline ----
+
+def test_async_snapshotter_roundtrip(snap, tmp_path):
+    f = str(tmp_path / "ckpt-00000002.npz")
+    s = AsyncSnapshotter()
+    try:
+        assert s.save(snap["step"], f) is True
+        assert s.wait_until_finished(timeout=60)
+        assert s.snapshots_written == 1
+        assert s.errors == []
+        manifest = verify_checkpoint(f)          # identical v1.1 format
+        assert manifest["format"] == FORMAT_VERSION
+        sync_params, _ = load_snapshot_params(snap["p2"])
+        async_params, _ = load_snapshot_params(f)
+        for a, b in zip(sync_params, async_params):
+            np.testing.assert_array_equal(a, b)  # same bytes as sync path
+    finally:
+        s.close(timeout=30)
+    with pytest.raises(RuntimeError, match="closed"):
+        s.save(snap["step"], f)
+
+
+def test_async_skip_if_busy_bounds_the_queue(snap, tmp_path, monkeypatch):
+    """max_pending writes in flight → the next save is SKIPPED (counted,
+    gauged, warned), never queued without bound and never a stall."""
+    real = ck._write_payload
+    gate = threading.Event()
+
+    def slow(*a, **kw):
+        gate.wait(30)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(ck, "_write_payload", slow)
+    f1 = str(tmp_path / "ckpt-00000001.npz")
+    f2 = str(tmp_path / "ckpt-00000002.npz")
+    s = AsyncSnapshotter(max_pending=1)
+    try:
+        assert s.save(snap["step"], f1) is True
+        assert s.save(snap["step"], f2) is False          # skip-if-busy
+        assert s.snapshots_skipped == 1
+        assert s.pending_writes == 1
+        assert s.wait_until_finished(timeout=0.2) is False  # still writing
+        gate.set()
+        assert flush_pending(timeout=60)          # process-wide drain
+        assert s.pending_writes == 0
+        assert s.snapshots_written == 1
+    finally:
+        gate.set()
+        s.close(timeout=30)
+    verify_checkpoint(f1)
+    assert not os.path.exists(f2)                 # the skip wrote nothing
+    assert telemetry.ckpt_gauges()["ckpt_snapshots_skipped"] >= 1
+
+
+def test_async_writer_failure_is_latched_not_fatal(snap, tmp_path,
+                                                   monkeypatch):
+    def boom(*a, **kw):
+        raise RuntimeError("disk full")
+
+    monkeypatch.setattr(ck, "_write_payload", boom)
+    f = str(tmp_path / "ckpt-00000009.npz")
+    s = AsyncSnapshotter()
+    try:
+        assert s.save(snap["step"], f) is True    # step loop unaffected
+        assert s.wait_until_finished(timeout=30)
+    finally:
+        s.close(timeout=30)
+    assert s.snapshots_written == 0
+    assert len(s.errors) == 1
+    bad_fname, exc = s.errors[0]
+    assert bad_fname == f and "disk full" in str(exc)
+    assert not os.path.exists(f)
+
+
+def test_flush_pending_with_no_live_snapshotters():
+    assert flush_pending(timeout=1.0) is True
+
+
+def test_manager_async_save_commits_and_retains(tmp_path):
+    """CheckpointManager(async_save=True): maybe_save returns the
+    DESTINED path immediately; retention runs on the writer's commit
+    callback and never prunes the newest committed snapshot."""
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(23))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=2,
+                            async_save=True, max_pending=8)
+    try:
+        for x, y in _batches(4, seed=9):
+            step(x, y)
+            assert mgr.maybe_save() is not None
+        assert mgr.wait_until_finished(timeout=60)
+        assert mgr.snapshots_skipped == 0
+        assert mgr.write_errors == []
+        cks = mgr.checkpoints()
+        assert len(cks) == 2                      # keep_last applied
+        assert cks[-1][0] == step._num_update     # newest survived
+        for _, p in cks:
+            verify_checkpoint(p)
+        assert not [f for f in os.listdir(d) if f.endswith(".tmp")]
+    finally:
+        mgr.close(timeout=30)
+
+    # the async stream is resumable like the sync one
+    step2 = _step_for(_net(31))
+    step2(*_batches(1, seed=9)[0])
+    assert resume_latest(step2, d) == 4
+
+
+def test_ckpt_gauges_family(snap, tmp_path):
+    g = telemetry.ckpt_gauges()
+    assert set(g) == set(GAUGES)
+    save_train_step(snap["step"], str(tmp_path / "ckpt-00000003.npz"))
+    g = telemetry.ckpt_gauges()
+    assert g["ckpt_bytes"] > 0
+    assert g["ckpt_last_snapshot_ms"] >= 0
+    assert g["ckpt_pending_writes"] == 0
+
+
+# ------------------------------------------------------------ stall bound -
+
+class _FakeStep:
+    """Duck-typed built TrainStep with a big host-resident payload so
+    serialize+fsync dominate: the async stall (fetch only) must then be
+    a small fraction of the synchronous write."""
+
+    _built = True
+
+    def __init__(self, mb=16):
+        n = (mb * 1024 * 1024) // 4
+        rng = np.random.RandomState(0)
+        self._train_arrays = [rng.rand(n).astype(np.float32)]
+        self._states = [()]
+        self._aux_arrays = []
+        self._names = ["w"]
+        self._train_idx = [0]
+        self._aux_idx = []
+        self.optimizer = mx.optimizer.create("sgd")
+        self._num_update = 1
+
+
+def test_async_stall_bound(tmp_path):
+    """Acceptance: the step-loop stall of an async save stays ≤ 25% of a
+    synchronous v1 write of the same payload (generous margins — the
+    async path pays ONLY the host fetch; serialize/crc/fsync/commit all
+    move to the writer thread)."""
+    step = _FakeStep()
+    sync_s, stall_s = [], []
+    s = AsyncSnapshotter(max_pending=1)
+    try:
+        for i in range(3):
+            t0 = time.perf_counter()
+            save_train_step(step, str(tmp_path / f"sync-{i:04d}.npz"))
+            sync_s.append(time.perf_counter() - t0)
+
+            f = str(tmp_path / f"async-{i:04d}.npz")
+            t0 = time.perf_counter()
+            assert s.save(step, f) is True
+            stall_s.append(time.perf_counter() - t0)
+            assert s.wait_until_finished(timeout=120)
+            verify_checkpoint(f)
+    finally:
+        s.close(timeout=60)
+    # best-of-N on both sides: immune to one-off scheduler hiccups while
+    # still proving the pipeline moves the write off the step loop
+    assert min(stall_s) <= 0.25 * max(sync_s), (sync_s, stall_s)
+
+
+def test_retention_keeps_newest_sync(tmp_path):
+    d = str(tmp_path / "ckpts")
+    step = _step_for(_net(13))
+    mgr = CheckpointManager(step, d, every_n_steps=1, keep_last=1)
+    for x, y in _batches(3, seed=13):
+        step(x, y)
+        mgr.maybe_save()
+    cks = mgr.checkpoints()
+    assert len(cks) == 1
+    assert cks[0][0] == step._num_update
+    verify_checkpoint(cks[0][1])

@@ -23,9 +23,10 @@ from mxnet_tpu.parallel.checkpoint import wait_for_new
 from mxnet_tpu.serving import (CircuitBreaker, FleetAutoscaler,
                                HotSwapApply, QoSClass, RejectedError,
                                ScalingPolicy, ServerClosedError,
-                               ServingFleet, SnapshotRejectedError,
-                               TenantQoS, TenantThrottledError,
-                               UpdateRolledBackError, WeightUpdater)
+                               ServingFleet, SnapshotPrunedError,
+                               SnapshotRejectedError, TenantQoS,
+                               TenantThrottledError, UpdateRolledBackError,
+                               WeightUpdater)
 
 pytestmark = pytest.mark.fleet
 chaos = pytest.mark.chaos
@@ -559,6 +560,98 @@ def test_updater_default_last_seen_skips_preexisting_snapshot(tmp_path):
         assert updater.applied == 0
         _write_snapshot(d, 6, [2.0 * W0], ["w"])
         assert updater.poll_once(timeout=5.0) == 6
+    finally:
+        assert fleet.drain(timeout=30)
+
+
+def _write_snapshot_v11(directory, num_update, params, names, corrupt=False):
+    """A v1.1 snapshot (manifest carries per-entry crc32 digests + byte
+    sizes) without a TrainStep.  ``corrupt=True`` flips one bit in the
+    largest payload entry AFTER the digests are computed — the container
+    stays internally consistent (zip member CRCs match the bytes on
+    disk), only the manifest digest disagrees, exactly the damage shape
+    ``BitFlipInjection`` produces in the writer."""
+    import zlib
+    os.makedirs(directory, exist_ok=True)
+    payload = {f"p.{k}": np.asarray(a) for k, a in enumerate(params)}
+    digests, sizes = {}, {}
+    for key, a in payload.items():
+        b = np.ascontiguousarray(a).tobytes()
+        digests[key] = zlib.crc32(b) & 0xFFFFFFFF
+        sizes[key] = len(b)
+    if corrupt:
+        key = max(payload, key=lambda k: payload[k].nbytes)
+        buf = bytearray(np.ascontiguousarray(payload[key]).tobytes())
+        buf[len(buf) // 2] ^= 1
+        payload[key] = np.frombuffer(
+            bytes(buf), dtype=payload[key].dtype).reshape(payload[key].shape)
+    manifest = {"format": "1.1", "train_names": list(names),
+                "aux_names": [], "optimizer": "SGD",
+                "num_update": int(num_update),
+                "state_counts": [0] * len(names),
+                "digests": digests, "sizes": sizes}
+    payload["__manifest__"] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8)
+    path = os.path.join(directory, f"ckpt-{num_update:08d}.npz")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **payload)
+    os.replace(tmp, path)
+    return path
+
+
+def test_updater_rejects_corrupt_snapshot_without_swap(tmp_path):
+    """ISSUE 17 satellite: a bit-flipped snapshot must be caught by the
+    digest check BEFORE any replica quarantine/swap — the fleet keeps
+    serving the old weights uninterrupted and the file is marked seen
+    (counted in ``skipped``) so the poll loop moves on to the next one."""
+    d = str(tmp_path / "ckpts")
+    _write_snapshot_v11(d, 1, [W0], ["w"])
+    fleet = make_fleet(n=2, name="FleetCorrupt").start()
+    try:
+        updater = WeightUpdater(fleet, d, last_seen=1, poll=0.05)
+        _write_snapshot_v11(d, 5, [4.0 * W0], ["w"], corrupt=True)
+        with pytest.raises(SnapshotRejectedError, match="integrity"):
+            updater.poll_once(timeout=5.0)
+        assert updater.skipped == 1 and updater.applied == 0
+        assert updater.last_seen == 5            # marked seen, not retried
+        np.testing.assert_allclose(fleet(_ex(1)), np.ones((4,)))  # old W0
+        for rep in fleet.replicas:               # no replica ever swapped
+            assert rep.apply.params[0] is W0
+
+        # the next INTACT snapshot still streams through normally
+        _write_snapshot_v11(d, 8, [2.0 * W0], ["w"])
+        assert updater.poll_once(timeout=5.0) == 8
+        np.testing.assert_allclose(fleet(_ex(1)), np.full((4,), 2.0))
+    finally:
+        assert fleet.drain(timeout=30)
+
+
+def test_updater_pruned_snapshot_is_stale_not_rejected(tmp_path, monkeypatch):
+    """ISSUE 17 satellite: a snapshot pruned by retention between
+    discovery and read is STALE (re-poll), not corrupt — ``update``
+    raises ``SnapshotPrunedError``, ``poll_once`` absorbs it and returns
+    None, and the ``skipped`` (bad-snapshot) counter stays untouched."""
+    d = str(tmp_path / "ckpts")
+    _write_snapshot(d, 1, [W0], ["w"])
+    fleet = make_fleet(n=1, name="FleetPrune").start()
+    try:
+        updater = WeightUpdater(fleet, d, last_seen=1, poll=0.05)
+        gone = os.path.join(d, "ckpt-00000007.npz")
+        with pytest.raises(SnapshotPrunedError, match="pruned"):
+            updater.update(gone)
+        assert updater.skipped == 0 and updater.applied == 0
+
+        # poll_once: discovery finds a snapshot that vanishes before the
+        # read — simulate the race by having wait_for_new hand back a
+        # path that retention already deleted
+        victim = _write_snapshot(d, 7, [3.0 * W0], ["w"])
+        os.remove(victim)
+        from mxnet_tpu.parallel import checkpoint as ck
+        monkeypatch.setattr(ck, "wait_for_new",
+                            lambda *a, **k: (7, victim))
+        assert updater.poll_once(timeout=1.0) is None
+        assert updater.skipped == 0              # stale, NOT bad
     finally:
         assert fleet.drain(timeout=30)
 

@@ -100,6 +100,20 @@ record, a metrics snapshot, compile events == the serving census, and
 ``recompiles_unexpected == 0``) while every accepted sequence still
 resolves explicitly.
 
+``--mode ckpt`` runs the ISSUE 17 acceptance: a subprocess snapshot
+storm is SIGKILLed mid-write repeatedly (every committed name must
+still pass ``verify_checkpoint`` — atomic commit + fsync means a kill
+can truncate only the invisible ``.tmp``), a fault-armed
+``BitFlipInjection`` commits a container-consistent but
+digest-poisoned snapshot (``verify_checkpoint`` /
+``load_snapshot_params`` / ``resume_latest`` must all treat it as
+damage), and a live ``WeightUpdater`` streams snapshots under
+``keep_last=1`` retention pruning while one mid-stream snapshot is
+corrupt.  The contract: **resume always lands on an intact verified
+snapshot**, **0 silently-loaded corrupt bytes** (trained on or
+served), and **0 dropped rolling updates** — a pruned path is stale
+(re-poll), never a skipped snapshot.
+
 ``--list-modes`` prints the mode registry and exits.
 
 Exit code 0 on success, 1 on any mismatch.  Forces ``JAX_PLATFORMS=cpu``
@@ -1710,6 +1724,248 @@ def elastic_mode(args):
     return 0
 
 
+_CKPT_WORKER = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel.checkpoint import CheckpointManager
+
+mx.random.seed(7)
+net = nn.HybridSequential()
+net.add(nn.Dense(16, activation="relu", in_units=8),
+        nn.Dense(4, in_units=16))
+net.initialize()
+mesh = parallel.make_mesh(dp=len(jax.devices()))
+step = parallel.TrainStep(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          mx.optimizer.create("adam"), mesh=mesh)
+rng = np.random.RandomState(0)
+x, y = rng.randn(16, 8).astype(np.float32), rng.randint(0, 4, (16,))
+step(x, y)
+mgr = CheckpointManager(step, sys.argv[1], every_n_steps=1, keep_last=4)
+mgr.resume_latest()
+while True:                     # snapshot storm until SIGKILLed
+    step(x, y)
+    mgr.maybe_save()
+"""
+
+
+def ckpt_mode(args):
+    """Durable-checkpoint chaos (ISSUE 17): kill -9 mid-write storm +
+    fault-armed bit-flip corruption + retention pruning under a live
+    WeightUpdater.  Resume must always land on an intact digest-verified
+    snapshot; corrupted bytes must never be trained on or served."""
+    import signal
+    import subprocess
+    import tempfile as _tempfile
+
+    import jax
+    import jax.numpy as jnp
+    import mxnet_tpu as mx
+    from mxnet_tpu import fault, gluon, parallel, serving
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.checkpoint import (BitFlipInjection,
+                                               CheckpointCorruptError,
+                                               CheckpointManager,
+                                               list_checkpoints,
+                                               load_snapshot_params,
+                                               resume_latest,
+                                               verify_checkpoint)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fails = []
+
+    def step_for(seed):
+        mx.random.seed(seed)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+        net.initialize()
+        mesh = parallel.make_mesh(dp=len(jax.devices()))
+        return parallel.TrainStep(net,
+                                  gluon.loss.SoftmaxCrossEntropyLoss(),
+                                  mx.optimizer.create("adam"), mesh=mesh)
+
+    rng = np.random.RandomState(0)
+    x, y = rng.randn(16, 8).astype(np.float32), rng.randint(0, 4, (16,))
+    survivor = step_for(99)
+    survivor(x, y)                       # build once, reused every leg
+
+    # ---- leg A: kill -9 a snapshot storm, repeatedly ---------------------
+    d = _tempfile.mkdtemp(prefix="chaos_ckpt_")
+    env = dict(os.environ, PYTHONPATH=root + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    kills = 3
+    def newest(directory):
+        cks = list_checkpoints(directory)
+        return cks[-1][0] if cks else 0
+
+    for round_no in range(kills):
+        # retention caps the COUNT at keep_last, so progress is measured
+        # by the newest committed num_update, not directory size
+        before = newest(d)
+        proc = subprocess.Popen([sys.executable, "-c", _CKPT_WORKER, d],
+                                env=env)
+        t0 = time.time()
+        while newest(d) < before + 2 and \
+                time.time() - t0 < 120 and proc.poll() is None:
+            time.sleep(0.02)
+        if proc.poll() is not None:
+            fails.append(f"leg A round {round_no}: worker exited "
+                         f"rc={proc.returncode} before the kill")
+            break
+        proc.send_signal(signal.SIGKILL)
+        proc.wait()
+        cks = list_checkpoints(d)
+        if newest(d) < before + 2:
+            fails.append(f"leg A round {round_no}: storm advanced the "
+                         f"newest snapshot from {before} to {newest(d)}, "
+                         f"wanted >= {before + 2}")
+        for _, path in cks:              # every COMMITTED name verifies —
+            try:                         # atomic commit + fsync means a
+                verify_checkpoint(path)  # kill can never corrupt one
+            except Exception as exc:     # noqa: BLE001
+                fails.append(f"leg A round {round_no}: committed snapshot "
+                             f"{os.path.basename(path)} failed "
+                             f"verification after kill -9: {exc}")
+        n = resume_latest(survivor, d)
+        if n is None:
+            fails.append(f"leg A round {round_no}: resume found nothing")
+        print(f"[chaos_check] ckpt: kill round {round_no}: "
+              f"{len(cks)} committed, all verified, resumed at step {n}",
+              flush=True)
+
+    # ---- leg B: fault-armed bit-flip — damage, never poison --------------
+    d2 = _tempfile.mkdtemp(prefix="chaos_ckpt_flip_")
+    victim = step_for(7)
+    victim(x, y)
+    mgr2 = CheckpointManager(victim, d2, keep_last=10)
+    mgr2.save()                          # intact
+    good = int(victim._num_update)
+    victim(x, y)
+    with fault.inject("checkpoint.serialize", BitFlipInjection(), times=1):
+        corrupt_path = mgr2.save()       # committed but digest-poisoned
+    try:
+        verify_checkpoint(corrupt_path)
+        fails.append("leg B: verify_checkpoint passed a bit-flipped "
+                     "snapshot")
+    except CheckpointCorruptError:
+        pass
+    try:
+        load_snapshot_params(corrupt_path)
+        fails.append("leg B: load_snapshot_params served corrupted bytes")
+    except CheckpointCorruptError:
+        pass
+    n = resume_latest(survivor, d2)
+    if n != good:
+        fails.append(f"leg B: resume landed on step {n}, wanted the "
+                     f"older intact snapshot {good}")
+    print(f"[chaos_check] ckpt: bit-flip rejected everywhere, resume "
+          f"fell back to intact step {good}", flush=True)
+
+    # ---- leg C: prune race + corrupt stream under a live updater ---------
+    d3 = _tempfile.mkdtemp(prefix="chaos_ckpt_race_")
+    trainer = step_for(7)
+    trainer(x, y)
+    # keep_last=1: retention prunes everything but the newest — the
+    # tightest possible race against the polling reader
+    mgr3 = CheckpointManager(trainer, d3, keep_last=1)
+    mgr3.save()
+    params, _ = load_snapshot_params(mgr3.checkpoints()[-1][1])
+    shapes = [tuple(p.shape) for p in params]
+    iw1, ib1 = shapes.index((16, 8)), shapes.index((16,))
+    iw2, ib2 = shapes.index((4, 16)), shapes.index((4,))
+
+    @jax.jit
+    def fwd(p, xx):
+        h = jnp.maximum(xx @ p[iw1].T + p[ib1], 0.0)
+        return h @ p[iw2].T + p[ib2]
+
+    applies = [serving.HotSwapApply(
+        lambda p, xx: np.asarray(fwd(p, xx)), list(params))
+        for _ in range(2)]
+    fleet = serving.ServingFleet(applies, buckets=(1, 4), max_delay=0.002,
+                                 sample=np.ones((8,), np.float32),
+                                 name="ChaosCkptFleet")
+    fleet.start()
+    updater = serving.WeightUpdater(fleet, mgr3, poll=0.01)
+    updater.start()
+    corrupt_round = 3
+    try:
+        for round_no in range(1, 6):
+            trainer(x, y)
+            if round_no == corrupt_round:
+                with fault.inject("checkpoint.serialize",
+                                  BitFlipInjection(), times=1):
+                    mgr3.save()
+                t0 = time.time()
+                while updater.skipped < 1 and time.time() - t0 < 30:
+                    time.sleep(0.01)
+                if updater.skipped < 1:
+                    fails.append("leg C: the corrupt snapshot was never "
+                                 "rejected by the updater")
+            else:
+                want_applied = updater.applied + 1
+                mgr3.save()
+                t0 = time.time()
+                while updater.applied < want_applied and \
+                        time.time() - t0 < 30:
+                    time.sleep(0.01)
+                if updater.applied < want_applied:
+                    fails.append(f"leg C: rolling update {round_no} "
+                                 f"dropped (applied={updater.applied}, "
+                                 f"skipped={updater.skipped})")
+        # deterministic prune-vs-reader race: the path vanishes between
+        # discovery and read — stale (re-poll), never a bad snapshot
+        pruned = os.path.join(d3, "ckpt-99999999.npz")
+        final = mgr3.checkpoints()[-1][1]
+        import shutil
+        shutil.copy(final, pruned)
+        os.remove(pruned)
+        skipped_before = updater.skipped
+        try:
+            updater.update(pruned)
+            fails.append("leg C: updating a pruned path did not raise")
+        except serving.SnapshotPrunedError:
+            pass
+        except Exception as exc:        # noqa: BLE001
+            fails.append(f"leg C: pruned path raised {type(exc).__name__}"
+                         f" instead of SnapshotPrunedError: {exc}")
+        if updater.skipped != skipped_before:
+            fails.append("leg C: a pruned (stale) path was counted as a "
+                         "skipped snapshot")
+    finally:
+        updater.stop(timeout=10)
+        fleet.drain(timeout=10)
+    # the fleet must serve the FINAL committed snapshot's weights — the
+    # corrupt round's bytes must never have reached a replica
+    want = np.asarray(fwd(
+        [jnp.asarray(p) for p in
+         load_snapshot_params(mgr3.checkpoints()[-1][1])[0]],
+        np.ones((1, 8), np.float32)))[0]
+    got = np.asarray(applies[0](np.ones((1, 8), np.float32)))[0]
+    if not np.allclose(got, want):
+        fails.append("leg C: replica does not serve the final intact "
+                     "snapshot's weights")
+    print(f"[chaos_check] ckpt: race leg applied={updater.applied} "
+          f"skipped={updater.skipped} (corrupt stream rejected, prune "
+          f"race re-polled)", flush=True)
+
+    if fails:
+        for f in fails:
+            print(f"[chaos_check] FAIL: {f}")
+        return 1
+    print(f"[chaos_check] PASS: {kills} kill -9 rounds left only "
+          f"verified-intact committed snapshots; bit-flip rejected by "
+          f"verify/load/resume; live updater under keep_last=1 pruning "
+          f"applied {updater.applied} updates, rejected the corrupt "
+          f"one, and served the final intact weights")
+    return 0
+
+
 MODES = {
     "train": ("kill-and-resume training smoke (ISSUE 2)", None),
     "serve": ("inject-and-drain serving smoke (ISSUE 4)", serve_mode),
@@ -1729,6 +1985,9 @@ MODES = {
     "obs": ("traced storm + replica kill + fault burst: complete span "
             "trees, attribution sums, off-switch overhead bound "
             "(ISSUE 13)", obs_mode),
+    "ckpt": ("kill -9 mid-write storm + armed bit-flip corruption + "
+             "retention-prune race under a live WeightUpdater "
+             "(ISSUE 17)", ckpt_mode),
 }
 
 
