@@ -98,6 +98,34 @@ def _cost_fields(step):
     return fields
 
 
+def _hlo_fields(src):
+    """Structural-HLO columns for a BENCH line (ISSUE 18):
+    ``donation_coverage`` (donated / donation-candidate entry params —
+    1.0 means every large float param that matches an output is
+    actually aliased) and ``collectives_n`` (collective op count),
+    computed by tools/hloguard's facts extractor over the SAME lowered
+    program the throughput came from — the structural numbers the
+    tier-1 hloguard gate pins, riding next to the measurement they
+    explain.  ``src`` is a TrainStep (lowered via ``.lower()``), an
+    already-lowered jax object, or raw module text.  Best-effort like
+    ``_cost_fields``; ``MXTPU_BENCH_HLO=0`` opts out."""
+    if os.environ.get("MXTPU_BENCH_HLO", "1").lower() in ("0", "false"):
+        return {}
+    try:
+        from tools.hloguard.rules import entry_census, extract_facts
+        text = src if isinstance(src, str) else (
+            src.as_text() if hasattr(src, "as_text")
+            else src.lower().as_text())
+        census = entry_census({"bench": extract_facts(text)})
+        d = census["donation"]
+        cov = (round(d["donated"] / d["candidates"], 3)
+               if d["candidates"] else 1.0)
+        return {"donation_coverage": cov,
+                "collectives_n": census["collectives"]["total"]}
+    except Exception:       # noqa: BLE001 — wedged mid-lower; the
+        return {}           # throughput line still ships
+
+
 def _trace_on(sample=1.0):
     """Arm the request tracer for a bench (ISSUE 13).  Returns True
     when armed.  ``sample=0.0`` arms ONLY the compile-event stream
@@ -308,6 +336,7 @@ def bench_resnet():
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
         **_cost_fields(step),
+        **_hlo_fields(step),
         **_ckpt_fields(step),
         **_compile_fields(),
     }))
@@ -374,6 +403,7 @@ def bench_bert():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_TOK_S, 4),
         **_cost_fields(step),
+        **_hlo_fields(step),
         **_ckpt_fields(step),
         **_compile_fields(),
     }))
@@ -430,6 +460,7 @@ def bench_lstm():
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s / BASELINE_LSTM_TOK_S, 4),
         **_cost_fields(step),
+        **_hlo_fields(step),
         **_ckpt_fields(step),
         **_compile_fields(),
     }))
@@ -537,34 +568,44 @@ def bench_llm():
     trace_fields = _trace_fields("BenchGen") if traced else {}
 
     fields = {}
-    if os.environ.get("MXTPU_BENCH_COSTS", "1").lower() not in ("0",
-                                                                "false"):
-        try:       # AOT cost analysis of THE decode program (lower-only;
-            #        sharded over the SAME tp mesh as the server, so the
-            #        per-device column reports the shard-local bytes)
-            import jax.numpy as jnp
-
+    lowered = None
+    n_param_leaves = 0
+    try:       # AOT re-lower of THE decode program (lower-only — no
+        #        compile — sharded over the SAME tp mesh as the server):
+        #        feeds both the cost column and the structural-HLO one
+        import jax.numpy as jnp
+        sds = jax.ShapeDtypeStruct
+        pool = sds((cfg.n_layers, n_pages, page_size, cfg.n_heads,
+                    cfg.head_dim), jnp.float32)
+        p_avals = jax.eval_shape(lambda: init_causal_lm(cfg, 0))
+        mesh = None
+        if tp_shards > 1:
+            from mxnet_tpu import parallel
+            mesh = parallel.make_mesh(
+                tp=tp_shards, devices=jax.devices()[:tp_shards])
+        # donate the KV pools like the server's real executable (and the
+        # costguard/hloguard registry) — else donation_coverage would
+        # report a gap the served program does not have
+        lowered = jax.jit(
+            build_decode_step(cfg, page_size, "jnp", mesh=mesh,
+                              tp_collectives=tp_collectives),
+            donate_argnums=(1, 2)).lower(
+            p_avals, pool, pool, sds((n_slots,), jnp.int32),
+            sds((n_slots,), jnp.int32), sds((n_slots,), jnp.bool_),
+            sds((n_slots, srv.pages_per_seq), jnp.int32),
+            sds((n_slots,), jnp.int32), sds((n_slots,), jnp.int32),
+            sds((2,), jnp.uint32), sds((n_slots,), jnp.float32),
+            sds((n_slots,), jnp.int32))
+        n_param_leaves = len(jax.tree.leaves(p_avals))
+    except Exception:       # noqa: BLE001 — wedged backend mid-lower;
+        pass                # the throughput line still ships
+    hlo_fields = _hlo_fields(lowered) if lowered is not None else {}
+    if lowered is not None and os.environ.get(
+            "MXTPU_BENCH_COSTS", "1").lower() not in ("0", "false"):
+        try:       # the compile is the expensive half — cost column only
             from tools.costguard.report import unit_report
-            sds = jax.ShapeDtypeStruct
-            pool = sds((cfg.n_layers, n_pages, page_size, cfg.n_heads,
-                        cfg.head_dim), jnp.float32)
-            p_avals = jax.eval_shape(lambda: init_causal_lm(cfg, 0))
-            mesh = None
-            if tp_shards > 1:
-                from mxnet_tpu import parallel
-                mesh = parallel.make_mesh(
-                    tp=tp_shards, devices=jax.devices()[:tp_shards])
-            lowered = jax.jit(
-                build_decode_step(cfg, page_size, "jnp", mesh=mesh,
-                                  tp_collectives=tp_collectives)).lower(
-                p_avals, pool, pool, sds((n_slots,), jnp.int32),
-                sds((n_slots,), jnp.int32), sds((n_slots,), jnp.bool_),
-                sds((n_slots, srv.pages_per_seq), jnp.int32),
-                sds((n_slots,), jnp.int32), sds((n_slots,), jnp.int32),
-                sds((2,), jnp.uint32), sds((n_slots,), jnp.float32),
-                sds((n_slots,), jnp.int32))
             rep = unit_report(lowered.compile(),
-                              n_args=len(jax.tree.leaves(p_avals)) + 11)
+                              n_args=n_param_leaves + 11)
             pd = rep.get("per_device", {})
             fields = {
                 "flops_T": round(rep.get("flops", 0.0) / 1e12, 6),
@@ -609,6 +650,7 @@ def bench_llm():
         "tp_shards": tp_shards,
         "tp_collectives": tp_collectives,
         **fields,
+        **hlo_fields,
         **prefix_fields,
         **trace_fields,
         **_compile_fields(),
@@ -680,6 +722,7 @@ def bench_ssd():
         "unit": "img/s/chip",
         "vs_baseline": round(img_s / BASELINE_SSD_IMG_S, 4),
         **_cost_fields(step),
+        **_hlo_fields(step),
         **_ckpt_fields(step),
         **_compile_fields(),
     }))

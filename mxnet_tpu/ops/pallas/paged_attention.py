@@ -45,9 +45,19 @@ def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32)            # (H, D)
         k = k_ref[0].astype(jnp.float32)            # (page, H, D)
         v = v_ref[0].astype(jnp.float32)
+        heads = q.shape[0]
         scale = 1.0 / jnp.sqrt(jnp.asarray(q.shape[-1], jnp.float32))
-        sc = jnp.einsum("hd,phd->hp", q * scale, k,
-                        preferred_element_type=jnp.float32)
+        qs = q * scale
+        # Mosaic only lowers 2D dots, so the batched ``hd,phd->hp``
+        # einsum is unrolled into one (1,D)·(page,D) contraction per
+        # head (H is a small compile-time constant)
+        sc = jnp.concatenate([
+            jax.lax.dot_general(
+                qs[h][None, :], k[:, h, :],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for h in range(heads)
+        ], axis=0)                                   # (H, page)
         pos = j * page_size + jax.lax.broadcasted_iota(
             jnp.int32, sc.shape, 1)                  # (H, page)
         sc = jnp.where(pos < length, sc, _NEG)
@@ -56,8 +66,14 @@ def _kernel(tables_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(sc - m_new[:, None])
         l_ref[...] = l_prev * alpha + p.sum(axis=-1)
-        acc_ref[...] = acc_ref[...] * alpha[:, None] + jnp.einsum(
-            "hp,phd->hd", p, v, preferred_element_type=jnp.float32)
+        pv = jnp.concatenate([
+            jax.lax.dot_general(
+                p[h][None, :], v[:, h, :],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for h in range(heads)
+        ], axis=0)                                   # (H, D)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + pv
         m_ref[...] = m_new
 
     @pl.when(j == pages_per_seq - 1)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Regenerate the committed hloguard structural goldens
+(``tests/goldens/hloguard/*.json``).
+
+Run after an INTENTIONAL structural change to a registered surface — a
+new collective schedule, a donation fix to ratchet in, a kernel
+instantiation added — then review the diff like any other source
+change: the golden IS the structural contract tier-1 lints against
+(``tests/test_hloguard.py::test_hloguard_gate_committed_tree``)::
+
+    python tests/goldens/hloguard/regen_hloguard.py             # all
+    python tests/goldens/hloguard/regen_hloguard.py llm_decode_step
+
+Goldens are recorded under the tier-1 bring-up (JAX_PLATFORMS=cpu,
+8-device virtual mesh) and only gate in a matching environment (the
+CPU-vs-TPU lowering caveat, docs/analysis.md).  Facts are extracted
+fresh — no cache — so a regen can never launder a stale record.
+``suppressions`` survive a regen verbatim: they are hand-written
+justified waivers, not generated data — edit them in the JSON, and any
+that no longer match raise ``stale-suppression`` at gate time.
+"""
+import json
+import os
+import sys
+from pathlib import Path
+
+# must precede any jax import — same bring-up as tests/conftest.py
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = Path(__file__).resolve().parents[3]
+sys.path.insert(0, str(REPO))
+
+
+def main(argv=None):
+    from tools.hloguard import engine, surfaces
+    from tools.hloguard.rules import entry_census, pattern_findings
+
+    names = (argv if argv else sys.argv[1:]) or surfaces.names()
+    unknown = [n for n in names if n not in surfaces.names()]
+    if unknown:
+        raise SystemExit(f"unknown surface(s): {unknown} "
+                         f"(registered: {surfaces.names()})")
+    out_dir = REPO / engine.GOLDEN_SUBDIR
+    out_dir.mkdir(parents=True, exist_ok=True)
+    env = engine.environment()
+    leftover = 0
+    for name in names:
+        surface = surfaces.build(name)
+        facts = engine.facts_for_programs(surface.programs)  # fresh
+        census = entry_census(facts)
+        path = out_dir / f"{name}.json"
+        suppressions = []
+        if path.exists():
+            old = json.loads(path.read_text(encoding="utf-8"))
+            suppressions = old.get("suppressions") or []
+        golden = dict(env)
+        golden.update({"entry": name, "meta": surface.meta,
+                       "census": census, "suppressions": suppressions})
+        path.write_text(json.dumps(golden, indent=2, sort_keys=True)
+                        + "\n", encoding="utf-8")
+        open_findings = [
+            (rule, msg) for rule, sev, msg in
+            pattern_findings(name, surface.meta, facts)
+            if sev == "error" and not any(
+                s.get("rule") == rule
+                and s.get("match", "") in msg
+                and (s.get("justification") or "").strip()
+                for s in suppressions)]
+        cc = census["custom_calls"]
+        print(f"wrote {path.relative_to(REPO)} "
+              f"({census['programs']} program(s), "
+              f"{census['collectives']['total']} collective(s), "
+              f"pallas {cc['pallas_unique']}/{cc['pallas_total']} "
+              f"unique/total)")
+        for rule, msg in open_findings:
+            leftover += 1
+            print(f"  UNSUPPRESSED {rule}: {msg}")
+    if leftover:
+        print(f"note: {leftover} unsuppressed pattern finding(s) remain "
+              f"— fix the program or add a justified suppression to the "
+              f"golden before committing (the tier-1 gate fails "
+              f"otherwise)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

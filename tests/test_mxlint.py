@@ -1325,14 +1325,37 @@ def test_unbudgeted_entrypoint_bad(tmp_path):
             pass
         """)
     msgs = fired(fs, "unbudgeted-entrypoint")
+    # a registration owes BOTH gate goldens: the costguard budget AND
+    # the hloguard structural census (ISSUE 18) — one finding per
+    # registration, naming every missing golden
     assert len(msgs) == 1
-    assert "my_new_model_train.json" in msgs[0].message
+    assert "goldens/budgets/my_new_model_train.json" in msgs[0].message
+    assert "goldens/hloguard/my_new_model_train.json" in msgs[0].message
+    assert "regen_hloguard.py" in msgs[0].message
 
 
-def test_unbudgeted_entrypoint_clean_with_golden(tmp_path):
+def test_unbudgeted_entrypoint_hloguard_golden_alone_missing(tmp_path):
     gdir = tmp_path / "tests" / "goldens" / "budgets"
     gdir.mkdir(parents=True)
     (gdir / "my_new_model_train.json").write_text("{}")
+    fs = lint(tmp_path, """
+        from tools.costguard import entrypoint
+
+        @entrypoint("my_new_model_train")
+        def build_my_new_model_train():
+            pass
+        """)
+    msgs = fired(fs, "unbudgeted-entrypoint")
+    assert len(msgs) == 1
+    assert "goldens/hloguard/my_new_model_train.json" in msgs[0].message
+    assert "goldens/budgets" not in msgs[0].message
+
+
+def test_unbudgeted_entrypoint_clean_with_golden(tmp_path):
+    for sub in ("budgets", "hloguard"):
+        gdir = tmp_path / "tests" / "goldens" / sub
+        gdir.mkdir(parents=True)
+        (gdir / "my_new_model_train.json").write_text("{}")
     fs = lint(tmp_path, """
         from tools.costguard import entrypoint
 

@@ -17,10 +17,13 @@ explicit warmup.  Two shapes silently break that:
                            a big model, the exact failure mode the
                            serving bucket grid exists to kill.
 ``unbudgeted-entrypoint``  a ``costguard.entrypoint("name")``
-                           registration with no committed budget golden
-                           under ``tests/goldens/budgets/`` — a surface
-                           declared budgetable but never actually
-                           budgeted regresses invisibly.
+                           registration missing either committed gate
+                           golden — the cost budget under
+                           ``tests/goldens/budgets/`` or the hloguard
+                           structural census under
+                           ``tests/goldens/hloguard/``.  A surface
+                           declared auditable but never actually
+                           audited regresses invisibly.
 """
 from __future__ import annotations
 
@@ -155,7 +158,8 @@ class UnbudgetedEntrypointRule(ProjectRule):
     id = "unbudgeted-entrypoint"
     default_severity = "error"
     description = ("costguard entry-point registration with no committed "
-                   "budget golden in tests/goldens/budgets/")
+                   "budget golden in tests/goldens/budgets/ or no "
+                   "structural golden in tests/goldens/hloguard/")
 
     def facts(self, mod):
         regs = []
@@ -170,20 +174,32 @@ class UnbudgetedEntrypointRule(ProjectRule):
         return regs or None
 
     def check_facts(self, facts, root, analyzed):
-        budgets_dir = root / "tests" / "goldens" / "budgets"
-        committed = {p.stem for p in budgets_dir.glob("*.json")} \
-            if budgets_dir.is_dir() else set()
+        # a registered entry point owes BOTH gate goldens: the costguard
+        # budget AND the hloguard structural census — either one missing
+        # means an unaudited surface
+        wanted = (
+            ("budgets", "python tests/goldens/budgets/regen_budgets.py"),
+            ("hloguard",
+             "python tests/goldens/hloguard/regen_hloguard.py"),
+        )
+        committed = {}
+        for subdir, _ in wanted:
+            gdir = root / "tests" / "goldens" / subdir
+            committed[subdir] = {p.stem for p in gdir.glob("*.json")} \
+                if gdir.is_dir() else set()
         for relpath, regs in facts:
             if relpath not in analyzed:
                 continue
             for name, line in regs or ():
-                if name in committed:
+                missing = [(subdir, regen) for subdir, regen in wanted
+                           if name not in committed[subdir]]
+                if not missing:
                     continue
+                paths = ", ".join(f"tests/goldens/{s}/{name}.json"
+                                  for s, _ in missing)
+                regens = "; ".join(f"{r} {name}" for _, r in missing)
                 yield Finding(
                     rule=self.id, path=relpath, line=line, col=1,
-                    message=f"entry point '{name}' is registered for "
-                            f"budgeting but tests/goldens/budgets/"
-                            f"{name}.json does not exist — commit a "
-                            f"golden (python tests/goldens/budgets/"
-                            f"regen_budgets.py {name}) or drop the "
-                            f"registration")
+                    message=f"entry point '{name}' is registered but "
+                            f"missing gate golden(s): {paths} — commit "
+                            f"them ({regens}) or drop the registration")

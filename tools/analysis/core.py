@@ -55,7 +55,7 @@ SEVERITIES = ("error", "warning", "info")
 
 #: bump when ANY rule's logic changes: it keys the incremental cache,
 #: and a stale record must never survive an analyzer upgrade
-ENGINE_VERSION = "3.1"
+ENGINE_VERSION = "3.2"
 
 # id of the meta-rule emitted for malformed disable comments; it cannot
 # itself be suppressed (suppressing the suppression-checker is turtles).

@@ -33,8 +33,13 @@ def _uri(path: str) -> str:
 
 
 def to_sarif(findings, rules: Optional[Iterable] = None,
-             tool_version: Optional[str] = None) -> str:
-    """Serialize findings as a SARIF 2.1.0 log (a JSON string)."""
+             tool_version: Optional[str] = None,
+             tool_name: str = "mxlint") -> str:
+    """Serialize findings as a SARIF 2.1.0 log (a JSON string).
+
+    ``tool_name`` names the SARIF driver: "mxlint" (default) or
+    "hloguard" — the structural HLO lint reuses this envelope so both
+    gates feed the same CI annotation tooling."""
     if tool_version is None:
         from .core import ENGINE_VERSION
         tool_version = ENGINE_VERSION
@@ -85,7 +90,7 @@ def to_sarif(findings, rules: Optional[Iterable] = None,
         "version": "2.1.0",
         "runs": [{
             "tool": {"driver": {
-                "name": "mxlint",
+                "name": tool_name,
                 "informationUri": "docs/analysis.md",
                 "version": tool_version,
                 "rules": rule_meta,

@@ -58,6 +58,15 @@ cache), come back byte-identical in verdicts, pass the budget check
 both times, and land inside the wall-clock budgets — the ISSUE 6
 analogue of the lint contract.
 
+``--mode hlo`` runs the full hloguard structural audit (every surface
+with a golden in tests/goldens/hloguard/) twice against a fresh facts
+cache, with every lowering prebuilt OUTSIDE the timed window: the cold
+run parses/extracts facts from ~2 MB of StableHLO text, the warm run
+must hit the HLO-hash facts cache, come back byte-identical in
+verdicts (findings, suppressions and censuses included), be >= 5x
+faster, and pass the structural gate both times — the ISSUE 18
+analogue of the lint and cost contracts.
+
 ``--mode elastic`` runs the ISSUE 9 acceptance end to end: an
 ``elastic.Supervisor`` drives a real 2-worker CPU training gang
 (``tests/elastic_worker.py``) to a target step while the harness
@@ -1548,6 +1557,80 @@ def cost_mode(args):
     return 0
 
 
+def hlo_mode(args):
+    """Cold-vs-warm structural-lint audit over every hloguard surface
+    (ISSUE 18).
+
+    Lowering every surface is deterministic and paid ONCE up front
+    (``surfaces.build`` memoizes per process) so the cold/warm timings
+    isolate exactly what the ``.hloguard_cache`` shortcuts: the
+    parse/extract stage keyed by the lowered-text hash.  The warm run
+    must come back byte-identical in verdicts — findings, suppressions
+    and censuses included — and actually skip the parse.
+    """
+    import shutil
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, root)
+    from tools import hloguard
+    from tools.hloguard import surfaces as hlo_surfaces
+
+    t0 = time.perf_counter()
+    names = hlo_surfaces.names()
+    n_programs = sum(len(hlo_surfaces.build(n).programs) for n in names)
+    build_s = time.perf_counter() - t0
+
+    cache_dir = tempfile.mkdtemp(prefix="chaos_hlo_cache_")
+    try:
+        t0 = time.perf_counter()
+        cold = hloguard.run_check(root=root, use_cache=True,
+                                  cache_dir=cache_dir)
+        cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        warm = hloguard.run_check(root=root, use_cache=True,
+                                  cache_dir=cache_dir)
+        warm_s = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+
+    speedup = cold_s / warm_s if warm_s > 0 else float("inf")
+    n_sup = sum(1 for f in cold.findings if f.suppressed)
+    print(f"[chaos_check] hlo: build={build_s:.2f}s cold={cold_s:.2f}s "
+          f"warm={warm_s:.2f}s speedup={speedup:.1f}x "
+          f"surfaces={len(cold.entries)} programs={n_programs} "
+          f"(suppressed={n_sup})")
+    fails = []
+    if not cold.ok:
+        fails.append("cold structural audit FAILED:\n" + cold.render())
+    if not warm.ok:
+        fails.append("warm structural audit FAILED:\n" + warm.render())
+    if cold.to_json() != warm.to_json():
+        fails.append("cached re-run changed the audit verdicts "
+                     "(byte mismatch)")
+    ungated = [e.name for e in cold.entries if not e.gated]
+    if ungated:
+        fails.append(f"surfaces not gated (golden/env mismatch): "
+                     f"{ungated} — the audit went dark on them")
+    if speedup < 5.0:
+        fails.append(f"cached re-run only {speedup:.1f}x faster (< 5x): "
+                     f"the facts cache is not skipping the parse "
+                     f"(lowering is prebuilt, so parse/extract is all "
+                     f"the cold run pays)")
+    if cold_s > 60.0:
+        fails.append(f"cold parse/extract audit took {cold_s:.1f}s "
+                     f"(> 60s budget)")
+    if warm_s > 10.0:
+        fails.append(f"warm audit took {warm_s:.1f}s (> 10s budget)")
+    if fails:
+        for f in fails:
+            print(f"[chaos_check] FAIL: {f}")
+        return 1
+    print(f"[chaos_check] PASS: warm audit {speedup:.1f}x faster, "
+          f"byte-identical verdicts, all {len(cold.entries)} surfaces "
+          f"structurally green")
+    return 0
+
+
 def elastic_mode(args):
     """Supervised-gang chaos (ISSUE 9): SIGKILL + SIGSTOP-hang +
     supervisor-SIGTERM legs over a real 2-worker CPU training gang."""
@@ -1977,6 +2060,8 @@ MODES = {
              lint_mode),
     "cost": ("cold-vs-warm compiled-cost budget audit (ISSUE 6)",
              cost_mode),
+    "hlo": ("cold-vs-warm structural HLO lint audit over every "
+            "hloguard surface (ISSUE 18)", hlo_mode),
     "elastic": ("supervised-gang SIGKILL + SIGSTOP-hang + supervisor "
                 "SIGTERM (ISSUE 9)", elastic_mode),
     "slo": ("mixed-tenant QoS storm + replica kill + autoscale cycle + "

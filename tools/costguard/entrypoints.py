@@ -153,8 +153,8 @@ def build_resnet50_nhwc_train(batch=8):
     return _train_step_build(
         "resnet50_nhwc_train", step, x, y,
         {"model": "resnet50_v1", "layout": "NHWC", "dtype": "bfloat16",
-         "batch": batch, "optimizer": "sgd(momentum=0.9, wd=1e-4)",
-         "sharded": True})
+         "precision": "bf16", "batch": batch,
+         "optimizer": "sgd(momentum=0.9, wd=1e-4)", "sharded": True})
 
 
 def _mnist_mlp_step(batch=64, dtype="float32", grad_reduce="f32",
@@ -191,8 +191,8 @@ def build_mnist_mlp_train(batch=64, dtype="float32"):
     step, x, y = _mnist_mlp_step(batch=batch, dtype=dtype)
     return _train_step_build(
         "mnist_mlp_train", step, x, y,
-        {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
-         "optimizer": "sgd(momentum=0.9)", "sharded": True,
+        {"model": "mlp 784-128-10", "dtype": dtype, "precision": "f32",
+         "batch": batch, "optimizer": "sgd(momentum=0.9)", "sharded": True,
          "dp_shards": int(step.mesh.devices.size)})
 
 
@@ -209,9 +209,9 @@ def build_mnist_mlp_train_dp1(batch=64, dtype="float32"):
     step, x, y = _mnist_mlp_step(batch=batch, dtype=dtype, dp=1)
     return _train_step_build(
         "mnist_mlp_train_dp1", step, x, y,
-        {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
-         "optimizer": "sgd(momentum=0.9)", "sharded": False,
-         "dp_shards": 1})
+        {"model": "mlp 784-128-10", "dtype": dtype, "precision": "f32",
+         "batch": batch, "optimizer": "sgd(momentum=0.9)",
+         "sharded": False, "dp_shards": 1})
 
 
 @entrypoint("mnist_mlp_train_gradq_int8")
@@ -231,9 +231,9 @@ def build_mnist_mlp_train_gradq_int8(batch=64, dtype="float32"):
                                  grad_reduce="int8")
     return _train_step_build(
         "mnist_mlp_train_gradq_int8", step, x, y,
-        {"model": "mlp 784-128-10", "dtype": dtype, "batch": batch,
-         "optimizer": "sgd(momentum=0.9)", "grad_reduce": "int8",
-         "sharded": True})
+        {"model": "mlp 784-128-10", "dtype": dtype, "precision": "int8",
+         "batch": batch, "optimizer": "sgd(momentum=0.9)",
+         "grad_reduce": "int8", "sharded": True})
 
 
 def _serving_mlp_grid_build(name, batch_buckets, length_buckets, features,
@@ -263,7 +263,8 @@ def _serving_mlp_grid_build(name, batch_buckets, length_buckets, features,
         return h @ p[2] + p[3]
 
     meta = {"model": f"mlp {features}-{hidden}-{out} apply",
-            "dtype": dtype, "batch_buckets": list(spec.batch),
+            "dtype": dtype, "precision": "int8" if quantize else "f32",
+            "batch_buckets": list(spec.batch),
             "length_buckets": list(spec.length)}
     if quantize:
         # the int8 serving shape: per-channel PTQ payload/scale pairs as
@@ -359,7 +360,8 @@ def build_llm_decode_step():
                          s["cow_dst"], s["key"], s["temps"], s["topks"])
     n_args = _n_leaves(p_avals) + 2 + 9
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
-                     f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged", **g}
+                     f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
+            "precision": "f32", **g}
     return EntryBuild(name="llm_decode_step", meta=meta, census=1,
                       programs=[Program("llm_decode_step", lowered,
                                         n_args)])
@@ -392,6 +394,7 @@ def _llm_decode_step_tp(name, collectives, shards=8):
     n_args = _n_leaves(p_avals) + 2 + 9
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
+            "precision": "int8" if collectives == "int8" else "f32",
             "sharded": True, "tp_shards": shards,
             "tp_collectives": collectives, **g}
     return EntryBuild(name=name, meta=meta, census=1,
@@ -447,7 +450,7 @@ def build_llm_decode_step_dense():
     n_args = _n_leaves(p_avals) + 2 + 6
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}",
-            "kv": "dense max-length", **g}
+            "kv": "dense max-length", "precision": "f32", **g}
     return EntryBuild(name="llm_decode_step_dense", meta=meta, census=1,
                       programs=[Program("llm_decode_step_dense", lowered,
                                         n_args)])
@@ -490,8 +493,8 @@ def build_llm_verify_step(spec_k=3, spec_window=16):
                      f"{cfg.n_heads}h{cfg.head_dim}",
             "draft": f"causal_lm {dcfg.vocab_size}v {dcfg.n_layers}L "
                      f"{dcfg.n_heads}h{dcfg.head_dim}",
-            "kv": "paged", "spec_k": spec_k, "spec_window": spec_window,
-            **g}
+            "kv": "paged", "precision": "f32", "spec_k": spec_k,
+            "spec_window": spec_window, **g}
     return EntryBuild(name="llm_verify_step", meta=meta, census=1,
                       programs=[Program("llm_verify_step", lowered,
                                         n_args)])
@@ -528,7 +531,8 @@ def _llm_admission(name, n_pages, shared_prefix_len, prompt_len=192,
     n_args = _n_leaves(p_avals) + 2 + 9
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
-            "prompt_len": prompt_len, "max_new": max_new,
+            "precision": "f32", "prompt_len": prompt_len,
+            "max_new": max_new,
             "shared_prefix_len": shared_prefix_len, **plan, **g}
     return EntryBuild(name=name, meta=meta, census=1,
                       programs=[Program(name, lowered, n_args)])
@@ -595,7 +599,7 @@ def build_llm_prefill_grid(batch_buckets=(1, 2), length_buckets=(32, 64)):
                                 n_args=_n_leaves(p_avals) + 2 + 7))
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}",
-            "batch_buckets": list(spec.batch),
+            "precision": "f32", "batch_buckets": list(spec.batch),
             "length_buckets": list(spec.length), **g}
     return EntryBuild(name="llm_prefill_grid", meta=meta,
                       programs=programs,
@@ -656,8 +660,8 @@ def _tp_mlp_build(name, shards, features=256, hidden=1024, batch=8):
                                        hidden=hidden, batch=batch)
     lowered = apply.lower(*avals)
     meta = {"model": f"mlp {features}-{hidden}-{features} apply",
-            "dtype": "float32", "batch": batch, "tp_shards": shards,
-            "sharded": shards > 1,
+            "dtype": "float32", "precision": "f32", "batch": batch,
+            "tp_shards": shards, "sharded": shards > 1,
             "layout": "w1 column-sharded / w2 row-sharded over tp; "
                       "activations replicated; one all-reduce on the "
                       "output"}

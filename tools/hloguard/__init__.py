@@ -1,0 +1,23 @@
+"""hloguard — structural lint over lowered HLO (docs/analysis.md
+"Structural HLO lint").
+
+The fourth leg of the static-analysis stack: mxlint reads Python
+source, costguard reads compiled-program costs, spmdlint reads
+shard_map regions — hloguard reads the *structure* of the lowered
+StableHLO itself, where missed donations, precision laundering,
+collective schedules, layout churn, and Pallas instantiation blowups
+are actually visible (Julia→TPU whole-program compilation,
+arXiv:1810.09868).
+
+Gate: ``python -m tools.hloguard`` (exit 0 = 0 unsuppressed findings
+over every registered surface with an environment-matched golden).
+"""
+from .engine import (CheckResult, EntryResult, check_entry, environment,
+                     golden_path, load_golden, run_check)
+from .rules import REPORT_VERSION, RULES
+
+__all__ = [
+    "CheckResult", "EntryResult", "REPORT_VERSION", "RULES",
+    "check_entry", "environment", "golden_path", "load_golden",
+    "run_check",
+]

@@ -1,0 +1,449 @@
+"""StableHLO text parser for hloguard (docs/analysis.md "Structural
+HLO lint").
+
+Operates on the *lowered* module text — ``jax.jit(fn).lower(...)
+.as_text()`` on the costguard CPU bring-up, or ``jax.export.export(...,
+platforms=["tpu"])(...).mlir_module()`` for the Pallas surfaces — NOT
+on the post-compile optimized HLO.  Lowered text preserves user dtypes
+(the CPU backend's bf16 emulation converts only appear after XLA
+compilation, which would make every bf16 entry look like an f32 leak),
+carries donation as ``tf.aliasing_output`` / ``jax.buffer_donor``
+parameter attributes, and is the same format for CPU lowerings and TPU
+exports, so one parser covers the whole surface.
+
+The parser is deliberately structural, not a full MLIR grammar: it
+tracks brace depth (quote-aware — Mosaic ``backend_config`` payloads
+embed braces inside string literals), splits the module into functions,
+and extracts per-function facts (parameters + donation attrs, result
+types, op census, SSA def/use edges for convert-chain walking, while
+regions, call edges, custom-call payloads).  Anything it cannot parse
+degrades to a ``ParsedModule(ok=False)`` graceful skip rather than an
+exception — a malformed module must never wedge the lint gate.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+# ops the rules care about, by census kind
+COLLECTIVE_KINDS = (
+    "all_reduce", "all_gather", "all_to_all", "collective_permute",
+    "reduce_scatter", "collective_broadcast",
+)
+_OP_RE = re.compile(
+    r'^\s*(?:%[\w#:]+\s*=\s*)?'           # optional "%0 = " / "%2:3 = "
+    r'(?:"(?P<q>[\w.]+)"|(?P<u>[\w.]+))'  # "stablehlo.all_reduce" | stablehlo.dot_general
+)
+_TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+_ARG_RE = re.compile(r"%arg(\d+):\s*")
+_ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+_DONOR_RE = re.compile(r"jax\.buffer_donor\s*=\s*true")
+_SSA_RE = re.compile(r"%[\w#]+")
+_CALLEE_RE = re.compile(r"@([\w.$-]+)")
+_BACKEND_CONFIG_STR_RE = re.compile(r'backend_config\s*=\s*"')
+# shape digits inside a payload (for the shape-normalized unique count)
+_PAYLOAD_SHAPE_RE = re.compile(r"(?:tensor<[^>]*>|\b\d+(?:x\d+)+\b|\b\d+\b)")
+
+
+@dataclass
+class Param:
+    index: int
+    type: str                 # raw type text, e.g. "tensor<128x784xf32>"
+    dims: tuple | None        # (128, 784) for ranked tensors, else None
+    dtype: str | None         # "f32", "bf16", "s8", ... else None
+    aliased: bool = False     # tf.aliasing_output present
+    donor: bool = False       # jax.buffer_donor present
+
+
+@dataclass
+class Op:
+    kind: str                 # dialect-stripped name: "dot_general", ...
+    line: int                 # 1-based line in the module text
+    result: str | None        # first SSA result id ("%12"), if any
+    operands: list = field(default_factory=list)   # SSA ids read
+    operand_types: list = field(default_factory=list)   # [(dims, dtype)]
+    result_types: list = field(default_factory=list)
+    in_while: bool = False
+    callee: str | None = None   # func.call target
+    payload: str | None = None  # custom_call backend_config text
+    target: str | None = None   # custom_call target name
+
+
+@dataclass
+class Func:
+    name: str
+    public: bool
+    params: list = field(default_factory=list)     # [Param]
+    results: list = field(default_factory=list)    # [(dims, dtype)]
+    ops: list = field(default_factory=list)        # [Op]
+    defs: dict = field(default_factory=dict)       # ssa id -> Op
+    calls_in_while: set = field(default_factory=set)
+    calls: set = field(default_factory=set)
+
+
+@dataclass
+class ParsedModule:
+    ok: bool
+    error: str | None = None
+    funcs: dict = field(default_factory=dict)      # name -> Func
+
+    @property
+    def main(self):
+        if "main" in self.funcs:
+            return self.funcs["main"]
+        for f in self.funcs.values():
+            if f.public:
+                return f
+        return None
+
+
+def _brace_delta(line: str) -> int:
+    """Net {} depth change, ignoring braces inside string literals."""
+    delta, in_str, esc = 0, False, False
+    for ch in line:
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch == "{":
+            delta += 1
+        elif ch == "}":
+            delta -= 1
+    return delta
+
+
+def _split_top(text: str, sep: str = ",") -> list:
+    """Split on ``sep`` at zero <>/()/{} depth, quote-aware."""
+    out, buf, depth, in_str, esc = [], [], 0, False, False
+    for ch in text:
+        if in_str:
+            buf.append(ch)
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch in "<({[":
+            depth += 1
+        elif ch in ">)}]":
+            depth -= 1
+        elif ch == sep and depth == 0:
+            out.append("".join(buf))
+            buf = []
+            continue
+        buf.append(ch)
+    if buf:
+        out.append("".join(buf))
+    return [s.strip() for s in out if s.strip()]
+
+
+def _tensor_info(type_text: str):
+    """("tensor<8x128xf32>") -> ((8, 128), "f32"); None fields if not
+    a ranked tensor type."""
+    m = _TENSOR_RE.search(type_text)
+    if not m:
+        return None, None
+    parts = m.group(1).split("x")
+    dims, dtype = [], None
+    for i, p in enumerate(parts):
+        if p.isdigit():
+            dims.append(int(p))
+        else:
+            dtype = "x".join(parts[i:])
+            break
+    else:
+        dtype = None
+    # strip encodings like "f32, #stablehlo.bounds<...>"
+    if dtype:
+        dtype = dtype.split(",")[0].strip()
+    return tuple(dims), dtype
+
+
+def _matching_brace(text: str, start: int) -> int:
+    """Index just past the brace-balanced region opening at
+    ``text[start] == '{'`` (quote-aware); -1 if unbalanced."""
+    depth, in_str, esc = 0, False, False
+    for i in range(start, len(text)):
+        ch = text[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                return i + 1
+    return -1
+
+
+def _parse_signature(sig: str, func: Func):
+    """Parse a func.func signature: parameters (with donation attrs)
+    and result types."""
+    lparen = sig.find("(")
+    if lparen < 0:
+        return
+    # walk the parameter list: "%argN: TYPE {attrs}, ..." up to the
+    # matching ")" at depth 0
+    depth, in_str, esc, i = 0, False, False, lparen
+    end = len(sig)
+    for i in range(lparen, len(sig)):
+        ch = sig[i]
+        if in_str:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = False
+            continue
+        if ch == '"':
+            in_str = True
+        elif ch in "(<{[":
+            depth += 1
+        elif ch in ")>}]":
+            depth -= 1
+            if depth == 0:
+                end = i
+                break
+    params_text = sig[lparen + 1:end]
+    for part in _split_top(params_text):
+        m = _ARG_RE.match(part)
+        if not m:
+            continue
+        idx = int(m.group(1))
+        rest = part[m.end():]
+        dims, dtype = _tensor_info(rest)
+        p = Param(index=idx, type=rest.strip(), dims=dims, dtype=dtype,
+                  aliased=bool(_ALIAS_RE.search(rest)),
+                  donor=bool(_DONOR_RE.search(rest)))
+        func.params.append(p)
+    # result types: after "->", either "(t1, t2, ...)" or a single type
+    arrow = sig.find("->", end)
+    if arrow < 0:
+        return
+    res = sig[arrow + 2:].strip()
+    if res.startswith("("):
+        close = res.rfind(")")
+        res_parts = _split_top(res[1:close if close > 0 else len(res)])
+    else:
+        res_parts = [res]
+    for part in res_parts:
+        dims, dtype = _tensor_info(part)
+        if dtype is not None:
+            func.results.append((dims, dtype))
+
+
+def _parse_op(line: str, line_no: int, in_while: bool):
+    m = _OP_RE.match(line)
+    if not m:
+        return None
+    full = m.group("q") or m.group("u")
+    if full in ("module", "func.func", "return") or full.startswith("#"):
+        return None
+    kind = full.split(".")[-1]
+    op = Op(kind=kind, line=line_no, result=None, in_while=in_while)
+    stripped = line.strip()
+    if stripped.startswith("%"):
+        op.result = "%" + stripped[1:].split("=")[0].split(":")[0].strip()
+    # operands: SSA ids mentioned after the op name, before the trailing
+    # functional-type annotation
+    body = line[m.end():]
+    type_split = body.rfind(" : ")
+    op.operands = _SSA_RE.findall(body[:type_split] if type_split >= 0
+                                  else body)
+    if type_split >= 0:
+        types = body[type_split + 3:]
+        arrow = types.find("->")
+        if arrow >= 0:
+            in_t, out_t = types[:arrow], types[arrow + 2:]
+        else:
+            in_t, out_t = types, types   # "same-type" ops: add, etc.
+        op.operand_types = [_tensor_info(t)
+                            for t in _split_top(in_t.strip().strip("()"))]
+        op.result_types = [_tensor_info(t)
+                           for t in _split_top(out_t.strip().strip("()"))]
+    if kind == "call":
+        cm = _CALLEE_RE.search(body)
+        op.callee = cm.group(1) if cm else None
+    if kind == "custom_call":
+        tm = _CALLEE_RE.search(body)
+        if tm:
+            op.target = tm.group(1)
+        else:
+            ct = re.search(r'call_target_name\s*=\s*"([^"]*)"', line)
+            op.target = ct.group(1) if ct else None
+        bm = _BACKEND_CONFIG_STR_RE.search(line)
+        if bm:
+            # quote-aware scan of the string literal
+            i, esc, buf = bm.end(), False, []
+            while i < len(line):
+                ch = line[i]
+                if esc:
+                    buf.append(ch)
+                    esc = False
+                elif ch == "\\":
+                    esc = True
+                elif ch == '"':
+                    break
+                else:
+                    buf.append(ch)
+                i += 1
+            op.payload = "".join(buf)
+        else:
+            bd = re.search(r"backend_config\s*=\s*\{", line)
+            if bd:
+                close = _matching_brace(line, bd.end() - 1)
+                if close > 0:
+                    op.payload = line[bd.end() - 1:close]
+    return op
+
+
+def parse_module(text: str) -> ParsedModule:
+    """Parse StableHLO module text into per-function facts.  Never
+    raises: malformed input returns ``ParsedModule(ok=False, error=…)``
+    so callers can graceful-skip."""
+    try:
+        return _parse_module(text)
+    except Exception as e:  # noqa: BLE001 — graceful-skip contract
+        return ParsedModule(ok=False, error=f"{type(e).__name__}: {e}")
+
+
+def _parse_module(text: str) -> ParsedModule:
+    mod = ParsedModule(ok=True)
+    lines = text.splitlines()
+    depth = 0
+    func = None
+    func_depth = 0
+    sig_buf = None            # accumulating a signature across lines
+    while_stack = []          # depths at which a while region opened
+    for ln, line in enumerate(lines, start=1):
+        delta = _brace_delta(line)
+        if sig_buf is not None:
+            sig_buf.append(line)
+            if depth + delta > depth0_sig:
+                _parse_signature(" ".join(sig_buf), func)
+                sig_buf = None
+            depth += delta
+            continue
+        stripped = line.strip()
+        if stripped.startswith("func.func"):
+            name_m = re.search(r"@([\w.$-]+)", line)
+            func = Func(name=name_m.group(1) if name_m else f"?line{ln}",
+                        public="private" not in stripped.split("@")[0])
+            mod.funcs[func.name] = func
+            func_depth = depth
+            if delta > 0:
+                _parse_signature(line, func)
+            else:
+                sig_buf = [line]
+                depth0_sig = depth
+            depth += delta
+            continue
+        if func is not None:
+            in_while = bool(while_stack)
+            op = _parse_op(line, ln, in_while)
+            if op is not None:
+                func.ops.append(op)
+                if op.result:
+                    func.defs[op.result] = op
+                if op.kind == "while" and delta > 0:
+                    while_stack.append(depth)
+                if op.kind == "call" and op.callee:
+                    func.calls.add(op.callee)
+                    if in_while:
+                        func.calls_in_while.add(op.callee)
+        depth += delta
+        while while_stack and depth <= while_stack[-1]:
+            while_stack.pop()
+        if func is not None and depth <= func_depth:
+            func = None
+    if depth != 0:
+        return ParsedModule(
+            ok=False, error=f"unbalanced braces (depth {depth} at EOF)",
+            funcs=mod.funcs)
+    if not mod.funcs:
+        return ParsedModule(ok=False, error="no func.func found")
+    return mod
+
+
+def reachable_funcs(mod: ParsedModule, entry: str = None) -> set:
+    """Names of funcs reachable from ``entry`` (default: main) through
+    ``func.call`` edges, entry included."""
+    start = entry or (mod.main.name if mod.main else None)
+    if start is None or start not in mod.funcs:
+        return set()
+    seen, todo = set(), [start]
+    while todo:
+        name = todo.pop()
+        if name in seen or name not in mod.funcs:
+            continue
+        seen.add(name)
+        todo.extend(mod.funcs[name].calls)
+    return seen
+
+
+def funcs_reached_from_while(mod: ParsedModule) -> set:
+    """Funcs whose bodies execute inside *some* while region reachable
+    from main: callees of in-while ``func.call`` sites, transitively
+    (a fori_loop body lowers to a private func called from the while
+    region, so "collective inside a while" must follow call edges)."""
+    reach = reachable_funcs(mod)
+    seeds = set()
+    for name in reach:
+        seeds |= mod.funcs[name].calls_in_while
+    seen, todo = set(), list(seeds)
+    while todo:
+        name = todo.pop()
+        if name in seen or name not in mod.funcs:
+            continue
+        seen.add(name)
+        todo.extend(mod.funcs[name].calls)
+    return seen
+
+
+def trace_back(func: Func, op: Op, want, limit: int = 256, stop=None):
+    """Walk SSA operands backwards from ``op`` within ``func`` looking
+    for an op for which ``want(op)`` is true; returns it or None.
+    ``stop(op)`` true = do not walk through that op's operands (a
+    barrier).  Bounded so pathological graphs stay cheap."""
+    seen, todo, steps = set(), list(op.operands), 0
+    while todo and steps < limit:
+        ssa = todo.pop()
+        if ssa in seen:
+            continue
+        seen.add(ssa)
+        steps += 1
+        d = func.defs.get(ssa)
+        if d is None:
+            continue
+        if want(d):
+            return d
+        if stop is not None and stop(d):
+            continue
+        todo.extend(d.operands)
+    return None
+
+
+def normalize_payload(payload: str) -> str:
+    """Shape-normalized payload: shape/tensor tokens stripped, so two
+    instantiations of one kernel at different geometries dedupe (the
+    item-4 "same kernel, 150 shapes" signal)."""
+    return _PAYLOAD_SHAPE_RE.sub("#", payload)
