@@ -594,7 +594,7 @@ def bench_llm():
             sds((n_slots,), jnp.int32), sds((n_slots,), jnp.bool_),
             sds((n_slots, srv.pages_per_seq), jnp.int32),
             sds((n_slots,), jnp.int32), sds((n_slots,), jnp.int32),
-            sds((2,), jnp.uint32), sds((n_slots,), jnp.float32),
+            sds((n_slots,), jnp.uint32), sds((n_slots,), jnp.float32),
             sds((n_slots,), jnp.int32))
         n_param_leaves = len(jax.tree.leaves(p_avals))
     except Exception:       # noqa: BLE001 — wedged backend mid-lower;
@@ -645,6 +645,8 @@ def bench_llm():
                                / n_slots, 1) if occupancy else None,
         "sequences": st["completed"],
         "preempted": st["preempted"],
+        "tokens_salvaged": st.get("tokens_salvaged", 0),
+        "resumes": st.get("resumes", 0),
         "n_executables": jit_count,
         "census": census,
         "tp_shards": tp_shards,

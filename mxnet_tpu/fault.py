@@ -56,6 +56,15 @@ point                        location
                              the slot grid
 ``generate.evict``           GenerationServer, before preempting a
                              sequence's pages back to the pool
+``generate.resume``          GenerationServer, before a prefill group
+                             containing resumed sequences (salvaged
+                             tokens re-entering the bucket grid) runs
+``generate.salvage``         GenerationServer, inside the salvage path
+                             that requeues a sequence with its tokens
+                             after a step failure or breaker fast-fail
+``generate.journal``         GenerationServer, before each decode-journal
+                             append (write failures must never fail
+                             serving)
 ``fleet.route``              ServingFleet.submit entry (before any routing
                              decision)
 ``fleet.dispatch``           ServingFleet dispatch, before handing a request
@@ -283,6 +292,12 @@ for _p, _w in (
                         "the slot grid"),
     ("generate.evict", "GenerationServer, before preempting a sequence's "
                        "pages back to the pool"),
+    ("generate.resume", "GenerationServer, before a prefill group with "
+                        "resumed sequences runs"),
+    ("generate.salvage", "GenerationServer, inside the requeue-with-"
+                         "tokens salvage path"),
+    ("generate.journal", "GenerationServer, before each decode-journal "
+                         "append"),
     ("fleet.route", "ServingFleet.submit entry, before routing"),
     ("fleet.dispatch", "ServingFleet dispatch, before the chosen replica"),
     ("fleet.swap", "WeightUpdater, before a replica's param hot-swap"),

@@ -34,7 +34,8 @@ from .fleet import (ServingFleet, ReplicaGroup, HotSwapApply,
                     SnapshotPrunedError, UpdateRolledBackError,
                     validate_params)
 from .generate import (GenerationServer, PageAllocator,
-                       PoolExhaustedError, prefix_admission_plan)
+                       PoolExhaustedError, SequenceSnapshot,
+                       prefix_admission_plan)
 from .autoscale import FleetAutoscaler, ScalingPolicy
 
 __all__ = ["InferenceServer", "module_apply", "BucketSpec",
@@ -46,5 +47,6 @@ __all__ = ["InferenceServer", "module_apply", "BucketSpec",
            "SnapshotRejectedError", "SnapshotPrunedError",
            "UpdateRolledBackError",
            "validate_params", "GenerationServer", "PageAllocator",
-           "PoolExhaustedError", "prefix_admission_plan",
+           "PoolExhaustedError", "SequenceSnapshot",
+           "prefix_admission_plan",
            "FleetAutoscaler", "ScalingPolicy"]

@@ -53,8 +53,17 @@ class ServerClosedError(RejectedError):
 
 
 class DeadlineExceededError(RuntimeError):
-    """The request's deadline passed while it waited in queue; it was
-    expired without touching the device."""
+    """The request's deadline passed — in queue (expired without
+    touching the device) or mid-generation.  For generation requests
+    the error carries the salvageable progress (ISSUE 19):
+    ``tokens_generated`` and ``partial_tokens`` expose what was
+    produced before expiry instead of silently discarding it."""
+
+    def __init__(self, *args, tokens_generated=0, partial_tokens=None):
+        super().__init__(*args)
+        self.tokens_generated = int(tokens_generated)
+        self.partial_tokens = [] if partial_tokens is None \
+            else list(partial_tokens)
 
 
 class NonFiniteOutputError(RuntimeError):

@@ -336,8 +336,9 @@ class ServingFleet:
         self._lock = threading.Lock()
         self._stats = {"admitted": 0, "completed": 0, "failed": 0,
                        "expired": 0, "shed": 0, "rejected": 0,
-                       "redispatched": 0, "probes": 0, "swaps": 0,
-                       "rollbacks": 0, "scale_ups": 0, "retired": 0}
+                       "redispatched": 0, "resumed": 0, "probes": 0,
+                       "swaps": 0, "rollbacks": 0, "scale_ups": 0,
+                       "retired": 0}
         self._outstanding = 0
         self._retry_q = queue.Queue()
         self._started = threading.Event()
@@ -567,11 +568,18 @@ class ServingFleet:
         cands.sort(key=lambda c: c[:3])
         return [c[3] for c in cands]
 
-    def _dispatch(self, freq, group, excluded, attempts, from_router):
+    def _dispatch(self, freq, group, excluded, attempts, from_router,
+                  resume=None):
         """Hand ``freq`` to the best replica of its group and register
         the completion callback.  True when a replica accepted it.  When
         none can: front-door callers get the admission verdict as a
-        raise; the router gets False and keeps the request pending."""
+        raise; the router gets False and keeps the request pending.
+
+        ``resume`` is a ``SequenceSnapshot`` salvaged off a failed
+        generation replica (ISSUE 19): when set and the target replica
+        supports ``submit_resume``, the redispatch carries the tokens
+        already generated — failover costs the remaining tokens, not a
+        restart from scratch."""
         remaining = self._remaining(freq)
         if remaining is not None and remaining <= 0:
             # the deadline verdict, not an admission one: a client must
@@ -597,7 +605,17 @@ class ServingFleet:
                                              replica=f"r{rep.index}")
             try:
                 _fault.fire("fleet.dispatch")
-                if dspan is None and _telemetry.ACTIVE:
+                can_resume = resume is not None \
+                    and hasattr(rep.server, "submit_resume")
+                if can_resume:
+                    # replica-side tracing stays suppressed either way:
+                    # submit_resume has no trace_parent seam, and a
+                    # partial replica-only tree would fail audit
+                    with _telemetry.suppress():
+                        rreq = rep.server.submit_resume(
+                            resume, deadline=remaining)
+                    self._count("resumed")
+                elif dspan is None and _telemetry.ACTIVE:
                     # the sampling decision was made at the front door —
                     # an unsampled fleet request must not be re-sampled
                     # into a partial replica-only tree
@@ -728,8 +746,13 @@ class ServingFleet:
                 self._finish(freq, error=last_err)
                 continue
             try:
+                # a generation replica that died with salvaged tokens
+                # left the snapshot on its terminal error — the next
+                # replica resumes instead of regenerating (ISSUE 19)
                 ok = self._dispatch(freq, group, excluded, attempts,
-                                    from_router=True)
+                                    from_router=True,
+                                    resume=getattr(last_err, "snapshot",
+                                                   None))
             except Exception as exc:    # injected fleet.dispatch fault —
                 self._finish(freq, error=exc)   # resolved, never dropped
                 continue

@@ -34,9 +34,21 @@ the PAPERS.md *Ragged Paged Attention* / Gemma-serving design
   ``drain()``/SIGTERM semantics are all the PR 4 pieces reused: an
   accepted sequence ALWAYS resolves to tokens or an explicit error.
 
-Sampling is greedy or temperature/top-k per request, drawn from the
-per-step PRNG key inside the compiled program (deterministic under a
-fixed server seed and traffic order).
+Sampling is greedy or temperature/top-k per request, drawn from a
+PER-POSITION PRNG schedule inside the compiled program: every sequence
+carries its own sampling seed (derived from the server seed and its
+admission ordinal, or set explicitly at ``submit``) and the key for the
+token at absolute position ``p`` of prompt+output is
+``fold_in(PRNGKey(seed), p)`` — a pure function of (sequence, position),
+never of the step counter or slot index.  That is what makes generation
+RESUMABLE token-exact (ISSUE 19): a sequence preempted, salvaged off a
+failed step, handed to another replica, or restored from the decode
+journal after kill -9 re-prefills its prompt + generated-so-far through
+the existing bucket grid and then samples the IDENTICAL future tokens
+the uninterrupted run would have (greedy and seeded sampling alike).
+``SequenceSnapshot`` is the portable resume state; ``drain(handoff=
+True)`` exports it instead of finishing, and ``restore_journal``
+re-imports a crashed sibling's in-flight set.
 
 ``tp_shards=N`` shards the whole stack tensor-parallel over an N-way
 ``tp`` mesh (``parallel.mesh``): head-parallel paged attention (each
@@ -71,7 +83,7 @@ from .batcher import BucketSpec
 from .breaker import CircuitBreaker
 
 __all__ = ["PageAllocator", "PoolExhaustedError", "GenerationServer",
-           "build_decode_step", "build_prefill_step",
+           "SequenceSnapshot", "build_decode_step", "build_prefill_step",
            "build_prefill_kv_step", "build_handoff_step",
            "build_dense_decode_step", "build_verify_step",
            "prefix_admission_plan"]
@@ -235,20 +247,36 @@ def _scaled_masked(logits, temps, topks):
     return jnp.where(cut, jnp.asarray(-1e30, scaled.dtype), scaled)
 
 
-def _sample_tokens(logits, key, temps, topks):
+def _position_keys(seeds, positions, domain=None):
+    """The per-position PRNG schedule (ISSUE 19): the key for row ``i``
+    is ``fold_in(PRNGKey(seeds[i]), positions[i])`` — a pure function
+    of (sequence seed, absolute token position), never of the step
+    counter or the slot index.  A resumed sequence therefore draws the
+    IDENTICAL randomness the uninterrupted run would have at every
+    future position.  ``domain`` sub-derives disjoint streams for the
+    speculative roles (draft proposal / acceptance / correction) that
+    all consume randomness at the same position."""
+    import jax
+
+    def one(sd, p):
+        k = jax.random.fold_in(jax.random.PRNGKey(sd), p)
+        return k if domain is None else jax.random.fold_in(k, domain)
+    return jax.vmap(one)(seeds, positions)
+
+
+def _sample_tokens(logits, seeds, positions, temps, topks):
     """Per-slot next-token choice inside the compiled program: greedy
     where ``temps == 0``, temperature softmax-sampling elsewhere, with
     an optional top-k cut (``topks > 0``).  Both arms always compute —
     that is what keeps a mixed greedy/sampling batch ONE executable —
-    and each slot draws from ``fold_in(step_key, slot)``."""
+    and row ``i`` draws from its position-keyed stream
+    ``fold_in(PRNGKey(seeds[i]), positions[i])``."""
     import jax
     import jax.numpy as jnp
 
-    slots = logits.shape[0]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     masked = _scaled_masked(logits, temps, topks)
-    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
-        jnp.arange(slots, dtype=jnp.uint32))
+    keys = _position_keys(seeds, positions)
     drawn = jax.vmap(jax.random.categorical)(keys, masked).astype(jnp.int32)
     return jnp.where(temps > 0.0, drawn, greedy)
 
@@ -282,8 +310,14 @@ def build_decode_step(config, page_size, attention_impl=None, mesh=None,
 
     Signature (all shapes configuration constants):
       ``(params, k_pool, v_pool, tokens[S], lengths[S], active[S],
-      tables[S, P], cow_src[S], cow_dst[S], key, temps[S],
+      tables[S, P], cow_src[S], cow_dst[S], seeds[S], temps[S],
       topks[S])`` → ``(next_tokens[S], k_pool, v_pool)``.
+
+    ``seeds[s]`` is slot ``s``'s per-sequence sampling seed; the next
+    token (absolute position ``lengths[s] + 1`` of prompt+output) is
+    drawn from ``fold_in(PRNGKey(seeds[s]), lengths[s] + 1)`` — the
+    position-keyed schedule that makes resumed sequences token-exact
+    (ISSUE 19).
 
     ``lengths[s]`` is the slot's cache occupancy BEFORE this step; the
     input token's K/V is written at position ``lengths[s]`` (page
@@ -333,7 +367,7 @@ def build_decode_step(config, page_size, attention_impl=None, mesh=None,
                                           mode=tp_collectives)
 
     def decode_step(params, k_pool, v_pool, tokens, lengths, active,
-                    tables, cow_src, cow_dst, key, temps, topks):
+                    tables, cow_src, cow_dst, seeds, temps, topks):
         slots = tokens.shape[0]
         # CoW fault lanes first: dst pages take on src pages' content
         # BEFORE this step's writes/reads (faultless slots self-copy
@@ -362,7 +396,8 @@ def build_decode_step(config, page_size, attention_impl=None, mesh=None,
                                               tables, att_len,
                                               impl=attention_impl)
             h = decode_hidden(params, layer, h, attend, reduce=reduce_fn)
-        nxt = _sample_tokens(lm_logits(params, h), key, temps, topks)
+        nxt = _sample_tokens(lm_logits(params, h), seeds, lengths + 1,
+                             temps, topks)
         return nxt, k_pool, v_pool
 
     if mesh is None:
@@ -402,7 +437,7 @@ def build_prefill_step(config, page_size, attention_impl=None, mesh=None,
             return jax.lax.psum(x, tp_axis)
 
     def prefill_step(params, k_pool, v_pool, tokens, lengths, active,
-                     tables, key, temps, topks):
+                     tables, seeds, temps, topks):
         b, L = tokens.shape
         logits, k_all, v_all = prefill_forward(params, config, tokens,
                                                lengths, reduce=reduce_fn)
@@ -413,7 +448,10 @@ def build_prefill_step(config, page_size, attention_impl=None, mesh=None,
         for layer in range(config.n_layers):
             k_pool = k_pool.at[layer, page, off].set(k_all[layer])
             v_pool = v_pool.at[layer, page, off].set(v_all[layer])
-        first = _sample_tokens(logits, key, temps, topks)
+        # the first generated token sits at absolute position lengths[i]
+        # (0-based) of prompt+output — same schedule the decode step
+        # continues at lengths + 1
+        first = _sample_tokens(logits, seeds, lengths, temps, topks)
         return first, k_pool, v_pool
 
     if mesh is None:
@@ -456,10 +494,10 @@ def build_prefill_kv_step(config, attention_impl=None, mesh=None,
         def reduce_fn(x):
             return jax.lax.psum(x, tp_axis)
 
-    def prefill_kv_step(params, tokens, lengths, key, temps, topks):
+    def prefill_kv_step(params, tokens, lengths, seeds, temps, topks):
         logits, k_all, v_all = prefill_forward(params, config, tokens,
                                                lengths, reduce=reduce_fn)
-        first = _sample_tokens(logits, key, temps, topks)
+        first = _sample_tokens(logits, seeds, lengths, temps, topks)
         # zero the padding positions so the handoff buffer stays inert
         # wherever lengths don't reach (the scatter sinks them to page 0
         # anyway — this just keeps the payload deterministic)
@@ -532,7 +570,7 @@ def build_dense_decode_step(config, max_ctx, attention_impl=None):
     heads, head_dim = config.n_heads, config.head_dim
 
     def dense_step(params, k_cache, v_cache, tokens, lengths, active,
-                   key, temps, topks):
+                   seeds, temps, topks):
         slots = tokens.shape[0]
         h = params["embed"][tokens]
         row = jnp.arange(slots)
@@ -550,7 +588,8 @@ def build_dense_decode_step(config, max_ctx, attention_impl=None):
                 return dense_decode_attention(q, k_cache[_l], v_cache[_l],
                                               att_len)
             h = decode_hidden(params, layer, h, attend)
-        nxt = _sample_tokens(lm_logits(params, h), key, temps, topks)
+        nxt = _sample_tokens(lm_logits(params, h), seeds, lengths + 1,
+                             temps, topks)
         return nxt, k_cache, v_cache
 
     return dense_step
@@ -567,8 +606,15 @@ def build_verify_step(config, draft_cfg, page_size, spec_k, window,
     Signature (all shapes configuration constants):
       ``(params, draft_params, k_pool, v_pool, tokens[S],
       window[S, W], n_valid[S], lengths[S], active[S], tables[S, P],
-      cow_src[S], cow_dst[S], key, temps[S], topks[S])`` →
+      cow_src[S], cow_dst[S], seeds[S], temps[S], topks[S])`` →
       ``(emitted[S, spec_k + 1], n_accept[S], k_pool, v_pool)``.
+
+    Randomness follows the same position-keyed schedule as the decode
+    step (``seeds[s]`` + absolute token position), with a disjoint
+    domain per speculative role at each position — draft proposal
+    (domain 1), acceptance uniform (2), correction/bonus draw (3) — so
+    a resumed sequence replays the identical accept/reject trajectory
+    the uninterrupted run would have taken (ISSUE 19).
 
     Per slot the program (1) applies the CoW fault copy exactly like
     ``build_decode_step``, (2) runs the draft ``spec_k`` times over a
@@ -637,7 +683,7 @@ def build_verify_step(config, draft_cfg, page_size, spec_k, window,
 
     def verify_step(params, draft_params, k_pool, v_pool, tokens, window,
                     n_valid, lengths, active, tables, cow_src, cow_dst,
-                    key, temps, topks):
+                    seeds, temps, topks):
         S = tokens.shape[0]
         W = window.shape[1]
         # (1) CoW fault lanes, exactly as in the decode step
@@ -648,17 +694,15 @@ def build_verify_step(config, draft_cfg, page_size, spec_k, window,
         # window (pool-free; the draft runs replicated under tp).  q_i
         # is the proposal distribution the acceptance ratio divides by
         # — the SAME tempered/top-k transform the target uses.
-        dkey = jax.random.fold_in(key, 1)
+        # Proposal i is a candidate for absolute position
+        # lengths + 1 + i — keyed there (domain 1).
         drafts, qprobs = [], []
         win, nv = window, n_valid
         for i in range(k):
             lg = window_logits(draft_params, draft_cfg, win, nv)
             masked = _scaled_masked(lg, temps, topks)
             qprobs.append(jax.nn.softmax(masked, axis=-1))
-            keys_i = jax.vmap(
-                lambda s, _i=i: jax.random.fold_in(
-                    jax.random.fold_in(dkey, _i), s))(
-                jnp.arange(S, dtype=jnp.uint32))
+            keys_i = _position_keys(seeds, lengths + 1 + i, domain=1)
             drawn = jax.vmap(jax.random.categorical)(
                 keys_i, masked).astype(jnp.int32)
             d_i = jnp.where(temps > 0.0, drawn,
@@ -714,10 +758,13 @@ def build_verify_step(config, draft_cfg, page_size, spec_k, window,
                                   axis=2)[..., 0]
         q_d = jnp.take_along_axis(q_all, d_all[:, :, None],
                                   axis=2)[..., 0]
-        ukeys = jax.vmap(lambda s: jax.random.fold_in(
-            jax.random.fold_in(key, 2), s))(
-            jnp.arange(S, dtype=jnp.uint32))
-        u = jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(ukeys)
+        # one scalar uniform per (slot, proposal), keyed at the
+        # proposal's absolute position (domain 2)
+        prop_pos = (lengths[:, None]
+                    + 1 + jnp.arange(k)[None, :]).reshape(S * k)
+        ukeys = _position_keys(jnp.repeat(seeds, k), prop_pos, domain=2)
+        u = jax.vmap(lambda kk: jax.random.uniform(kk, ()))(
+            ukeys).reshape(S, k)
         a_sample = jnp.cumprod(
             (u <= p_d / jnp.maximum(q_d, 1e-30)).astype(jnp.int32),
             axis=1).sum(axis=1)
@@ -731,9 +778,11 @@ def build_verify_step(config, draft_cfg, page_size, spec_k, window,
         resid = jnp.where(rsum > 0.0, resid / jnp.maximum(rsum, 1e-30),
                           p_all[:, :k])
         corr_dist = jnp.concatenate([resid, p_all[:, k:]], axis=1)
-        ckeys = jax.vmap(lambda i: jax.random.fold_in(
-            jax.random.fold_in(key, 3), i))(
-            jnp.arange(lanes, dtype=jnp.uint32))
+        # correction lane j replaces absolute position
+        # lengths + 1 + j — keyed there (domain 3)
+        corr_pos = (lengths[:, None]
+                    + 1 + jnp.arange(K1)[None, :]).reshape(lanes)
+        ckeys = _position_keys(jnp.repeat(seeds, K1), corr_pos, domain=3)
         corr_drawn = jax.vmap(jax.random.categorical)(
             ckeys, jnp.log(jnp.maximum(
                 corr_dist.reshape(lanes, vocab), 1e-38))
@@ -785,13 +834,62 @@ def prefix_admission_plan(n_pages, page_size, prompt_len, max_new,
             "multiplier": with_sharing / max(unshared, 1)}
 
 
+class SequenceSnapshot:
+    """Resumable state of one in-flight generation (ISSUE 19) —
+    capturable at any step boundary, portable across processes and
+    replicas, JSON-serializable (the decode journal's record shape).
+
+    Because sampling is position-keyed (``fold_in(PRNGKey(seed),
+    position)``), this is ALL the state resume needs: re-prefilling
+    ``prompt + out`` through the existing bucket grid reconstructs the
+    KV cache, and every future draw coincides with the uninterrupted
+    run's — greedy and seeded sampling alike.  ``deadline_wall`` is the
+    absolute wall-clock expiry (``time.time()`` base — monotonic clocks
+    don't survive a process), converted back to a remaining-seconds
+    deadline at ``submit_resume``."""
+
+    __slots__ = ("rid", "prompt", "out", "max_new", "temperature",
+                 "top_k", "seed", "priority", "deadline_wall", "tenant",
+                 "klass")
+
+    def __init__(self, rid, prompt, out, max_new, temperature, top_k,
+                 seed, priority=0, deadline_wall=None, tenant=None,
+                 klass=None):
+        self.rid = int(rid)
+        self.prompt = [int(t) for t in prompt]
+        self.out = [int(t) for t in out]
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.priority = int(priority)
+        self.deadline_wall = None if deadline_wall is None \
+            else float(deadline_wall)
+        self.tenant = tenant
+        self.klass = klass
+
+    def to_json(self):
+        return {name: getattr(self, name) for name in self.__slots__}
+
+    @classmethod
+    def from_json(cls, d):
+        return cls(**{name: d[name] for name in cls.__slots__
+                      if name in d})
+
+    def __repr__(self):
+        return (f"SequenceSnapshot(rid={self.rid}, "
+                f"prompt_len={len(self.prompt)}, "
+                f"generated={len(self.out)}/{self.max_new}, "
+                f"seed={self.seed})")
+
+
 # ---------------------------------------------------------------- scheduler --
 class _Seq:
     """Decode-loop-private state of one admitted sequence."""
 
     __slots__ = ("req", "prompt", "max_new", "temp", "top_k", "slot",
                  "pages", "cached", "out", "stamp", "ran", "priority",
-                 "shared_n")
+                 "shared_n", "seed", "rid", "salvage", "replay")
 
     def __init__(self, req, prompt, max_new, temp, top_k, priority=0):
         self.req = req
@@ -807,6 +905,10 @@ class _Seq:
         self.stamp = 0.0         # admission order — eviction picks youngest
         self.ran = False         # ever prefilled (survives preemption)
         self.shared_n = 0        # leading pages mapped from the prefix index
+        self.seed = 0            # per-sequence sampling seed (position-keyed)
+        self.rid = -1            # admission ordinal — the journal's key
+        self.salvage = 0         # failure-salvage retries consumed
+        self.replay = []         # recorded tokens still to force post-resume
 
 
 class GenerationServer:
@@ -880,7 +982,8 @@ class GenerationServer:
                  seed=0, attention_impl=None, prefill_workers=0,
                  qos=None, tp_shards=1, tp_collectives="f32",
                  draft=None, draft_config=None, spec_k=3,
-                 spec_window=16, memory_report=None,
+                 spec_window=16, salvage_retries=2, journal=None,
+                 journal_every=8, memory_report=None,
                  name="GenerationServer"):
         import jax
         import jax.numpy as jnp
@@ -1013,8 +1116,21 @@ class GenerationServer:
                                    attention_impl, mesh=self._mesh),
                 donate_argnums=(1, 2))
             self._handoff = None
-        self._key_base = jax.random.PRNGKey(int(seed))
-        self._steps = 0          # device-call counter → per-step PRNG key
+        # per-position PRNG (ISSUE 19): the server seed only SALTS the
+        # per-sequence seed derivation (admission ordinal → splitmix) —
+        # no step counter exists anywhere, so randomness is a pure
+        # function of (sequence seed, token position) and resume is
+        # token-exact by construction
+        self._seed_root = int(seed) & 0xFFFFFFFFFFFFFFFF
+        self._admit_ord = 0                 # _admit_lock-guarded
+        # failure salvage + decode journal (ISSUE 19)
+        self._salvage_retries = max(0, int(salvage_retries))
+        self._journal = None if journal is None \
+            else _telemetry.JsonlSink(journal)
+        self._journal_every = max(1, int(journal_every))
+        self._jsteps = 0                    # decode-loop-private
+        self._handoff_exit = threading.Event()
+        self.exported = []                  # SequenceSnapshots from handoff
 
         # decode-loop-private device + slot state (created in start())
         self._k_pool = self._v_pool = None
@@ -1026,6 +1142,7 @@ class GenerationServer:
                                 np.int32)
         self._temps = np.zeros((self.n_slots,), np.float32)
         self._topks = np.zeros((self.n_slots,), np.int32)
+        self._seeds = np.zeros((self.n_slots,), np.uint32)
         # CoW fault lanes, reset each step; (0, 0) = inert sink self-copy
         self._cow_src = np.zeros((self.n_slots,), np.int32)
         self._cow_dst = np.zeros((self.n_slots,), np.int32)
@@ -1049,7 +1166,11 @@ class GenerationServer:
                        "handoffs": 0, "decode_steps": 0, "active_slots": 0,
                        "verify_steps": 0, "spec_proposed": 0,
                        "spec_accepted": 0, "cow_faults": 0,
-                       "pages_charged": 0, "pages_shared_mapped": 0}
+                       "pages_charged": 0, "pages_shared_mapped": 0,
+                       "tokens_salvaged": 0, "resumes": 0,
+                       "salvage_retries": 0, "journal_restores": 0,
+                       "journal_errors": 0, "resume_pages_remapped": 0,
+                       "handoff_exports": 0}
         self._last_error = None
         self._ready = threading.Event()
         self._draining = threading.Event()
@@ -1117,6 +1238,7 @@ class GenerationServer:
                         self._run_prefill_kv(
                             np.zeros((b, L), np.int32),
                             np.zeros((b,), np.int32),
+                            np.zeros((b,), np.uint32),
                             np.zeros((b,), np.float32),
                             np.zeros((b,), np.int32))
                     else:
@@ -1125,6 +1247,7 @@ class GenerationServer:
                             np.zeros((b,), np.int32),
                             np.zeros((b,), bool),
                             np.zeros((b, self.pages_per_seq), np.int32),
+                            np.zeros((b,), np.uint32),
                             np.zeros((b,), np.float32),
                             np.zeros((b,), np.int32))
             if self._n_prefill_workers > 0:
@@ -1183,7 +1306,8 @@ class GenerationServer:
 
     # ------------------------------------------------------------ admission --
     def submit(self, tokens, *, max_new_tokens=None, temperature=0.0,
-               top_k=0, deadline=None, tenant=None, klass=None):
+               top_k=0, deadline=None, tenant=None, klass=None,
+               seed=None, trace_parent=None):
         """Admit one prompt; returns a ``Request`` future resolving to
         the generated ``np.int32`` token ids (EOS excluded).
 
@@ -1191,6 +1315,12 @@ class GenerationServer:
         class supplies the default deadline, its priority orders the
         scheduler's seating, and the resolution lands in the class's
         ``healthz()["classes"]`` stats.
+
+        ``seed`` pins this sequence's sampling seed explicitly (any
+        uint32); by default it derives from the server seed and the
+        admission ordinal.  Two servers given the same seed and prompt
+        produce the same sampled stream — the oracle lever of the
+        resume-exactness tests (ISSUE 19).
 
         Refusals are immediate and explicit (PR 4 contract):
         ``ServerClosedError`` draining, ``CircuitOpenError`` fast-fail,
@@ -1288,12 +1418,17 @@ class GenerationServer:
         # sequence immediately and needs the queue span already open.  A
         # refusal below never resolves the request, so the trace is
         # never exported.
-        if t0_us is not None:
-            _telemetry.begin_request(req, self._name, t0_us=t0_us)
+        if trace_parent is not None or t0_us is not None:
+            _telemetry.begin_request(req, self._name, t0_us=t0_us,
+                                     parent=trace_parent)
         with self._admit_lock:
             admitted = not self._stop.is_set() \
                 and len(self._pending) < queue_cap
             if admitted:
+                seq.rid = self._admit_ord
+                self._admit_ord += 1
+                seq.seed = self._derive_seed(seq.rid) if seed is None \
+                    else int(seed) & 0xFFFFFFFF
                 self._pending.append(seq)
             else:
                 stopped = self._stop.is_set()
@@ -1312,7 +1447,152 @@ class GenerationServer:
                 f"{self._max_queue}) — shedding")
         self._qos.track(qc, req)
         self._bump("admitted")
+        self._journal_admit(seq)
         return req
+
+    def submit_resume(self, snapshot, *, deadline=None):
+        """Admit a ``SequenceSnapshot`` — the resume half of ISSUE 19:
+        the sequence re-enters the queue WITH its generated-so-far
+        tokens and its ORIGINAL sampling seed, re-prefills prompt +
+        generated through the existing bucket grid, and completes
+        token-exact with what the uninterrupted run would have
+        produced.  Fleet failover and journal restore both land here.
+
+        ``deadline`` (seconds from now) overrides the snapshot's
+        wall-clock expiry; with neither, the sequence has no deadline.
+        QoS classification is NOT re-applied (the request paid at its
+        original admission); the snapshot's priority orders seating.
+        Refusals match ``submit``: ``ServerClosedError`` draining,
+        ``CircuitOpenError`` fast-fail, ``RejectedError`` full queue /
+        structurally unservable."""
+        t0_us = _telemetry.now_us() if _telemetry.ACTIVE else None
+        if isinstance(snapshot, dict):
+            snapshot = SequenceSnapshot.from_json(snapshot)
+        if self._draining.is_set():
+            self._bump("rejected")
+            raise ServerClosedError(f"{self._name}: draining — "
+                                    f"not admitting")
+        if not self._ready.is_set():
+            self._bump("rejected")
+            raise RejectedError(f"{self._name}: not started")
+        if not self._thread.is_alive():
+            self._bump("rejected")
+            raise ServerClosedError(f"{self._name}: decode loop is not "
+                                    f"running — not admitting")
+        if self.breaker.engaged():
+            self._bump("rejected")
+            raise CircuitOpenError(
+                f"{self._name}: circuit open after repeated step failures "
+                f"— fast-failing until a probe succeeds")
+        prompt = np.asarray(snapshot.prompt, np.int32)
+        n = prompt.shape[0]
+        max_new = int(snapshot.max_new)
+        spare = self._spec_k if self._verify is not None else 0
+        try:
+            if n < 1:
+                raise RejectedError("snapshot prompt is empty")
+            if n > max(self.buckets.length):
+                raise RejectedError(
+                    f"snapshot prompt length {n} exceeds the largest "
+                    f"length bucket {max(self.buckets.length)} on this "
+                    f"server — no prefill executable exists")
+            if n + max_new + spare > self.max_context \
+                    or self.alloc.pages_for(n + max_new + spare) \
+                    > self.alloc.allocatable:
+                raise RejectedError(
+                    f"snapshot worst case ({n} + {max_new} new) does not "
+                    f"fit this server's page capacity")
+        except RejectedError:
+            self._bump("rejected")
+            raise
+        if deadline is None and snapshot.deadline_wall is not None:
+            deadline = snapshot.deadline_wall - time.time()
+        req = Request((prompt,), deadline=deadline,
+                      tenant=snapshot.tenant, klass=snapshot.klass)
+        seq = _Seq(req, prompt, max_new, float(snapshot.temperature),
+                   int(snapshot.top_k), priority=snapshot.priority)
+        seq.out = [int(t) for t in snapshot.out]
+        seq.stamp = time.monotonic()
+        if len(seq.out) >= max_new:
+            # complete already (the journal caught it between its last
+            # token and its retirement record) — resolve without work
+            self._bump("admitted")
+            req.set_result(np.asarray(seq.out[:max_new], np.int32))
+            self._bump("completed")
+            self._bump("retired")
+            return req
+        if t0_us is not None:
+            _telemetry.begin_request(req, self._name, t0_us=t0_us)
+        with self._admit_lock:
+            admitted = not self._stop.is_set() \
+                and len(self._pending) < self._max_queue
+            if admitted:
+                seq.rid = self._admit_ord
+                self._admit_ord += 1
+                seq.seed = int(snapshot.seed) & 0xFFFFFFFF
+                self._pending.append(seq)
+            else:
+                stopped = self._stop.is_set()
+        if not admitted:
+            self._bump("rejected")
+            _telemetry.abort_request(req)
+            if stopped:
+                raise ServerClosedError(f"{self._name}: draining — "
+                                        f"not admitting")
+            raise RejectedError(f"{self._name}: request queue full "
+                                f"({self._max_queue}) — shedding")
+        self._bump("admitted")
+        self._journal_admit(seq)
+        return req
+
+    def restore_journal(self, path):
+        """Import a crashed sibling's decode journal (ISSUE 19): replay
+        ``gen_admit``/``gen_snapshot``/``gen_handoff``/``gen_retire``
+        records in order, reconstruct every sequence that was admitted
+        but never retired, and ``submit_resume`` each — the restored
+        server completes them token-exact (position-keyed sampling +
+        the journaled seed).  Stale in-flight snapshots are harmless:
+        the missing tail regenerates identically.
+
+        Reads the rotated ``<path>.1`` first, then ``path``; a torn
+        tail line (kill -9 mid-write) is skipped.  Returns ``{rid:
+        Request}`` for the resumed sequences (rids from the DEAD
+        server's journal).  Sequences this server must refuse
+        structurally raise through; call on a started, healthy server
+        before opening it to traffic."""
+        import json
+        import os
+
+        live = {}
+        for p in (str(path) + ".1", str(path)):
+            if not os.path.exists(p):
+                continue
+            with open(p, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue               # torn tail — kill -9
+                    if rec.get("kind") != "generate":
+                        continue
+                    nm, rid = rec.get("name"), rec.get("rid")
+                    if rid is None:
+                        continue
+                    if nm in ("gen_admit", "gen_handoff"):
+                        live[rid] = dict(rec)
+                    elif nm == "gen_snapshot" and rid in live:
+                        live[rid]["out"] = list(rec.get("out", []))
+                    elif nm == "gen_retire":
+                        live.pop(rid, None)
+        restored = {}
+        for rid, rec in live.items():
+            snap = SequenceSnapshot.from_json(rec)
+            restored[rid] = self.submit_resume(snap)
+            self._bump("journal_restores")
+        return restored
 
     def __call__(self, tokens, timeout=None, **kw):
         """Blocking convenience: submit + ``result()``."""
@@ -1326,28 +1606,82 @@ class GenerationServer:
         with self._lock:
             self._last_error = (type(exc).__name__, time.monotonic())
 
-    # ----------------------------------------------------------- decode loop --
-    def _next_key(self):
-        """A fresh per-device-call PRNG key.  The counter is lock-guarded
-        (disaggregated prefill workers and the decode loop both draw);
-        the fold_in happens OUTSIDE the lock."""
-        import jax
-        with self._lock:
-            self._steps += 1
-            n = self._steps
-        return jax.random.fold_in(self._key_base, n)
+    # ---------------------------------------------------- snapshots + journal --
+    def _snapshot_of(self, seq):
+        """Capture one sequence's resumable state (step boundary —
+        decode-loop thread, or admission state not yet seated)."""
+        dw = None
+        if seq.req.deadline is not None:
+            dw = time.time() + (seq.req.deadline - time.monotonic())
+        return SequenceSnapshot(
+            rid=seq.rid, prompt=seq.prompt, out=seq.out,
+            max_new=seq.max_new, temperature=seq.temp, top_k=seq.top_k,
+            seed=seq.seed, priority=seq.priority, deadline_wall=dw,
+            tenant=seq.req.tenant, klass=seq.req.klass)
 
-    def _run_prefill(self, tokens, lengths, active, tables, temps, topks):
+    def _journal_event(self, name, **fields):
+        """Append one record to the decode journal.  Write failures are
+        swallowed into the ``journal_errors`` counter — the journal is
+        a durability aid, never a serving liability (``generate.journal``
+        is the fault point that proves it)."""
+        if self._journal is None:
+            return
+        try:
+            _fault.fire("generate.journal")
+            self._journal.write("generate", name=name, **fields)
+        except Exception:   # noqa: BLE001 — journaling must not fail serving
+            self._bump("journal_errors")
+
+    def _journal_admit(self, seq):
+        """One ``gen_admit`` record per accepted sequence — the full
+        snapshot (out included: a resumed admission re-journals its
+        salvaged tokens, so restore needs no cross-file history)."""
+        if self._journal is not None:
+            self._journal_event("gen_admit", **self._snapshot_of(seq)
+                                .to_json())
+
+    def _journal_tick(self):
+        """Periodic in-flight snapshots (every ``journal_every``
+        successful steps): bounds how many trailing tokens a kill -9
+        can force the restored server to regenerate — regeneration is
+        token-exact either way, this only trades journal bytes against
+        recompute."""
+        if self._journal is None:
+            return
+        self._jsteps += 1
+        if self._jsteps % self._journal_every:
+            return
+        for seq in self._seqs.values():
+            self._journal_event("gen_snapshot", rid=seq.rid,
+                                out=list(seq.out))
+
+    # ----------------------------------------------------------- decode loop --
+    def _derive_seed(self, ordinal):
+        """The per-sequence sampling seed: a splitmix64-style mix of the
+        server seed and the admission ordinal.  Stable across processes
+        (pure arithmetic — no RNG object, no clock), so a journal
+        restore or a fleet redispatch carries the ORIGINAL seed and the
+        resumed sequence samples the original stream.  ``submit(seed=)``
+        overrides it per request."""
+        x = (self._seed_root
+             + (int(ordinal) + 1) * 0x9E3779B97F4A7C15) \
+            & 0xFFFFFFFFFFFFFFFF
+        x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & 0xFFFFFFFFFFFFFFFF
+        x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & 0xFFFFFFFFFFFFFFFF
+        return (x ^ (x >> 31)) & 0xFFFFFFFF
+
+    def _run_prefill(self, tokens, lengths, active, tables, seeds,
+                     temps, topks):
         """One prefill program invocation (pools donated/reassigned)."""
         with _telemetry.compile_guard(
                 self._name, self._prefill,
                 key=f"prefill/b{tokens.shape[0]}_l{tokens.shape[1]}"):
             first, self._k_pool, self._v_pool = self._prefill(
                 self._params, self._k_pool, self._v_pool, tokens, lengths,
-                active, tables, self._next_key(), temps, topks)
+                active, tables, seeds, temps, topks)
         return np.asarray(first)
 
-    def _run_prefill_kv(self, tokens, lengths, temps, topks):
+    def _run_prefill_kv(self, tokens, lengths, seeds, temps, topks):
         """One POOL-FREE prefill invocation (disaggregated mode; any
         prefill-group worker thread).  Host-realizes the outputs so the
         device wait lands on the worker, never the decode loop."""
@@ -1355,8 +1689,7 @@ class GenerationServer:
                 self._name, self._prefill,
                 key=f"prefill/b{tokens.shape[0]}_l{tokens.shape[1]}"):
             first, k_all, v_all = self._prefill(
-                self._params, tokens, lengths, self._next_key(), temps,
-                topks)
+                self._params, tokens, lengths, seeds, temps, topks)
         return np.asarray(first), np.asarray(k_all), np.asarray(v_all)
 
     def _staging(self):
@@ -1411,15 +1744,16 @@ class GenerationServer:
         out of the gang fails the collective, the step raises, and the
         re-zeroed pools come back sharded over the same mesh — the
         breaker keeps the server fast-failing until the gang answers
-        again (docs/api.md failure matrix)."""
+        again (docs/api.md failure matrix).  Bystanders whose cache was
+        collateral are SALVAGED (ISSUE 19): their tokens requeue for a
+        token-exact resume against the fresh pools, unbudgeted — the
+        failing step was not theirs."""
         if self._k_pool is not None and not self._k_pool.is_deleted() \
                 and not self._v_pool.is_deleted():
             return
         self._k_pool, self._v_pool = self._new_pools()
-        for seq in list(self._seqs.values()):
-            self._retire(seq, ServerClosedError(
-                "KV pool lost to a failed device step — sequence cannot "
-                "continue"), stat="failed")
+        self._salvage_seated(ServerClosedError(
+            "KV pool lost to a failed device step"), budgeted=False)
 
     def _run_decode(self):
         """One decode program invocation over the full slot grid."""
@@ -1427,7 +1761,7 @@ class GenerationServer:
             nxt, self._k_pool, self._v_pool = self._decode(
                 self._params, self._k_pool, self._v_pool, self._tokens,
                 self._lengths, self._active, self._tables,
-                self._cow_src, self._cow_dst, self._next_key(),
+                self._cow_src, self._cow_dst, self._seeds,
                 self._temps, self._topks)
         return np.asarray(nxt)
 
@@ -1439,7 +1773,7 @@ class GenerationServer:
                 self._params, self._draft_params, self._k_pool,
                 self._v_pool, self._tokens, self._window, self._nvalid,
                 self._lengths, self._active, self._tables,
-                self._cow_src, self._cow_dst, self._next_key(),
+                self._cow_src, self._cow_dst, self._seeds,
                 self._temps, self._topks)
         return np.asarray(emitted), np.asarray(n_acc)
 
@@ -1461,6 +1795,11 @@ class GenerationServer:
     def _loop(self):
         try:
             while True:
+                if self._stop.is_set() and self._handoff_exit.is_set():
+                    # handoff drain: export unfinished work for a
+                    # successor instead of generating to completion
+                    self._export_all()
+                    return
                 if self._stop.is_set() and not self._seqs \
                         and not self._pending and self._pipeline_idle():
                     return
@@ -1469,6 +1808,11 @@ class GenerationServer:
                     # drain must terminate: an open breaker during drain
                     # cannot half-open through traffic it refuses, so
                     # everything still accepted resolves explicitly now
+                    # (handoff mode exports instead — same termination
+                    # guarantee, no work destroyed)
+                    if self._handoff_exit.is_set():
+                        self._export_all()
+                        return
                     self._fail_everything(CircuitOpenError(
                         f"{self._name}: circuit open during drain — "
                         f"fast-failing accepted work"))
@@ -1609,8 +1953,9 @@ class GenerationServer:
         resident in the prefix index are SHARED (a refcount bump, zero
         pool cost); only the remainder is allocated — all-or-nothing,
         so ``PoolExhaustedError`` leaves nothing taken."""
-        n = int(seq.prompt.shape[0])
-        shared = self._match_prefix(seq.prompt)
+        ptoks = self._prefill_tokens(seq)
+        n = int(ptoks.shape[0])
+        shared = self._match_prefix(ptoks)
         own = self.alloc.alloc(self.alloc.pages_for(n) - len(shared))
         self.alloc.share(shared)
         seq.pages = shared + own
@@ -1618,6 +1963,10 @@ class GenerationServer:
         self._bump("pages_charged", len(own))
         if shared:
             self._bump("pages_shared_mapped", len(shared))
+            if seq.out:
+                # resume re-maps onto still-resident pages — the
+                # prefix-index dividend that makes preemption cheap
+                self._bump("resume_pages_remapped", len(shared))
         # index NOW, not at seat time: the same program call that maps
         # these pages fills them (prefill scatter / handoff), so a
         # LATER sequence in the same batch can already share them — a
@@ -1670,7 +2019,10 @@ class GenerationServer:
         self._c_pages.set_value(int(100 * held / total))
 
     def _retire(self, seq, error=None, stat="completed"):
-        """Terminal retirement: vacate, resolve the future, account."""
+        """Terminal retirement: vacate, resolve the future, account.
+        Journaled (retirement granularity) — EXCEPT in handoff-drain
+        mode, where exported sequences must stay importable: a retire
+        record would erase the handoff record the next server reads."""
         if seq.pages:
             self._h_slot_pages.observe(len(seq.pages))
         self._vacate(seq)
@@ -1678,13 +2030,17 @@ class GenerationServer:
             seq.req.set_result(np.asarray(seq.out, np.int32))
         else:
             seq.req.set_error(error)
+        if not self._handoff_exit.is_set():
+            self._journal_event("gen_retire", rid=seq.rid, status=stat)
         self._bump(stat)
         self._bump("retired")
         self._c_retired.increment()
 
     def _retire_expired(self):
         """Deadline sweep: queued sequences expire without device work,
-        in-flight ones mid-generation (pages freed either way)."""
+        in-flight ones mid-generation (pages freed either way; the
+        error carries the partial tokens — progress is visible, ISSUE
+        19, not discarded silently)."""
         worked = False
         now = time.monotonic()
         for seq in [s for s in self._seqs.values()
@@ -1692,7 +2048,10 @@ class GenerationServer:
             self._retire(seq, DeadlineExceededError(
                 f"deadline exceeded mid-generation after "
                 f"{len(seq.out)} of {seq.max_new} tokens — pages freed, "
-                f"partial output discarded"), stat="expired")
+                f"partial output on the error",
+                tokens_generated=len(seq.out),
+                partial_tokens=[int(t) for t in seq.out]),
+                stat="expired")
             worked = True
         with self._admit_lock:
             queued = [s for s in self._pending if s.req.expired(now)]
@@ -1701,9 +2060,12 @@ class GenerationServer:
         for seq in queued:
             self._retire(seq, DeadlineExceededError(
                 "deadline exceeded in queue after preemption — partial "
-                "work discarded" if seq.ran else
+                "tokens on the error" if seq.ran else
                 "deadline exceeded in queue — the request never touched "
-                "the device"), stat="expired")
+                "the device",
+                tokens_generated=len(seq.out),
+                partial_tokens=[int(t) for t in seq.out]),
+                stat="expired")
             worked = True
         return worked
 
@@ -1713,6 +2075,31 @@ class GenerationServer:
 
     def _bucket_len(self, n):
         return next(L for L in self.buckets.length if L >= n)
+
+    def _prefill_len(self, seq):
+        """Tokens a (re-)prefill of this sequence runs through the
+        bucket grid.  Fresh sequence: the prompt.  Resume (``seq.out``
+        non-empty): prompt + generated-so-far minus the pending token —
+        the exact step-boundary cache occupancy — capped at the largest
+        length bucket.  The overflow tail becomes ``seq.replay``,
+        forced one token per step through the pinned decode/verify
+        program (a chunked prefill through the grid is impossible: the
+        bucket programs recompute the whole context, so a chunk's
+        forward would need K/V the grid cannot be given).  Either way
+        resume reuses ONLY existing executables — the census contract
+        is untouched."""
+        n = int(seq.prompt.shape[0])
+        if not seq.out:
+            return n
+        return min(n + len(seq.out) - 1, max(self.buckets.length))
+
+    def _prefill_tokens(self, seq):
+        """The token array a (re-)prefill feeds the bucket grid."""
+        if not seq.out:
+            return seq.prompt
+        full = np.concatenate([seq.prompt,
+                               np.asarray(seq.out, np.int32)])
+        return full[:self._prefill_len(seq)]
 
     def _take_prefill_group(self, need_resources=True):
         """Pop one same-length-bucket group of queued sequences, highest
@@ -1733,19 +2120,20 @@ class GenerationServer:
                 return []
             ordered = sorted(self._pending,
                              key=lambda s: (-s.priority, s.stamp))
-            bucket = self._bucket_len(ordered[0].prompt.shape[0])
+            bucket = self._bucket_len(self._prefill_len(ordered[0]))
             group, budget = [], self.alloc.free_count()
             for seq in ordered:
                 if len(group) >= limit:
                     break
-                if self._bucket_len(seq.prompt.shape[0]) != bucket:
+                if self._bucket_len(self._prefill_len(seq)) != bucket:
                     continue
                 if need_resources:
                     # charge only NON-shared pages: blocks resident in
                     # the prefix index cost nothing — the concurrency
                     # multiplier of prefix sharing lands here
-                    need = self.alloc.pages_for(seq.prompt.shape[0]) \
-                        - len(self._match_prefix(seq.prompt))
+                    need = self.alloc.pages_for(self._prefill_len(seq)) \
+                        - len(self._match_prefix(
+                            self._prefill_tokens(seq)))
                     if need > budget:
                         break   # keep order: don't starve the big one
                     budget -= need
@@ -1827,22 +2215,29 @@ class GenerationServer:
 
     def _do_prefill_kv(self, group):
         """Run one group through the pool-free prefill and hand off the
-        per-sequence payloads.  A failure resolves the whole group
-        explicitly (breaker sees it); the pools are untouched either
-        way — prefill-side faults cannot hurt seated sequences."""
+        per-sequence payloads.  Resumed members run prompt + generated
+        through the same bucket executables.  A failure resolves the
+        whole group explicitly (breaker sees it; resumed members are
+        salvaged against their retry budget); the pools are untouched
+        either way — prefill-side faults cannot hurt seated
+        sequences."""
         k = len(group)
-        bucket = self._bucket_len(max(s.prompt.shape[0] for s in group))
+        bucket = self._bucket_len(max(self._prefill_len(s)
+                                      for s in group))
         b = self.buckets.batch_bucket(k)
         tokens = np.zeros((b, bucket), np.int32)
         lengths = np.zeros((b,), np.int32)
+        seeds = np.zeros((b,), np.uint32)
         temps = np.zeros((b,), np.float32)
         topks = np.zeros((b,), np.int32)
         pspans = None
         worker = threading.current_thread().name
         for i, seq in enumerate(group):
-            n = seq.prompt.shape[0]
-            tokens[i, :n] = seq.prompt
+            ptoks = self._prefill_tokens(seq)
+            n = ptoks.shape[0]
+            tokens[i, :n] = ptoks
             lengths[i] = n
+            seeds[i] = seq.seed
             temps[i] = seq.temp
             topks[i] = seq.top_k
             if seq.req.trace is not None:
@@ -1856,15 +2251,20 @@ class GenerationServer:
             _telemetry.push_current(pspans)
         try:
             _fault.fire("generate.prefill")
+            if any(s.out for s in group):
+                _fault.fire("generate.resume")
             with _profiler.scope(f"{self._name}.prefill", cat="serving"):
                 first, k_all, v_all = self._run_prefill_kv(
-                    tokens, lengths, temps, topks)
+                    tokens, lengths, seeds, temps, topks)
         except Exception as exc:    # noqa: BLE001 — resolved per sequence
             self.breaker.record_failure()
             self._note_step_failure(exc)
             err = _fault.with_context(exc, f"{self._name} prefill of {k}")
             for seq in group:
-                self._retire(seq, err, stat="failed")
+                if seq.out:
+                    self._requeue_salvaged(seq, err)
+                else:
+                    self._retire(seq, err, stat="failed")
             return
         finally:
             if pspans is not None:
@@ -1872,7 +2272,7 @@ class GenerationServer:
         self.breaker.record_success()
         self._bump("prefills")
         for i, seq in enumerate(group):
-            n = seq.prompt.shape[0]
+            n = self._prefill_len(seq)
             if seq.req.trace is not None:   # handoff wait + scatter next
                 _telemetry.end_span(seq.req, "prefill")
                 _telemetry.open_span(seq.req, "handoff")
@@ -1909,12 +2309,14 @@ class GenerationServer:
             if seq.req.expired(now):
                 self._retire(seq, DeadlineExceededError(
                     "deadline exceeded before the prefilled sequence "
-                    "reached a decode slot — pages never held"),
+                    "reached a decode slot — pages never held",
+                    tokens_generated=len(seq.out),
+                    partial_tokens=[int(t) for t in seq.out]),
                     stat="expired")
                 worked = True
                 continue
-            need = self.alloc.pages_for(seq.prompt.shape[0]) \
-                - len(self._match_prefix(seq.prompt))
+            need = self.alloc.pages_for(self._prefill_len(seq)) \
+                - len(self._match_prefix(self._prefill_tokens(seq)))
             if len(batch) >= min(len(free_slots), self.buckets.max_batch) \
                     or need > budget:
                 still.append(entry)
@@ -1943,7 +2345,7 @@ class GenerationServer:
         try:
             _fault.fire("fleet.handoff")
             for j, (seq, first_tok, k_seq, v_seq) in enumerate(batch):
-                n = seq.prompt.shape[0]
+                n = k_seq.shape[1]
                 self._map_pages(seq)
                 kbuf[:, j, :n] = k_seq
                 vbuf[:, j, :n] = v_seq
@@ -1959,7 +2361,10 @@ class GenerationServer:
             err = _fault.with_context(
                 exc, f"{self._name} handoff of {len(batch)}")
             for seq, _t, _k, _v in batch:
-                self._retire(seq, err, stat="failed")
+                if seq.out:
+                    self._requeue_salvaged(seq, err)
+                else:
+                    self._retire(seq, err, stat="failed")
             self._recover_pools()
             return True
         finally:
@@ -1973,9 +2378,13 @@ class GenerationServer:
         return True
 
     def _prefill_group(self, group):
-        """Prefill one bucket-aligned group and seat it in decode slots."""
+        """Prefill one bucket-aligned group and seat it in decode slots.
+        Resumed members (``seq.out`` non-empty) run prompt + generated
+        through the SAME bucket executables — their sampled first token
+        is overridden at seat time by the recorded one."""
         k = len(group)
-        bucket = self._bucket_len(max(s.prompt.shape[0] for s in group))
+        bucket = self._bucket_len(max(self._prefill_len(s)
+                                      for s in group))
         b = self.buckets.batch_bucket(k)
         slots = self._free_slots()[:k]
         pspans = None
@@ -2007,29 +2416,39 @@ class GenerationServer:
         lengths = np.zeros((b,), np.int32)
         active = np.zeros((b,), bool)
         tables = np.zeros((b, self.pages_per_seq), np.int32)
+        seeds = np.zeros((b,), np.uint32)
         temps = np.zeros((b,), np.float32)
         topks = np.zeros((b,), np.int32)
         for i, seq in enumerate(group):
-            n = seq.prompt.shape[0]
-            tokens[i, :n] = seq.prompt
+            ptoks = self._prefill_tokens(seq)
+            n = ptoks.shape[0]
+            tokens[i, :n] = ptoks
             lengths[i] = n
             active[i] = True
             tables[i] = self._scatter_table_row(seq)
+            seeds[i] = seq.seed
             temps[i] = seq.temp
             topks[i] = seq.top_k
         if pspans is not None:
             _telemetry.push_current(pspans)
         try:
             _fault.fire("generate.prefill")
+            if any(s.out for s in group):
+                _fault.fire("generate.resume")
             with _profiler.scope(f"{self._name}.prefill", cat="serving"):
                 first = self._run_prefill(tokens, lengths, active, tables,
-                                          temps, topks)
+                                          seeds, temps, topks)
         except Exception as exc:    # noqa: BLE001 — resolved per sequence
             self.breaker.record_failure()
             self._note_step_failure(exc)
             err = _fault.with_context(exc, f"{self._name} prefill of {k}")
             for seq in group:
-                self._retire(seq, err, stat="failed")
+                if seq.out:
+                    # a resumed member's tokens survive the failed
+                    # re-prefill — salvage against its retry budget
+                    self._requeue_salvaged(seq, err)
+                else:
+                    self._retire(seq, err, stat="failed")
             self._recover_pools()
             return
         finally:
@@ -2046,7 +2465,16 @@ class GenerationServer:
     def _seat(self, seq, slot, tok):
         """Seat one prefilled sequence in a decode slot: slot init is
         seat-time only — the per-token path advances ``_tokens`` /
-        ``_lengths``; ``_ensure_capacity`` appends table entries."""
+        ``_lengths``; ``_ensure_capacity`` appends table entries.
+
+        A RESUMED sequence (``seq.out`` non-empty) re-enters here after
+        its re-prefill covered ``full[:H]`` (``full`` = prompt ++
+        generated, ``H = _prefill_len``): the pending token is forced to
+        the recorded ``full[H]`` (the prefill's sampled first token is
+        identical under position-keyed sampling, but the record is
+        authoritative), recorded tokens past ``H`` replay one per step
+        through the pinned decode path, and only then does live sampling
+        continue — token-exact, zero new executables."""
         if seq.req.trace is not None:
             _telemetry.end_span(seq.req, "handoff")   # no-op when fused
             _telemetry.open_span(seq.req, "decode", slot=slot)
@@ -2062,9 +2490,26 @@ class GenerationServer:
         self._tables[s, :len(seq.pages)] = seq.pages
         self._temps[s] = seq.temp
         self._topks[s] = seq.top_k
+        self._seeds[s] = seq.seed
         self._active[s] = True
         self._cow_src[s] = 0
         self._cow_dst[s] = 0
+        if seq.out:
+            full = np.concatenate(
+                [seq.prompt, np.asarray(seq.out, np.int32)])
+            H = self._prefill_len(seq)
+            seq.cached = H
+            seq.replay = [int(t) for t in full[H + 1:]]
+            self._tokens[s] = int(full[H])
+            self._lengths[s] = H
+            self._bump("resumes")
+            if seq.req.trace is not None:
+                _telemetry.span_event(seq.req, "resume",
+                                      tokens=len(seq.out),
+                                      replay=len(seq.replay))
+            if self._verify is not None:
+                self._refresh_window(seq)
+            return
         if not self._finish_token(seq, tok) and self._verify is not None:
             self._refresh_window(seq)
 
@@ -2153,35 +2598,109 @@ class GenerationServer:
 
     def _refresh_window(self, seq):
         """Right-align the draft's token context: the last
-        ``spec_window`` tokens of prompt + generated-so-far, the
-        pending token included (the draft proposes its successors)."""
+        ``spec_window`` tokens through the PENDING token (the draft
+        proposes its successors).  At steady state that is all of
+        prompt + generated; during resume replay the pending token sits
+        at position ``seq.cached`` and later recorded tokens must stay
+        out of the draft's view."""
         s = seq.slot
         W = self._spec_window
         toks = np.concatenate(
-            [seq.prompt, np.asarray(seq.out, np.int32)])[-W:]
+            [seq.prompt,
+             np.asarray(seq.out, np.int32)])[:seq.cached + 1][-W:]
         self._window[s, :] = 0
         self._window[s, W - len(toks):] = toks
         self._nvalid[s] = len(toks)
 
     def _preempt(self, victim):
         """Evict a sequence: free its pages and requeue it at the FRONT
-        for a from-scratch restart (generated-so-far is discarded — the
-        cache that backed it is gone).  The request future is untouched:
-        preemption is invisible to the client beyond latency."""
+        WITH its generated-so-far tokens (ISSUE 19) — re-admission
+        re-prefills prompt + generated through the existing bucket grid
+        and the position-keyed sampler continues the identical stream,
+        so preemption costs latency, never work.  The request future is
+        untouched: preemption is invisible to the client beyond that
+        latency.  Preemption is scheduling, not failure — it does NOT
+        consume the salvage-retry budget."""
         _fault.fire("generate.evict")
         self._vacate(victim)
         victim.cached = 0
-        victim.out = []
+        victim.replay = []
+        if victim.out:
+            self._bump("tokens_salvaged", len(victim.out))
         self._bump("preempted")
         self._c_preempted.increment()
+        self._journal_event("gen_snapshot", rid=victim.rid,
+                            out=list(victim.out))
         if victim.req.trace is not None:
             # preemption is a span event on the tree, and the requeue
             # wait is a fresh queue span — the restarted life (queue →
             # prefill → decode again) stays attributed
-            _telemetry.span_event(victim.req, "preempt")
+            _telemetry.span_event(victim.req, "preempt",
+                                  tokens_salvaged=len(victim.out))
             _telemetry.open_span(victim.req, "queue", requeued=True)
         with self._admit_lock:
             self._pending.appendleft(victim)
+
+    def _requeue_salvaged(self, seq, err, budgeted=True):
+        """Salvage one accepted sequence off a failure domain (ISSUE
+        19): keep its generated tokens, requeue it for a token-exact
+        resume.  ``budgeted`` failures (the sequence sat in the failing
+        step) consume the per-sequence ``salvage_retries`` budget —
+        exhausted, the sequence retires with a terminal error carrying
+        ``tokens_generated`` / ``partial_tokens`` / ``snapshot``, which
+        is what fleet failover redispatches to the next replica.
+        Unbudgeted salvage (breaker fast-fail, collateral pool loss)
+        preserves work without charging the sequence for a failure
+        that was not its own.  Returns True when the sequence was
+        requeued, False when it retired terminally."""
+        if budgeted:
+            seq.salvage += 1
+            if seq.salvage > self._salvage_retries:
+                terminal = _fault.with_context(
+                    err, f"{self._name}: salvage budget "
+                    f"({self._salvage_retries}) exhausted after "
+                    f"{len(seq.out)} of {seq.max_new} tokens — partial "
+                    f"output and a resume snapshot ride the error")
+                terminal.tokens_generated = len(seq.out)
+                terminal.partial_tokens = [int(t) for t in seq.out]
+                terminal.snapshot = self._snapshot_of(seq)
+                self._retire(seq, terminal, stat="failed")
+                return False
+            self._bump("salvage_retries")
+        try:
+            _fault.fire("generate.salvage")
+        except Exception as sexc:   # noqa: BLE001 — salvage path faulted
+            terminal = _fault.with_context(
+                sexc, f"{self._name}: salvage of sequence {seq.rid} "
+                f"failed — resolving with partial output")
+            terminal.tokens_generated = len(seq.out)
+            terminal.partial_tokens = [int(t) for t in seq.out]
+            terminal.snapshot = self._snapshot_of(seq)
+            self._retire(seq, terminal, stat="failed")
+            return False
+        self._vacate(seq)
+        seq.cached = 0
+        seq.replay = []
+        self._bump("tokens_salvaged", len(seq.out))
+        self._journal_event("gen_snapshot", rid=seq.rid,
+                            out=list(seq.out))
+        if seq.req.trace is not None:
+            _telemetry.end_span(seq.req, "prefill")
+            _telemetry.end_span(seq.req, "handoff")
+            _telemetry.span_event(seq.req, "salvage",
+                                  tokens_salvaged=len(seq.out),
+                                  retry=seq.salvage)
+            _telemetry.open_span(seq.req, "queue", requeued=True)
+        with self._admit_lock:
+            self._pending.appendleft(seq)
+        return True
+
+    def _salvage_seated(self, err, budgeted=True):
+        """Requeue every seated sequence with its tokens intact — the
+        ISSUE 19 replacement for failing everything on a device step
+        failure or a breaker fast-fail."""
+        for seq in list(self._seqs.values()):
+            self._requeue_salvaged(seq, err, budgeted=budgeted)
 
     def _decode_once(self):
         """One token for every in-flight sequence: capacity, the pinned
@@ -2204,9 +2723,13 @@ class GenerationServer:
         if not self._seqs:
             return
         if not self.breaker.allow():
-            self._fail_everything(CircuitOpenError(
+            # breaker fast-fail: salvage, don't destroy — seated work
+            # goes back to the queue with tokens intact and re-seats
+            # when the probe succeeds.  Unbudgeted: the breaker being
+            # open is not this sequence's failure.
+            self._salvage_seated(CircuitOpenError(
                 f"{self._name}: circuit open — fast-failing in-flight "
-                f"generation"), queued=False)
+                f"generation"), budgeted=False)
             return
         dspans = None
         for seq in self._seqs.values():    # fault firings → span events
@@ -2228,8 +2751,7 @@ class GenerationServer:
             err = _fault.with_context(
                 exc, f"{self._name} decode step over "
                 f"{len(self._seqs)} sequences")
-            for seq in list(self._seqs.values()):
-                self._retire(seq, err, stat="failed")
+            self._salvage_seated(err)
             self._recover_pools()
             return
         finally:
@@ -2239,7 +2761,18 @@ class GenerationServer:
         self._bump("decode_steps")
         for seq in list(self._seqs.values()):
             seq.cached += 1          # this step wrote the input token
+            if seq.replay:
+                # resume replay: the step re-derived this recorded
+                # token (position-keyed sampling); advance the slot
+                # from the record — never re-append to seq.out
+                tok = seq.replay.pop(0)
+                self._tokens[seq.slot] = tok
+                self._lengths[seq.slot] = seq.cached
+                if self._verify is not None:
+                    self._refresh_window(seq)
+                continue
             self._finish_token(seq, int(nxt[seq.slot]))
+        self._journal_tick()
 
     def _verify_once(self):
         """One SPECULATIVE step for every in-flight sequence: capacity
@@ -2261,9 +2794,9 @@ class GenerationServer:
         if not self._seqs:
             return
         if not self.breaker.allow():
-            self._fail_everything(CircuitOpenError(
+            self._salvage_seated(CircuitOpenError(
                 f"{self._name}: circuit open — fast-failing in-flight "
-                f"generation"), queued=False)
+                f"generation"), budgeted=False)
             return
         dspans = None
         for seq in self._seqs.values():
@@ -2285,8 +2818,7 @@ class GenerationServer:
             err = _fault.with_context(
                 exc, f"{self._name} verify step over "
                 f"{len(self._seqs)} sequences")
-            for seq in list(self._seqs.values()):
-                self._retire(seq, err, stat="failed")
+            self._salvage_seated(err)
             self._recover_pools()
             return
         finally:
@@ -2298,6 +2830,17 @@ class GenerationServer:
         k = self._spec_k
         for seq in list(self._seqs.values()):
             s = seq.slot
+            if seq.replay:
+                # resume replay: force ONE recorded token per step and
+                # skip speculative accounting — the draft window is
+                # truncated at the pending position, so acceptance
+                # stats over replayed steps would be meaningless
+                seq.cached += 1
+                tok = seq.replay.pop(0)
+                self._tokens[s] = tok
+                self._lengths[s] = seq.cached
+                self._refresh_window(seq)
+                continue
             a = int(n_acc[s])
             self._bump("spec_proposed", k)
             self._bump("spec_accepted", a)
@@ -2311,6 +2854,38 @@ class GenerationServer:
                     break
             else:
                 self._refresh_window(seq)
+        self._journal_tick()
+
+    def _export_error(self, seq):
+        """Resolve one exported sequence's request (handoff drain): the
+        snapshot — and the partial tokens — ride a ``ServerClosedError``
+        so the caller (typically a fleet router) can redispatch it
+        token-exact, and the journal gains a ``gen_handoff`` record a
+        successor's ``restore_journal`` re-admits."""
+        snap = self._snapshot_of(seq)
+        self.exported.append(snap)
+        self._journal_event("gen_handoff", **snap.to_json())
+        self._bump("handoff_exports")
+        err = ServerClosedError(
+            f"{self._name}: drained with handoff after {len(seq.out)} "
+            f"of {seq.max_new} tokens — resume snapshot exported")
+        err.tokens_generated = len(seq.out)
+        err.partial_tokens = [int(t) for t in seq.out]
+        err.snapshot = snap
+        return err
+
+    def _export_all(self):
+        """Handoff-drain sweep: every accepted sequence still alive —
+        seated or queued — exports instead of finishing.  Disaggregated
+        pipeline residue is swept by ``_fail_residue``, which routes
+        through the same exporter in handoff mode."""
+        for seq in list(self._seqs.values()):
+            self._retire(seq, self._export_error(seq), stat="failed")
+        with self._admit_lock:
+            residue = list(self._pending)
+            self._pending.clear()
+        for seq in residue:
+            self._retire(seq, self._export_error(seq), stat="failed")
 
     def _fail_everything(self, err, queued=True):
         """Explicitly resolve every in-flight (and optionally queued)
@@ -2366,8 +2941,11 @@ class GenerationServer:
                 self._release(seq.pages)
                 seq.pages = []
                 seq.shared_n = 0
-            seq.req.set_error(ServerClosedError(
-                "server stopped before this sequence finished"))
+            if self._handoff_exit.is_set():
+                seq.req.set_error(self._export_error(seq))
+            else:
+                seq.req.set_error(ServerClosedError(
+                    "server stopped before this sequence finished"))
             self._bump("failed")
             self._bump("retired")
 
@@ -2469,6 +3047,11 @@ class GenerationServer:
                       self.alloc.extra_refs() * self._page_bytes(),
                   "spec_k": self._spec_k if self._verify is not None
                       else 0,
+                  # resume economics (ISSUE 19): pages a resumed
+                  # sequence re-mapped from the prefix index instead of
+                  # re-allocating — the preemption-is-cheap dividend
+                  "resume_prefill_pages_remapped":
+                      counters.get("resume_pages_remapped", 0),
                   "prefill_workers": h["prefill_workers"],
                   "prefill_inflight": h["prefill_inflight"],
                   "tp_shards": h["tp_shards"],
@@ -2495,12 +3078,22 @@ class GenerationServer:
         return _telemetry.render(payload, fmt)
 
     # ----------------------------------------------------------------- drain --
-    def drain(self, timeout=None):
+    def drain(self, timeout=None, handoff=False):
         """Graceful shutdown: stop admitting (submits raise
         ``ServerClosedError``), finish EVERY accepted sequence — queued
         ones included; generation is bounded by per-request max-tokens —
         then stop the loop.  After ``drain()`` every ``Request`` ever
-        returned is ``done()``.  True when the loop exited in time."""
+        returned is ``done()``.  True when the loop exited in time.
+
+        ``handoff=True`` (ISSUE 19, rolling updates): instead of
+        finishing long generations, EXPORT every unfinished sequence as
+        a ``SequenceSnapshot`` — collected in ``self.exported`` and
+        written to the journal as ``gen_handoff`` records — and resolve
+        its request with a ``ServerClosedError`` carrying the snapshot
+        and partial tokens.  A successor server completes them
+        token-exact via ``submit_resume`` / ``restore_journal``."""
+        if handoff:
+            self._handoff_exit.set()
         self._draining.set()
         self._ready.clear()
         with self._admit_lock:
@@ -2513,10 +3106,11 @@ class GenerationServer:
 
     close = drain
 
-    def serve_forever(self, poll=0.05):
+    def serve_forever(self, poll=0.05, handoff=False):
         """Block until SIGTERM/SIGINT (``fault.GracefulExit``), then
-        drain — accepted sequences resolve, mid-decode work finishes."""
+        drain — accepted sequences resolve, mid-decode work finishes
+        (``handoff=True``: they export for a successor instead)."""
         with _fault.GracefulExit() as g:
             while not g.requested and self.alive():
                 time.sleep(poll)
-        return self.drain()
+        return self.drain(handoff=handoff)

@@ -346,34 +346,51 @@ def test_greedy_and_sampled_share_one_executable():
 
 
 # ------------------------------------------------------ failure lifecycle --
-def test_decode_fault_fails_inflight_explicitly_and_recovers():
-    """An armed generate.decode fault errors every in-flight sequence
-    EXPLICITLY (nothing dropped, pages freed) and later traffic is
-    served again once the breaker's probe succeeds."""
+def test_decode_fault_salvages_inflight_token_exact():
+    """An armed generate.decode fault no longer destroys in-flight work
+    (ISSUE 19): the seated sequence is SALVAGED — generated tokens
+    intact — requeued, re-prefilled through the same bucket grid, and
+    completes with exactly the stream an unfaulted run produces."""
+    prompt = np.asarray([1, 2], np.int32)
+    oracle = oracle_greedy(LOUD, prompt, 6)
     srv = make_server(n_pages=33,
-                      breaker=CircuitBreaker(threshold=1, base_delay=0.01,
-                                             max_delay=0.02)).start()
+                      breaker=CircuitBreaker(threshold=3)).start()
     try:
         with fault.inject("generate.decode", RuntimeError("injected"),
                           times=1) as h:
-            req = srv.submit(np.asarray([1, 2], np.int32))
-            err = req.exception(timeout=60)
+            out = srv.submit(prompt).result(timeout=120)
         assert h.fired == 1
-        assert err is not None and "injected" in str(err)
-        assert srv.alloc.free_count() == srv.alloc.allocatable
-        deadline = time.monotonic() + 10
-        while time.monotonic() < deadline:     # breaker re-closes via probe
-            try:
-                out = srv.submit(np.asarray([1, 2], np.int32)) \
-                    .result(timeout=60)
-                break
-            except (CircuitOpenError, RejectedError):
-                time.sleep(0.01)
-        else:
-            pytest.fail("breaker never recovered")
-        assert len(out) == 6
+        np.testing.assert_array_equal(np.asarray(out), oracle)
         st = srv.stats
-        assert st["failed"] == 1 and st["completed"] == 1
+        assert st["completed"] == 1 and st["failed"] == 0
+        assert st["salvage_retries"] == 1
+        assert st["tokens_salvaged"] >= 1 and st["resumes"] >= 1
+        assert srv.alloc.free_count() == srv.alloc.allocatable
+    finally:
+        assert srv.drain(30)
+
+
+def test_salvage_budget_exhausted_is_terminal_with_partials():
+    """With ``salvage_retries=0`` a step failure retires the sequence
+    terminally — and the error carries ``tokens_generated``, the
+    partial token list, and a resume snapshot (the fleet-failover
+    payload)."""
+    prompt = np.asarray([1, 2], np.int32)
+    srv = make_server(n_pages=33, salvage_retries=0,
+                      breaker=CircuitBreaker(threshold=3)).start()
+    try:
+        with fault.inject("generate.decode", RuntimeError("injected"),
+                          times=1) as h:
+            err = srv.submit(prompt).exception(timeout=60)
+        assert h.fired == 1
+        assert err is not None and "salvage budget" in str(err)
+        assert err.tokens_generated == len(err.partial_tokens) >= 1
+        snap = err.snapshot
+        assert snap.out == err.partial_tokens
+        assert list(snap.prompt) == [1, 2]
+        st = srv.stats
+        assert st["failed"] == 1 and st["completed"] == 0
+        assert srv.alloc.free_count() == srv.alloc.allocatable
     finally:
         assert srv.drain(30)
 
@@ -432,10 +449,13 @@ def test_sigterm_serve_forever_drains():
 # ------------------------------------------------------- plumbing details --
 def test_generate_fault_points_registered():
     pts = fault.points()
-    for p in ("generate.prefill", "generate.decode", "generate.evict"):
+    for p in ("generate.prefill", "generate.decode", "generate.evict",
+              "generate.resume", "generate.salvage", "generate.journal"):
         assert p in pts
     with pytest.raises(ValueError):
         fault.inject("generate.decoed", RuntimeError("typo")).__enter__()
+    with pytest.raises(ValueError):
+        fault.inject("generate.salvge", RuntimeError("typo")).__enter__()
 
 
 def test_profiler_counters_and_healthz():
@@ -989,7 +1009,7 @@ def test_speculative_sampling_statistical_identity():
     t0, kp, vp = pre(LOUD, pool, pool, jnp.asarray(toks),
                      jnp.asarray([n_prompt], np.int32),
                      jnp.asarray([True]), tables,
-                     jax.random.PRNGKey(0), jnp.asarray([0.0]),
+                     jnp.asarray([0], jnp.uint32), jnp.asarray([0.0]),
                      jnp.asarray([0], np.int32))    # greedy pending token
     t0 = int(t0[0])
     # analytic target marginal for the token AFTER the pending one
@@ -1011,12 +1031,14 @@ def test_speculative_sampling_statistical_identity():
             jnp.asarray([n_prompt], np.int32), jnp.asarray([True]),
             tables, jnp.asarray([0], jnp.int32),
             jnp.asarray([0], jnp.int32))
-    base = jax.random.PRNGKey(42)
     counts = np.zeros(CFG.vocab_size)
     n_draws = 600
     for i in range(n_draws):
+        # a fresh per-sequence seed per draw: position-keyed sampling
+        # (ISSUE 19) derives every draw from (seed, position), so
+        # varying the seed IS the fresh-randomness lever
         emitted, _, _, _ = vf(LOUD, dparams, kp, vp, *args,
-                              jax.random.fold_in(base, i),
+                              jnp.asarray([i], jnp.uint32),
                               jnp.asarray([temp], jnp.float32),
                               jnp.asarray([topk], jnp.int32))
         counts[int(emitted[0, 0])] += 1
@@ -1026,11 +1048,11 @@ def test_speculative_sampling_statistical_identity():
     assert tv < 0.12, (
         f"speculative first-token marginal diverges from the target "
         f"distribution: TV={tv:.3f}\n emp={np.nonzero(counts)[0]}")
-    # determinism: the same key replays the same acceptance decisions
-    e1 = vf(LOUD, dparams, kp, vp, *args, base,
+    # determinism: the same seed replays the same acceptance decisions
+    e1 = vf(LOUD, dparams, kp, vp, *args, jnp.asarray([42], jnp.uint32),
             jnp.asarray([temp], jnp.float32),
             jnp.asarray([topk], jnp.int32))[0]
-    e2 = vf(LOUD, dparams, kp, vp, *args, base,
+    e2 = vf(LOUD, dparams, kp, vp, *args, jnp.asarray([42], jnp.uint32),
             jnp.asarray([temp], jnp.float32),
             jnp.asarray([topk], jnp.int32))[0]
     np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
@@ -1089,3 +1111,303 @@ def test_speculative_validation_errors():
         make_server(draft=dparams, draft_config=dcfg, spec_k=0)
     with pytest.raises(ValueError, match="spec_window"):
         make_server(draft=dparams, draft_config=dcfg, spec_window=0)
+
+
+# ------------------------------------------------ ISSUE 19: preempt / resume --
+def _storm_server(**kw):
+    """A pool sized so two worst-case sequences CANNOT coexist: the
+    junior one is repeatedly preempted mid-generation and must resume
+    through the bucket grid — the ISSUE 19 salvage treadmill."""
+    kw.setdefault("n_pages", 5)              # 4 allocatable
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_new_tokens", 10)
+    return make_server(**kw)
+
+
+def test_preempt_storm_token_exact_greedy():
+    """Preemption no longer discards generated tokens: under a starved
+    pool every sequence still completes with EXACTLY the uninterrupted
+    greedy stream, through the existing executables only."""
+    prompts = [np.asarray([1, 2], np.int32),
+               np.asarray([7, 3, 5], np.int32)]
+    oracles = [oracle_greedy(LOUD, p, 10) for p in prompts]
+    srv = _storm_server().start()
+    try:
+        reqs = [srv.submit(p) for p in prompts]
+        outs = [r.result(timeout=180) for r in reqs]
+        for o, e in zip(outs, oracles):
+            np.testing.assert_array_equal(np.asarray(o), e)
+        st = srv.stats
+        assert st["completed"] == 2 and st["failed"] == 0
+        assert st["preempted"] >= 1 and st["tokens_salvaged"] >= 1
+        assert st["resumes"] >= 1
+        assert st["salvage_retries"] == 0     # preemption is unbudgeted
+        assert srv.jit_cache_count() == srv.census()
+        assert srv.alloc.free_count() == srv.alloc.allocatable
+    finally:
+        assert srv.drain(60)
+
+
+def test_preempt_storm_token_exact_seeded_sampling():
+    """Same treadmill, stochastic decoding: position-keyed sampling
+    makes the resumed draws coincide with the uninterrupted run's, so
+    a fixed ``submit(seed=)`` yields identical streams on a calm pool
+    and on a storming one."""
+    prompts = [np.asarray([1, 2], np.int32),
+               np.asarray([7, 3, 5], np.int32)]
+    seeds = [101, 202]
+    ref = make_server(n_pages=33, max_new_tokens=10).start()
+    try:
+        expected = [np.asarray(
+            ref.submit(p, temperature=0.8, top_k=4, seed=s)
+               .result(timeout=120)) for p, s in zip(prompts, seeds)]
+        assert ref.stats["preempted"] == 0    # the calm oracle run
+    finally:
+        assert ref.drain(60)
+    srv = _storm_server().start()
+    try:
+        reqs = [srv.submit(p, temperature=0.8, top_k=4, seed=s)
+                for p, s in zip(prompts, seeds)]
+        outs = [np.asarray(r.result(timeout=180)) for r in reqs]
+        st = srv.stats
+        assert st["preempted"] >= 1 and st["resumes"] >= 1
+        for o, e in zip(outs, expected):
+            np.testing.assert_array_equal(o, e)
+        assert srv.jit_cache_count() == srv.census()
+    finally:
+        assert srv.drain(60)
+
+
+def test_disaggregated_salvage_token_exact_greedy():
+    """The resume prefill also rides the DISAGGREGATED path: a decode
+    fault on a prefill-worker server salvages, re-prefills via the
+    prefill-KV executables, and completes greedy-token-exact."""
+    prompt = np.asarray([4, 1, 3], np.int32)
+    oracle = oracle_greedy(LOUD, prompt, 6)
+    srv = make_server(n_pages=33, prefill_workers=1,
+                      breaker=CircuitBreaker(threshold=4)).start()
+    try:
+        with fault.inject("generate.decode", RuntimeError("injected"),
+                          times=1) as h:
+            out = srv.submit(prompt).result(timeout=120)
+        assert h.fired == 1
+        np.testing.assert_array_equal(np.asarray(out), oracle)
+        st = srv.stats
+        assert st["completed"] == 1 and st["resumes"] >= 1
+        assert srv.jit_cache_count() == srv.census()
+        assert srv.alloc.free_count() == srv.alloc.allocatable
+    finally:
+        assert srv.drain(30)
+
+
+def test_disaggregated_preempt_storm_seeded_sampling_token_exact():
+    """Disaggregated + starved pool + fixed-seed sampling: the resumed
+    prefill-KV handoffs reproduce the calm run's stream exactly."""
+    prompts = [np.asarray([6, 2], np.int32),
+               np.asarray([3, 8, 1], np.int32)]
+    seeds = [11, 23]
+    ref = make_server(n_pages=33, max_new_tokens=10,
+                      prefill_workers=1).start()
+    try:
+        expected = [np.asarray(
+            ref.submit(p, temperature=0.7, top_k=6, seed=s)
+               .result(timeout=120)) for p, s in zip(prompts, seeds)]
+    finally:
+        assert ref.drain(60)
+    srv = _storm_server(prefill_workers=1).start()
+    try:
+        reqs = [srv.submit(p, temperature=0.7, top_k=6, seed=s)
+                for p, s in zip(prompts, seeds)]
+        outs = [np.asarray(r.result(timeout=180)) for r in reqs]
+        assert srv.stats["preempted"] >= 1 and srv.stats["resumes"] >= 1
+        for o, e in zip(outs, expected):
+            np.testing.assert_array_equal(o, e)
+        assert srv.jit_cache_count() == srv.census()
+    finally:
+        assert srv.drain(60)
+
+
+def test_breaker_fastfail_salvages_seated_unbudgeted():
+    """A breaker trip mid-generation fast-fails the STEP, not the
+    sequences: seated work is salvaged without spending the salvage
+    budget, waits out the cooldown, resumes, completes token-exact."""
+    class _Gate:
+        """Self-arming OPEN window: defer to the real breaker until the
+        server has emitted ``arm_at`` tokens, then deny the next
+        ``deny`` dispatch gates (a window the decode thread cannot
+        immediately close again), then defer again.  Installing the
+        gate BEFORE submit makes the trip deterministic — no poll race
+        against a decode thread that can finish the whole sequence in
+        a few milliseconds."""
+
+        def __init__(self, inner, srv, arm_at, deny):
+            self._inner, self._srv = inner, srv
+            self._arm_at, self.deny = arm_at, deny
+
+        def allow(self):
+            if self.deny > 0 and self._srv.stats["tokens_out"] >= self._arm_at:
+                self.deny -= 1
+                return False
+            return self._inner.allow()
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    prompt = np.asarray([3, 1, 2], np.int32)
+    oracle = oracle_greedy(LOUD, prompt, 10)
+    srv = make_server(n_pages=33, max_new_tokens=10,
+                      breaker=CircuitBreaker(threshold=3)).start()
+    try:
+        srv.breaker = _Gate(srv.breaker, srv, arm_at=2, deny=2)
+        req = srv.submit(prompt)
+        out = req.result(timeout=120)
+        np.testing.assert_array_equal(np.asarray(out), oracle)
+        st = srv.stats
+        assert st["completed"] == 1 and st["failed"] == 0
+        assert st["resumes"] >= 1 and st["tokens_salvaged"] >= 1
+        assert st["salvage_retries"] == 0    # fast-fail is unbudgeted
+    finally:
+        assert srv.drain(30)
+
+
+def test_salvage_storm_allocator_and_prefix_index_invariants():
+    """Shared prefixes + starved pool + injected step failures: after
+    the storm every page is back on the free list and the host prefix
+    index advertises nothing — no leaked refcount, no stale entry."""
+    base = [5, 9, 2, 6]
+    prompts = [np.asarray(base + [i], np.int32) for i in range(4)]
+    srv = _storm_server(salvage_retries=8,
+                        breaker=CircuitBreaker(threshold=6)).start()
+    try:
+        with fault.inject("generate.decode", RuntimeError("injected"),
+                          times=2) as h:
+            reqs = [srv.submit(p) for p in prompts]
+            outs = [r.result(timeout=240) for r in reqs]
+        assert h.fired == 2
+        st = srv.stats
+        assert st["completed"] == 4 and st["failed"] == 0
+        for p, o in zip(prompts, outs):
+            np.testing.assert_array_equal(
+                np.asarray(o), oracle_greedy(LOUD, p, 10))
+        assert srv.alloc.free_count() == srv.alloc.allocatable
+        assert srv._indexed_by_page == {}
+        assert srv._children == {}
+        assert srv.jit_cache_count() == srv.census()
+    finally:
+        assert srv.drain(60)
+
+
+def test_journal_restore_completes_token_exact(tmp_path):
+    """The crash-consistency tentpole leg: a server whose journal goes
+    dark mid-flight (the kill -9 point — admits recorded, retires
+    never) is survivable.  A FRESH server imports the journal and
+    completes every in-flight sequence with exactly the stream the
+    dead server would have produced — greedy and seeded sampling."""
+    jpath = str(tmp_path / "decode.jsonl")
+    p_greedy = np.asarray([1, 2, 6], np.int32)
+    p_sampled = np.asarray([8, 4], np.int32)
+    a = make_server(n_pages=33, max_new_tokens=8, journal=jpath,
+                    journal_every=1).start()
+    try:
+        r1 = a.submit(p_greedy)
+        r2 = a.submit(p_sampled, temperature=0.9, top_k=6, seed=77)
+        a._journal = None     # kill -9: nothing after this line lands
+        exp1 = np.asarray(r1.result(timeout=120))
+        exp2 = np.asarray(r2.result(timeout=120))
+    finally:
+        assert a.drain(30)
+    np.testing.assert_array_equal(exp1, oracle_greedy(LOUD, p_greedy, 8))
+
+    b = make_server(n_pages=33, max_new_tokens=8).start()
+    try:
+        restored = b.restore_journal(jpath)
+        assert len(restored) == 2
+        assert b.stats["journal_restores"] == 2
+        got = sorted(tuple(int(t) for t in r.result(timeout=120))
+                     for r in restored.values())
+        want = sorted(tuple(int(t) for t in e) for e in (exp1, exp2))
+        assert got == want
+        assert b.stats["completed"] == 2 and b.stats["failed"] == 0
+        assert b.alloc.free_count() == b.alloc.allocatable
+    finally:
+        assert b.drain(30)
+
+
+def test_drain_handoff_exports_and_successor_resumes(tmp_path):
+    """``drain(handoff=True)`` (rolling update): unfinished sequences
+    EXPORT instead of finishing — snapshots in ``.exported`` +
+    ``gen_handoff`` journal records, requests resolved with a
+    ``ServerClosedError`` carrying the partial tokens — and a successor
+    restores them token-exact."""
+    jpath = str(tmp_path / "decode.jsonl")
+    prompts = [np.asarray([2, 7], np.int32),
+               np.asarray([9, 1, 4], np.int32),
+               np.asarray([5, 5, 8], np.int32),
+               np.asarray([1, 6], np.int32)]
+    # long generations + more work than slots: the immediate handoff
+    # drain below is guaranteed to catch unfinished sequences
+    a = make_server(n_pages=65, max_new_tokens=48, journal=jpath,
+                    journal_every=1).start()
+    reqs = [a.submit(p) for p in prompts]
+    limit = time.monotonic() + 60
+    while a.stats["tokens_out"] < 1 and time.monotonic() < limit:
+        time.sleep(0.001)
+    assert a.drain(30, handoff=True)
+    errs = [r.exception(timeout=5) for r in reqs]
+    exported = [e for e in errs if e is not None]
+    assert len(exported) >= 1                  # caught mid-flight
+    for e in exported:
+        assert isinstance(e, ServerClosedError)
+        assert hasattr(e, "snapshot")
+        assert e.tokens_generated == len(e.partial_tokens)
+    assert a.stats["handoff_exports"] == len(exported)
+    assert len(a.exported) == len(exported)
+
+    b = make_server(n_pages=65, max_new_tokens=48).start()
+    try:
+        restored = b.restore_journal(jpath)
+        assert len(restored) == len(exported)
+        assert b.stats["journal_restores"] == len(exported)
+        got = sorted(tuple(int(t) for t in r.result(timeout=180))
+                     for r in restored.values())
+        want = sorted(tuple(int(t) for t in
+                            oracle_greedy(LOUD, e.snapshot.prompt, 48,
+                                          pad_to=64))
+                      for e in exported)
+        assert got == want
+    finally:
+        assert b.drain(60)
+
+
+def test_fleet_failover_redispatches_with_salvaged_tokens():
+    """The fleet leg: a replica that retires a sequence terminally
+    (salvage budget exhausted) hands the fleet an error CARRYING the
+    resume snapshot; the router re-dispatches to the next replica via
+    ``submit_resume`` and the client sees the uninterrupted stream."""
+    from mxnet_tpu.serving.fleet import ServingFleet
+    prompt = np.asarray([3, 1, 2], np.int32)
+    oracle = oracle_greedy(LOUD, prompt, 6)
+    fleet = ServingFleet([lambda x: x, lambda x: x], buckets=(1,),
+                         sample=None, name=f"GenFleet-{time.monotonic_ns()}")
+    fleet.start()
+    gens, olds = [], []
+    try:
+        for rep in fleet.replicas:
+            g = make_server(n_pages=33, salvage_retries=0,
+                            breaker=CircuitBreaker(threshold=4)).start()
+            gens.append(g)
+            olds.append(rep.server)
+            rep.server = g
+        for s in olds:
+            s.drain(10)
+        with fault.inject("generate.decode", RuntimeError("injected"),
+                          times=1) as h:
+            out = fleet.submit(prompt, deadline=120).result(timeout=120)
+        assert h.fired == 1
+        np.testing.assert_array_equal(np.asarray(out), oracle)
+        assert fleet._stats["resumed"] >= 1
+        assert fleet._stats["redispatched"] >= 1
+        assert sum(g.stats["failed"] for g in gens) == 1
+        assert sum(g.stats["completed"] for g in gens) == 1
+    finally:
+        fleet.drain(timeout=30)

@@ -922,6 +922,38 @@ def test_generation_exposition_carries_registry_gauges_and_slot_pages():
         srv.drain()
 
 
+def test_generation_salvage_counters_ride_exposition():
+    """ISSUE 19 satellite: the salvage/resume counter family rides the
+    GenerationServer exposition under the SAME snake_case key schema as
+    every other counter, and the resume-prefill page-remap gauge is
+    present (zero included) — dashboards never probe for optional
+    keys."""
+    import re
+    telemetry.enable()
+    srv = make_genserver(name="SalvTel")
+    srv.start()
+    try:
+        with fault.inject("generate.decode", RuntimeError("injected"),
+                          times=1):
+            srv.submit(np.array([1, 2, 3], np.int32),
+                       max_new_tokens=4).result(60)
+        pay = srv.telemetry()
+        ctr = pay["counters"]
+        for key in ("tokens_salvaged", "resumes", "salvage_retries",
+                    "journal_restores"):
+            assert key in ctr, key
+            assert re.fullmatch(r"[a-z][a-z0-9_]*", key)
+        assert ctr["tokens_salvaged"] >= 1 and ctr["resumes"] >= 1
+        assert ctr["salvage_retries"] == 1
+        assert ctr["journal_restores"] == 0
+        assert "resume_prefill_pages_remapped" in pay["gauges"]
+        text = telemetry.render_prometheus(pay)
+        assert "tokens_salvaged" in text
+        assert "resume_prefill_pages_remapped" in text
+    finally:
+        srv.drain()
+
+
 # ------------------------------------------------------------ flight recorder
 def test_flight_ring_is_bounded():
     fl = telemetry.flight()

@@ -329,8 +329,12 @@ def llm_mode(args):
                      f"were silently dropped")
     if h.fired == 0:
         fails.append("injected decode faults never fired")
-    if errs == 0:
-        fails.append("no sequence surfaced the injected failure")
+    if errs == 0 and st["tokens_salvaged"] == 0:
+        # ISSUE 19: a decode fault SALVAGES in-flight work (bounded
+        # budget) — visible as either a budget-exhausted error or
+        # salvaged tokens, never as silence
+        fails.append("injected failures neither errored nor salvaged "
+                     "any sequence")
     if oks == 0:
         fails.append("no sequence was actually served")
     if srv.jit_cache_count() != warm or warm != census:
@@ -342,6 +346,7 @@ def llm_mode(args):
     if srv.alive():
         fails.append("decode loop survived the drain")
     fails.extend(_llm_spec_leg(args))
+    fails.extend(_llm_salvage_leg(args))
     if fails:
         for f in fails:
             print(f"[chaos_check] FAIL: {f}")
@@ -349,7 +354,8 @@ def llm_mode(args):
     print(f"[chaos_check] PASS: drain completed with every accepted "
           f"sequence resolved ({oks} served, {errs} explicitly errored, "
           f"0 dropped), 0 recompiles ({warm} executables == census), "
-          f"pages fully reclaimed; shared-prefix + speculative leg clean")
+          f"pages fully reclaimed; shared-prefix + speculative + "
+          f"salvage/journal legs clean")
     return 0
 
 
@@ -447,8 +453,9 @@ def _llm_spec_leg(args):
                      f"sequences were silently dropped")
     if h.fired == 0:
         fails.append("spec leg: injected decode faults never fired")
-    if errs == 0:
-        fails.append("spec leg: no sequence surfaced the injected failure")
+    if errs == 0 and st["tokens_salvaged"] == 0:
+        fails.append("spec leg: injected failures neither errored nor "
+                     "salvaged any sequence")
     if oks == 0:
         fails.append("spec leg: no sequence was actually served")
     if st["verify_steps"] == 0:
@@ -466,6 +473,167 @@ def _llm_spec_leg(args):
                      f"free of {srv.alloc.allocatable} after drain")
     if srv.alive():
         fails.append("spec leg: decode loop survived the drain")
+    return fails
+
+
+def _llm_salvage_leg(args):
+    """ISSUE 19 leg: token-exact preempt/resume under chaos.  Two
+    probes: (1) a STARVED pool (two worst-case sequences cannot
+    coexist) plus a ``generate.decode`` fault burst — every victim is
+    salvaged with its tokens and completes with EXACTLY the stream an
+    unfaulted big-pool oracle produces; (2) a sibling process running
+    with a decode journal is kill -9'd mid-generation and a fresh
+    server restores its in-flight sequences from the journal,
+    token-exact.  Must hold: 0 dropped, ``tokens_salvaged > 0``,
+    ``journal_restores > 0``, ``recompiles_unexpected == 0``, free
+    list == pool.  Returns failure strings."""
+    import signal
+    import subprocess
+    import tempfile
+
+    from mxnet_tpu import fault, serving
+    from mxnet_tpu.gluon.model_zoo.causal_lm import (CausalLMConfig,
+                                                     init_causal_lm)
+
+    cfg = CausalLMConfig(vocab_size=64, n_layers=2, n_heads=2,
+                         head_dim=8, d_ff=32)
+    params = init_causal_lm(cfg, seed=0)
+    buckets = serving.BucketSpec(batch=(1,), length=(8,))
+    prompts = [np.asarray([3, 1, 2], np.int32),
+               np.asarray([5, 4], np.int32),
+               np.asarray([9, 2, 7], np.int32),
+               np.asarray([1, 6], np.int32)]
+    kinds = [dict(), dict(temperature=0.9, top_k=6),
+             dict(), dict(temperature=0.7, top_k=4)]
+    seeds = [11, 22, 33, 44]
+    fails = []
+
+    # ---- unfaulted oracle: calm pool, same prompts + explicit seeds
+    oracle = serving.GenerationServer(
+        params, cfg, buckets=buckets, n_slots=2, n_pages=33,
+        page_size=4, max_new_tokens=10, seed=0, name="ChaosSalvOracle")
+    oracle.start()
+    expected = []
+    for p, kw, s in zip(prompts, kinds, seeds):
+        expected.append(tuple(int(t) for t in
+                              oracle.submit(p, seed=s, **kw).result(60)))
+    oracle.drain(30)
+
+    # ---- probe 1: preemption storm + fault burst on a starved pool
+    srv = serving.GenerationServer(
+        params, cfg, buckets=buckets, n_slots=2, n_pages=5,
+        page_size=4, max_new_tokens=10, seed=0, salvage_retries=8,
+        breaker=serving.CircuitBreaker(threshold=6, base_delay=0.02,
+                                       max_delay=0.1),
+        name="ChaosSalvGen")
+    srv.start()
+    census, warm = srv.census(), srv.jit_cache_count()
+    with fault.inject("generate.decode",
+                      RuntimeError("injected decode fault"),
+                      after_n=3, times=2) as h:
+        reqs = [srv.submit(p, seed=s, **kw)
+                for p, kw, s in zip(prompts, kinds, seeds)]
+        got = [tuple(int(t) for t in r.result(timeout=240)) for r in reqs]
+    st = srv.stats
+    recomp = srv.telemetry()["gauges"].get("recompiles_unexpected", 0)
+    print(f"[chaos_check] llm salvage leg: storm served "
+          f"{st['completed']}/{len(prompts)} "
+          f"(preempted={st['preempted']} "
+          f"tokens_salvaged={st['tokens_salvaged']} "
+          f"resumes={st['resumes']} "
+          f"salvage_retries={st['salvage_retries']} "
+          f"injected_fired={h.fired})")
+    if h.fired == 0:
+        fails.append("salvage leg: injected decode faults never fired")
+    if st["completed"] != len(prompts) or st["failed"] != 0:
+        fails.append(f"salvage leg: {st['failed']} sequences failed — "
+                     f"salvage dropped accepted work")
+    if st["tokens_salvaged"] == 0:
+        fails.append("salvage leg: the storm salvaged no tokens")
+    if st["preempted"] == 0 or st["resumes"] == 0:
+        fails.append("salvage leg: the starved pool never preempted/"
+                     "resumed — the storm probe probed nothing")
+    if got != expected:
+        fails.append("salvage leg: salvaged streams diverge from the "
+                     "unfaulted oracle — resume is not token-exact")
+    if recomp != 0:
+        fails.append(f"salvage leg: recompiles_unexpected == {recomp}")
+    if srv.jit_cache_count() != warm or warm != census:
+        fails.append(f"salvage leg: jit cache {srv.jit_cache_count()} vs "
+                     f"warmup {warm} vs census {census}")
+    if srv.alloc.free_count() != srv.alloc.allocatable:
+        fails.append(f"salvage leg: page leak — {srv.alloc.free_count()} "
+                     f"free of {srv.alloc.allocatable} after drain")
+    if not srv.drain(30):
+        fails.append("salvage leg: storm server drain did not complete")
+
+    # ---- probe 2: kill -9 mid-generation, restore from the journal
+    jdir = tempfile.mkdtemp(prefix="chaos_salvage_")
+    jpath = os.path.join(jdir, "decode.jsonl")
+    child_src = (
+        "import os, sys, time\n"
+        "import numpy as np\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from mxnet_tpu import serving\n"
+        "from mxnet_tpu.gluon.model_zoo.causal_lm import "
+        "CausalLMConfig, init_causal_lm\n"
+        "cfg = CausalLMConfig(vocab_size=64, n_layers=2, n_heads=2, "
+        "head_dim=8, d_ff=32)\n"
+        "srv = serving.GenerationServer(\n"
+        "    init_causal_lm(cfg, seed=0), cfg,\n"
+        "    buckets=serving.BucketSpec(batch=(1,), length=(8,)),\n"
+        "    n_slots=2, n_pages=33, page_size=4, max_new_tokens=32,\n"
+        "    seed=0, journal=sys.argv[1], journal_every=1,\n"
+        "    name='ChaosJournalGen')\n"
+        "srv.start()\n"
+        "srv.submit(np.asarray([3, 1, 2], np.int32), seed=11)\n"
+        "srv.submit(np.asarray([5, 4], np.int32), temperature=0.9, "
+        "top_k=6, seed=22)\n"
+        "limit = time.monotonic() + 60\n"
+        "while srv.stats['tokens_out'] < 2 "
+        "and time.monotonic() < limit:\n"
+        "    time.sleep(0.002)\n"
+        "print('READY', flush=True)\n"
+        "time.sleep(120)\n")
+    child = subprocess.Popen([sys.executable, "-c", child_src, jpath],
+                             stdout=subprocess.PIPE, text=True)
+    ready = False
+    line = child.stdout.readline()          # blocks until READY/EOF
+    ready = line.strip() == "READY"
+    if ready:
+        os.kill(child.pid, signal.SIGKILL)  # the actual kill -9
+    child.wait()
+    if not ready:
+        fails.append("salvage leg: journal child never reached READY")
+        return fails
+
+    rsrv = serving.GenerationServer(
+        params, cfg, buckets=buckets, n_slots=2, n_pages=33,
+        page_size=4, max_new_tokens=32, seed=0, name="ChaosRestoreGen")
+    rsrv.start()
+    exp = [tuple(int(t) for t in
+                 rsrv.submit(np.asarray([3, 1, 2], np.int32),
+                             seed=11).result(120)),
+           tuple(int(t) for t in
+                 rsrv.submit(np.asarray([5, 4], np.int32),
+                             temperature=0.9, top_k=6,
+                             seed=22).result(120))]
+    restored = rsrv.restore_journal(jpath)
+    outs = sorted(tuple(int(t) for t in r.result(timeout=240))
+                  for r in restored.values())
+    rst = rsrv.stats
+    print(f"[chaos_check] llm salvage leg: kill -9 restore — "
+          f"journal_restores={rst['journal_restores']} "
+          f"restored={len(restored)} resumes={rst['resumes']}")
+    if rst["journal_restores"] == 0 or len(restored) != 2:
+        fails.append(f"salvage leg: journal restore recovered "
+                     f"{len(restored)} of 2 in-flight sequences")
+    if outs != sorted(exp):
+        fails.append("salvage leg: restored streams diverge from the "
+                     "uninterrupted oracle — journal restore is not "
+                     "token-exact")
+    if not rsrv.drain(30):
+        fails.append("salvage leg: restore server drain did not complete")
     return fails
 
 

@@ -324,7 +324,7 @@ def _llm_parts(vocab=256, n_layers=2, n_heads=8, head_dim=4, d_ff=64,
         "tables": sds((n_slots, pages_per_seq), jnp.int32),
         "cow_src": sds((n_slots,), jnp.int32),
         "cow_dst": sds((n_slots,), jnp.int32),
-        "key": sds((2,), jnp.uint32),
+        "seeds": sds((n_slots,), jnp.uint32),
         "temps": sds((n_slots,), jnp.float32),
         "topks": sds((n_slots,), jnp.int32),
     }
@@ -357,7 +357,7 @@ def build_llm_decode_step():
                    donate_argnums=(1, 2))
     lowered = step.lower(p_avals, pool, pool, s["tokens"], s["lengths"],
                          s["active"], s["tables"], s["cow_src"],
-                         s["cow_dst"], s["key"], s["temps"], s["topks"])
+                         s["cow_dst"], s["seeds"], s["temps"], s["topks"])
     n_args = _n_leaves(p_avals) + 2 + 9
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
@@ -390,7 +390,7 @@ def _llm_decode_step_tp(name, collectives, shards=8):
                    donate_argnums=(1, 2))
     lowered = step.lower(p_avals, pool, pool, s["tokens"], s["lengths"],
                          s["active"], s["tables"], s["cow_src"],
-                         s["cow_dst"], s["key"], s["temps"], s["topks"])
+                         s["cow_dst"], s["seeds"], s["temps"], s["topks"])
     n_args = _n_leaves(p_avals) + 2 + 9
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
@@ -446,7 +446,7 @@ def build_llm_decode_step_dense():
     step = jax.jit(build_dense_decode_step(cfg, g["max_context"]),
                    donate_argnums=(1, 2))
     lowered = step.lower(p_avals, cache, cache, s["tokens"], s["lengths"],
-                         s["active"], s["key"], s["temps"], s["topks"])
+                         s["active"], s["seeds"], s["temps"], s["topks"])
     n_args = _n_leaves(p_avals) + 2 + 6
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}",
@@ -486,7 +486,7 @@ def build_llm_verify_step(spec_k=3, spec_window=16):
         p_avals, d_avals, pool, pool, s["tokens"],
         sds((g["n_slots"], spec_window), jnp.int32),
         sds((g["n_slots"],), jnp.int32), s["lengths"], s["active"],
-        s["tables"], s["cow_src"], s["cow_dst"], s["key"], s["temps"],
+        s["tables"], s["cow_src"], s["cow_dst"], s["seeds"], s["temps"],
         s["topks"])
     n_args = _n_leaves(p_avals, d_avals) + 2 + 11
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
@@ -527,7 +527,7 @@ def _llm_admission(name, n_pages, shared_prefix_len, prompt_len=192,
                    donate_argnums=(1, 2))
     lowered = step.lower(p_avals, pool, pool, s["tokens"], s["lengths"],
                          s["active"], s["tables"], s["cow_src"],
-                         s["cow_dst"], s["key"], s["temps"], s["topks"])
+                         s["cow_dst"], s["seeds"], s["temps"], s["topks"])
     n_args = _n_leaves(p_avals) + 2 + 9
     meta = {"model": f"causal_lm {cfg.vocab_size}v {cfg.n_layers}L "
                      f"{cfg.n_heads}h{cfg.head_dim}", "kv": "paged",
@@ -593,7 +593,8 @@ def build_llm_prefill_grid(batch_buckets=(1, 2), length_buckets=(32, 64)):
         lowered = step.lower(
             p_avals, pool, pool, sds((b, L), jnp.int32),
             sds((b,), jnp.int32), sds((b,), jnp.bool_),
-            sds((b, g["pages_per_seq"]), jnp.int32), s["key"],
+            sds((b, g["pages_per_seq"]), jnp.int32),
+            sds((b,), jnp.uint32),
             sds((b,), jnp.float32), sds((b,), jnp.int32))
         programs.append(Program(f"llm_prefill_grid/b{b}_l{L}", lowered,
                                 n_args=_n_leaves(p_avals) + 2 + 7))
